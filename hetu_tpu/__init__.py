@@ -1,0 +1,36 @@
+"""hetu-tpu: a TPU-native distributed deep-learning framework.
+
+A from-scratch rebuild of the capability surface of Hetu (PKU DAIR Lab;
+reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
+
+* ``hetu_tpu.core``   — pytree module system, reproducible RNG, dtype policy
+* ``hetu_tpu.ops``    — the functional op surface (reference src/ops kernels)
+* ``hetu_tpu.optim``  — optimizers + lr schedulers (reference optimizer.py)
+* ``hetu_tpu.init``   — initializers (reference initializers.py)
+* ``hetu_tpu.layers`` — NN layers (reference python/hetu/layers)
+* ``hetu_tpu.parallel`` — mesh/sharding-spec algebra, collectives, pipeline,
+  MoE all-to-all, ring attention (reference context.py + communicator/)
+* ``hetu_tpu.exec``   — trainer/executor facade, checkpointing, profiling
+  (reference gpu_ops/executor.py)
+* ``hetu_tpu.embed``  — host-side cached sparse-embedding engine (HET;
+  reference src/hetu_cache + ps-lite)
+* ``hetu_tpu.models`` — model zoo (reference examples/)
+* ``hetu_tpu.data``   — dataloaders (reference dataloader.py)
+* ``hetu_tpu.autoparallel`` — cost-model-driven parallelism search
+  (reference distributed_strategies/ + tools/Galvatron)
+"""
+
+__version__ = "0.1.0"
+
+from hetu_tpu import core, init, ops, optim
+from hetu_tpu.core import (
+    Module,
+    Policy,
+    get_seed_status,
+    logical_axes,
+    next_key,
+    param_count,
+    reset_seed_seqnum,
+    set_random_seed,
+    trainable_mask,
+)

@@ -1,0 +1,17 @@
+from hetu_tpu.core.module import (
+    FrozenDict,
+    Module,
+    logical_axes,
+    named_parameters,
+    param_count,
+    trainable_mask,
+    tree_replace,
+)
+from hetu_tpu.core.rng import (
+    get_seed_status,
+    next_key,
+    next_keys,
+    reset_seed_seqnum,
+    set_random_seed,
+)
+from hetu_tpu.core.dtypes import DEFAULT_POLICY, FP32_POLICY, Policy

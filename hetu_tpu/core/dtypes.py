@@ -1,0 +1,57 @@
+"""Mixed-precision policy for TPU.
+
+The reference runs fp32 throughout (CUDA kernels in src/ops are float-only).
+On TPU the MXU natively consumes bfloat16, so hetu-tpu makes the precision
+policy explicit and defaults compute to bf16 with fp32 params/reductions —
+the standard TPU recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Policy", "DEFAULT_POLICY", "FP32_POLICY", "cast_to_compute", "cast_to_param", "cast_to_output"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return cast_tree(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return cast_tree(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return cast_tree(tree, self.output_dtype)
+
+
+def cast_tree(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+DEFAULT_POLICY = Policy()
+FP32_POLICY = Policy(jnp.float32, jnp.float32, jnp.float32)
+
+
+def cast_to_compute(tree, policy: Policy = DEFAULT_POLICY):
+    return policy.cast_to_compute(tree)
+
+
+def cast_to_param(tree, policy: Policy = DEFAULT_POLICY):
+    return policy.cast_to_param(tree)
+
+
+def cast_to_output(tree, policy: Policy = DEFAULT_POLICY):
+    return policy.cast_to_output(tree)

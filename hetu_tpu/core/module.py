@@ -1,0 +1,267 @@
+"""Pytree-based module system — the structural core of hetu-tpu.
+
+The reference frames models as define-then-run dataflow graphs of ``Op`` nodes
+(reference: python/hetu/gpu_ops/Node.py:20) with hand-built autodiff
+(executor.py:1265), shape inference, and scheduling.  On TPU, ``jax.jit``
+supplies graph capture, ``jax.grad`` the autodiff, and XLA the scheduling — so
+the module system here only needs to
+
+1. organize parameters/state as pytrees so jit/grad/pjit see them natively,
+2. carry *logical sharding axes* per parameter, consumed by the strategy layer
+   (``hetu_tpu/parallel/spec.py`` — the ``NodeStatus`` equivalent of
+   reference python/hetu/context.py:248).
+
+Conventions
+-----------
+* A ``Module`` subclass assigns attributes in ``__init__``.  Attributes holding
+  jax/numpy arrays, sub-``Module``s, or containers thereof become pytree
+  children; everything else is static metadata (must be hashable; lists are
+  frozen to tuples at flatten time).
+* A static attribute ``<name>_axes = ('logical0', 'logical1', ...)`` declares
+  the logical sharding axes of array attribute ``<name>``.  ``logical_axes``
+  collects them into a module-shaped pytree of ``PartitionSpec`` leaves.
+* A static attribute/class attribute ``_state_fields: tuple[str, ...]`` names
+  attributes that are *mutable state* (e.g. batch-norm statistics), not
+  trainable parameters.  ``trainable_mask`` exposes this to optimizers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "Module",
+    "FrozenDict",
+    "is_array",
+    "logical_axes",
+    "trainable_mask",
+    "tree_replace",
+    "named_parameters",
+    "param_count",
+]
+
+
+def is_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray, jax.ShapeDtypeStruct))
+
+
+def _is_dynamic(v: Any) -> bool:
+    """True if ``v`` belongs in the pytree-children partition."""
+    if isinstance(v, Module):
+        return True
+    if isinstance(v, (jax.Array, np.ndarray, jax.ShapeDtypeStruct)):
+        return True
+    # PartitionSpec leaves keep spec-trees (logical_axes output) congruent
+    # with the module trees they mirror.
+    if isinstance(v, P):
+        return True
+    if isinstance(v, (list, tuple)):
+        return any(_is_dynamic(x) for x in v)
+    if isinstance(v, dict):
+        return any(_is_dynamic(x) for x in v.values())
+    return False
+
+
+class FrozenDict(dict):
+    """Hashable dict used for static metadata in pytree aux data."""
+
+    def __hash__(self):  # type: ignore[override]
+        return hash(tuple(sorted((k, _try_hash(v)) for k, v in self.items())))
+
+    def __setitem__(self, *a):
+        raise TypeError("FrozenDict is immutable")
+
+
+def _try_hash(v):
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def _freeze(v: Any) -> Any:
+    """Make static metadata hashable (lists -> tuples, dicts -> FrozenDict)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, FrozenDict):
+        return v
+    if isinstance(v, dict):
+        return FrozenDict({k: _freeze(x) for k, x in v.items()})
+    return v
+
+
+def _flatten_module(m: "Module"):
+    children, keys, static = [], [], []
+    for k in sorted(m.__dict__):
+        v = m.__dict__[k]
+        if _is_dynamic(v):
+            keys.append(k)
+            children.append(v)
+        else:
+            static.append((k, _freeze(v)))
+    aux = (tuple(keys), tuple(static))
+    return children, aux
+
+
+def _flatten_module_with_keys(m: "Module"):
+    children, aux = _flatten_module(m)
+    keyed = [(jtu.GetAttrKey(k), c) for k, c in zip(aux[0], children)]
+    return keyed, aux
+
+
+def _unflatten_module(cls, aux, children):
+    m = object.__new__(cls)
+    keys, static = aux
+    d = m.__dict__
+    for k, v in zip(keys, children):
+        d[k] = v
+    for k, v in static:
+        d[k] = v
+    return m
+
+
+class Module:
+    """Base class; every subclass is automatically a registered pytree node."""
+
+    _state_fields: tuple = ()
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        jtu.register_pytree_with_keys(
+            cls,
+            _flatten_module_with_keys,
+            lambda aux, children, cls=cls: _unflatten_module(cls, aux, children),
+            flatten_func=_flatten_module,
+        )
+
+    # -- functional update ----------------------------------------------------
+    def replace(self, **updates) -> "Module":
+        """Return a shallow copy with the given attributes replaced."""
+        m = object.__new__(type(self))
+        m.__dict__.update(self.__dict__)
+        m.__dict__.update(updates)
+        return m
+
+    # -- convenience ----------------------------------------------------------
+    def __repr__(self) -> str:
+        parts = []
+        for k in sorted(self.__dict__):
+            v = self.__dict__[k]
+            if isinstance(v, (jax.Array, np.ndarray)):
+                parts.append(f"{k}={v.dtype}{list(v.shape)}")
+            elif isinstance(v, Module):
+                parts.append(f"{k}={type(v).__name__}(...)")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+
+# -----------------------------------------------------------------------------
+# Tree utilities over modules
+# -----------------------------------------------------------------------------
+
+
+def _axes_for(m: Module, name: str, default=None):
+    ax = m.__dict__.get(f"{name}_axes", default)
+    if ax is None:
+        return None
+    return tuple(ax)
+
+
+def logical_axes(tree: Any) -> Any:
+    """Replace every array leaf with a logical ``PartitionSpec``.
+
+    Arrays annotated via ``<name>_axes`` get ``P(*axes)`` (``None`` entries
+    allowed for unsharded dims); unannotated arrays get ``P()`` (replicate).
+    The result has the same treedef as ``tree``, with ``PartitionSpec`` leaves.
+    """
+
+    def rec(node, axes):
+        if isinstance(node, Module):
+            children, aux = _flatten_module(node)
+            keys = aux[0]
+            new_children = [
+                rec(c, _axes_for(node, k)) for k, c in zip(keys, children)
+            ]
+            return _unflatten_module(type(node), aux, new_children)
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c, axes) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v, axes) for k, v in node.items()}
+        # array leaf
+        if axes is None:
+            return P()
+        spec = tuple(a if a else None for a in axes)
+        return P(*spec)
+
+    return rec(tree, None)
+
+
+def trainable_mask(tree: Any) -> Any:
+    """Module-shaped pytree of bools: True for trainable params, False for state."""
+
+    def rec(node, is_state):
+        if isinstance(node, Module):
+            children, aux = _flatten_module(node)
+            keys = aux[0]
+            state_fields = set(node.__dict__.get("_state_fields", ()) or ()) | set(
+                getattr(type(node), "_state_fields", ()) or ()
+            )
+            new_children = [
+                rec(c, is_state or (k in state_fields))
+                for k, c in zip(keys, children)
+            ]
+            return _unflatten_module(type(node), aux, new_children)
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c, is_state) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v, is_state) for k, v in node.items()}
+        return np.asarray(not is_state)
+
+    return rec(tree, False)
+
+
+def tree_replace(tree: Any, where: Callable[[Any], Any], new: Any) -> Any:
+    """Functional update: replace the subtree selected by ``where(tree)``.
+
+    ``where`` must return a node (by identity) contained in ``tree``.
+    """
+    target = where(tree)
+
+    def rec(node):
+        if node is target:
+            return new
+        if isinstance(node, Module):
+            children, aux = _flatten_module(node)
+            return _unflatten_module(type(node), aux, [rec(c) for c in children])
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return node
+
+    return rec(tree)
+
+
+def named_parameters(tree: Any) -> list[tuple[str, Any]]:
+    """Flat list of (dotted-path, array) pairs, analogous to a state dict."""
+    out = []
+    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        name = ".".join(
+            str(getattr(k, "name", getattr(k, "idx", getattr(k, "key", k))))
+            for k in path
+        )
+        out.append((name, leaf))
+    return out
+
+
+def param_count(tree: Any) -> int:
+    return sum(
+        int(np.prod(x.shape)) for x in jtu.tree_leaves(tree) if hasattr(x, "shape")
+    )

@@ -1,0 +1,68 @@
+"""Reproducible global RNG: seed + sequence number.
+
+Mirrors the capability of the reference's stateful RNG (reference:
+src/common/random.cc — ``SetRandomSeed``/``StepSeqNum``; Python binding
+python/hetu/random.py:14-43): a global seed plus a monotonically increasing
+sequence number, checkpointed together so that training resumed from a
+checkpoint replays the identical random stream.
+
+TPU-natively this is a thin facade over ``jax.random``: each draw folds the
+next sequence number into a key derived from the seed.  The (seed, seqnum)
+pair round-trips through ``state()``/``load_state()`` and is stored in
+checkpoints by ``hetu_tpu.exec.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.random as jrandom
+
+__all__ = ["set_random_seed", "get_seed_status", "next_key", "next_keys", "reset_seed_seqnum"]
+
+_lock = threading.Lock()
+_seed: int = 0
+_seqnum: int = 0
+
+
+def set_random_seed(seed: int) -> None:
+    """Set the global seed and reset the sequence number (random.py:14)."""
+    global _seed, _seqnum
+    with _lock:
+        _seed = int(seed)
+        _seqnum = 0
+
+
+def get_seed_status() -> tuple[int, int]:
+    """Return (seed, seqnum) — the checkpointable RNG state (random.py:31)."""
+    return _seed, _seqnum
+
+
+def reset_seed_seqnum(seed: int, seqnum: int) -> None:
+    """Restore RNG state from a checkpoint (random.py:36)."""
+    global _seed, _seqnum
+    with _lock:
+        _seed = int(seed)
+        _seqnum = int(seqnum)
+
+
+def next_key() -> jax.Array:
+    """Return a fresh PRNG key; advances the global sequence number."""
+    global _seqnum
+    with _lock:
+        seq = _seqnum
+        _seqnum += 1
+    return jrandom.fold_in(jrandom.key(_seed), seq)
+
+
+def next_keys(n: int) -> jax.Array:
+    """Return ``n`` fresh PRNG keys as a stacked array."""
+    global _seqnum
+    with _lock:
+        seq = _seqnum
+        _seqnum += n
+    base = jrandom.key(_seed)
+    return jax.vmap(lambda i: jrandom.fold_in(base, i))(
+        jax.numpy.arange(seq, seq + n)
+    )

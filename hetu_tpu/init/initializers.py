@@ -1,0 +1,98 @@
+"""Parameter initializers.
+
+TPU-native equivalents of the reference initializers
+(reference: python/hetu/initializers.py:10-433 — constant/zeros/ones/uniform/
+normal/truncated_normal, xavier/he {uniform,normal}; CUDA kernels
+src/ops/Initializers.cu).  The reference's ``init_on_gpu/cpu/ps`` split
+(initializers.py:29) maps here to: on-device jax.random draws (this module)
+vs host-side table init in the embedding engine (hetu_tpu/embed/).
+
+Each initializer is ``(key, shape, dtype) -> array``; factory functions
+return closures so layers can store them as static config.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "zeros", "ones", "constant", "uniform", "normal", "truncated_normal",
+    "xavier_uniform", "xavier_normal", "he_uniform", "he_normal",
+    "lecun_uniform", "lecun_normal",
+]
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def constant(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+def uniform(minval: float = -0.05, maxval: float = 0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval, maxval)
+
+    return init
+
+
+def normal(mean: float = 0.0, stddev: float = 0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def truncated_normal(mean: float = 0.0, stddev: float = 0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+    return init
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels HWIO
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def _scaled(mode_fn, distribution):
+    def factory(gain: float = 1.0):
+        def init(key, shape, dtype=jnp.float32):
+            fan_in, fan_out = _fans(shape)
+            scale = gain * mode_fn(fan_in, fan_out)
+            if distribution == "uniform":
+                limit = math.sqrt(3.0) * scale
+                return jax.random.uniform(key, shape, dtype, -limit, limit)
+            if distribution == "normal":
+                return scale * jax.random.normal(key, shape, dtype)
+            return scale * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+        return init
+
+    return factory
+
+
+xavier_uniform = _scaled(lambda fi, fo: math.sqrt(2.0 / (fi + fo)), "uniform")
+xavier_normal = _scaled(lambda fi, fo: math.sqrt(2.0 / (fi + fo)), "normal")
+he_uniform = _scaled(lambda fi, fo: math.sqrt(2.0 / fi), "uniform")
+he_normal = _scaled(lambda fi, fo: math.sqrt(2.0 / fi), "normal")
+lecun_uniform = _scaled(lambda fi, fo: math.sqrt(1.0 / fi), "uniform")
+lecun_normal = _scaled(lambda fi, fo: math.sqrt(1.0 / fi), "normal")

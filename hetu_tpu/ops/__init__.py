@@ -1,0 +1,14 @@
+"""hetu_tpu.ops — the functional op surface.
+
+Covers the reference's kernel inventory (src/ops, 121 CUDA files; SURVEY §2.1)
+as jnp/lax expressions that XLA fuses and tiles for the MXU/VPU, with Pallas
+kernels for the ops XLA can't fuse well (``hetu_tpu.ops.pallas``).
+"""
+
+from hetu_tpu.ops.math import *  # noqa: F401,F403
+from hetu_tpu.ops.nn import *  # noqa: F401,F403
+from hetu_tpu.ops.losses import *  # noqa: F401,F403
+from hetu_tpu.ops.reduce import *  # noqa: F401,F403
+from hetu_tpu.ops.shape import *  # noqa: F401,F403
+from hetu_tpu.ops.sparse import *  # noqa: F401,F403
+from hetu_tpu.ops.embed import *  # noqa: F401,F403

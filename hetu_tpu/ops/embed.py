@@ -1,0 +1,105 @@
+"""On-device embedding ops.
+
+TPU-native equivalents of the reference embedding kernels:
+EmbeddingLookup.cu, SparseEmbeddingLookup.cu, CompressedEmbedding.cu,
+QuantizeEmbedding.cu, Quantize.cu/SignedQuantize.cu, OptEmbedBinaryStep.cu,
+PruneMask.cu/Prune.cu, AutoDimOps.cu — the kernels behind the
+EmbeddingMemoryCompression suite (tools/EmbeddingMemoryCompression).
+
+The host-side cached parameter-server path (HET) lives in
+``hetu_tpu/embed/``; these are the pure on-device pieces.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.sparse import IndexedSlices
+
+__all__ = [
+    "embedding_lookup", "gather_rows", "embedding_lookup_grad", "compressed_embedding_lookup",
+    "quantize", "dequantize", "signed_quantize", "quantized_embedding_lookup",
+    "binary_step", "prune_mask",
+]
+
+
+def embedding_lookup(table, ids):
+    """Dense row gather (src/ops/EmbeddingLookup.cu).  ids may be any shape."""
+    return jnp.take(table, ids, axis=0)
+
+
+# Alias: the same primitive under its shape-op name (reference Gather.cu usage).
+gather_rows = embedding_lookup
+
+
+def embedding_lookup_grad(grad_out, ids, num_rows: int) -> IndexedSlices:
+    """Backward of lookup as IndexedSlices (reference EmbeddingLookUp gradient)."""
+    flat_ids = ids.reshape(-1)
+    flat_grad = grad_out.reshape(flat_ids.shape[0], -1)
+    return IndexedSlices(flat_ids, flat_grad, num_rows)
+
+
+def compressed_embedding_lookup(table, ids, num_buckets: int):
+    """Compositional-hash lookup (src/ops/CompressedEmbedding.cu): id -> two
+    hashed buckets whose rows are summed."""
+    h1 = ids % num_buckets
+    h2 = (ids // num_buckets) % num_buckets
+    return jnp.take(table, h1, axis=0) + jnp.take(table, h2, axis=0)
+
+
+def quantize(x, bits: int, scale, zero_point=0.0, key=None):
+    """Uniform quantization with optional stochastic rounding
+    (src/ops/Quantize.cu)."""
+    qmax = 2.0**bits - 1
+    scaled = (x - zero_point) / scale
+    if key is not None:
+        noise = jax.random.uniform(key, x.shape) - 0.5
+        scaled = scaled + noise
+    q = jnp.clip(jnp.round(scaled), 0, qmax)
+    return q.astype(jnp.uint8 if bits <= 8 else jnp.int32)
+
+
+def dequantize(q, scale, zero_point=0.0):
+    return q.astype(jnp.float32) * scale + zero_point
+
+
+def signed_quantize(x, bits: int, scale, key=None):
+    """Symmetric signed quantization (src/ops/SignedQuantize.cu)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scaled = x / scale
+    if key is not None:
+        noise = jax.random.uniform(key, x.shape) - 0.5
+        scaled = scaled + noise
+    return jnp.clip(jnp.round(scaled), -qmax - 1, qmax).astype(jnp.int8)
+
+
+def quantized_embedding_lookup(qtable, ids, scale, zero_point=0.0):
+    """Lookup into a uint8/int8 table with on-the-fly dequantization
+    (src/ops/QuantizeEmbedding.cu)."""
+    rows = jnp.take(qtable, ids, axis=0)
+    return dequantize(rows, scale, zero_point)
+
+
+@jax.custom_vjp
+def binary_step(x):
+    """Straight-through binary step used by OptEmbed
+    (src/ops/OptEmbedBinaryStep.cu): forward 1[x>0], backward a clipped
+    long-tailed derivative approximation."""
+    return (x > 0).astype(x.dtype)
+
+
+def _binary_step_fwd(x):
+    return binary_step(x), x
+
+
+def _binary_step_bwd(x, g):
+    return (g * jnp.clip(2.0 - 4.0 * jnp.abs(x), 0.0),)
+
+
+binary_step.defvjp(_binary_step_fwd, _binary_step_bwd)
+
+
+def prune_mask(x, threshold):
+    """Magnitude prune mask (src/ops/PruneMask.cu)."""
+    return (jnp.abs(x) >= threshold).astype(x.dtype)

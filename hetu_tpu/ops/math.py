@@ -1,0 +1,178 @@
+"""Elementwise and matmul-family ops.
+
+TPU-native equivalents of the reference CUDA kernels in src/ops (one
+``DLGpu*`` kernel per file: Abs.cu, AddElewise/AddConst.cu, MultiplyElewise.cu,
+Division.cu, Pow.cu, Exp.cu, Log.cu, Sqrt.cu, Tanh.cu, Sigmoid.cu, Gelu.cu,
+LeakyRelu.cu, Relu.cu, Sin.cu, Floor.cu, Clamp.cu, Sign.cu, Opposite.cu;
+matmul family: MatrixMult.cu, BatchMatrixMult.cu, Addmm.cu, Baddbmm.cu,
+Linear.cu, Outer.cu, Dot.cu).  Here each is a jnp/lax expression that XLA
+fuses; matmuls hit the MXU with an explicit fp32 accumulation policy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "abs", "add", "add_const", "mul", "mul_const", "div", "div_const", "rdiv_const",
+    "pow", "exp", "log", "sqrt", "rsqrt", "tanh", "sigmoid", "gelu", "relu",
+    "leaky_relu", "sin", "cos", "floor", "ceil", "clamp", "sign", "opposite",
+    "matmul", "batch_matmul", "addmm", "baddbmm", "linear", "outer", "dot",
+]
+
+# Default matmul accumulation: bf16 inputs, fp32 accumulate on the MXU.
+_PREC = None  # defer to jax_default_matmul_precision (bf16-on-MXU on TPU)
+
+
+def abs(x):  # noqa: A001 - mirrors reference op name (src/ops/Abs.cu)
+    return jnp.abs(x)
+
+
+def add(a, b):
+    return jnp.add(a, b)
+
+
+def add_const(x, c):
+    return x + c
+
+
+def mul(a, b):
+    return jnp.multiply(a, b)
+
+
+def mul_const(x, c):
+    return x * c
+
+
+def div(a, b):
+    return jnp.divide(a, b)
+
+
+def div_const(x, c):
+    return x / c
+
+
+def rdiv_const(x, c):
+    return c / x
+
+
+def pow(x, p):  # noqa: A001
+    return jnp.power(x, p)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def gelu(x, approximate: bool = True):
+    """Gelu (src/ops/Gelu.cu); tanh approximation is the TPU-friendly default."""
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def leaky_relu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def clamp(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def opposite(x):
+    return jnp.negative(x)
+
+
+# -- matmul family ------------------------------------------------------------
+
+
+def matmul(a, b, trans_a: bool = False, trans_b: bool = False, precision=_PREC):
+    """2-D matmul with transpose flags (reference gpu_ops/MatrixMult.py:9)."""
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    return jnp.matmul(a, b, precision=precision)
+
+
+def batch_matmul(a, b, trans_a: bool = False, trans_b: bool = False, precision=_PREC):
+    """Batched matmul over leading dims (src/ops/BatchMatrixMult.cu)."""
+    if trans_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if trans_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, precision=precision)
+
+
+def addmm(bias, a, b, alpha: float = 1.0, beta: float = 1.0):
+    """beta*bias + alpha*(a @ b) (src/ops/Addmm.cu)."""
+    return beta * bias + alpha * jnp.matmul(a, b, precision=_PREC)
+
+
+def baddbbm(*a, **k):  # pragma: no cover - legacy alias typo guard
+    return baddbmm(*a, **k)
+
+
+def baddbmm(bias, a, b, alpha: float = 1.0, beta: float = 1.0):
+    """Batched addmm (src/ops/Baddbmm.cu)."""
+    return beta * bias + alpha * jnp.matmul(a, b, precision=_PREC)
+
+
+def linear(x, w, bias=None, precision=_PREC):
+    """x @ w + b (src/ops/Linear.cu). w is (in, out)."""
+    y = jnp.matmul(x, w, precision=precision)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+def dot(a, b):
+    return jnp.dot(a.ravel(), b.ravel())

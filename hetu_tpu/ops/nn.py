@@ -1,0 +1,185 @@
+"""NN ops: conv/pool, normalization, dropout, softmax, attention primitives.
+
+TPU-native equivalents of the reference kernels: Conv2d{,Broadcast,ReduceSum}.cu,
+CudnnConv2d.cu, AvgPool.cu, MaxPool.cu, CudnnAvg/MaxPool.cu, LayerNorm.cu,
+InstanceNorm2d.cu, CudnnBn.cu, Dropout.cu, CudnnDropout.cu, Softmax.cu,
+CudnnSoftmax.cu.  Convolutions use NHWC (TPU-preferred layout; the reference
+uses NCHW — layout is a free choice here, and NHWC keeps the channel dim on
+the 128-lane minor axis).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "conv2d", "conv2d_transpose", "max_pool2d", "avg_pool2d",
+    "batch_norm", "layer_norm", "instance_norm2d", "group_norm", "rms_norm",
+    "dropout", "softmax", "log_softmax",
+]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, w, stride=1, padding="SAME", dilation=1, groups: int = 1,
+           precision=None):
+    """2-D convolution, NHWC activations, HWIO weights (src/ops/Conv2d.cu).
+
+    ``padding`` may be "SAME"/"VALID" or an int (symmetric pad, matching the
+    reference's explicit-padding API).
+    """
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        precision=precision,
+    )
+
+
+def conv2d_transpose(x, w, stride=1, padding="SAME",
+                     precision=None):
+    stride = _pair(stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return lax.conv_transpose(
+        x, w, strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=precision,
+    )
+
+
+def max_pool2d(x, window=2, stride=None, padding="VALID"):
+    """Max pooling over NHWC (src/ops/MaxPool.cu)."""
+    window = _pair(window)
+    stride = _pair(stride) if stride is not None else window
+    if isinstance(padding, int):
+        padding = [(0, 0), (padding, padding), (padding, padding), (0, 0)]
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, *window, 1),
+        window_strides=(1, *stride, 1),
+        padding=padding,
+    )
+
+
+def avg_pool2d(x, window=2, stride=None, padding="VALID"):
+    """Average pooling over NHWC (src/ops/AvgPool.cu)."""
+    window = _pair(window)
+    stride = _pair(stride) if stride is not None else window
+    if isinstance(padding, int):
+        padding = [(0, 0), (padding, padding), (padding, padding), (0, 0)]
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, *window, 1),
+        window_strides=(1, *stride, 1),
+        padding=padding,
+    )
+    if padding == "VALID":
+        return summed / (window[0] * window[1])
+    # count actual window sizes for padded edges
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add,
+        window_dimensions=(1, *window, 1),
+        window_strides=(1, *stride, 1),
+        padding=padding,
+    )
+    return summed / counts
+
+
+def batch_norm(x, scale, bias, mean, var, *, axis: int = -1, training: bool,
+               momentum: float = 0.9, eps: float = 1e-5):
+    """Batch norm (src/ops/CudnnBn.cu).  Functional: returns (y, new_mean, new_var).
+
+    ``mean``/``var`` are the running statistics (module state fields).
+    """
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    if training:
+        batch_mean = jnp.mean(x, axis=reduce_axes)
+        batch_var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * mean + (1 - momentum) * batch_mean
+        new_var = momentum * var + (1 - momentum) * batch_var
+        use_mean, use_var = batch_mean, batch_var
+    else:
+        new_mean, new_var = mean, var
+        use_mean, use_var = mean, var
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    inv = lax.rsqrt(use_var + eps).reshape(shape)
+    y = (x - use_mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    return y, new_mean, new_var
+
+
+def layer_norm(x, scale=None, bias=None, *, axis: int = -1, eps: float = 1e-5):
+    """Layer norm over the trailing axis (src/ops/LayerNorm.cu).
+
+    Statistics are computed in fp32 regardless of input dtype (TPU numerics).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axis, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def rms_norm(x, scale=None, *, axis: int = -1, eps: float = 1e-6):
+    """RMSNorm — not in the reference kernel set, standard for modern LMs."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    y = xf * lax.rsqrt(ms + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def instance_norm2d(x, eps: float = 1e-7):
+    """Instance norm over NHWC spatial dims (src/ops/InstanceNorm2d.cu)."""
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+    var = jnp.var(x, axis=(1, 2), keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps)
+
+
+def group_norm(x, scale, bias, *, groups: int, eps: float = 1e-5):
+    """Group norm over NHWC."""
+    n, h, w, c = x.shape
+    xg = x.reshape(n, h, w, groups, c // groups)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    return y * scale + bias
+
+
+def dropout(x, rate: float, key, *, training: bool = True):
+    """Inverted dropout (src/ops/Dropout.cu)."""
+    if not training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)

@@ -1,0 +1,121 @@
+"""Reduction, scan, and sort/index ops.
+
+TPU-native equivalents of the reference kernels: ReduceSum{,General}.cu,
+ReduceMean via general, Max.cu/Min.cu, Norm.cu, CumSum.cu, Argmax.cu,
+ArgmaxPartial.cu, Argsort.cu, TopKIdx.cu/TopKVal.cu, GroupTopKIdx.cu,
+SamGroupSum.cu/SamMax.cu, UniqueIndices.cu, ReduceIndexedSlice.cu.
+Sorting/top-k lower to XLA's sort HLO; dynamic-size ``unique`` is expressed
+with a static ``size`` bound so shapes remain jit-compatible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_norm",
+    "cumsum", "argmax", "argmin", "argsort", "topk", "topk_idx", "topk_val",
+    "group_topk_idx", "unique_indices", "sam_group_sum", "sam_max", "arange",
+]
+
+
+def reduce_sum(x, axes=None, keepdims: bool = False):
+    return jnp.sum(x, axis=axes, keepdims=keepdims)
+
+
+def reduce_mean(x, axes=None, keepdims: bool = False):
+    return jnp.mean(x, axis=axes, keepdims=keepdims)
+
+
+def reduce_max(x, axes=None, keepdims: bool = False):
+    return jnp.max(x, axis=axes, keepdims=keepdims)
+
+
+def reduce_min(x, axes=None, keepdims: bool = False):
+    return jnp.min(x, axis=axes, keepdims=keepdims)
+
+
+def reduce_norm(x, ord: int = 2, axes=None, keepdims: bool = False):  # noqa: A002
+    """p-norm reduction (src/ops/Norm.cu)."""
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=keepdims)
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keepdims))
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), ord), axis=axes, keepdims=keepdims), 1.0 / ord
+    )
+
+
+def cumsum(x, axis: int = -1):
+    return jnp.cumsum(x, axis=axis)
+
+
+def argmax(x, axis: int = -1):
+    return jnp.argmax(x, axis=axis)
+
+
+def argmin(x, axis: int = -1):
+    return jnp.argmin(x, axis=axis)
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx
+
+
+def topk(x, k: int, axis: int = -1):
+    """(values, indices) of the k largest entries (src/ops/TopKIdx.cu, TopKVal.cu)."""
+    if axis in (-1, x.ndim - 1):
+        return lax.top_k(x, k)
+    x = jnp.moveaxis(x, axis, -1)
+    v, i = lax.top_k(x, k)
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+
+
+def topk_idx(x, k: int, axis: int = -1):
+    return topk(x, k, axis)[1]
+
+
+def topk_val(x, k: int, axis: int = -1):
+    return topk(x, k, axis)[0]
+
+
+def group_topk_idx(x, group_ids, k: int, num_groups: int):
+    """Top-k indices within each group (src/ops/GroupTopKIdx.cu).
+
+    Used by MoE BASE-layer style gates: for each group g, the k highest-scoring
+    positions among entries with group_ids == g.  Returns (num_groups, k) indices.
+    """
+    masked = jnp.where(group_ids[None, :] == jnp.arange(num_groups)[:, None],
+                       x[None, :], -jnp.inf)
+    return lax.top_k(masked, k)[1]
+
+
+def unique_indices(x, size: int, fill_value: int = -1):
+    """Deduplicate integer indices with a static output size (src/ops/UniqueIndices.cu).
+
+    Returns (unique_padded, inverse_map) where ``unique_padded`` has shape
+    (size,) padded with ``fill_value`` and ``inverse_map[i]`` locates x[i] in
+    the unique list — the layout the sparse-embedding gradient path needs
+    (reference: executor.py sparse gradient tuples).
+    """
+    uniq, inv = jnp.unique(x, return_inverse=True, size=size, fill_value=fill_value)
+    return uniq, inv.reshape(x.shape)
+
+
+def sam_group_sum(x, group_ids, num_groups: int):
+    """Segment-sum rows by group id (src/ops/SamGroupSum.cu; SAM MoE gate)."""
+    return jax.ops.segment_sum(x, group_ids, num_segments=num_groups)
+
+
+def sam_max(x, group_ids, num_groups: int):
+    """Segment-max by group id (src/ops/SamMax.cu)."""
+    return jax.ops.segment_max(x, group_ids, num_segments=num_groups)
+
+
+def arange(start, stop=None, step=1, dtype=jnp.int32):
+    return jnp.arange(start, stop, step, dtype=dtype)

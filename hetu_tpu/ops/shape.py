@@ -1,0 +1,154 @@
+"""Shape / layout / indexing ops.
+
+TPU-native equivalents of the reference kernels: Reshape (gpu_ops/Reshape.py),
+Transpose.cu, Broadcast.cu/BroadcastShape.cu, Concat.cu/Concatenate.cu,
+Slice.cu/SliceAssign.cu/SliceByMatrix.cu, Pad.cu, Repeat.cu, Roll.cu,
+Gather.cu, Scatter.cu/Scatter1D.cu, Interpolate.cu, OneHot.cu, TrilLookup.cu,
+Where.cu, MaskedFill.cu, ArraySet.cu, Tile (python-side).
+
+The reference implements "lazy" stride views for reshape/broadcast
+(ndarray.py:235-484); under XLA these are free layout changes, so no
+special-casing is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "reshape", "transpose", "broadcast_to", "broadcast_shape", "concat",
+    "concatenate", "split", "slice", "slice_assign", "slice_by_matrix", "pad",
+    "repeat", "roll", "tile", "gather", "scatter", "scatter_1d",
+    "interpolate", "one_hot", "tril_lookup", "triu", "tril", "where",
+    "masked_fill", "array_set", "flip", "arange_like",
+]
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_shape(x, shape, add_axes=None):
+    """Broadcast with explicit inserted axes (src/ops/BroadcastShape.cu)."""
+    if add_axes:
+        for ax in sorted(add_axes):
+            x = jnp.expand_dims(x, ax)
+    return jnp.broadcast_to(x, shape)
+
+
+def concat(arrs, axis: int = 0):
+    return jnp.concatenate(arrs, axis=axis)
+
+
+concatenate = concat
+
+
+def split(x, parts_or_sections, axis: int = 0):
+    return jnp.split(x, parts_or_sections, axis=axis)
+
+
+def slice(x, begin, sizes):  # noqa: A001
+    """Static slice (src/ops/Slice.cu)."""
+    return lax.dynamic_slice(x, begin, sizes)
+
+
+def slice_assign(x, update, begin):
+    """Write ``update`` into ``x`` at offset ``begin`` (src/ops/SliceAssign.cu)."""
+    return lax.dynamic_update_slice(x, update.astype(x.dtype), begin)
+
+
+def slice_by_matrix(x, row_idx, col_idx):
+    """x[row_idx, col_idx] pairwise gather (src/ops/SliceByMatrix.cu)."""
+    return x[row_idx, col_idx]
+
+
+def pad(x, pad_width, mode: str = "constant", constant_value=0):
+    return jnp.pad(x, pad_width, mode=mode,
+                   **({"constant_values": constant_value} if mode == "constant" else {}))
+
+
+def repeat(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def roll(x, shift, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def gather(x, indices, axis: int = 0):
+    """take_along_axis-style gather (src/ops/Gather.cu)."""
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def scatter(x, indices, updates, axis: int = 0):
+    """Scatter ``updates`` along ``axis`` at ``indices`` (src/ops/Scatter.cu)."""
+    return jnp.put_along_axis(x, indices, updates, axis=axis, inplace=False)
+
+
+def scatter_1d(x, indices, updates, add: bool = False):
+    """1-D index scatter (src/ops/Scatter1D.cu)."""
+    if add:
+        return x.at[indices].add(updates)
+    return x.at[indices].set(updates)
+
+
+def interpolate(x, size, method: str = "bilinear"):
+    """Spatial resize over NHWC (src/ops/Interpolate.cu)."""
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, size[0], size[1], c), method=method)
+
+
+def one_hot(ids, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(ids, num_classes, dtype=dtype)
+
+
+def tril_lookup(x, offset: int = 0):
+    """Pack the lower triangle of trailing (n, n) dims into a vector
+    (src/ops/TrilLookup.cu)."""
+    n = x.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset)
+    return x[..., rows, cols]
+
+
+def tril(x, k: int = 0):
+    return jnp.tril(x, k)
+
+
+def triu(x, k: int = 0):
+    return jnp.triu(x, k)
+
+
+def where(cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+def masked_fill(x, mask, value):
+    """Fill positions where mask!=0 with value (src/ops/MaskedFill.cu)."""
+    return jnp.where(mask.astype(bool), jnp.asarray(value, x.dtype), x)
+
+
+def array_set(x, value):
+    """Fill with a scalar (src/ops/ArraySet.cu)."""
+    return jnp.full_like(x, value)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+def arange_like(x, axis: int):
+    return jnp.arange(x.shape[axis])

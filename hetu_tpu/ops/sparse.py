@@ -1,0 +1,87 @@
+"""Sparse gradient structures.
+
+The reference carries embedding gradients as ``IndexedSlices``
+(reference: python/hetu/ndarray.py:680) — (indices, values) pairs produced by
+embedding-lookup backward, deduplicated via UniqueIndices/ReduceIndexedSlice
+kernels (src/ops/UniqueIndices.cu, ReduceIndexedSlice.cu) before the sparse
+optimizer update.  Here the same structure is a pytree dataclass; dedup is a
+segment-sum, and ``to_dense`` a scatter-add — both single XLA ops.
+
+CSR sparse matmul (reference src/ops/CuSparseCsrmm.cu/Csrmv.cu,
+ndarray.py:549 ``ND_Sparse_Array``) maps to a gather+segment-sum formulation
+that XLA tiles well for the moderately-sparse matrices the reference targets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.reduce import unique_indices
+
+__all__ = ["IndexedSlices", "dedup_indexed_slices", "csr_matmul", "csr_matvec", "CSRMatrix"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class IndexedSlices:
+    """Sparse rows-update: ``dense[indices[i]] += values[i]``."""
+
+    indices: Any  # (n,) int32
+    values: Any  # (n, dim)
+    dense_rows: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    def to_dense(self):
+        out = jnp.zeros((self.dense_rows, self.values.shape[-1]), self.values.dtype)
+        return out.at[self.indices].add(self.values, mode="drop")
+
+    def dedup(self) -> "IndexedSlices":
+        return dedup_indexed_slices(self)
+
+
+def dedup_indexed_slices(s: IndexedSlices) -> IndexedSlices:
+    """Merge duplicate indices by summation (src/ops/ReduceIndexedSlice.cu).
+
+    Output keeps the static input length (padded with index -1 / zero rows) so
+    the op is jit-compatible; downstream consumers drop fill rows.
+    """
+    flat_idx = s.indices.reshape(-1)
+    flat_val = s.values.reshape(flat_idx.shape[0], -1)
+    uniq, inv = unique_indices(flat_idx, size=flat_idx.shape[0], fill_value=-1)
+    summed = jax.ops.segment_sum(flat_val, inv.reshape(-1), num_segments=flat_idx.shape[0])
+    return IndexedSlices(uniq, summed, s.dense_rows)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CSRMatrix:
+    """CSR sparse matrix (reference ndarray.py:549 ND_Sparse_Array)."""
+
+    data: Any
+    indices: Any  # column ids, (nnz,)
+    indptr: Any  # row pointers, (rows+1,)
+    shape: tuple = dataclasses.field(metadata=dict(static=True), default=(0, 0))
+
+    def row_ids(self):
+        """Expand indptr to per-nnz row ids (static nnz)."""
+        nnz = self.data.shape[0]
+        return jnp.searchsorted(self.indptr, jnp.arange(nnz), side="right") - 1
+
+
+def csr_matmul(sp: CSRMatrix, dense, trans_sparse: bool = False):
+    """CSR @ dense (src/ops/CuSparseCsrmm.cu)."""
+    rows = sp.row_ids()
+    if trans_sparse:
+        return jax.ops.segment_sum(
+            dense[rows] * sp.data[:, None], sp.indices, num_segments=sp.shape[1]
+        )
+    gathered = dense[sp.indices] * sp.data[:, None]
+    return jax.ops.segment_sum(gathered, rows, num_segments=sp.shape[0])
+
+
+def csr_matvec(sp: CSRMatrix, vec):
+    """CSR @ vec (src/ops/CuSparseCsrmv.cu)."""
+    return csr_matmul(sp, vec[:, None])[:, 0]

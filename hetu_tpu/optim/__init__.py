@@ -1,0 +1,18 @@
+from hetu_tpu.optim.optimizers import (
+    AdaGradOptimizer,
+    AdamOptimizer,
+    AdamWOptimizer,
+    LambOptimizer,
+    MomentumOptimizer,
+    Optimizer,
+    SGDOptimizer,
+)
+from hetu_tpu.optim.schedulers import (
+    ExponentialScheduler,
+    FixedScheduler,
+    MultiStepScheduler,
+    ReduceOnPlateauScheduler,
+    StepScheduler,
+    WarmupCosineScheduler,
+    WarmupLinearScheduler,
+)

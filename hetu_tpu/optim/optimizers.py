@@ -1,0 +1,261 @@
+"""Optimizers.
+
+TPU-native equivalents of the reference optimizer family
+(reference: python/hetu/optimizer.py — SGDUpdateOp:203, MomentumUpdateOp:289,
+AdaGradUpdateOp:335, AdamUpdateOp:462, AdamWUpdateOp:629, LambUpdateOp:686,
+plus sparse variants e.g. AdamSparseUpdateOp:553; CUDA kernels
+src/ops/Optimizers.cu, OptimizersSparse.cu).
+
+Design: each optimizer is a pure pytree transform —
+``init(params) -> state`` and ``update(grads, state, params) ->
+(new_params, new_state)`` — so the whole update jits into the train step and
+shards with the params (ZeRO partitioning is just a sharding rule on the
+state pytree, hetu_tpu/parallel/zero.py).  Learning rates may be floats or
+schedules (step -> lr callables, hetu_tpu/optim/schedulers.py).
+
+Sparse semantics: ``IndexedSlices`` gradients (embedding rows) are applied
+row-wise, matching the reference's *lazy* sparse updates (only touched rows'
+moments advance — optimizer.py:553 AdamSparse).  Dense pytrees and pytrees
+containing IndexedSlices leaves both work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.sparse import IndexedSlices
+
+__all__ = [
+    "Optimizer", "SGDOptimizer", "MomentumOptimizer", "AdaGradOptimizer",
+    "AdamOptimizer", "AdamWOptimizer", "LambOptimizer",
+]
+
+ScheduleOrFloat = Union[float, Callable[[Any], Any]]
+
+
+def _lr_at(lr: ScheduleOrFloat, step):
+    return lr(step) if callable(lr) else lr
+
+
+def _is_leaf(x):
+    return isinstance(x, IndexedSlices)
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees, is_leaf=_is_leaf)
+
+
+def _zeros_slot(p):
+    # Slots live in fp32 regardless of param dtype (bf16 moments destroy Adam
+    # numerics, and dtype-stable state pytrees are required for scan/donation).
+    if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+        return jnp.zeros(jnp.shape(p), jnp.float32)
+    return jnp.zeros_like(p)
+
+
+@dataclasses.dataclass
+class Optimizer:
+    """Base class.  Subclasses implement ``_dense`` and ``_sparse`` row updates."""
+
+    learning_rate: ScheduleOrFloat = 0.01
+    l2reg: float = 0.0
+
+    def init(self, params) -> dict:
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            **{k: jax.tree_util.tree_map(_zeros_slot, params) for k in self.slot_names()},
+        }
+
+    def slot_names(self) -> tuple:
+        return ()
+
+    # -- single-leaf updates --------------------------------------------------
+    def _dense(self, g, p, slots: dict, lr, step):
+        raise NotImplementedError
+
+    def _sparse(self, s: IndexedSlices, p, slots: dict, lr, step):
+        """Default sparse path: apply the dense rule on gathered rows only
+        (lazy semantics — untouched rows' params and moments don't advance,
+        reference optimizer.py:553 AdamSparseUpdateOp)."""
+        s = s.dedup()
+        idx = s.indices
+        valid = (idx >= 0)[:, None]
+        old_rows = {k: v[idx] for k, v in slots.items()}
+        p_rows = p[idx]
+        g_rows = s.values
+        if self.l2reg > 0.0:
+            g_rows = g_rows + self.l2reg * p_rows
+        new_rows, new_slot_rows = self._dense(g_rows, p_rows, dict(old_rows), lr, step)
+        upd = jnp.where(valid, (new_rows - p_rows).astype(p.dtype), 0)
+        p = p.at[idx].add(upd, mode="drop")
+        for k in slots:
+            slot_upd = jnp.where(
+                valid, (new_slot_rows[k] - old_rows[k]).astype(slots[k].dtype), 0
+            )
+            slots[k] = slots[k].at[idx].add(slot_upd, mode="drop")
+        return p, slots
+
+    # -- pytree update --------------------------------------------------------
+    def update(self, grads, state, params):
+        step = state["step"] + 1
+        lr = _lr_at(self.learning_rate, step)
+        slot_names = self.slot_names()
+
+        # None grads mark frozen params; keep them as leaves so the treedefs
+        # of grads and params stay congruent.
+        is_leaf = lambda x: _is_leaf(x) or x is None  # noqa: E731
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads, is_leaf=is_leaf)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_slots = {k: treedef.flatten_up_to(state[k]) for k in slot_names}
+
+        new_p, new_slots = [], {k: [] for k in slot_names}
+        for i, (g, p) in enumerate(zip(leaves_g, leaves_p)):
+            slots = {k: leaves_slots[k][i] for k in slot_names}
+            if g is None:
+                np_, ns = p, slots
+            elif isinstance(g, IndexedSlices):
+                np_, ns = self._sparse(g, p, dict(slots), lr, step)
+            else:
+                if self.l2reg > 0.0:
+                    g = g + self.l2reg * p
+                np_, ns = self._dense(g, p, dict(slots), lr, step)
+                np_ = np_.astype(p.dtype)
+                ns = {k: v.astype(slots[k].dtype) for k, v in ns.items()}
+            new_p.append(np_)
+            for k in slot_names:
+                new_slots[k].append(ns[k])
+
+        new_params = jax.tree_util.tree_unflatten(treedef, new_p)
+        new_state = {"step": step}
+        for k in slot_names:
+            new_state[k] = jax.tree_util.tree_unflatten(treedef, new_slots[k])
+        return new_params, new_state
+
+    # Facade matching the reference Optimizer.minimize (optimizer.py:66): the
+    # graph-building role is subsumed by jax.grad; exec.Trainer wires it up.
+
+
+@dataclasses.dataclass
+class SGDOptimizer(Optimizer):
+    """Plain SGD (optimizer.py:203 SGDUpdateOp; src/ops/Optimizers.cu sgd_update)."""
+
+    def _dense(self, g, p, slots, lr, step):
+        return p.astype(jnp.float32) - lr * g.astype(jnp.float32), slots
+
+
+@dataclasses.dataclass
+class MomentumOptimizer(Optimizer):
+    """(Nesterov) momentum (optimizer.py:289 MomentumUpdateOp)."""
+
+    momentum: float = 0.9
+    nesterov: bool = False
+
+    def slot_names(self):
+        return ("velocity",)
+
+    def _dense(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        v = self.momentum * slots["velocity"] - lr * g32
+        if self.nesterov:
+            p32 = p32 + self.momentum * v - lr * g32
+        else:
+            p32 = p32 + v
+        slots["velocity"] = v
+        return p32, slots
+
+
+@dataclasses.dataclass
+class AdaGradOptimizer(Optimizer):
+    """AdaGrad (optimizer.py:335 AdaGradUpdateOp)."""
+
+    initial_accumulator_value: float = 0.0
+    eps: float = 1e-7
+
+    def slot_names(self):
+        return ("accum",)
+
+    def init(self, params):
+        state = super().init(params)
+        if self.initial_accumulator_value:
+            state["accum"] = jax.tree_util.tree_map(
+                lambda a: a + self.initial_accumulator_value, state["accum"]
+            )
+        return state
+
+    def _dense(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = slots["accum"] + jnp.square(g32)
+        p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self.eps)
+        slots["accum"] = acc
+        return p, slots
+
+
+@dataclasses.dataclass
+class AdamOptimizer(Optimizer):
+    """Adam (optimizer.py:462 AdamUpdateOp), with optional AMSGrad."""
+
+    learning_rate: ScheduleOrFloat = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-7
+    amsgrad: bool = False
+
+    def slot_names(self):
+        return ("m", "v") + (("vhat",) if self.amsgrad else ())
+
+    def _dense(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g32)
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1**stepf)
+        vhat = v / (1 - self.beta2**stepf)
+        if self.amsgrad:
+            vmax = jnp.maximum(slots["vhat"], vhat)
+            slots["vhat"] = vmax
+            denom = jnp.sqrt(vmax) + self.eps
+        else:
+            denom = jnp.sqrt(vhat) + self.eps
+        p = (p.astype(jnp.float32) - lr * mhat / denom).astype(p.dtype)
+        slots["m"], slots["v"] = m, v
+        return p, slots
+
+
+@dataclasses.dataclass
+class AdamWOptimizer(AdamOptimizer):
+    """AdamW — decoupled weight decay (optimizer.py:629 AdamWUpdateOp)."""
+
+    weight_decay: float = 0.01
+
+    def _dense(self, g, p, slots, lr, step):
+        new_p, slots = super()._dense(g, p, slots, lr, step)
+        return new_p - lr * self.weight_decay * p, slots
+
+
+@dataclasses.dataclass
+class LambOptimizer(AdamOptimizer):
+    """LAMB — layerwise trust-ratio AdamW (optimizer.py:686 LambUpdateOp)."""
+
+    weight_decay: float = 0.01
+
+    def _dense(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g32)
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1**stepf)
+        vhat = v / (1 - self.beta2**stepf)
+        update = mhat / (jnp.sqrt(vhat) + self.eps) + self.weight_decay * p.astype(jnp.float32)
+        wnorm = jnp.linalg.norm(p.astype(jnp.float32))
+        unorm = jnp.linalg.norm(update)
+        trust = jnp.where(
+            (wnorm > 0) & (unorm > 0), wnorm / unorm, jnp.ones_like(wnorm)
+        )
+        p = (p.astype(jnp.float32) - lr * trust * update).astype(p.dtype)
+        slots["m"], slots["v"] = m, v
+        return p, slots

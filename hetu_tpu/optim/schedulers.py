@@ -1,0 +1,149 @@
+"""Learning-rate schedulers.
+
+TPU-native equivalents of the reference schedulers
+(reference: python/hetu/lr_scheduler.py — Fixed/Step/MultiStep/Exponential/
+ReduceOnPlateau), plus warmup-linear and warmup-cosine which the reference's
+BERT example implements ad hoc.
+
+Each scheduler is a callable ``step -> lr`` safe to trace under jit
+(except ReduceOnPlateau, which is inherently host-driven and stateful).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax.numpy as jnp
+
+__all__ = [
+    "FixedScheduler", "StepScheduler", "MultiStepScheduler",
+    "ExponentialScheduler", "ReduceOnPlateauScheduler",
+    "WarmupLinearScheduler", "WarmupCosineScheduler",
+]
+
+
+@dataclasses.dataclass
+class FixedScheduler:
+    learning_rate: float = 0.01
+
+    def __call__(self, step):
+        return self.learning_rate
+
+
+@dataclasses.dataclass
+class StepScheduler:
+    """lr * gamma^(step // step_size)."""
+
+    learning_rate: float = 0.01
+    step_size: int = 1000
+    gamma: float = 0.1
+
+    def __call__(self, step):
+        return self.learning_rate * self.gamma ** (step // self.step_size)
+
+
+@dataclasses.dataclass
+class MultiStepScheduler:
+    """Decay by gamma at each milestone."""
+
+    learning_rate: float = 0.01
+    milestones: Sequence[int] = (1000,)
+    gamma: float = 0.1
+
+    def __call__(self, step):
+        k = jnp.sum(step >= jnp.asarray(list(self.milestones)))
+        return self.learning_rate * self.gamma ** k
+
+
+@dataclasses.dataclass
+class ExponentialScheduler:
+    learning_rate: float = 0.01
+    gamma: float = 0.99
+
+    def __call__(self, step):
+        return self.learning_rate * self.gamma ** step
+
+
+@dataclasses.dataclass
+class WarmupLinearScheduler:
+    """Linear warmup then linear decay to zero (reference BERT recipe)."""
+
+    learning_rate: float = 1e-4
+    warmup_steps: int = 1000
+    total_steps: int = 100000
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, self.warmup_steps)
+        decay = jnp.maximum(
+            0.0,
+            (self.total_steps - step)
+            / jnp.maximum(1.0, self.total_steps - self.warmup_steps),
+        )
+        return self.learning_rate * jnp.minimum(warm, decay)
+
+
+@dataclasses.dataclass
+class WarmupCosineScheduler:
+    learning_rate: float = 1e-4
+    warmup_steps: int = 1000
+    total_steps: int = 100000
+    final_fraction: float = 0.0
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, self.warmup_steps)
+        progress = jnp.clip(
+            (step - self.warmup_steps)
+            / jnp.maximum(1.0, self.total_steps - self.warmup_steps),
+            0.0, 1.0,
+        )
+        cos = self.final_fraction + (1 - self.final_fraction) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress)
+        )
+        return self.learning_rate * jnp.minimum(warm, 1.0) * jnp.where(
+            step < self.warmup_steps, 1.0, cos
+        )
+
+
+class ReduceOnPlateauScheduler:
+    """Host-side stateful plateau scheduler (lr_scheduler.py ReduceOnPlateau)."""
+
+    def __init__(self, learning_rate=0.01, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, cooldown=0, min_lr=0.0):
+        assert mode in ("min", "max")
+        self.lr = float(learning_rate)
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.bad_steps = 0
+        self.cooldown_left = 0
+
+    def record(self, metric: float) -> float:
+        """Feed a new metric value; returns the (possibly reduced) lr."""
+        metric = float(metric)
+        improved = (
+            self.best is None
+            or (self.mode == "min" and metric < self.best - self.threshold)
+            or (self.mode == "max" and metric > self.best + self.threshold)
+        )
+        if improved:
+            self.best = metric
+            self.bad_steps = 0
+        elif self.cooldown_left > 0:
+            self.cooldown_left -= 1
+        else:
+            self.bad_steps += 1
+            if self.bad_steps > self.patience:
+                self.lr = max(self.lr * self.factor, self.min_lr)
+                self.bad_steps = 0
+                self.cooldown_left = self.cooldown
+        return self.lr
+
+    def __call__(self, step):
+        return self.lr

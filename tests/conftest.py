@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+Runs the whole suite on a virtual 8-device CPU mesh so every parallelism mode
+(DP/TP/PP/EP/SP) is exercised without TPU pod hardware — the multi-device
+simulation story SURVEY §4 calls for (the reference needs real mpirun
+processes for any distributed test; tests/test_comm.py:23).
+"""
+
+import os
+
+# Force CPU: the session environment presets JAX_PLATFORMS=axon (one real TPU
+# chip over a tunnel) and /root/.axon_site on PYTHONPATH force-registers that
+# backend regardless of JAX_PLATFORMS.  Unit tests must run on the virtual
+# 8-device CPU mesh, so drop the axon hook from sys.path before jax imports.
+import sys
+
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize (axon PJRT hook) imports jax before this conftest runs and
+# pins jax_platforms to the axon TPU backend; point it back at CPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

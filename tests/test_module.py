@@ -1,0 +1,110 @@
+"""Module-system tests: pytree round-trip, jit/grad transparency, axis collection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.core import Module, logical_axes, next_key, trainable_mask
+from hetu_tpu.core.module import named_parameters, param_count
+
+
+class Linear(Module):
+    def __init__(self, key, din, dout):
+        self.w = jax.random.normal(key, (din, dout)) * 0.02
+        self.w_axes = ("in", "out")
+        self.b = jnp.zeros((dout,))
+        self.b_axes = ("out",)
+        self.din = din
+
+    def __call__(self, x):
+        return x @ self.w + self.b
+
+
+class MLP(Module):
+    def __init__(self, key, d):
+        k1, k2 = jax.random.split(key)
+        self.fc1 = Linear(k1, d, 2 * d)
+        self.fc2 = Linear(k2, 2 * d, d)
+        self.scale = jnp.ones(())
+        self.name = "mlp"
+
+    def __call__(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x))) * self.scale
+
+
+def test_pytree_roundtrip():
+    m = MLP(jax.random.key(0), 4)
+    leaves, treedef = jax.tree_util.tree_flatten(m)
+    m2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(m2, MLP)
+    assert m2.name == "mlp"
+    np.testing.assert_array_equal(m.fc1.w, m2.fc1.w)
+
+
+def test_jit_and_grad_through_module():
+    m = MLP(jax.random.key(0), 4)
+    x = jnp.ones((2, 4))
+
+    @jax.jit
+    def loss_fn(model, x):
+        return jnp.sum(model(x) ** 2)
+
+    g = jax.grad(loss_fn)(m, x)
+    assert isinstance(g, MLP)
+    assert g.fc1.w.shape == m.fc1.w.shape
+    assert float(loss_fn(m, x)) == float(loss_fn(m, x))  # cache hit, no error
+
+
+def test_logical_axes():
+    m = MLP(jax.random.key(0), 4)
+    ax = logical_axes(m)
+    assert ax.fc1.w == P("in", "out")
+    assert ax.fc1.b == P("out")
+    assert ax.scale == P()
+    # same treedef
+    assert jax.tree_util.tree_structure(ax) == jax.tree_util.tree_structure(m)
+
+
+def test_trainable_mask_state_fields():
+    class BN(Module):
+        _state_fields = ("mean", "var")
+
+        def __init__(self):
+            self.scale = jnp.ones((3,))
+            self.mean = jnp.zeros((3,))
+            self.var = jnp.ones((3,))
+
+    mask = trainable_mask(BN())
+    assert bool(mask.scale) is True
+    assert bool(mask.mean) is False and bool(mask.var) is False
+    assert jax.tree_util.tree_structure(mask) == jax.tree_util.tree_structure(BN())
+
+
+def test_named_parameters_and_count():
+    m = MLP(jax.random.key(0), 4)
+    names = dict(named_parameters(m))
+    assert any("fc1" in k and k.endswith("w") for k in names)
+    assert param_count(m) == 4 * 8 + 8 + 8 * 4 + 4 + 1
+
+
+def test_replace():
+    m = MLP(jax.random.key(0), 4)
+    m2 = m.replace(scale=jnp.zeros(()))
+    assert float(m2.scale) == 0.0 and float(m.scale) == 1.0
+
+
+def test_rng_reproducible():
+    from hetu_tpu.core import get_seed_status, reset_seed_seqnum, set_random_seed
+
+    set_random_seed(123)
+    k1 = next_key()
+    k2 = next_key()
+    seed, seq = get_seed_status()
+    assert seq == 2
+    reset_seed_seqnum(123, 0)
+    k1b = next_key()
+    np.testing.assert_array_equal(
+        jax.random.key_data(k1), jax.random.key_data(k1b)
+    )
+    assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))

@@ -1,0 +1,198 @@
+"""Op-level oracle tests vs numpy/scipy — the reference's kernel-test style
+(tests/test_gpu_op.py compares DLGpu kernels against numpy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hetu_tpu.ops as ops
+
+
+def assert_close(a, b, **kw):
+    # XLA:CPU vectorized transcendentals differ from numpy by ~1e-5 relative.
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5, **kw)
+
+
+def test_elementwise(rng):
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.standard_normal((4, 5)).astype(np.float32)
+    assert_close(ops.add(x, y), x + y)
+    assert_close(ops.mul(x, y), x * y)
+    assert_close(ops.tanh(x), np.tanh(x))
+    assert_close(ops.sigmoid(x), 1 / (1 + np.exp(-x)))
+    assert_close(ops.leaky_relu(x, 0.1), np.where(x > 0, x, 0.1 * x))
+    assert_close(ops.clamp(x, -0.5, 0.5), np.clip(x, -0.5, 0.5))
+    assert_close(ops.opposite(x), -x)
+
+
+def test_matmul_family(rng):
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+    assert_close(ops.matmul(a, b), a @ b)
+    assert_close(ops.matmul(a.T, b, trans_a=True), a @ b)
+    assert_close(ops.matmul(a, b.T, trans_b=True), a @ b)
+    bias = rng.standard_normal((3, 5)).astype(np.float32)
+    assert_close(ops.addmm(bias, a, b, alpha=2.0, beta=0.5), 0.5 * bias + 2.0 * (a @ b))
+    ab = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    bb = rng.standard_normal((2, 4, 5)).astype(np.float32)
+    assert_close(ops.batch_matmul(ab, bb), ab @ bb)
+    assert_close(ops.linear(a, b, np.zeros(5, np.float32)), a @ b)
+
+
+def test_conv_pool(rng):
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 4)).astype(np.float32)
+    y = ops.conv2d(x, w, stride=1, padding="VALID")
+    assert y.shape == (2, 6, 6, 4)
+    # oracle: direct loop conv on one output position
+    patch = x[:, 2:5, 3:6, :]
+    expect = np.einsum("nhwc,hwco->no", patch, w)
+    assert_close(y[:, 2, 3, :], expect)
+
+    mp = ops.max_pool2d(x, 2)
+    assert mp.shape == (2, 4, 4, 3)
+    assert_close(mp[0, 0, 0], x[0, :2, :2].max(axis=(0, 1)))
+    ap = ops.avg_pool2d(x, 2)
+    assert_close(ap[0, 0, 0], x[0, :2, :2].mean(axis=(0, 1)))
+
+
+def test_norms(rng):
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    scale = rng.standard_normal(6).astype(np.float32)
+    bias = rng.standard_normal(6).astype(np.float32)
+    y = ops.layer_norm(x, scale, bias)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    assert_close(y, (x - mu) / np.sqrt(var + 1e-5) * scale + bias, err_msg="layer_norm")
+
+    y, m, v = ops.batch_norm(
+        x, scale, bias, np.zeros(6, np.float32), np.ones(6, np.float32),
+        training=True,
+    )
+    bm, bv = x.mean(0), x.var(0)
+    assert_close(y, (x - bm) / np.sqrt(bv + 1e-5) * scale + bias, err_msg="batch_norm")
+    assert_close(m, 0.1 * bm)
+
+
+def test_losses(rng):
+    logits = rng.standard_normal((4, 7)).astype(np.float32)
+    labels = rng.integers(0, 7, size=(4,))
+    onehot = np.eye(7, dtype=np.float32)[labels]
+    dense = ops.softmax_cross_entropy(logits, onehot)
+    sparse = ops.softmax_cross_entropy_sparse(logits, jnp.asarray(labels))
+    # numpy oracle
+    z = logits - logits.max(-1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    assert_close(dense, -logp[np.arange(4), labels])
+    assert_close(sparse, dense)
+
+    t = (rng.random((4, 7)) > 0.5).astype(np.float32)
+    l64 = logits.astype(np.float64)
+    oracle = np.maximum(l64, 0) - l64 * t + np.log1p(np.exp(-np.abs(l64)))
+    np.testing.assert_allclose(
+        np.asarray(ops.binary_cross_entropy_with_logits(logits, t)), oracle,
+        rtol=1e-3, atol=1e-4, err_msg="bce_logits",
+    )
+    p64 = 1 / (1 + np.exp(-l64))
+    assert_close(
+        ops.binary_cross_entropy(jnp.asarray(p64.astype(np.float32)), t),
+        -(t * np.log(p64) + (1 - t) * np.log(1 - p64)),
+        err_msg="bce",
+    )
+
+
+def test_reductions_topk(rng):
+    x = rng.standard_normal((3, 10)).astype(np.float32)
+    assert_close(ops.reduce_sum(x, axes=1), x.sum(1))
+    assert_close(ops.reduce_norm(x, 2), np.linalg.norm(x))
+    assert_close(ops.cumsum(x, 1), np.cumsum(x, 1))
+    v, i = ops.topk(x, 3)
+    expect_i = np.argsort(-x, axis=1)[:, :3]
+    assert_close(v, np.take_along_axis(x, expect_i, 1))
+    assert np.array_equal(np.asarray(i), expect_i)
+
+
+def test_unique_indices():
+    x = jnp.asarray([3, 1, 3, 7, 1, 0])
+    uniq, inv = ops.unique_indices(x, size=6)
+    uniq = np.asarray(uniq)
+    inv = np.asarray(inv)
+    for j, xi in enumerate([3, 1, 3, 7, 1, 0]):
+        assert uniq[inv[j]] == xi
+
+
+def test_shape_ops(rng):
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    assert_close(ops.transpose(x), x.T)
+    assert_close(ops.pad(x, ((1, 1), (0, 0))), np.pad(x, ((1, 1), (0, 0))))
+    assert_close(ops.roll(x, 2, axis=1), np.roll(x, 2, axis=1))
+    idx = rng.integers(0, 4, size=(2,))
+    assert_close(ops.gather_rows(x, jnp.asarray(idx)), x[idx])
+    assert_close(
+        ops.masked_fill(x, x > 0, -1.0), np.where(x > 0, -1.0, x)
+    )
+    assert_close(ops.one_hot(jnp.asarray([0, 2]), 3), np.eye(3, dtype=np.float32)[[0, 2]])
+    y = ops.slice_assign(x, jnp.ones((2, 2), np.float32), (1, 1))
+    expect = x.copy()
+    expect[1:3, 1:3] = 1.0
+    assert_close(y, expect)
+    t = ops.tril_lookup(jnp.asarray(x[:4, :4]))
+    rows, cols = np.tril_indices(4)
+    assert_close(t, x[:4, :4][rows, cols])
+
+
+def test_indexed_slices_dedup():
+    s = ops.IndexedSlices(
+        jnp.asarray([2, 0, 2, 5]),
+        jnp.asarray([[1.0], [2.0], [3.0], [4.0]]),
+        dense_rows=6,
+    )
+    dense = np.zeros((6, 1), np.float32)
+    for i, v in zip([2, 0, 2, 5], [1.0, 2.0, 3.0, 4.0]):
+        dense[i] += v
+    assert_close(s.to_dense(), dense)
+    assert_close(s.dedup().to_dense(), dense)
+
+
+def test_csr(rng):
+    import scipy.sparse as sp
+
+    dense = sp.random(6, 5, density=0.4, random_state=0, dtype=np.float32)
+    csr = dense.tocsr()
+    m = ops.CSRMatrix(
+        jnp.asarray(csr.data),
+        jnp.asarray(csr.indices),
+        jnp.asarray(csr.indptr),
+        shape=(6, 5),
+    )
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    assert_close(ops.csr_matmul(m, x), csr @ x)
+    v = rng.standard_normal(5).astype(np.float32)
+    assert_close(ops.csr_matvec(m, v), csr @ v)
+
+
+def test_embedding(rng):
+    table = rng.standard_normal((10, 4)).astype(np.float32)
+    ids = jnp.asarray([[1, 3], [7, 1]])
+    out = ops.embedding_lookup(table, ids)
+    assert_close(out, table[np.asarray(ids)])
+    g = rng.standard_normal((2, 2, 4)).astype(np.float32)
+    s = ops.embedding_lookup_grad(g, ids, 10)
+    dense = np.zeros((10, 4), np.float32)
+    for i, gid in enumerate(np.asarray(ids).ravel()):
+        dense[gid] += g.reshape(-1, 4)[i]
+    assert_close(s.to_dense(), dense)
+
+
+def test_quantize_roundtrip(rng):
+    x = rng.uniform(-1, 1, (8, 8)).astype(np.float32)
+    q = ops.quantize(x, 8, scale=2.0 / 255, zero_point=-1.0)
+    back = ops.dequantize(q, 2.0 / 255, -1.0)
+    assert np.abs(np.asarray(back) - x).max() < 2.0 / 255
+
+
+def test_interpolate(rng):
+    x = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    y = ops.interpolate(x, (8, 8))
+    assert y.shape == (1, 8, 8, 2)

@@ -1,0 +1,220 @@
+"""Optimizer tests — oracle comparison vs optax (the reference compares its
+optimizer ops CPU-vs-GPU via HetuOptimizerTester, tests/tester.py:106; optax
+is our independent oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from hetu_tpu.optim import (
+    AdaGradOptimizer,
+    AdamOptimizer,
+    AdamWOptimizer,
+    LambOptimizer,
+    MomentumOptimizer,
+    SGDOptimizer,
+)
+from hetu_tpu.ops.sparse import IndexedSlices
+
+
+def make_tree(rng):
+    return {
+        "w": jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.standard_normal((3,)).astype(np.float32)),
+    }
+
+
+def run_ours(opt, params, grads, steps=3):
+    state = opt.init(params)
+    for _ in range(steps):
+        params, state = opt.update(grads, state, params)
+    return params
+
+
+def run_optax(tx, params, grads, steps=3):
+    state = tx.init(params)
+    for _ in range(steps):
+        upd, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, upd)
+    return params
+
+
+@pytest.mark.parametrize(
+    "ours,oracle",
+    [
+        (SGDOptimizer(0.1), optax.sgd(0.1)),
+        (MomentumOptimizer(0.1, momentum=0.9), optax.sgd(0.1, momentum=0.9)),
+        (
+            MomentumOptimizer(0.1, momentum=0.9, nesterov=True),
+            optax.sgd(0.1, momentum=0.9, nesterov=True),
+        ),
+        (
+            AdamOptimizer(1e-2, eps=1e-8),
+            optax.adam(1e-2, eps=1e-8, eps_root=0.0),
+        ),
+        (
+            AdamWOptimizer(1e-2, eps=1e-8, weight_decay=0.01),
+            optax.adamw(1e-2, eps=1e-8, weight_decay=0.01),
+        ),
+    ],
+)
+def test_vs_optax(rng, ours, oracle):
+    params = make_tree(rng)
+    grads = make_tree(rng)
+    p1 = run_ours(ours, params, grads)
+    p2 = run_optax(oracle, params, grads)
+    for k in params:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-5, atol=2e-6)
+
+
+def test_adagrad(rng):
+    params = make_tree(rng)
+    grads = make_tree(rng)
+    p1 = run_ours(AdaGradOptimizer(0.1, eps=1e-7), params, grads)
+    # numpy oracle
+    acc = {k: np.zeros_like(np.asarray(v)) for k, v in params.items()}
+    p2 = {k: np.asarray(v).copy() for k, v in params.items()}
+    for _ in range(3):
+        for k in p2:
+            g = np.asarray(grads[k])
+            acc[k] += g * g
+            p2[k] -= 0.1 * g / (np.sqrt(acc[k]) + 1e-7)
+    for k in params:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_runs(rng):
+    params = make_tree(rng)
+    grads = make_tree(rng)
+    p = run_ours(LambOptimizer(1e-2), params, grads, steps=2)
+    for k in params:
+        assert np.isfinite(np.asarray(p[k])).all()
+        assert not np.allclose(p[k], params[k])
+
+
+def test_sparse_adam_matches_dense_on_touched_rows(rng):
+    """Sparse update must equal dense update on touched rows and leave
+    untouched rows (params AND moments) alone — the reference's lazy sparse
+    Adam semantics (optimizer.py:553)."""
+    table = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+    rows = jnp.asarray([0, 4, 4])
+    vals = jnp.asarray(rng.standard_normal((3, 3)).astype(np.float32))
+
+    opt = AdamOptimizer(1e-2, eps=1e-8)
+    state = opt.init({"t": table})
+    p_sparse, state2 = opt.update(
+        {"t": IndexedSlices(rows, vals, 6)}, state, {"t": table}
+    )
+
+    # dense equivalent on rows {0, 4}
+    dense_grad = np.zeros((6, 3), np.float32)
+    for r, v in zip(np.asarray(rows), np.asarray(vals)):
+        dense_grad[r] += v
+    p_dense, _ = opt.update(
+        {"t": jnp.asarray(dense_grad)}, opt.init({"t": table}), {"t": table}
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_sparse["t"])[[0, 4]], np.asarray(p_dense["t"])[[0, 4]],
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_sparse["t"])[[1, 2, 3, 5]], np.asarray(table)[[1, 2, 3, 5]]
+    )
+    np.testing.assert_array_equal(np.asarray(state2["m"]["t"])[[1, 2, 3, 5]], 0.0)
+
+
+def test_sparse_adam_moments_accumulate(rng):
+    """Regression: slot state must advance on the sparse path across steps
+    (the first implementation returned the mutated dict, diffing to zero)."""
+    table = jnp.asarray(rng.standard_normal((4, 2)).astype(np.float32))
+    grad = IndexedSlices(jnp.asarray([1]), jnp.ones((1, 2)), 4)
+    opt = AdamOptimizer(1e-2, eps=1e-8)
+    params = {"t": table}
+    state = opt.init(params)
+    for expected_m in [0.1, 0.19]:
+        params, state = opt.update({"t": grad}, state, params)
+        np.testing.assert_allclose(
+            np.asarray(state["m"]["t"])[1], expected_m, rtol=1e-6
+        )
+    np.testing.assert_array_equal(np.asarray(state["m"]["t"])[[0, 2, 3]], 0.0)
+
+
+def test_dtype_stability_bf16():
+    """State pytree dtypes must not drift between init and update (scan/donation)."""
+    params = {"w": jnp.ones((3, 3), jnp.bfloat16)}
+    grads = {"w": jnp.ones((3, 3), jnp.bfloat16)}
+    for opt in [SGDOptimizer(0.1), MomentumOptimizer(0.1), AdamWOptimizer(1e-3)]:
+        state = opt.init(params)
+        p2, s2 = opt.update(grads, state, params)
+        assert p2["w"].dtype == jnp.bfloat16
+        d1 = jax.tree_util.tree_map(lambda x: x.dtype, state)
+        d2 = jax.tree_util.tree_map(lambda x: x.dtype, s2)
+        assert d1 == d2, (opt, d1, d2)
+
+
+def test_frozen_none_grads(rng):
+    params = make_tree(rng)
+    grads = {"w": jnp.ones_like(params["w"]), "b": None}
+    opt = AdamOptimizer(1e-2)
+    state = opt.init(params)
+    p2, _ = opt.update(grads, state, params)
+    assert not np.allclose(p2["w"], params["w"])
+    np.testing.assert_array_equal(p2["b"], params["b"])
+
+
+def test_sparse_l2reg(rng):
+    """l2reg must reach sparse rows (reference sparse optimizer kernels do)."""
+    table = jnp.asarray(rng.standard_normal((4, 2)).astype(np.float32))
+    zero_grad = IndexedSlices(jnp.asarray([1]), jnp.zeros((1, 2)), 4)
+    opt = SGDOptimizer(0.1, l2reg=0.5)
+    p2, _ = opt.update({"t": zero_grad}, opt.init({"t": table}), {"t": table})
+    np.testing.assert_allclose(
+        np.asarray(p2["t"])[1], np.asarray(table)[1] * (1 - 0.1 * 0.5), rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(p2["t"])[[0, 2, 3]], np.asarray(table)[[0, 2, 3]])
+
+
+def test_update_jits(rng):
+    params = make_tree(rng)
+    grads = make_tree(rng)
+    opt = AdamWOptimizer(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(g, s, p):
+        return opt.update(g, s, p)
+
+    p2, s2 = step(grads, state, params)
+    assert int(s2["step"]) == 1
+
+
+def test_schedulers():
+    from hetu_tpu.optim import (
+        ExponentialScheduler,
+        MultiStepScheduler,
+        ReduceOnPlateauScheduler,
+        StepScheduler,
+        WarmupCosineScheduler,
+        WarmupLinearScheduler,
+    )
+
+    s = StepScheduler(0.1, step_size=10, gamma=0.5)
+    assert float(s(0)) == 0.1 and float(s(10)) == 0.05
+    m = MultiStepScheduler(0.1, milestones=[5, 15], gamma=0.1)
+    np.testing.assert_allclose(float(m(0)), 0.1)
+    np.testing.assert_allclose(float(m(6)), 0.01)
+    np.testing.assert_allclose(float(m(20)), 0.001)
+    e = ExponentialScheduler(0.1, 0.9)
+    np.testing.assert_allclose(float(e(2)), 0.1 * 0.81)
+    w = WarmupLinearScheduler(1.0, 10, 110)
+    np.testing.assert_allclose(float(w(5)), 0.5)
+    np.testing.assert_allclose(float(w(110)), 0.0)
+    c = WarmupCosineScheduler(1.0, 10, 110)
+    np.testing.assert_allclose(float(c(60)), 0.5, atol=1e-6)
+    r = ReduceOnPlateauScheduler(1.0, patience=1, factor=0.1)
+    r.record(1.0)
+    r.record(1.0)
+    lr = r.record(1.0)
+    np.testing.assert_allclose(lr, 0.1)
