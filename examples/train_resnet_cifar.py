@@ -1,0 +1,74 @@
+"""ResNet-18 / CIFAR-10 single-device training — BASELINE config 1
+(reference: examples/cnn/scripts/hetu_1gpu.sh → examples/cnn/main.py).
+
+Runs on whatever jax backend is active (TPU chip, or CPU for smoke tests):
+    python examples/train_resnet_cifar.py --steps 100 --batch-size 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.data import Dataloader, cifar10
+from hetu_tpu.exec import Logger, Trainer
+from hetu_tpu.models import resnet18
+from hetu_tpu.optim import MomentumOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    ht.set_random_seed(args.seed)
+    x, y, xt, yt = cifar10()
+    dl = Dataloader({"x": x, "y": y}, args.batch_size, shuffle=True)
+
+    model = resnet18(num_classes=10)
+
+    def loss_fn(model, batch, key):
+        logits, new_model = model(batch["x"], training=True)
+        loss = softmax_cross_entropy_sparse(logits, batch["y"]).mean()
+        acc = (logits.argmax(-1) == batch["y"]).mean()
+        return loss, {"acc": acc, "model": new_model}
+
+    trainer = Trainer(model, MomentumOptimizer(args.lr, momentum=0.9), loss_fn)
+    logger = Logger(log_every=20)
+
+    it = iter(dl)
+    t0 = time.time()
+    n = 0
+    for step in range(args.steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(dl)
+            batch = next(it)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        m = trainer.step(batch)
+        logger.multi_log(m)
+        logger.step()
+        n += 1
+        if step == 4:  # exclude compile from throughput
+            jax.block_until_ready(trainer.state.model.fc.w)
+            t0, n = time.time(), 0
+    jax.block_until_ready(trainer.state.model.fc.w)
+    dt = time.time() - t0
+    print(f"steps/sec: {n / dt:.2f}  samples/sec: {n * args.batch_size / dt:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from hetu_tpu.data.dataloader import Dataloader
+from hetu_tpu.data.datasets import cifar10, mnist, synthetic_ctr, synthetic_lm

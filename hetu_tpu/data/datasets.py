@@ -1,0 +1,89 @@
+"""Dataset helpers (reference python/hetu/data.py MNIST/CIFAR loaders).
+
+Zero-egress image: loads from local files when present, otherwise generates
+deterministic synthetic data with the right shapes — benchmarks measure
+throughput, and correctness tests use oracle losses, so synthetic data is
+sufficient and hermetic.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["mnist", "cifar10", "synthetic_ctr", "synthetic_lm"]
+
+
+def _synth_images(n, shape, classes, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, *shape)).astype(np.float32)
+    # make labels learnable: class = argmax of per-class plane means
+    w = rng.standard_normal((int(np.prod(shape)), classes)).astype(np.float32)
+    y = (x.reshape(n, -1) @ w).argmax(-1).astype(np.int32)
+    return x, y
+
+
+def mnist(root: str = "datasets/mnist", n_synth: int = 10000):
+    """(train_x, train_y, test_x, test_y) NHWC float32 / int32."""
+    path = os.path.join(root, "mnist.npz")
+    if os.path.exists(path):
+        d = np.load(path)
+        return (
+            d["x_train"][..., None].astype(np.float32) / 255.0,
+            d["y_train"].astype(np.int32),
+            d["x_test"][..., None].astype(np.float32) / 255.0,
+            d["y_test"].astype(np.int32),
+        )
+    x, y = _synth_images(n_synth, (28, 28, 1), 10, seed=0)
+    xt, yt = _synth_images(n_synth // 5, (28, 28, 1), 10, seed=1)
+    return x, y, xt, yt
+
+
+def cifar10(root: str = "datasets/cifar10", n_synth: int = 10000):
+    """(train_x, train_y, test_x, test_y) NHWC float32 / int32."""
+    batch1 = os.path.join(root, "data_batch_1")
+    if os.path.exists(batch1):
+        xs, ys = [], []
+        for i in range(1, 6):
+            with open(os.path.join(root, f"data_batch_{i}"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.append(d[b"labels"])
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.concatenate(ys)
+        with open(os.path.join(root, "test_batch"), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xt = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        yt = np.asarray(d[b"labels"])
+        return (
+            x.astype(np.float32) / 255.0, y.astype(np.int32),
+            xt.astype(np.float32) / 255.0, yt.astype(np.int32),
+        )
+    x, y = _synth_images(n_synth, (32, 32, 3), 10, seed=0)
+    xt, yt = _synth_images(n_synth // 5, (32, 32, 3), 10, seed=1)
+    return x, y, xt, yt
+
+
+def synthetic_ctr(n: int = 100000, dense_dim: int = 13, sparse_fields: int = 26,
+                  vocab_per_field: int = 1000, seed: int = 0):
+    """Criteo-shaped CTR data (reference examples/ctr data layout):
+    dense float features, per-field categorical ids, binary click label."""
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal((n, dense_dim)).astype(np.float32)
+    sparse = rng.integers(0, vocab_per_field, size=(n, sparse_fields)).astype(np.int32)
+    # offset ids per field into one global id space (reference criteo handling)
+    sparse = sparse + np.arange(sparse_fields, dtype=np.int32) * vocab_per_field
+    logits = dense[:, 0] + 0.1 * ((sparse[:, 0] % 7) - 3)
+    y = (logits + 0.5 * rng.standard_normal(n) > 0).astype(np.float32)
+    return {"dense": dense, "sparse": sparse, "label": y}
+
+
+def synthetic_lm(n: int = 2048, seq_len: int = 128, vocab: int = 30522,
+                 seed: int = 0):
+    """Token sequences with enough structure for loss to fall."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(4, vocab, size=(n, seq_len)).astype(np.int32)
+    ids[:, ::4] = ids[:, 1::4] % vocab  # correlations to learn
+    return ids

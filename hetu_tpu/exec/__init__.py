@@ -1,0 +1,9 @@
+from hetu_tpu.exec.executor import Executor, Trainer, TrainState
+from hetu_tpu.exec.checkpoint import (
+    load_checkpoint,
+    load_state_dict,
+    save_checkpoint,
+    state_dict,
+)
+from hetu_tpu.exec.logger import Logger, WandbLogger
+from hetu_tpu.exec import metrics

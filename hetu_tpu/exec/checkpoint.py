@@ -1,0 +1,91 @@
+"""Checkpoint save/load.
+
+Reference: Executor.save/load (reference: gpu_ops/executor.py:568-670) —
+rank-0 pickles a ``{name: array}`` state dict plus RNG ``(seed, seqnum)``
+(executor.py:596-599; random.py:31); ``load_dict(consider_splits=True)``
+reshapes entries for re-sharded placeholders (executor.py:619-636).
+
+TPU-native version: pytrees of Modules round-trip directly (arrays are
+device_get'd to numpy); the state dict form keyed by dotted path supports
+loading into a differently-sharded/resized model (``consider_splits``).
+Optimizer state is saved too (the reference leaves it on PS servers; here
+there is no server — it is part of the functional state).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+
+from hetu_tpu.core import get_seed_status, reset_seed_seqnum
+from hetu_tpu.core.module import named_parameters
+
+__all__ = ["save_checkpoint", "load_checkpoint", "state_dict", "load_state_dict"]
+
+
+def _to_host(tree):
+    return jtu.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(path: str, state: Any, extra: Optional[dict] = None) -> None:
+    """Pickle a host copy of ``state`` plus the global RNG (seed, seqnum)."""
+    payload = {
+        "state": _to_host(state),
+        "rng": get_seed_status(),
+        "extra": extra or {},
+    }
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_checkpoint(path: str, restore_rng: bool = True):
+    """Returns (state, extra).  Restores the RNG stream by default so resumed
+    training replays the identical randomness (reference executor.py:653)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if restore_rng and "rng" in payload:
+        reset_seed_seqnum(*payload["rng"])
+    return payload["state"], payload.get("extra", {})
+
+
+def state_dict(tree: Any) -> dict:
+    """Flat {dotted.path: numpy array} — the reference's state_dict form."""
+    return {name: np.asarray(jax.device_get(x))
+            for name, x in named_parameters(tree)}
+
+
+def load_state_dict(tree: Any, sd: dict, *, consider_splits: bool = False):
+    """Load a flat state dict into a congruent pytree.
+
+    With ``consider_splits`` a saved entry larger than the model parameter is
+    sliced down to fit — the reference's re-sharded placeholder reload
+    (executor.py:619-636 PlaceholderOp.reshape_tensor).  A smaller entry is
+    an error either way.
+    """
+    leaves, treedef = jtu.tree_flatten_with_path(tree)
+    new_leaves = []
+    for path, leaf in leaves:
+        name = ".".join(
+            str(getattr(k, "name", getattr(k, "idx", getattr(k, "key", k))))
+            for k in path
+        )
+        if name not in sd:
+            new_leaves.append(leaf)
+            continue
+        val = sd[name]
+        if hasattr(leaf, "shape") and tuple(val.shape) != tuple(leaf.shape):
+            if not consider_splits or any(
+                v < s for v, s in zip(val.shape, leaf.shape)
+            ) or len(val.shape) != len(leaf.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: ckpt {val.shape} vs model {leaf.shape}"
+                )
+            val = val[tuple(slice(0, s) for s in leaf.shape)]
+        new_leaves.append(
+            val.astype(leaf.dtype) if hasattr(leaf, "dtype") else val
+        )
+    return jtu.tree_unflatten(jtu.tree_structure(tree), new_leaves)

@@ -1,0 +1,137 @@
+"""Executor / Trainer — the user-facing run loop.
+
+The reference's ``Executor`` (reference: python/hetu/gpu_ops/executor.py:430)
+owns named subgraphs ({'train': ..., 'validate': ...}), a ``run(feed_dict)``
+loop that walks a topo order calling kernels, manual stream/event overlap, a
+memory planner, and checkpoint save/load.  Under XLA the topo walk, memory
+plan, and stream overlap are the compiler's job, so the TPU-native executor
+is thin: it jits step functions, carries a functional ``TrainState``, applies
+the sharding strategy (hetu_tpu/parallel), and keeps API parity with
+``run('train', feed_dict)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module, trainable_mask
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.optim.optimizers import Optimizer
+
+__all__ = ["TrainState", "Trainer", "Executor"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    model: Any
+    opt_state: Any
+
+    @property
+    def step(self):
+        return self.opt_state["step"]
+
+
+class Trainer:
+    """Builds and jits the train/eval step.
+
+    ``loss_fn(model, batch, key) -> (loss, aux)`` where ``aux`` is a dict of
+    scalars; if the model carries functional state (BatchNorm), ``aux`` may
+    include the updated model under the reserved key ``"model"`` (it is
+    extracted, not treated as a metric).
+    """
+
+    def __init__(self, model: Module, optimizer: Optimizer,
+                 loss_fn: Callable, *, strategy=None, donate: bool = True):
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.strategy = strategy
+        self._state = TrainState(model, optimizer.init(model))
+        # Non-trainable state (BatchNorm statistics) must not see weight decay
+        # or moment updates; the mask is static model structure, closed over.
+        param_mask = trainable_mask(model)
+
+        def train_step(state: TrainState, batch, key):
+            def wrapped(model):
+                loss, aux = loss_fn(model, batch, key)
+                new_model = aux.pop("model", None)
+                return loss, (aux, new_model)
+
+            (loss, (aux, new_model)), grads = jax.value_and_grad(
+                wrapped, has_aux=True
+            )(state.model)
+            base = new_model if new_model is not None else state.model
+            params, opt_state = optimizer.update(
+                grads, state.opt_state, base, mask=param_mask
+            )
+            metrics = {"loss": loss, **aux}
+            return TrainState(params, opt_state), metrics
+
+        def eval_step(state: TrainState, batch):
+            loss, aux = loss_fn(state.model, batch, None)
+            aux.pop("model", None)
+            return {"loss": loss, **aux}
+
+        if strategy is not None:
+            train_step, eval_step, self._state = strategy.install(
+                train_step, eval_step, self._state
+            )
+        else:
+            donate_args = (0,) if donate else ()
+            train_step = jax.jit(train_step, donate_argnums=donate_args)
+            eval_step = jax.jit(eval_step)
+        self._train_step = train_step
+        self._eval_step = eval_step
+
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    @state.setter
+    def state(self, s: TrainState):
+        self._state = s
+
+    @property
+    def model(self):
+        return self._state.model
+
+    def step(self, batch, key=None) -> dict:
+        if key is None:
+            key = next_key()
+        self._state, metrics = self._train_step(self._state, batch, key)
+        return metrics
+
+    def evaluate(self, batch) -> dict:
+        return self._eval_step(self._state, batch)
+
+
+class Executor:
+    """Named-subgraph facade for reference API parity (executor.py:430).
+
+    ``Executor({'train': trainer.step, 'validate': trainer.evaluate}})`` —
+    or construct from a Trainer directly: ``Executor.from_trainer(trainer)``.
+    ``run(name, feed_dict)`` invokes the named step with the feeds.
+    """
+
+    def __init__(self, subgraphs: dict, logger=None):
+        self.subgraphs = dict(subgraphs)
+        self.logger = logger
+
+    @classmethod
+    def from_trainer(cls, trainer: Trainer, logger=None) -> "Executor":
+        return cls({"train": trainer.step, "validate": trainer.evaluate},
+                   logger=logger)
+
+    def run(self, name: str, feed_dict=None, **kw):
+        fn = self.subgraphs[name]
+        out = fn(feed_dict, **kw) if feed_dict is not None else fn(**kw)
+        if self.logger is not None and isinstance(out, dict):
+            for k, v in out.items():
+                self.logger.log(k, v)
+            self.logger.step()
+        return out

@@ -1,0 +1,81 @@
+"""Scalar logging.
+
+Reference: python/hetu/logger.py — ``HetuLogger:28`` buffers scalars and
+flushes per step; ``dist_log`` NCCL-reduces a scalar across ranks before
+logging; ``WandbLogger:90`` is the wandb backend.  TPU-native: cross-device
+reduction happens inside the jitted step (psum/pmean), so the logger only
+needs host-side buffering; a process-0 gate covers multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Logger", "WandbLogger"]
+
+
+class Logger:
+    def __init__(self, log_every: int = 1, file=None, is_main: Optional[bool] = None):
+        self.log_every = log_every
+        self.file = file or sys.stderr
+        self.buffer: dict = {}
+        self._step = 0
+        self.is_main = (
+            is_main if is_main is not None else jax.process_index() == 0
+        )
+        self._t0 = time.time()
+
+    def log(self, key: str, value) -> None:
+        self.buffer.setdefault(key, []).append(float(np.asarray(value)))
+
+    def multi_log(self, scalars: dict) -> None:
+        for k, v in scalars.items():
+            self.log(k, v)
+
+    def step(self) -> None:
+        self._step += 1
+        if self._step % self.log_every == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self.buffer or not self.is_main:
+            self.buffer.clear()
+            return
+        means = {k: float(np.mean(v)) for k, v in self.buffer.items()}
+        line = {"step": self._step, "t": round(time.time() - self._t0, 2), **means}
+        print(json.dumps(line), file=self.file, flush=True)
+        self.buffer.clear()
+
+
+class WandbLogger(Logger):
+    """wandb backend (reference logger.py:90); degrades to Logger if wandb
+    is unavailable (this image has no wandb and zero egress)."""
+
+    def __init__(self, project: str = "hetu-tpu", config: Optional[dict] = None,
+                 **kw):
+        super().__init__(**kw)
+        self._wandb = None
+        if self.is_main:
+            try:
+                import wandb  # noqa: PLC0415
+
+                self._wandb = wandb
+                wandb.init(project=project, config=config or {})
+            except Exception:
+                self._wandb = None
+
+    def flush(self) -> None:
+        if self._wandb is not None and self.buffer and self.is_main:
+            self._wandb.log(
+                {k: float(np.mean(v)) for k, v in self.buffer.items()},
+                step=self._step,
+            )
+            self.buffer.clear()
+        else:
+            super().flush()

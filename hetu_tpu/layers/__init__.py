@@ -1,0 +1,13 @@
+from hetu_tpu.layers.base import Identity, Lambda, Sequential
+from hetu_tpu.layers.linear import Embedding, Linear
+from hetu_tpu.layers.conv import AvgPool2d, Conv2d, Flatten, MaxPool2d
+from hetu_tpu.layers.norm import (
+    BatchNorm2d,
+    Dropout,
+    GroupNorm,
+    InstanceNorm2d,
+    LayerNorm,
+    RMSNorm,
+)
+from hetu_tpu.layers.attention import MultiHeadAttention, dot_product_attention
+from hetu_tpu.layers.transformer import TransformerBlock, TransformerMLP

@@ -1,0 +1,85 @@
+"""Multi-head attention.
+
+Reference: python/hetu/layers/attention.py:5 (an OpLayer composing matmul/
+softmax ops; materialized QK^T).  TPU-native design: einsum formulation with
+head axes annotated for tensor parallelism ('heads' logical axis → 'tp' mesh
+axis under the Megatron preset), fp32 softmax statistics, and a pluggable
+attention core so the Pallas flash-attention kernel (ops/pallas/flash.py) or
+ring attention (parallel/ring_attention.py) can replace the reference
+materialized path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import xavier_uniform, zeros
+from hetu_tpu.ops import dropout as dropout_op
+
+__all__ = ["MultiHeadAttention", "dot_product_attention"]
+
+
+def dot_product_attention(q, k, v, mask=None, *, scale: float | None = None,
+                          causal: bool = False):
+    """Reference attention core: softmax(QK^T/sqrt(d))V, fp32 statistics.
+
+    q,k,v: (batch, seq, heads, head_dim).  mask: broadcastable to
+    (batch, heads, q_seq, kv_seq), True/1 = attend.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(Module):
+    """MHA with fused qkv projection (reference layers/attention.py:5)."""
+
+    def __init__(self, dim: int, num_heads: int, *, bias: bool = True,
+                 causal: bool = False, dropout_rate: float = 0.0,
+                 attn_fn: Optional[Callable] = None, dtype=jnp.float32):
+        assert dim % num_heads == 0
+        init = xavier_uniform()
+        self.wqkv = init(next_key(), (dim, 3 * dim), dtype)
+        self.wqkv_axes = ("embed", "qkv_three_heads")
+        self.bqkv = zeros(None, (3 * dim,), dtype) if bias else None
+        self.bqkv_axes = ("qkv_three_heads",)
+        self.wo = init(next_key(), (dim, dim), dtype)
+        self.wo_axes = ("heads_merged", "embed")
+        self.bo = zeros(None, (dim,), dtype) if bias else None
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.causal = causal
+        self.dropout_rate = dropout_rate
+        self.attn_fn = attn_fn  # static; None -> dot_product_attention
+
+    def __call__(self, x, mask=None, *, key=None, training: bool = False):
+        b, s, d = x.shape
+        qkv = x @ self.wqkv.astype(x.dtype)
+        if self.bqkv is not None:
+            qkv = qkv + self.bqkv.astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        attn = self.attn_fn or dot_product_attention
+        out = attn(q, k, v, mask, causal=self.causal)
+        out = out.reshape(b, s, d)
+        if training and self.dropout_rate > 0.0 and key is not None:
+            out = dropout_op(out, self.dropout_rate, key, training=True)
+        y = out @ self.wo.astype(x.dtype)
+        if self.bo is not None:
+            y = y + self.bo.astype(x.dtype)
+        return y

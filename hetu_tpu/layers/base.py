@@ -1,0 +1,64 @@
+"""Layer base utilities.
+
+TPU-native equivalent of the reference layer sugar
+(reference: python/hetu/layers/base.py:15 OpLayer grouping, sequence.py
+Sequential).  Layers are just Modules; ``Sequential`` composes them.
+"""
+
+from __future__ import annotations
+
+from hetu_tpu.core.module import Module
+
+__all__ = ["Sequential", "Identity", "Lambda"]
+
+
+class Sequential(Module):
+    """Composition of layers (reference layers/sequence.py)."""
+
+    def __init__(self, *layers):
+        self.layers = list(layers)
+
+    def __call__(self, x, **kw):
+        for layer in self.layers:
+            x = layer(x, **kw) if _wants_kwargs(layer) else layer(x)
+        return x
+
+    def __getitem__(self, i):
+        return self.layers[i]
+
+    def __len__(self):
+        return len(self.layers)
+
+
+def _wants_kwargs(layer) -> bool:
+    call = getattr(type(layer), "__call__", None)
+    if call is None:
+        return False
+    import inspect
+
+    try:
+        sig = inspect.signature(call)
+    except (TypeError, ValueError):
+        return False
+    return any(
+        p.kind is inspect.Parameter.VAR_KEYWORD or p.kind is inspect.Parameter.KEYWORD_ONLY
+        for p in sig.parameters.values()
+    )
+
+
+class Identity(Module):
+    def __init__(self):
+        self._noop = ()
+
+    def __call__(self, x):
+        return x
+
+
+class Lambda(Module):
+    """Wrap a pure function as a layer (static attribute, not traced)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)

@@ -1,0 +1,65 @@
+"""Convolution and pooling layers (reference layers/conv.py, pooling.py).
+
+NHWC activations, HWIO kernels (TPU-preferred; see ops/nn.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import he_normal, zeros
+from hetu_tpu.ops import avg_pool2d, conv2d, max_pool2d
+
+__all__ = ["Conv2d", "MaxPool2d", "AvgPool2d", "Flatten"]
+
+
+class Conv2d(Module):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int = 3,
+                 stride: int = 1, padding="SAME", bias: bool = True,
+                 groups: int = 1, initializer=None, dtype=jnp.float32):
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        init = initializer or he_normal()
+        self.w = init(next_key(), (*k, in_channels // groups, out_channels), dtype)
+        self.w_axes = (None, None, "conv_in", "conv_out")
+        self.b = zeros(None, (out_channels,), dtype) if bias else None
+        self.b_axes = ("conv_out",)
+        self.stride = stride
+        self.padding = padding
+        self.groups = groups
+
+    def __call__(self, x):
+        y = conv2d(x, self.w.astype(x.dtype), stride=self.stride,
+                   padding=self.padding, groups=self.groups)
+        if self.b is not None:
+            y = y + self.b.astype(y.dtype)
+        return y
+
+
+class MaxPool2d(Module):
+    def __init__(self, window: int = 2, stride=None, padding="VALID"):
+        self.window = window
+        self.stride = stride
+        self.pad = padding
+
+    def __call__(self, x):
+        return max_pool2d(x, self.window, self.stride, self.pad)
+
+
+class AvgPool2d(Module):
+    def __init__(self, window: int = 2, stride=None, padding="VALID"):
+        self.window = window
+        self.stride = stride
+        self.pad = padding
+
+    def __call__(self, x):
+        return avg_pool2d(x, self.window, self.stride, self.pad)
+
+
+class Flatten(Module):
+    def __init__(self):
+        self._noop = ()
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)

@@ -1,0 +1,55 @@
+"""Linear and embedding layers.
+
+Reference: python/hetu/layers/linear.py, layers/embedding.py:5.
+Logical sharding axes: Linear weights are ('in','out') so the strategy layer
+(parallel/spec.py) can emit Megatron column/row-parallel placements; Embedding
+tables are ('vocab','embed').
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import he_uniform, normal, zeros
+from hetu_tpu.ops import embedding_lookup, linear
+
+__all__ = ["Linear", "Embedding"]
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 initializer=None, dtype=jnp.float32,
+                 axes: tuple = ("in", "out")):
+        init = initializer or he_uniform()
+        self.w = init(next_key(), (in_features, out_features), dtype)
+        self.w_axes = axes
+        self.b = zeros(None, (out_features,), dtype) if bias else None
+        self.b_axes = (axes[1],)
+        self.in_features = in_features
+        self.out_features = out_features
+
+    def __call__(self, x):
+        return linear(x, self.w.astype(x.dtype),
+                      None if self.b is None else self.b.astype(x.dtype))
+
+
+class Embedding(Module):
+    """Dense on-device embedding (reference layers/embedding.py:5).
+
+    The host-cached parameter-server variant (HET) is
+    ``hetu_tpu.embed.CachedEmbedding``.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 initializer=None, dtype=jnp.float32,
+                 axes: tuple = ("vocab", "embed")):
+        init = initializer or normal(stddev=0.02)
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = axes
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        return embedding_lookup(self.weight, ids)

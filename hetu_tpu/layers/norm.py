@@ -1,0 +1,94 @@
+"""Normalization and dropout layers (reference layers/normalization.py,
+layers/dropout.py).
+
+``BatchNorm2d`` is functional: in training mode ``__call__`` returns
+``(y, new_layer)`` carrying updated running statistics — the TPU-native
+replacement for the reference's in-place stat updates (src/ops/CudnnBn.cu).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import ones, zeros
+from hetu_tpu.ops import batch_norm, dropout, group_norm, instance_norm2d, layer_norm, rms_norm
+
+__all__ = ["LayerNorm", "RMSNorm", "BatchNorm2d", "InstanceNorm2d", "GroupNorm", "Dropout"]
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-5, dtype=jnp.float32):
+        self.scale = ones(None, (dim,), dtype)
+        self.scale_axes = ("embed",)
+        self.bias = zeros(None, (dim,), dtype)
+        self.bias_axes = ("embed",)
+        self.eps = eps
+
+    def __call__(self, x):
+        return layer_norm(x, self.scale, self.bias, eps=self.eps)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-6, dtype=jnp.float32):
+        self.scale = ones(None, (dim,), dtype)
+        self.scale_axes = ("embed",)
+        self.eps = eps
+
+    def __call__(self, x):
+        return rms_norm(x, self.scale, eps=self.eps)
+
+
+class BatchNorm2d(Module):
+    _state_fields = ("running_mean", "running_var")
+
+    def __init__(self, channels: int, momentum: float = 0.9, eps: float = 1e-5,
+                 dtype=jnp.float32):
+        self.scale = ones(None, (channels,), dtype)
+        self.bias = zeros(None, (channels,), dtype)
+        self.running_mean = zeros(None, (channels,), dtype)
+        self.running_var = ones(None, (channels,), dtype)
+        self.momentum = momentum
+        self.eps = eps
+
+    def __call__(self, x, *, training: bool = False):
+        y, mean, var = batch_norm(
+            x, self.scale, self.bias, self.running_mean, self.running_var,
+            training=training, momentum=self.momentum, eps=self.eps,
+        )
+        if training:
+            return y, self.replace(running_mean=mean, running_var=var)
+        return y, self
+
+
+class InstanceNorm2d(Module):
+    def __init__(self, eps: float = 1e-7):
+        self.eps = eps
+        self._noop = ()
+
+    def __call__(self, x):
+        return instance_norm2d(x, self.eps)
+
+
+class GroupNorm(Module):
+    def __init__(self, groups: int, channels: int, eps: float = 1e-5,
+                 dtype=jnp.float32):
+        self.scale = ones(None, (channels,), dtype)
+        self.bias = zeros(None, (channels,), dtype)
+        self.groups = groups
+        self.eps = eps
+
+    def __call__(self, x):
+        return group_norm(x, self.scale, self.bias, groups=self.groups, eps=self.eps)
+
+
+class Dropout(Module):
+    def __init__(self, rate: float = 0.5):
+        self.rate = rate
+        self._noop = ()
+
+    def __call__(self, x, *, key=None, training: bool = False):
+        if not training or self.rate == 0.0 or key is None:
+            return x
+        return dropout(x, self.rate, key, training=True)

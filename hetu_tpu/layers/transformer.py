@@ -1,0 +1,78 @@
+"""Transformer blocks (the building material for BERT/GPT/MoE models —
+reference examples/nlp/bert/hetu_bert.py layer structure, re-designed
+TPU-first: pre/post-LN options, bf16 compute with fp32 norms, logical axes
+for Megatron TP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import normal, zeros
+from hetu_tpu.layers.attention import MultiHeadAttention
+from hetu_tpu.layers.norm import LayerNorm
+from hetu_tpu.ops import dropout as dropout_op
+from hetu_tpu.ops import gelu
+
+__all__ = ["TransformerMLP", "TransformerBlock"]
+
+
+class TransformerMLP(Module):
+    """2-layer gelu MLP; weights annotated ('embed','mlp')/('mlp','embed')
+    for Megatron column→row parallel placement."""
+
+    def __init__(self, dim: int, hidden: int, *, dtype=jnp.float32,
+                 init_std: float = 0.02):
+        init = normal(stddev=init_std)
+        self.w_in = init(next_key(), (dim, hidden), dtype)
+        self.w_in_axes = ("embed", "mlp")
+        self.b_in = zeros(None, (hidden,), dtype)
+        self.b_in_axes = ("mlp",)
+        self.w_out = init(next_key(), (hidden, dim), dtype)
+        self.w_out_axes = ("mlp", "embed")
+        self.b_out = zeros(None, (dim,), dtype)
+
+    def __call__(self, x):
+        h = gelu(x @ self.w_in.astype(x.dtype) + self.b_in.astype(x.dtype))
+        return h @ self.w_out.astype(x.dtype) + self.b_out.astype(x.dtype)
+
+
+class TransformerBlock(Module):
+    """Attention + MLP with residuals.  ``post_ln=True`` gives the original
+    BERT ordering (reference hetu_bert.py); default pre-LN trains stably at
+    scale."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: int = 4, *,
+                 causal: bool = False, post_ln: bool = False,
+                 dropout_rate: float = 0.0, attn_fn=None, dtype=jnp.float32):
+        self.ln1 = LayerNorm(dim)
+        self.attn = MultiHeadAttention(
+            dim, num_heads, causal=causal, dropout_rate=dropout_rate,
+            attn_fn=attn_fn, dtype=dtype,
+        )
+        self.ln2 = LayerNorm(dim)
+        self.mlp = TransformerMLP(dim, mlp_ratio * dim, dtype=dtype)
+        self.post_ln = post_ln
+        self.dropout_rate = dropout_rate
+
+    def __call__(self, x, mask=None, *, key=None, training: bool = False):
+        ka = k1 = k2 = None
+        if key is not None:
+            ka, k1, k2 = jax.random.split(key, 3)
+        if self.post_ln:
+            x = self.ln1(x + self._drop(self.attn(x, mask, key=ka, training=training), k1, training))
+            x = self.ln2(x + self._drop(self.mlp(x), k2, training))
+        else:
+            x = x + self._drop(self.attn(self.ln1(x), mask, key=ka, training=training), k1, training)
+            x = x + self._drop(self.mlp(self.ln2(x)), k2, training)
+        return x
+
+    def _drop(self, x, key, training):
+        if training and self.dropout_rate > 0.0 and key is not None:
+            return dropout_op(x, self.dropout_rate, key, training=True)
+        return x

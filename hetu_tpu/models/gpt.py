@@ -1,0 +1,90 @@
+"""GPT decoder-only LM (reference examples/auto_parallel GPT configs;
+Galvatron's GPT target).  Pre-LN causal transformer with tied output head
+option — the model family used by the auto-parallel searcher benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.init import normal
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.layers import Embedding, LayerNorm, TransformerBlock
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+__all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium", "gpt2_large"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    dropout_rate: float = 0.0
+    initializer_range: float = 0.02
+    tie_embeddings: bool = True
+    dtype: object = jnp.float32
+
+
+def gpt2_small(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt2_medium(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt2_large(**kw):
+    return GPTConfig(hidden_size=1280, num_layers=36, num_heads=20, **kw)
+
+
+class GPT(Module):
+    def __init__(self, cfg: GPTConfig, attn_fn=None):
+        init = normal(stddev=cfg.initializer_range)
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size, initializer=init,
+                             dtype=cfg.dtype)
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size, initializer=init,
+                             dtype=cfg.dtype, axes=(None, "embed"))
+        self.blocks = [
+            TransformerBlock(cfg.hidden_size, cfg.num_heads, 4, causal=True,
+                             dropout_rate=cfg.dropout_rate, attn_fn=attn_fn,
+                             dtype=cfg.dtype)
+            for _ in range(cfg.num_layers)
+        ]
+        self.ln_f = LayerNorm(cfg.hidden_size)
+        self.lm_head = (
+            None if cfg.tie_embeddings
+            else init(next_key(), (cfg.hidden_size, cfg.vocab_size), cfg.dtype)
+        )
+        self.lm_head_axes = ("embed", "vocab")
+        self.config = cfg
+
+    def __call__(self, input_ids, *, key=None, training: bool = False,
+                 compute_dtype=None):
+        s = input_ids.shape[-1]
+        x = self.wte(input_ids) + self.wpe(jnp.arange(s))
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        keys = (
+            jax.random.split(key, len(self.blocks)) if key is not None
+            else [None] * len(self.blocks)
+        )
+        for blk, k in zip(self.blocks, keys):
+            x = blk(x, key=k, training=training)
+        x = self.ln_f(x)
+        head = self.wte.weight.T if self.lm_head is None else self.lm_head
+        return x @ head.astype(x.dtype)
+
+    def loss(self, input_ids, *, key=None, training: bool = True,
+             compute_dtype=None):
+        """Next-token cross entropy."""
+        logits = self(input_ids, key=key, training=training,
+                      compute_dtype=compute_dtype)
+        nll = softmax_cross_entropy_sparse(logits[:, :-1], input_ids[:, 1:])
+        return nll.mean()

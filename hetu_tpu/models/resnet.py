@@ -1,0 +1,92 @@
+"""ResNet for CIFAR/ImageNet — BASELINE config 1 (ResNet-18/CIFAR-10).
+
+Reference: examples/cnn/models/hetu/resnet.py (ResNet-18/34 via its op graph).
+TPU-native design: NHWC layout, functional BatchNorm threading (training
+forward returns (logits, updated_model) carrying new running stats — XLA
+keeps everything fused; there is no in-place state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.layers import AvgPool2d, BatchNorm2d, Conv2d, Flatten, Linear, MaxPool2d
+from hetu_tpu.ops import relu
+
+__all__ = ["ResNet", "resnet18", "resnet34", "BasicBlock"]
+
+
+class BasicBlock(Module):
+    expansion = 1
+
+    def __init__(self, in_ch: int, out_ch: int, stride: int = 1):
+        self.conv1 = Conv2d(in_ch, out_ch, 3, stride=stride, padding=1, bias=False)
+        self.bn1 = BatchNorm2d(out_ch)
+        self.conv2 = Conv2d(out_ch, out_ch, 3, stride=1, padding=1, bias=False)
+        self.bn2 = BatchNorm2d(out_ch)
+        if stride != 1 or in_ch != out_ch:
+            self.shortcut_conv = Conv2d(in_ch, out_ch, 1, stride=stride,
+                                        padding=0, bias=False)
+            self.shortcut_bn = BatchNorm2d(out_ch)
+        else:
+            self.shortcut_conv = None
+            self.shortcut_bn = None
+
+    def __call__(self, x, *, training: bool = False):
+        y, bn1 = self.bn1(self.conv1(x), training=training)
+        y = relu(y)
+        y, bn2 = self.bn2(self.conv2(y), training=training)
+        if self.shortcut_conv is not None:
+            sc, sbn = self.shortcut_bn(self.shortcut_conv(x), training=training)
+        else:
+            sc, sbn = x, self.shortcut_bn
+        new = self.replace(bn1=bn1, bn2=bn2, shortcut_bn=sbn)
+        return relu(y + sc), new
+
+
+class ResNet(Module):
+    def __init__(self, layers_per_stage, num_classes: int = 10,
+                 cifar_stem: bool = True):
+        self.stem_conv = Conv2d(3, 64, 3 if cifar_stem else 7,
+                                stride=1 if cifar_stem else 2,
+                                padding=1 if cifar_stem else 3, bias=False)
+        self.stem_bn = BatchNorm2d(64)
+        self.stem_pool = None if cifar_stem else MaxPool2d(3, 2, padding=1)
+        stages = []
+        in_ch = 64
+        for i, n in enumerate(layers_per_stage):
+            out_ch = 64 * (2**i)
+            blocks = []
+            for j in range(n):
+                stride = 2 if (j == 0 and i > 0) else 1
+                blocks.append(BasicBlock(in_ch, out_ch, stride))
+                in_ch = out_ch
+            stages.append(blocks)
+        self.stages = stages
+        self.flatten = Flatten()
+        self.fc = Linear(in_ch, num_classes)
+
+    def __call__(self, x, *, training: bool = False):
+        y, stem_bn = self.stem_bn(self.stem_conv(x), training=training)
+        y = relu(y)
+        if self.stem_pool is not None:
+            y = self.stem_pool(y)
+        new_stages = []
+        for blocks in self.stages:
+            new_blocks = []
+            for blk in blocks:
+                y, nb = blk(y, training=training)
+                new_blocks.append(nb)
+            new_stages.append(new_blocks)
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        logits = self.fc(y)
+        return logits, self.replace(stem_bn=stem_bn, stages=new_stages)
+
+
+def resnet18(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet([2, 2, 2, 2], num_classes, cifar_stem)
+
+
+def resnet34(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, cifar_stem)

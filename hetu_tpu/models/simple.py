@@ -1,0 +1,92 @@
+"""Small reference-parity models: MLP, LeNet, VGG-style CNN, logistic
+regression (reference examples/cnn/models/hetu/{mlp,lenet,vgg,logreg}.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.layers import (
+    AvgPool2d,
+    Conv2d,
+    Flatten,
+    Linear,
+    MaxPool2d,
+    Sequential,
+)
+from hetu_tpu.layers.base import Lambda
+from hetu_tpu.ops import relu
+
+__all__ = ["MLP", "LeNet", "VGGBlock", "vgg16", "LogReg"]
+
+
+class MLP(Module):
+    def __init__(self, sizes=(784, 256, 128, 10)):
+        self.layers = [Linear(a, b) for a, b in zip(sizes[:-1], sizes[1:])]
+
+    def __call__(self, x):
+        for i, l in enumerate(self.layers):
+            x = l(x)
+            if i < len(self.layers) - 1:
+                x = relu(x)
+        return x
+
+
+class LeNet(Module):
+    """LeNet-5 over NHWC (reference examples/cnn/models/hetu/lenet.py)."""
+
+    def __init__(self, num_classes: int = 10, in_ch: int = 1):
+        self.c1 = Conv2d(in_ch, 6, 5, padding=2)
+        self.p1 = AvgPool2d(2)
+        self.c2 = Conv2d(6, 16, 5, padding=0)
+        self.p2 = AvgPool2d(2)
+        self.flat = Flatten()
+        self.f1 = Linear(16 * 5 * 5, 120)
+        self.f2 = Linear(120, 84)
+        self.f3 = Linear(84, num_classes)
+
+    def __call__(self, x):
+        x = self.p1(relu(self.c1(x)))
+        x = self.p2(relu(self.c2(x)))
+        x = self.flat(x)
+        x = relu(self.f1(x))
+        x = relu(self.f2(x))
+        return self.f3(x)
+
+
+class VGGBlock(Module):
+    def __init__(self, in_ch: int, out_ch: int, n: int):
+        convs = []
+        for i in range(n):
+            convs.append(Conv2d(in_ch if i == 0 else out_ch, out_ch, 3, padding=1))
+        self.convs = convs
+        self.pool = MaxPool2d(2)
+
+    def __call__(self, x):
+        for c in self.convs:
+            x = relu(c(x))
+        return self.pool(x)
+
+
+def vgg16(num_classes: int = 10) -> Sequential:
+    """VGG-16 for 32x32 inputs (reference examples/cnn/models/hetu/vgg.py)."""
+    return Sequential(
+        VGGBlock(3, 64, 2),
+        VGGBlock(64, 128, 2),
+        VGGBlock(128, 256, 3),
+        VGGBlock(256, 512, 3),
+        VGGBlock(512, 512, 3),
+        Flatten(),
+        Linear(512, 4096), Lambda(relu),
+        Linear(4096, 4096), Lambda(relu),
+        Linear(4096, num_classes),
+    )
+
+
+class LogReg(Module):
+    def __init__(self, in_dim: int = 784, num_classes: int = 10):
+        self.fc = Linear(in_dim, num_classes)
+
+    def __call__(self, x):
+        return self.fc(x)

@@ -45,10 +45,15 @@ def softmax_cross_entropy(logits, labels, axis: int = -1):
     return -jnp.sum(_f32(labels) * logp, axis=axis)
 
 
+def _select_along(logp, label_ids, axis):
+    idx = jnp.expand_dims(label_ids, axis)
+    return jnp.squeeze(jnp.take_along_axis(logp, idx, axis=axis), axis=axis)
+
+
 def softmax_cross_entropy_sparse(logits, label_ids, axis: int = -1, ignore_index: int | None = None):
     """Fused softmax+CE against integer labels (src/ops/SoftmaxCrossEntropySparse.cu)."""
     logp = jax.nn.log_softmax(_f32(logits), axis=axis)
-    nll = -jnp.take_along_axis(logp, label_ids[..., None], axis=axis)[..., 0]
+    nll = -_select_along(logp, label_ids, axis)
     if ignore_index is not None:
         nll = jnp.where(label_ids == ignore_index, 0.0, nll)
     return nll
@@ -61,13 +66,13 @@ def cross_entropy(pred_probs, labels, axis: int = -1, eps: float = 1e-12):
 
 def cross_entropy_sparse(pred_probs, label_ids, axis: int = -1, eps: float = 1e-12):
     """CE on probabilities with integer labels (src/ops/CrossEntropySparse.cu)."""
-    p = jnp.take_along_axis(_f32(pred_probs), label_ids[..., None], axis=axis)[..., 0]
+    p = _select_along(_f32(pred_probs), label_ids, axis)
     return -jnp.log(p + eps)
 
 
 def nll_loss(logp, label_ids, axis: int = -1):
     """Negative log-likelihood on log-probabilities (src/ops/NllLoss.cu)."""
-    return -jnp.take_along_axis(_f32(logp), label_ids[..., None], axis=axis)[..., 0]
+    return -_select_along(_f32(logp), label_ids, axis)
 
 
 def mse_loss(pred, target):

@@ -153,10 +153,6 @@ def addmm(bias, a, b, alpha: float = 1.0, beta: float = 1.0):
     return beta * bias + alpha * jnp.matmul(a, b, precision=_PREC)
 
 
-def baddbbm(*a, **k):  # pragma: no cover - legacy alias typo guard
-    return baddbmm(*a, **k)
-
-
 def baddbmm(bias, a, b, alpha: float = 1.0, beta: float = 1.0):
     """Batched addmm (src/ops/Baddbmm.cu)."""
     return beta * bias + alpha * jnp.matmul(a, b, precision=_PREC)

@@ -100,7 +100,11 @@ class Optimizer:
         return p, slots
 
     # -- pytree update --------------------------------------------------------
-    def update(self, grads, state, params):
+    def update(self, grads, state, params, mask=None):
+        """Apply one update.  ``mask`` (optional) is a params-congruent pytree
+        of bools — False marks non-trainable leaves (BatchNorm statistics
+        etc., see core.module.trainable_mask) which are passed through
+        untouched (no weight decay, no moment update)."""
         step = state["step"] + 1
         lr = _lr_at(self.learning_rate, step)
         slot_names = self.slot_names()
@@ -111,11 +115,14 @@ class Optimizer:
         leaves_g, treedef = jax.tree_util.tree_flatten(grads, is_leaf=is_leaf)
         leaves_p = treedef.flatten_up_to(params)
         leaves_slots = {k: treedef.flatten_up_to(state[k]) for k in slot_names}
+        leaves_m = (
+            treedef.flatten_up_to(mask) if mask is not None else [True] * len(leaves_g)
+        )
 
         new_p, new_slots = [], {k: [] for k in slot_names}
         for i, (g, p) in enumerate(zip(leaves_g, leaves_p)):
             slots = {k: leaves_slots[k][i] for k in slot_names}
-            if g is None:
+            if g is None or not bool(leaves_m[i]):
                 np_, ns = p, slots
             elif isinstance(g, IndexedSlices):
                 np_, ns = self._sparse(g, p, dict(slots), lr, step)

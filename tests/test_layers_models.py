@@ -1,0 +1,140 @@
+"""Layer/model shape & behavior tests; BERT/GPT vs reference semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.layers import (
+    BatchNorm2d,
+    Dropout,
+    LayerNorm,
+    Linear,
+    MultiHeadAttention,
+    Sequential,
+    TransformerBlock,
+)
+from hetu_tpu.layers.attention import dot_product_attention
+from hetu_tpu.models import (
+    GPT,
+    BertForPreTraining,
+    LeNet,
+    MLP,
+    bert_base,
+    gpt2_small,
+    resnet18,
+)
+
+
+def setup_module():
+    set_random_seed(0)
+
+
+def test_linear_sequential():
+    m = Sequential(Linear(8, 16), Linear(16, 4))
+    y = m(jnp.ones((2, 8)))
+    assert y.shape == (2, 4)
+
+
+def test_attention_causal_masks_future():
+    attn = MultiHeadAttention(16, 4, causal=True)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((1, 6, 16)), jnp.float32)
+    y1 = attn(x)
+    # perturb the last position: outputs at earlier positions must not change
+    x2 = x.at[0, -1].add(10.0)
+    y2 = attn(x2)
+    np.testing.assert_allclose(y1[0, :-1], y2[0, :-1], atol=1e-5)
+    assert not np.allclose(y1[0, -1], y2[0, -1])
+
+
+def test_attention_oracle():
+    """dot_product_attention vs explicit numpy softmax attention."""
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+    k = rng.standard_normal((1, 5, 2, 4)).astype(np.float32)
+    v = rng.standard_normal((1, 5, 2, 4)).astype(np.float32)
+    out = dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    # numpy oracle
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_state_threading():
+    bn = BatchNorm2d(3)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 5, 5, 3)), jnp.float32)
+    y, bn2 = bn(x, training=True)
+    assert not np.allclose(bn2.running_mean, bn.running_mean)
+    # eval mode: unchanged state, uses running stats
+    y_eval, bn3 = bn2(x, training=False)
+    np.testing.assert_array_equal(bn3.running_mean, bn2.running_mean)
+
+
+def test_resnet18_forward_and_state():
+    m = resnet18(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    logits, m2 = m(x, training=True)
+    assert logits.shape == (2, 10)
+    assert not np.allclose(m2.stem_bn.running_mean, m.stem_bn.running_mean)
+    logits_eval, _ = m2(x, training=False)
+    assert logits_eval.shape == (2, 10)
+
+
+def test_lenet_mlp():
+    assert LeNet()(jnp.ones((2, 28, 28, 1))).shape == (2, 10)
+    assert MLP((16, 8, 4))(jnp.ones((3, 16))).shape == (3, 4)
+
+
+def test_bert_tiny_forward_and_loss():
+    cfg = bert_base(vocab_size=100, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=16)
+    model = BertForPreTraining(cfg)
+    b, s = 2, 8
+    ids = jnp.ones((b, s), jnp.int32)
+    mlm_logits, nsp_logits = model(ids)
+    assert mlm_logits.shape == (b, s, 100)
+    assert nsp_logits.shape == (b, 2)
+    labels = jnp.full((b, s), -1, jnp.int32).at[:, 2].set(5)
+    loss, aux = model.loss(ids, jnp.zeros_like(ids), jnp.ones((b, s)), labels,
+                           jnp.zeros((b,), jnp.int32))
+    assert np.isfinite(float(loss))
+    # loss ≈ log(vocab) + log(2) at init
+    assert 2.0 < float(loss) < 12.0
+
+
+def test_bert_mlm_ignores_unmasked():
+    cfg = bert_base(vocab_size=50, hidden_size=16, num_layers=1, num_heads=2,
+                    max_position_embeddings=8)
+    model = BertForPreTraining(cfg)
+    ids = jnp.ones((1, 4), jnp.int32)
+    all_ignored = jnp.full((1, 4), -1, jnp.int32)
+    loss, aux = model.loss(ids, None, None, all_ignored, jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(float(aux["mlm_loss"]), 0.0, atol=1e-6)
+
+
+def test_gpt_loss_decreases():
+    cfg = gpt2_small(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                     max_seq_len=16)
+    model = GPT(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (4, 12)), jnp.int32
+    )
+    from hetu_tpu.optim import AdamOptimizer
+
+    opt = AdamOptimizer(1e-2)
+    state = opt.init(model)
+
+    @jax.jit
+    def step(model, state):
+        loss, g = jax.value_and_grad(lambda m: m.loss(ids))(model)
+        model, state = opt.update(g, state, model)
+        return model, state, loss
+
+    losses = []
+    for _ in range(10):
+        model, state, loss = step(model, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
