@@ -1,0 +1,123 @@
+"""Benchmark: BERT-large pretraining throughput + MFU on one chip.
+
+The BASELINE headline metric (BASELINE.md): BERT-large pretraining
+samples/sec/chip and model-FLOPs-utilization, bf16 compute.  Prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"} where value is MFU and
+vs_baseline is MFU / 0.45 (the north-star ≥45% target).
+
+Runs on whatever backend is active; on non-TPU hosts it shrinks the model so
+the line is still produced (CI smoke), flagged via "device".
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def transformer_train_flops(L, h, V, batch, seq, ratio=4):
+    """Forward+backward matmul FLOPs per step (2 flops per MAC, bwd = 2x fwd)."""
+    per_layer_fwd = (
+        6 * seq * h * h      # qkv projection
+        + 2 * seq * h * h    # attention out projection
+        + 4 * seq * seq * h  # QK^T and PV
+        + 4 * ratio * seq * h * h  # MLP in+out
+    )
+    heads_fwd = 2 * seq * (h * h + h * V)  # mlm transform + tied decoder
+    fwd = L * per_layer_fwd + heads_fwd
+    return 3 * fwd * batch
+
+
+PEAK_BF16 = {
+    # chip kind (jax.devices()[0].device_kind) -> peak bf16 FLOP/s
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def main():
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    on_tpu = "TPU" in str(kind).upper() or dev.platform in ("tpu", "axon")
+    peak = PEAK_BF16.get(kind, 197e12 if on_tpu else 1e12)
+
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import BertForPreTraining, bert_large, bert_base
+    from hetu_tpu.optim import AdamWOptimizer
+
+    set_random_seed(0)
+    if on_tpu:
+        cfg = bert_large(dtype=jnp.bfloat16)
+        batch, seq, iters = 32, 128, 20
+    else:  # smoke fallback
+        cfg = bert_base(num_layers=2, hidden_size=128, num_heads=2,
+                        vocab_size=8192, dtype=jnp.float32)
+        batch, seq, iters = 8, 64, 3
+
+    model = BertForPreTraining(cfg)
+
+    def loss_fn(model, batch_, key):
+        loss, aux = model.loss(
+            batch_["input_ids"], batch_["token_type"], None,
+            batch_["mlm_labels"], batch_["nsp_labels"], key=key,
+            training=False,  # dropout off for a deterministic perf path
+        )
+        return loss, {}
+
+    trainer = Trainer(model, AdamWOptimizer(1e-4, weight_decay=0.01), loss_fn)
+
+    rng = np.random.default_rng(0)
+    b = {
+        "input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        "token_type": jnp.zeros((batch, seq), jnp.int32),
+        "mlm_labels": jnp.asarray(
+            np.where(rng.random((batch, seq)) < 0.15,
+                     rng.integers(0, cfg.vocab_size, (batch, seq)), -1),
+            jnp.int32,
+        ),
+        "nsp_labels": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
+    }
+
+    key = jax.random.key(0)
+    # warmup/compile
+    for _ in range(2):
+        m = trainer.step(b, key=key)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = trainer.step(b, key=key)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    flops = transformer_train_flops(
+        cfg.num_layers, cfg.hidden_size, cfg.vocab_size, batch, seq,
+        cfg.intermediate_ratio,
+    )
+    mfu = flops / dt / peak
+    samples_per_sec = batch / dt
+    print(json.dumps({
+        "metric": "bert_large_pretrain_mfu" if on_tpu else "bert_smoke_mfu",
+        "value": round(float(mfu), 4),
+        "unit": "MFU",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+        "samples_per_sec_per_chip": round(samples_per_sec, 2),
+        "step_ms": round(dt * 1e3, 2),
+        "device": str(kind),
+        "batch": batch, "seq": seq,
+    }))
+
+
+if __name__ == "__main__":
+    main()

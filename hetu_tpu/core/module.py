@@ -58,9 +58,9 @@ def _is_dynamic(v: Any) -> bool:
         return True
     if isinstance(v, (jax.Array, np.ndarray, jax.ShapeDtypeStruct)):
         return True
-    # PartitionSpec leaves keep spec-trees (logical_axes output) congruent
-    # with the module trees they mirror.
-    if isinstance(v, P):
+    # PartitionSpec/Sharding leaves keep spec-trees (logical_axes /
+    # named_shardings output) congruent with the module trees they mirror.
+    if isinstance(v, (P, jax.sharding.Sharding)):
         return True
     if isinstance(v, (list, tuple)):
         return any(_is_dynamic(x) for x in v)
@@ -99,16 +99,27 @@ def _freeze(v: Any) -> Any:
 
 
 def _flatten_module(m: "Module"):
-    children, keys, static = [], [], []
-    for k in sorted(m.__dict__):
-        v = m.__dict__[k]
-        if _is_dynamic(v):
-            keys.append(k)
-            children.append(v)
-        else:
-            static.append((k, _freeze(v)))
-    aux = (tuple(keys), tuple(static))
-    return children, aux
+    """Flatten with a *value-independent* structure.
+
+    The dynamic-key set is decided once (by value inspection on the first
+    flatten after __init__) and then pinned via ``_dyn_keys`` so that
+    unflatten→flatten round-trips preserve structure for ANY leaf values —
+    jax's prefix-tree machinery (jit in_shardings/in_layouts) rebuilds trees
+    with None/sentinel leaves and requires this invariant.
+    """
+    d = m.__dict__
+    dyn = d.get("_dyn_keys")
+    if dyn is None:
+        dyn = tuple(k for k in sorted(d) if _is_dynamic(d[k]))
+        d["_dyn_keys"] = dyn  # pin: structure is now value-independent
+    dyn_set = set(dyn)
+    children = [d[k] for k in dyn]
+    static = tuple(
+        (k, _freeze(d[k]))
+        for k in sorted(d)
+        if k not in dyn_set and k != "_dyn_keys"
+    )
+    return children, (dyn, static)
 
 
 def _flatten_module_with_keys(m: "Module"):
@@ -121,6 +132,7 @@ def _unflatten_module(cls, aux, children):
     m = object.__new__(cls)
     keys, static = aux
     d = m.__dict__
+    d["_dyn_keys"] = keys
     for k, v in zip(keys, children):
         d[k] = v
     for k, v in static:
@@ -144,10 +156,23 @@ class Module:
 
     # -- functional update ----------------------------------------------------
     def replace(self, **updates) -> "Module":
-        """Return a shallow copy with the given attributes replaced."""
+        """Return a shallow copy with the given attributes replaced.
+
+        If the flatten structure is already pinned (``_dyn_keys``), newly
+        added dynamic attributes extend the pinned set; attributes already
+        pinned stay dynamic even when set to None (tree_map semantics).
+        """
         m = object.__new__(type(self))
         m.__dict__.update(self.__dict__)
         m.__dict__.update(updates)
+        pinned = m.__dict__.get("_dyn_keys")
+        if pinned is not None:
+            extra = [
+                k for k, v in updates.items()
+                if k not in pinned and _is_dynamic(v)
+            ]
+            if extra:
+                m.__dict__["_dyn_keys"] = tuple(sorted((*pinned, *extra)))
         return m
 
     # -- convenience ----------------------------------------------------------

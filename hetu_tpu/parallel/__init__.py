@@ -1,0 +1,18 @@
+from hetu_tpu.parallel.mesh import DEFAULT_AXES, MeshSpec, make_mesh
+from hetu_tpu.parallel.spec import (
+    DP_RULES,
+    MEGATRON_RULES,
+    AxisRules,
+    ShardState,
+    named_shardings,
+    resolve_specs,
+    shard_tree,
+    transition,
+)
+from hetu_tpu.parallel.strategies import (
+    DataParallel,
+    MegatronTP,
+    ShardingStrategy,
+    ZeRO,
+)
+from hetu_tpu.parallel import collectives

@@ -1,0 +1,104 @@
+"""Collective communication layer.
+
+Reference: src/communication/mpi_nccl_communication.cu — AllReduce:137,
+Reduce:145, hierarchical AllToAll:152, flat AllToAll:245, Broadcast:279,
+AllGather:287, ReduceScatter:293, Send/Recv:301-307, grouped P2P
+(GroupStart/End:129) — plus the Python ``NCCL_Communicator``
+(communicator/mpi_nccl_comm.py:164).
+
+TPU-native: these are ``jax.lax`` collectives addressed by *mesh axis name*
+inside ``shard_map``/jit — XLA schedules them asynchronously over ICI/DCN
+(the reference's dedicated nccl stream + event sync, executor.py:839, is
+subsumed by XLA's latency-hiding scheduler).  The hierarchical AllToAll is
+axis factorization: a2a over ('dcn_axis','ici_axis') composes the intra-node
+gather / inter-node exchange / scatter pipeline the reference hand-codes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "all_reduce", "all_reduce_mean", "reduce_scatter", "all_gather",
+    "all_to_all", "hierarchical_all_to_all", "broadcast", "ppermute",
+    "send_next", "recv_prev", "axis_index", "axis_size", "pmean",
+]
+
+
+def all_reduce(x, axis: str | Sequence[str]):
+    """Sum-allreduce over mesh axis (dlarrayNcclAllReduce, mpi_nccl_comm.py:295)."""
+    return lax.psum(x, axis)
+
+
+def all_reduce_mean(x, axis: str | Sequence[str]):
+    return lax.pmean(x, axis)
+
+
+pmean = all_reduce_mean
+
+
+def reduce_scatter(x, axis: str, *, scatter_dim: int = 0, tiled: bool = True):
+    """Sum then scatter along ``scatter_dim`` (_ncclReduceScatter)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
+
+
+def all_gather(x, axis: str, *, gather_dim: int = 0, tiled: bool = True):
+    """Concatenate shards along ``gather_dim`` (_ncclAllGather)."""
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int, tiled: bool = True):
+    """Flat AllToAll (_ncclAllToAll:245): split ``split_dim`` across the
+    group, concatenate received chunks on ``concat_dim``."""
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=tiled)
+
+
+def hierarchical_all_to_all(x, outer_axis: str, inner_axis: str, *,
+                            split_dim: int, concat_dim: int):
+    """Hierarchical AllToAll (_ncclHAllToAll:152).
+
+    The reference pipeline — intra-node gather → inter-node a2a → intra-node
+    scatter — is exactly an all_to_all over the factored (outer, inner) axis
+    pair; XLA lowers the inner exchange onto ICI and the outer onto DCN.
+    """
+    return lax.all_to_all(x, (outer_axis, inner_axis), split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def broadcast(x, axis: str, root: int = 0):
+    """Broadcast root's shard to the group (_ncclBroadcast:279)."""
+    idx = lax.axis_index(axis)
+    # psum of (x if idx==root else 0) — single collective, no gather
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
+
+
+def ppermute(x, axis: str, perm):
+    """Point-to-point permutation — the PipelineSend/Receive pair
+    (reference gpu_ops/PipelineSend.py:5/PipelineReceive.py:5) as a single
+    grouped collective over the stage axis."""
+    return lax.ppermute(x, axis, perm)
+
+
+def send_next(x, axis: str):
+    """Ring-shift toward higher indices (stage i -> i+1, wrap)."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def recv_prev(x, axis: str):
+    """Ring-shift toward lower indices (stage i -> i-1, wrap)."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

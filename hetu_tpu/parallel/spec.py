@@ -1,0 +1,193 @@
+"""Sharding-state algebra — the ``NodeStatus`` equivalent.
+
+Reference: ``NodeStatus`` (reference: python/hetu/context.py:248) describes a
+tensor's placement as ``state`` (dim -> #splits), ``duplicate`` (replica
+count), ``partial`` (pending-reduction copies — GSPMD's "unreduced"), and
+``order`` (device-to-shard layout over dims ∪ {-1 dup, -2 partial}), with a
+combine/reduce algebra (context.py:352-723) and collective-pattern checks
+(check_allreduce/allgather/reducescatter/broadcast, context.py:769-782) that
+the graph rewriter uses to pick comm ops.
+
+TPU-native role: GSPMD does the propagation and comm insertion, so the
+algebra here is the *strategy* language — auto-parallel searchers and
+presets express per-tensor placements as ``ShardState`` and lower them to
+``PartitionSpec``s; transition analysis (``transition``) names the
+collective XLA will insert, which the cost model (autoparallel/) prices.
+
+``AxisRules`` maps the *logical* axis names modules annotate (e.g. 'mlp',
+'heads', 'vocab') to mesh axes — flax-style logical partitioning, the
+mechanism by which one model definition serves every parallelism strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_tpu.core.module import logical_axes
+
+__all__ = [
+    "ShardState", "transition", "AxisRules", "resolve_specs",
+    "named_shardings", "shard_tree", "MEGATRON_RULES", "DP_RULES",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardState:
+    """Placement of one tensor over a device group of size
+    ``prod(splits) * duplicate * partial`` (context.py:248 semantics).
+
+    splits: per-dim split counts, e.g. {0: 2, 1: 4}
+    duplicate: replication factor (the '-1' axis of the reference order)
+    partial: pending-reduce copies (the '-2' axis; matmul partial sums)
+    mesh_axes: optional per-dim mesh-axis names for lowering to PartitionSpec
+    """
+
+    splits: Mapping[int, int] = dataclasses.field(default_factory=dict)
+    duplicate: int = 1
+    partial: int = 1
+    mesh_axes: Mapping[int, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "splits", dict(self.splits))
+        object.__setattr__(self, "mesh_axes", dict(self.mesh_axes))
+
+    def device_count(self) -> int:
+        n = self.duplicate * self.partial
+        for v in self.splits.values():
+            n *= v
+        return n
+
+    def split(self, dim: int, parts: int, mesh_axis: Optional[str] = None) -> "ShardState":
+        splits = dict(self.splits)
+        splits[dim] = splits.get(dim, 1) * parts
+        axes = dict(self.mesh_axes)
+        if mesh_axis is not None:
+            prev = axes.get(dim)
+            axes[dim] = (*(prev or ()), mesh_axis) if isinstance(prev, tuple) or prev is None else (prev, mesh_axis)
+        return dataclasses.replace(self, splits=splits, mesh_axes=axes)
+
+    def replicate(self, copies: int) -> "ShardState":
+        return dataclasses.replace(self, duplicate=self.duplicate * copies)
+
+    def make_partial(self, copies: int) -> "ShardState":
+        return dataclasses.replace(self, partial=self.partial * copies)
+
+    def reduce_partial(self) -> "ShardState":
+        """After an all-reduce over the partial axis: copies become replicas
+        (context.py combine_state reduce semantics)."""
+        return dataclasses.replace(
+            self, partial=1, duplicate=self.duplicate * self.partial
+        )
+
+    def to_partition_spec(self, ndim: int) -> P:
+        entries = []
+        for d in range(ndim):
+            ax = self.mesh_axes.get(d)
+            if ax is None or self.splits.get(d, 1) == 1:
+                entries.append(None)
+            elif isinstance(ax, tuple) and len(ax) == 1:
+                entries.append(ax[0])
+            else:
+                entries.append(ax)
+        return P(*entries)
+
+
+def transition(src: ShardState, dst: ShardState, ndim: int) -> str:
+    """Name the collective that moves ``src`` to ``dst`` — the TPU analogue
+    of the reference's pattern checks (context.py:769-782 check_allreduce /
+    check_allgather / check_reducescatter / check_broadcast) used by the
+    cost model to price a resharding edge."""
+    if src.partial > 1 and dst.partial == 1:
+        if dst.duplicate >= src.partial:
+            return "all_reduce"
+        for d in range(ndim):
+            if dst.splits.get(d, 1) > src.splits.get(d, 1):
+                return "reduce_scatter"
+        return "reduce"
+    for d in range(ndim):
+        if src.splits.get(d, 1) > dst.splits.get(d, 1):
+            if any(
+                dst.splits.get(e, 1) > src.splits.get(e, 1) for e in range(ndim)
+            ):
+                return "all_to_all"
+            return "all_gather"
+    if dst.duplicate > src.duplicate and src.duplicate == 1:
+        return "broadcast"
+    for d in range(ndim):
+        if dst.splits.get(d, 1) > src.splits.get(d, 1):
+            return "dynamic_slice"  # free under GSPMD (local slice)
+    return "identity"
+
+
+# -----------------------------------------------------------------------------
+# Logical-axis rules
+# -----------------------------------------------------------------------------
+
+
+class AxisRules:
+    """logical axis name -> mesh axis (or None = replicate).
+
+    ``resolve_specs(model, rules)`` turns the module tree's logical axes
+    (core.module.logical_axes) into physical PartitionSpecs.
+    """
+
+    def __init__(self, rules: Mapping[str, Any]):
+        self.rules = dict(rules)
+
+    def physical(self, spec: P) -> P:
+        out = []
+        for entry in spec:
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry, (tuple, list)):
+                mapped = tuple(
+                    m for e in entry
+                    if (m := self.rules.get(e)) is not None
+                )
+                out.append(mapped if mapped else None)
+            else:
+                out.append(self.rules.get(entry))
+        return P(*out)
+
+
+# Megatron-LM preset (reference distributed_strategies/simple.py:174
+# MegatronLM): column-parallel in-proj, row-parallel out-proj, vocab-parallel
+# embedding; everything else replicated over tp.
+MEGATRON_RULES = AxisRules({
+    "mlp": "tp",                # MLP hidden — column parallel
+    "qkv_three_heads": "tp",    # attention qkv — column parallel (head-major)
+    "heads_merged": "tp",       # attention out-proj — row parallel
+    "vocab": "tp",              # embedding/vocab parallel
+    "embed": None,
+    "in": None, "out": None,
+    "conv_in": None, "conv_out": None,
+})
+
+# Pure data parallel: everything replicated (reference simple.py:6).
+DP_RULES = AxisRules({})
+
+
+def resolve_specs(tree: Any, rules: AxisRules) -> Any:
+    """Module-shaped pytree of physical PartitionSpecs."""
+    return jtu.tree_map(
+        rules.physical, logical_axes(tree),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def named_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    return jtu.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_tree(tree: Any, mesh: Mesh, rules: AxisRules) -> Any:
+    """device_put the tree according to its logical axes + rules."""
+    shardings = named_shardings(mesh, resolve_specs(tree, rules))
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,144 @@
+"""Distribution strategies — GSPMD placement instead of graph rewriting.
+
+Reference: distributed_strategies/ (DataParallel simple.py:6,
+ModelParallel4LM:113, MegatronLM:174) set per-op DeviceGroups + NodeStatus
+and the executor rewrites the graph with comm ops
+(context.py:1469 assign_context_by_traverse_nodes); DP gradient allreduce is
+injected by OptimizerOp.backward_hook (optimizer.py:164-182); ZeRO-style
+sharding is the 'partial' axis + AllGather/ReduceScatter ops.
+
+TPU-native: a strategy is (mesh, axis rules, batch placement, ZeRO stage).
+``install`` wraps the Trainer's step functions in jit with input/output
+shardings; GSPMD propagates and inserts the collectives the reference
+hand-wires (grad psum over dp, activation gathers for TP, slot gathers for
+ZeRO).  One model definition + one train_step serve every strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+from hetu_tpu.parallel.spec import (
+    AxisRules,
+    DP_RULES,
+    MEGATRON_RULES,
+    named_shardings,
+    resolve_specs,
+)
+
+__all__ = ["ShardingStrategy", "DataParallel", "MegatronTP", "ZeRO"]
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+class ShardingStrategy:
+    """mesh + rules + ZeRO stage → jitted, sharded step functions.
+
+    zero_stage: 0 = replicated optimizer state; 1/2 = optimizer slots sharded
+    over dp (ZeRO-1/2 — identical in a functional runtime where gradients are
+    never materialized unsharded per-rank); 3 = parameters sharded over dp
+    too (the reference's 'partial' + AllGather pattern, context.py:304-317).
+    """
+
+    def __init__(self, *, mesh: Optional[Mesh] = None,
+                 mesh_spec: Optional[MeshSpec] = None,
+                 rules: AxisRules = DP_RULES,
+                 batch_axes: Any = "dp",
+                 zero_stage: int = 0):
+        self._mesh = mesh
+        self.mesh_spec = mesh_spec
+        self.rules = rules
+        self.batch_axes = batch_axes
+        self.zero_stage = zero_stage
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = make_mesh(self.mesh_spec)
+        return self._mesh
+
+    # -- spec construction ----------------------------------------------------
+    def model_specs(self, model):
+        specs = resolve_specs(model, self.rules)
+        if self.zero_stage >= 3:
+            specs = jtu.tree_map(self._zero_shard, specs, model, is_leaf=None)
+        return specs
+
+    def _zero_shard(self, spec: P, leaf) -> P:
+        """Shard dim 0 over dp when it is unsharded and divisible."""
+        if not hasattr(leaf, "shape") or not leaf.shape:
+            return spec
+        dp = self.mesh.shape.get("dp", 1)
+        if dp == 1:
+            return spec
+        entries = list(spec) + [None] * (len(leaf.shape) - len(spec))
+        if entries[0] is None and leaf.shape[0] % dp == 0:
+            entries[0] = "dp"
+            return P(*entries)
+        return spec
+
+    def opt_specs(self, opt_state, model_spec_tree, model):
+        slot_spec = model_spec_tree
+        if self.zero_stage >= 1:
+            slot_spec = jtu.tree_map(
+                self._zero_shard, model_spec_tree, model, is_leaf=_is_spec
+            )
+        return {
+            k: (P() if k == "step" else slot_spec) for k in opt_state
+        }
+
+    # -- install --------------------------------------------------------------
+    def install(self, train_step, eval_step, state):
+        mesh = self.mesh
+        mspec = self.model_specs(state.model)
+        ospec = self.opt_specs(state.opt_state, mspec, state.model)
+        state_spec = dataclasses.replace(state, model=mspec, opt_state=ospec)
+        state_sh = named_shardings(mesh, state_spec)
+        batch_sh = NamedSharding(mesh, P(self.batch_axes))
+        repl = NamedSharding(mesh, P())
+
+        train = jax.jit(
+            train_step,
+            in_shardings=(state_sh, batch_sh, repl),
+            out_shardings=(state_sh, repl),
+            donate_argnums=(0,),
+        )
+        evals = jax.jit(
+            eval_step,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=repl,
+        )
+        state = jax.device_put(state, state_sh)
+        return train, evals, state
+
+
+def DataParallel(*, mesh: Optional[Mesh] = None, zero_stage: int = 0) -> ShardingStrategy:
+    """All devices on the dp axis (reference simple.py:6 DataParallel;
+    grad allreduce is GSPMD-inserted rather than backward_hook-injected)."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(), rules=DP_RULES,
+                            zero_stage=zero_stage)
+
+
+def MegatronTP(tp: int, *, dp: int = 1, mesh: Optional[Mesh] = None,
+               zero_stage: int = 0) -> ShardingStrategy:
+    """Megatron column/row-parallel transformer placement
+    (reference simple.py:174 MegatronLM)."""
+    return ShardingStrategy(
+        mesh=mesh, mesh_spec=MeshSpec(dp=dp, tp=tp), rules=MEGATRON_RULES,
+        zero_stage=zero_stage,
+    )
+
+
+def ZeRO(stage: int = 1, *, mesh: Optional[Mesh] = None) -> ShardingStrategy:
+    """ZeRO-style dp-sharded optimizer state / params
+    (reference 'partial' NodeStatus axis + AllGather, context.py:304-317)."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(), rules=DP_RULES,
+                            zero_stage=stage)
