@@ -15,4 +15,10 @@ from hetu_tpu.parallel.strategies import (
     ShardingStrategy,
     ZeRO,
 )
+from hetu_tpu.parallel.pipeline import (
+    Pipelined,
+    spmd_pipeline,
+    stack_modules,
+    stage_partition,
+)
 from hetu_tpu.parallel import collectives

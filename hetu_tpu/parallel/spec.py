@@ -166,10 +166,12 @@ MEGATRON_RULES = AxisRules({
     "embed": None,
     "in": None, "out": None,
     "conv_in": None, "conv_out": None,
+    "layers": "pp",             # stacked pipeline-stage dim (parallel/pipeline.py)
 })
 
-# Pure data parallel: everything replicated (reference simple.py:6).
-DP_RULES = AxisRules({})
+# Pure data parallel: everything replicated over tp (reference simple.py:6);
+# stacked layer dims still follow the pp axis.
+DP_RULES = AxisRules({"layers": "pp"})
 
 
 def resolve_specs(tree: Any, rules: AxisRules) -> Any:

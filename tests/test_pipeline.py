@@ -1,0 +1,106 @@
+"""Pipeline parallelism tests on the virtual 8-device CPU mesh.
+
+Oracle: a pipelined stack must be numerically identical to running the same
+blocks sequentially on one device — the cross-parallelism equivalence
+discipline of the reference's validate_results.py
+(reference: examples/runner/parallel/validate_results.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import normal
+from hetu_tpu.layers import TransformerBlock
+from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+from hetu_tpu.parallel.pipeline import (
+    Pipelined, spmd_pipeline, stack_modules, stage_partition,
+)
+
+
+@pytest.fixture
+def pp_mesh():
+    return make_mesh(MeshSpec(pp=4, dp=2), devices=jax.devices())
+
+
+class Tiny(Module):
+    def __init__(self, d):
+        self.w = normal(stddev=0.5)(next_key(), (d, d), jnp.float32)
+        self.w_axes = ("in", "out")
+
+    def __call__(self, x, mask=None, *, key=None, training=False):
+        return jnp.tanh(x @ self.w) + x
+
+
+def test_stage_partition():
+    assert [list(r) for r in stage_partition(7, 3)] == [[0, 1, 2], [3, 4], [5, 6]]
+    assert [len(r) for r in stage_partition(8, 4)] == [2, 2, 2, 2]
+
+
+def test_spmd_pipeline_matches_sequential(pp_mesh):
+    set_random_seed(0)
+    d, B, M = 8, 8, 4
+    blocks = [Tiny(d) for _ in range(4)]
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(B, 16, d)), jnp.float32)
+
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+
+    params = stack_modules(blocks)
+
+    def stage_fn(blk, h, ex, k):
+        return blk(h)
+
+    out = jax.jit(
+        lambda p, v: spmd_pipeline(
+            stage_fn, p, v, mesh=pp_mesh, n_microbatches=M
+        )
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pipelined_module_fwd_and_grad(pp_mesh):
+    set_random_seed(1)
+    d, B = 8, 8
+    blocks = [Tiny(d) for _ in range(8)]  # 2 layers per stage
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(B, 4, d)), jnp.float32)
+
+    pipe = Pipelined(blocks, n_microbatches=4, mesh=pp_mesh, remat=True)
+    seq = Pipelined(blocks, n_microbatches=4, mesh=None)  # degenerate scan path
+
+    out_p = jax.jit(lambda m, v: m(v))(pipe, x)
+    out_s = jax.jit(lambda m, v: m(v))(seq, x)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_s),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads wrt stacked params must match the sequential oracle
+    def loss_p(m, v):
+        return (m(v) ** 2).mean()
+
+    gp = jax.jit(jax.grad(loss_p))(pipe, x)
+    gs = jax.jit(jax.grad(loss_p))(seq, x)
+    np.testing.assert_allclose(
+        np.asarray(gp.stacked.w), np.asarray(gs.stacked.w), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_pipelined_transformer_blocks(pp_mesh):
+    """Real transformer blocks through the pipeline, with mask extras."""
+    set_random_seed(2)
+    d, H, B, S = 16, 4, 8, 12
+    blocks = [TransformerBlock(d, H, causal=True) for _ in range(4)]
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(B, S, d)), jnp.float32)
+
+    pipe = Pipelined(blocks, n_microbatches=2, mesh=pp_mesh, remat=False)
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+
+    out = jax.jit(lambda m, v: m(v))(pipe, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
