@@ -11,3 +11,10 @@ from hetu_tpu.layers.norm import (
 )
 from hetu_tpu.layers.attention import MultiHeadAttention, dot_product_attention
 from hetu_tpu.layers.transformer import TransformerBlock, TransformerMLP
+from hetu_tpu.layers.moe import (
+    ExpertMLP,
+    HashGate,
+    MoELayer,
+    TopKGate,
+    moe_transformer_mlp,
+)

@@ -6,5 +6,6 @@ from hetu_tpu.models.bert import (
     bert_large,
 )
 from hetu_tpu.models.gpt import GPT, GPTConfig, gpt2_large, gpt2_medium, gpt2_small
+from hetu_tpu.models.moe_lm import MoEBlock, MoELM, MoELMConfig
 from hetu_tpu.models.resnet import BasicBlock, ResNet, resnet18, resnet34
 from hetu_tpu.models.simple import MLP, LeNet, LogReg, vgg16

@@ -21,4 +21,10 @@ from hetu_tpu.parallel.pipeline import (
     stack_modules,
     stage_partition,
 )
+from hetu_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attn_fn,
+    ulysses_attention,
+    ulysses_attn_fn,
+)
 from hetu_tpu.parallel import collectives

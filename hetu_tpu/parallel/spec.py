@@ -167,11 +167,12 @@ MEGATRON_RULES = AxisRules({
     "in": None, "out": None,
     "conv_in": None, "conv_out": None,
     "layers": "pp",             # stacked pipeline-stage dim (parallel/pipeline.py)
+    "experts": "ep",            # stacked expert dim (layers/moe.py)
 })
 
 # Pure data parallel: everything replicated over tp (reference simple.py:6);
-# stacked layer dims still follow the pp axis.
-DP_RULES = AxisRules({"layers": "pp"})
+# stacked layer/expert dims still follow their pp/ep axes.
+DP_RULES = AxisRules({"layers": "pp", "experts": "ep"})
 
 
 def resolve_specs(tree: Any, rules: AxisRules) -> Any:
