@@ -55,6 +55,7 @@ def main():
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.exec import Trainer
     from hetu_tpu.models import BertForPreTraining, bert_large, bert_base
+    from hetu_tpu.ops.pallas import flash_attn_fn
     from hetu_tpu.optim import AdamWOptimizer
 
     set_random_seed(0)
@@ -66,7 +67,10 @@ def main():
                         vocab_size=8192, dtype=jnp.float32)
         batch, seq, iters = 8, 64, 3
 
-    model = BertForPreTraining(cfg)
+    # interpret=False explicitly: bench's TPU detection accepts the axon
+    # platform, and the compiled kernel (never the interpreter) must run there
+    model = BertForPreTraining(
+        cfg, attn_fn=flash_attn_fn(interpret=False) if on_tpu else None)
 
     def loss_fn(model, batch_, key):
         loss, aux = model.loss(
@@ -91,14 +95,17 @@ def main():
     }
 
     key = jax.random.key(0)
-    # warmup/compile
+    # warmup/compile.  NOTE: block_until_ready does not actually block
+    # through the axon TPU tunnel — a device→host transfer (float()) is the
+    # only reliable sync.  Steps chain through the donated TrainState, so
+    # timing N steps and syncing on the last loss measures real step time.
     for _ in range(2):
         m = trainer.step(b, key=key)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(iters):
         m = trainer.step(b, key=key)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     dt = (time.perf_counter() - t0) / iters
 
     flops = transformer_train_flops(

@@ -1,0 +1,26 @@
+"""hetu_tpu.embed — host-side sparse embedding engine (HET, VLDB'22).
+
+The TPU-native re-design of the reference's parameter-server stack
+(ps-lite/) + worker embedding cache (src/hetu_cache/): a native C++ engine
+(native/embed/embed_engine.cpp) holding sharded host-memory tables with
+server-side optimizers, per-row versions, LRU/LFU/LFUOpt caches with
+pull/push staleness bounds, an async thread pool, SSP barriers, and
+partial-reduce partner matching — bridged into jitted train steps via
+``io_callback`` (bridge.py) and exposed as the ``HostEmbedding`` layer.
+"""
+
+from hetu_tpu.embed.engine import (
+    AsyncEngine,
+    CacheTable,
+    HostEmbeddingTable,
+    PartialReduceCoordinator,
+    SSPBarrier,
+)
+from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
+from hetu_tpu.embed.layer import HostEmbedding
+
+__all__ = [
+    "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
+    "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
+    "HostEmbedding",
+]

@@ -1,0 +1,110 @@
+"""Bridging the host embedding engine into jitted programs.
+
+The reference reaches its PS/cache from the executor's Python compute loop
+(EmbeddingLookUp.py:34-47 dispatches to SparsePull RPC or the HET cache;
+ParameterServerCommunicate.py pushes IndexedSlices grads).  Under XLA the
+train step is one compiled program, so the host path enters via
+``io_callback``: the forward lookup is an ordered host callback, and the
+gradient push rides the backward pass of a ``custom_vjp`` — preserving the
+reference's semantics (lookup-then-async-push) inside one jitted step.
+
+Perf notes: host→TPU transfers for looked-up rows ride the callback; the
+``Prefetcher`` overlaps next-batch row pulls with the current step
+(reference prefetch path, executor.py:770-775), and the engine's thread pool
+makes pushes async so the step never waits on the host optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from hetu_tpu.embed.engine import AsyncEngine, CacheTable, HostEmbeddingTable
+
+__all__ = ["make_host_lookup", "Prefetcher"]
+
+Store = Union[HostEmbeddingTable, CacheTable]
+
+
+def _sync_fn(store: Store):
+    return store.sync if isinstance(store, CacheTable) else store.pull
+
+
+def make_host_lookup(store: Store, dim: int):
+    """Returns ``lookup(ids, anchor) -> rows`` usable inside jit/grad.
+
+    Forward: ordered host callback into ``store.sync``/``pull``.
+    Backward: ordered host callback into ``store.push`` (the engine applies
+    its server-side optimizer).
+
+    ``anchor`` must be a *differentiated* float scalar (a trainable model
+    leaf — ``HostEmbedding`` carries one).  Without it the whole lookup has
+    only the int ids as input, JAX prunes its backward as unreachable from
+    any differentiable input, and gradients would silently never reach the
+    host table.
+    """
+    pull = _sync_fn(store)
+
+    def _raw_lookup(ids):
+        shape = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
+
+        def host(i):
+            i = np.asarray(i)
+            return pull(i.ravel().astype(np.int64)).reshape(
+                tuple(i.shape) + (dim,))
+
+        return io_callback(host, shape, ids, ordered=True)
+
+    @jax.custom_vjp
+    def lookup(ids, anchor):
+        return _raw_lookup(ids)
+
+    def fwd(ids, anchor):
+        return _raw_lookup(ids), ids
+
+    def bwd(ids, g):
+        def host(i, gg):
+            store.push(np.asarray(i).ravel().astype(np.int64),
+                       np.asarray(gg, np.float32).reshape(-1, dim))
+            return np.zeros((), np.float32)
+
+        io_callback(host, jax.ShapeDtypeStruct((), jnp.float32), ids, g,
+                    ordered=True)
+        return (np.zeros(ids.shape, jax.dtypes.float0),
+                jnp.zeros((), jnp.float32))
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+class Prefetcher:
+    """Double-buffered async row pulls (reference ParameterServerSparsePullOp
+    overlap, executor.py:770-775).
+
+    ``prefetch(next_ids)`` starts an async sync on the engine's thread pool;
+    ``get(ids)`` returns the prefetched rows if they match, else pulls
+    synchronously.
+    """
+
+    def __init__(self, store: CacheTable, engine: AsyncEngine | None = None):
+        self.store = store
+        self.engine = engine or AsyncEngine(2)
+        self._pending = None  # (ticket, ids_key, out)
+
+    def prefetch(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        ticket, out = self.engine.sync_async(self.store, ids)
+        self._pending = (ticket, ids.tobytes(), out)
+
+    def get(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        if self._pending is not None and self._pending[1] == ids.tobytes():
+            ticket, _, out = self._pending
+            self._pending = None
+            self.engine.wait(ticket)
+            return out
+        return _sync_fn(self.store)(ids)

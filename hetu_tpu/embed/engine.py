@@ -1,0 +1,298 @@
+"""ctypes binding to the host embedding engine (build/libhetu_embed.so).
+
+Python facade over the native engine; mirrors the reference's worker-side
+surface: ``parameterServerCommunicate``-style dense/sparse push-pull
+(ps-lite/src/python_binding.cc:6-151), ``CacheSparseTable`` with async
+waitable ops (python/hetu/cstable.py:19), SSP sync and partial-reduce
+partner matching.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+
+__all__ = [
+    "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
+    "PartialReduceCoordinator", "OPTIMIZERS", "POLICIES",
+]
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+_SO = _REPO / "build" / "libhetu_embed.so"
+_SRC = _REPO / "native" / "embed" / "embed_engine.cpp"
+
+OPTIMIZERS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3, "adamw": 4}
+POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO.exists() or (_SRC.exists()
+                            and _SRC.stat().st_mtime > _SO.stat().st_mtime):
+        subprocess.run(["sh", str(_REPO / "native" / "embed" / "build.sh")],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(str(_SO))
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    sigs = {
+        "het_table_create": ([ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                              ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                              ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                              ctypes.c_uint64, ctypes.c_float],
+                             ctypes.c_void_p),
+        "het_table_destroy": ([ctypes.c_void_p], None),
+        "het_table_set_lr": ([ctypes.c_void_p, ctypes.c_float], None),
+        "het_table_pull": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                           None),
+        "het_table_push": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                           None),
+        "het_table_set_rows": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                               None),
+        "het_table_version": ([ctypes.c_void_p, ctypes.c_int64],
+                              ctypes.c_uint64),
+        "het_table_save": ([ctypes.c_void_p, ctypes.c_char_p], ctypes.c_int),
+        "het_table_load": ([ctypes.c_void_p, ctypes.c_char_p], ctypes.c_int),
+        "het_cache_create": ([ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                              ctypes.c_uint64, ctypes.c_int64],
+                             ctypes.c_void_p),
+        "het_cache_destroy": ([ctypes.c_void_p], None),
+        "het_cache_sync": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                           None),
+        "het_cache_push": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                           None),
+        "het_cache_flush": ([ctypes.c_void_p], None),
+        "het_cache_size": ([ctypes.c_void_p], ctypes.c_int64),
+        "het_cache_stats": ([ctypes.c_void_p, u64p, u64p], None),
+        "het_engine_create": ([ctypes.c_int], ctypes.c_void_p),
+        "het_engine_destroy": ([ctypes.c_void_p], None),
+        "het_cache_sync_async": ([ctypes.c_void_p, ctypes.c_void_p, i64p,
+                                  ctypes.c_int64, f32p], ctypes.c_uint64),
+        "het_cache_push_async": ([ctypes.c_void_p, ctypes.c_void_p, i64p,
+                                  ctypes.c_int64, f32p], ctypes.c_uint64),
+        "het_table_push_async": ([ctypes.c_void_p, ctypes.c_void_p, i64p,
+                                  ctypes.c_int64, f32p], ctypes.c_uint64),
+        "het_wait": ([ctypes.c_void_p, ctypes.c_uint64], None),
+        "het_ssp_create": ([ctypes.c_int, ctypes.c_int], ctypes.c_void_p),
+        "het_ssp_destroy": ([ctypes.c_void_p], None),
+        "het_ssp_sync": ([ctypes.c_void_p, ctypes.c_int, ctypes.c_int], None),
+        "het_preduce_create": ([ctypes.c_int, ctypes.c_double, ctypes.c_int],
+                               ctypes.c_void_p),
+        "het_preduce_destroy": ([ctypes.c_void_p], None),
+        "het_preduce_get_partner": ([ctypes.c_void_p, ctypes.c_int],
+                                    ctypes.c_uint64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    _lib = lib
+    return lib
+
+
+def _i64(a):
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32(a):
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class HostEmbeddingTable:
+    """Host-memory embedding table with a server-side optimizer.
+
+    The "server" of the PS pair: rows live in host RAM, gradient pushes run
+    the optimizer on the host (ps-lite optimizer.h:25 capability), versions
+    track per-row update counts for cache staleness.
+    """
+
+    def __init__(self, rows: int, dim: int, *, optimizer: str = "sgd",
+                 lr: float = 0.01, momentum: float = 0.9, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01):
+        self._lib = _load()
+        self.rows, self.dim = rows, dim
+        self._h = self._lib.het_table_create(
+            rows, dim, OPTIMIZERS[optimizer], lr, momentum, beta1, beta2,
+            eps, weight_decay, seed, init_scale)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.het_table_destroy(self._h)
+            self._h = None
+
+    def pull(self, keys) -> np.ndarray:
+        keys, kp = _i64(keys)
+        out = np.empty((len(keys), self.dim), np.float32)
+        self._lib.het_table_pull(self._h, kp, len(keys),
+                                 out.ctypes.data_as(
+                                     ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def push(self, keys, grads):
+        keys, kp = _i64(keys)
+        grads, gp = _f32(grads)
+        assert grads.shape == (len(keys), self.dim)
+        self._lib.het_table_push(self._h, kp, len(keys), gp)
+
+    def set_rows(self, keys, values):
+        keys, kp = _i64(keys)
+        values, vp = _f32(values)
+        self._lib.het_table_set_rows(self._h, kp, len(keys), vp)
+
+    def version(self, row: int) -> int:
+        return int(self._lib.het_table_version(self._h, row))
+
+    def set_lr(self, lr: float):
+        self._lib.het_table_set_lr(self._h, lr)
+
+    def save(self, path: str):
+        rc = self._lib.het_table_save(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"save failed ({rc}): {path}")
+
+    def load(self, path: str):
+        rc = self._lib.het_table_load(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"load failed ({rc}): {path}")
+
+
+class CacheTable:
+    """Worker-side cache over a HostEmbeddingTable (HET protocol).
+
+    ``sync(keys)`` = syncEmbedding: serve rows, re-pulling those staler than
+    ``pull_bound`` server updates. ``push(keys, grads)`` = pushEmbedding:
+    accumulate locally, flushing rows after ``push_bound`` accumulations.
+    (src/hetu_cache/include/hetu_client.h:19-30.)
+    """
+
+    def __init__(self, table: HostEmbeddingTable, capacity: int, *,
+                 policy: str = "lru", pull_bound: int = 0,
+                 push_bound: int = 0):
+        self._lib = _load()
+        self.table = table
+        self.dim = table.dim
+        self._h = self._lib.het_cache_create(
+            table._h, capacity, POLICIES[policy], pull_bound, push_bound)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.het_cache_destroy(self._h)
+            self._h = None
+
+    def sync(self, keys) -> np.ndarray:
+        keys, kp = _i64(keys)
+        out = np.empty((len(keys), self.dim), np.float32)
+        self._lib.het_cache_sync(self._h, kp, len(keys),
+                                 out.ctypes.data_as(
+                                     ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def push(self, keys, grads):
+        keys, kp = _i64(keys)
+        grads, gp = _f32(grads)
+        self._lib.het_cache_push(self._h, kp, len(keys), gp)
+
+    def flush(self):
+        self._lib.het_cache_flush(self._h)
+
+    def stats(self) -> dict:
+        h, m = ctypes.c_uint64(), ctypes.c_uint64()
+        self._lib.het_cache_stats(self._h, ctypes.byref(h), ctypes.byref(m))
+        total = h.value + m.value
+        return {"hits": h.value, "misses": m.value, "size":
+                int(self._lib.het_cache_size(self._h)),
+                "hit_rate": h.value / total if total else 0.0}
+
+
+class AsyncEngine:
+    """Thread pool issuing cache/table ops off the training thread; returns
+    waitable tickets (reference CSEvent/PSEvent, python/hetu/stream.py:73)."""
+
+    def __init__(self, n_threads: int = 2):
+        self._lib = _load()
+        self._h = self._lib.het_engine_create(n_threads)
+        self._live = {}  # ticket -> pinned buffers
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.het_engine_destroy(self._h)
+            self._h = None
+
+    def sync_async(self, cache: CacheTable, keys):
+        keys, kp = _i64(keys)
+        out = np.empty((len(keys), cache.dim), np.float32)
+        t = self._lib.het_cache_sync_async(
+            self._h, cache._h, kp, len(keys),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self._live[t] = (keys, out)
+        return t, out
+
+    def push_async(self, cache: CacheTable, keys, grads):
+        keys, kp = _i64(keys)
+        grads, gp = _f32(grads)
+        t = self._lib.het_cache_push_async(self._h, cache._h, kp, len(keys),
+                                           gp)
+        self._live[t] = (keys, grads)
+        return t
+
+    def table_push_async(self, table: HostEmbeddingTable, keys, grads):
+        keys, kp = _i64(keys)
+        grads, gp = _f32(grads)
+        t = self._lib.het_table_push_async(self._h, table._h, kp, len(keys),
+                                           gp)
+        self._live[t] = (keys, grads)
+        return t
+
+    def wait(self, ticket):
+        self._lib.het_wait(self._h, ticket)
+        self._live.pop(ticket, None)
+
+
+class SSPBarrier:
+    """Bounded-staleness barrier (ssp_handler.h:12): ``sync(worker, clock)``
+    blocks until the slowest worker is within ``staleness`` clocks."""
+
+    def __init__(self, n_workers: int, staleness: int):
+        self._lib = _load()
+        self._h = self._lib.het_ssp_create(n_workers, staleness)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.het_ssp_destroy(self._h)
+            self._h = None
+
+    def sync(self, worker: int, clock: int):
+        self._lib.het_ssp_sync(self._h, worker, clock)
+
+
+class PartialReduceCoordinator:
+    """Dynamic reduce-group matching (preduce_handler.cc; SIGMOD'21):
+    ``get_partner(worker)`` returns the bitmask of workers grouped with the
+    caller — whoever arrived within the wait window."""
+
+    def __init__(self, n_workers: int, wait_ms: float = 10.0,
+                 min_group: int = 2):
+        self._lib = _load()
+        self.n_workers = n_workers
+        self._h = self._lib.het_preduce_create(n_workers, wait_ms, min_group)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.het_preduce_destroy(self._h)
+            self._h = None
+
+    def get_partner(self, worker: int) -> list[int]:
+        mask = self._lib.het_preduce_get_partner(self._h, worker)
+        return [w for w in range(self.n_workers) if mask & (1 << w)]

@@ -1,0 +1,65 @@
+"""HostEmbedding — the Hybrid-mode embedding layer.
+
+Reference semantics (executor.py:276-283 + optimizer.py:170-178): dense
+params train on-chip with allreduce DP; embedding tables route through the
+PS — always PS in hybrid mode, with the HET cache when a policy is set.
+Here the dense model is ordinary on-chip pytree params and this layer holds
+a host-side table (optionally cached) reached through the io_callback
+bridge, so one jitted train step does on-chip compute + host sparse update.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.embed.bridge import make_host_lookup
+from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
+
+__all__ = ["HostEmbedding"]
+
+
+class HostEmbedding(Module):
+    """Embedding whose rows live in host memory (HET capability).
+
+    No on-chip parameters: lookups and gradient pushes go through the host
+    engine, whose server-side optimizer owns the update rule.  ``cache``
+    enables the worker-side cache with staleness bounds.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01, cache_capacity: int = 0,
+                 policy: str = "lru", pull_bound: int = 0,
+                 push_bound: int = 0, dtype=jnp.float32):
+        self.num_embeddings = num_embeddings
+        self.dim = dim
+        self.dtype = dtype
+        self.table = HostEmbeddingTable(
+            num_embeddings, dim, optimizer=optimizer, lr=lr,
+            weight_decay=weight_decay, seed=seed, init_scale=init_scale)
+        if cache_capacity > 0:
+            self.store = CacheTable(self.table, cache_capacity,
+                                    policy=policy, pull_bound=pull_bound,
+                                    push_bound=push_bound)
+        else:
+            self.store = self.table
+        self._lookup = make_host_lookup(self.store, dim)
+        # Differentiable anchor keeping the lookup's backward (the host grad
+        # push) alive in every grad trace; receives zero gradient itself.
+        self.anchor = jnp.zeros((), jnp.float32)
+
+    def __call__(self, ids):
+        return self._lookup(ids, self.anchor).astype(self.dtype)
+
+    def flush(self):
+        if isinstance(self.store, CacheTable):
+            self.store.flush()
+
+    def save(self, path: str):
+        self.flush()
+        self.table.save(path)
+
+    def load(self, path: str):
+        self.table.load(path)

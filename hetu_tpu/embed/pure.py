@@ -1,0 +1,155 @@
+"""Pure-numpy reference for the native embedding engine.
+
+Implements identical semantics to native/embed/embed_engine.cpp — the tests
+cross-check the C++ engine against this, the same way the reference
+cross-checks GPU kernels against numpy oracles (tests/tester.py:6).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["PyTable", "PyCache"]
+
+
+class PyTable:
+    def __init__(self, rows, dim, *, optimizer="sgd", lr=0.01, momentum=0.9,
+                 beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
+                 seed=0, init_scale=0.01):
+        gen = np.random.default_rng()  # unused; match C++ std::mt19937_64?
+        # C++ uses mt19937_64 normal draws — not bit-reproducible from numpy,
+        # so tests construct both sides with init_scale=0 and set_rows.
+        self.data = np.zeros((rows, dim), np.float32)
+        if init_scale > 0:
+            self.data = np.random.default_rng(seed).normal(
+                0, init_scale, (rows, dim)).astype(np.float32)
+        self.version = np.zeros(rows, np.uint64)
+        self.rows, self.dim = rows, dim
+        self.kind = optimizer
+        self.lr, self.momentum = lr, momentum
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+        self.m1 = np.zeros((rows, dim), np.float32)
+        self.m2 = np.zeros((rows, dim), np.float32)
+        self.step = 0
+
+    def pull(self, keys):
+        return self.data[np.asarray(keys, np.int64)].copy()
+
+    def set_rows(self, keys, values):
+        keys = np.asarray(keys, np.int64)
+        self.data[keys] = np.asarray(values, np.float32)
+        self.version[keys] += 1
+
+    def _apply_row(self, r, g):
+        w = self.data[r]
+        t = self.step + 1
+        if self.kind == "sgd":
+            w -= self.lr * (g + self.weight_decay * w)
+        elif self.kind == "momentum":
+            g = g + self.weight_decay * w
+            self.m1[r] = self.momentum * self.m1[r] + g
+            w -= self.lr * self.m1[r]
+        elif self.kind == "adagrad":
+            g = g + self.weight_decay * w
+            self.m1[r] += g * g
+            w -= self.lr * g / (np.sqrt(self.m1[r]) + self.eps)
+        elif self.kind in ("adam", "adamw"):
+            gj = g + (self.weight_decay * w if self.kind == "adam" else 0)
+            self.m1[r] = self.beta1 * self.m1[r] + (1 - self.beta1) * gj
+            self.m2[r] = self.beta2 * self.m2[r] + (1 - self.beta2) * gj * gj
+            mh = self.m1[r] / (1 - self.beta1 ** t)
+            vh = self.m2[r] / (1 - self.beta2 ** t)
+            upd = mh / (np.sqrt(vh) + self.eps)
+            if self.kind == "adamw":
+                upd = upd + self.weight_decay * w
+            w -= self.lr * upd
+        self.version[r] += 1
+
+    def push(self, keys, grads):
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32)
+        self.step += 1
+        acc = {}
+        for k, g in zip(keys, grads):
+            acc[int(k)] = acc.get(int(k), 0) + g
+        for k, g in acc.items():
+            self._apply_row(k, g)
+
+
+class PyCache:
+    def __init__(self, table: PyTable, capacity, *, policy="lru",
+                 pull_bound=0, push_bound=0):
+        self.table = table
+        self.capacity = capacity
+        self.policy = policy
+        self.pull_bound = pull_bound
+        self.push_bound = push_bound
+        # key -> [emb, grad, version, pending, freq]; OrderedDict gives LRU
+        self.map: OrderedDict = OrderedDict()
+        self.hits = self.misses = 0
+
+    def _flush_entry(self, key, e):
+        if e[3] == 0:
+            return
+        self.table.push([key], [e[1]])
+        e[1] = np.zeros(self.table.dim, np.float32)
+        e[3] = 0
+        e[0] = self.table.data[key].copy()
+        e[2] = int(self.table.version[key])
+
+    def _evict(self):
+        while len(self.map) > self.capacity:
+            if self.policy == "lru":
+                key = next(iter(self.map))  # least-recent = front
+            else:
+                key = min(self.map, key=lambda k: self.map[k][4])
+            e = self.map.pop(key)
+            self._flush_entry(key, e)
+
+    def sync(self, keys):
+        out = np.empty((len(keys), self.table.dim), np.float32)
+        for i, key in enumerate(np.asarray(keys, np.int64)):
+            key = int(key)
+            e = self.map.get(key)
+            if e is not None:
+                if int(self.table.version[key]) - e[2] > self.pull_bound:
+                    self._flush_entry(key, e)
+                    e[0] = self.table.data[key].copy()
+                    e[2] = int(self.table.version[key])
+                    self.misses += 1
+                else:
+                    self.hits += 1
+                if self.policy == "lru":
+                    self.map.move_to_end(key)  # most-recent = back
+                else:
+                    e[4] += 1
+                out[i] = e[0]
+            else:
+                self.misses += 1
+                e = [self.table.data[key].copy(),
+                     np.zeros(self.table.dim, np.float32),
+                     int(self.table.version[key]), 0, 1]
+                self.map[key] = e
+                out[i] = e[0]
+                self._evict()
+        return out
+
+    def push(self, keys, grads):
+        grads = np.asarray(grads, np.float32)
+        for i, key in enumerate(np.asarray(keys, np.int64)):
+            key = int(key)
+            e = self.map.get(key)
+            if e is None:
+                self.table.push([key], [grads[i]])
+                continue
+            e[1] = e[1] + grads[i]
+            e[3] += 1
+            if e[3] > self.push_bound:
+                self._flush_entry(key, e)
+
+    def flush(self):
+        for key, e in self.map.items():
+            self._flush_entry(key, e)

@@ -1,0 +1,11 @@
+"""hetu_tpu.ops.pallas — TPU Pallas kernels for the ops XLA can't fuse well.
+
+The reference's hot CUDA kernels (src/ops/*.cu) mostly map to single XLA HLOs;
+the long-tail that needs hand-tiling on TPU lives here.  Flash attention is
+the MFU-critical one (SURVEY §7: "BERT-large ≥45% MFU requires fused
+attention").
+"""
+
+from hetu_tpu.ops.pallas.flash import flash_attention, flash_attn_fn
+
+__all__ = ["flash_attention", "flash_attn_fn"]

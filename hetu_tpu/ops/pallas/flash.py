@@ -1,0 +1,361 @@
+"""Flash attention for TPU (Pallas/Mosaic).
+
+Replaces the reference's materialized QK^T softmax attention
+(python/hetu/layers/attention.py:5) with a fused online-softmax kernel so the
+(seq, seq) score matrix never touches HBM — the MFU-critical kernel for the
+BERT/GPT baselines (BASELINE.md north star).
+
+Design (FlashAttention-2 schedule on the MXU):
+- forward: grid (batch, heads, q_blocks, kv_blocks), kv innermost; VMEM
+  scratch carries the running max ``m``, normalizer ``l`` and fp32 output
+  accumulator across kv blocks; output and logsumexp are flushed on the last
+  kv step.
+- backward: the standard two-kernel split — one pass accumulates dK/dV with
+  the q axis innermost, one pass accumulates dQ with the kv axis innermost —
+  recomputing probabilities from the saved logsumexp instead of storing the
+  score matrix.
+- fp32 statistics and accumulation regardless of input dtype (bf16 inputs
+  feed the MXU directly; probabilities are cast back to the value dtype for
+  the PV matmul, matching the reference's softmax-in-compute-dtype behavior).
+- causal masking skips fully-masked kv blocks; ragged seq lengths are handled
+  by padding to block multiples and masking padded kv columns (padded q rows
+  produce garbage that is sliced off, and contribute zero to gradients
+  because their dO is zero).
+
+On non-TPU backends the kernels run in interpreter mode (tests), so the same
+code path is exercised everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attn_fn"]
+
+_NEG_INF = -1e30  # finite: -inf - -inf = nan would poison alpha/exp paths
+
+
+def _compiler_params(n_parallel: int):
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
+    except TypeError:  # field renamed/absent in this jax version
+        return None
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *,
+                scale, causal, block_q, block_k, kv_len):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[:] = jnp.zeros_like(acc)
+
+    # causal: kv block strictly above the diagonal band contributes nothing
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_sc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, :1] = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:, :1] = m_new
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_sc[:, :1]
+        o_ref[0, 0, :, :] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_sc[:, :1] + jnp.log(l)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    nq, nk = Sq // block_q, Sk // block_k
+    grid = (B, H, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=kv_len)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, lse_ref, *, scale, causal, block_q, block_k,
+                 kv_len, i, j):
+    """exp(QK^T*scale - lse) with padding/causal masking; (block_q, block_k)."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    col = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = col < kv_len
+    if causal:
+        row = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(mask, col <= row)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - lse_ref[0, 0, :, :])
+
+
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   scale, causal, block_q, block_k, kv_len):
+    # grid (B, H, nk, nq) — q innermost, accumulate dK/dV for kv block j
+    j, i = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p(q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k, kv_len=kv_len,
+                         i=i, j=j)
+        do = do_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        q = q_ref[0, 0, :, :]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc, *, scale, causal, block_q, block_k, kv_len):
+    # grid (B, H, nq, nk) — kv innermost, accumulate dQ for q block i
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        p = _recompute_p(q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k, kv_len=kv_len,
+                         i=i, j=j)
+        do = do_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
+    q, k, v, out, lse = res
+    do, _ = g  # cotangent of (out, lse); lse cotangent unused
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    nq, nk = Sq // block_q, Sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    kv_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=kv_len),
+        grid=(B, H, nk, nq),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    q_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_q_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=kv_len),
+        grid=(B, H, nq, nk),
+        in_specs=q_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
+    return _fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, kv_len,
+                    interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+def _round_up(x, m):
+    return ((x + m - 1) // m) * m
+
+
+def flash_attention(q, k, v, mask=None, *, causal: bool = False,
+                    scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention; drop-in for ``dot_product_attention``.
+
+    q,k,v: (batch, seq, heads, head_dim).  Arbitrary ``mask`` falls back to
+    the XLA materialized path (the kernel handles causal + ragged-kv only).
+    """
+    if mask is not None:
+        from hetu_tpu.layers.attention import dot_product_attention
+        return dot_product_attention(q, k, v, mask, scale=scale,
+                                     causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    block_q = min(block_q, _round_up(Sq, 128))
+    block_k = min(block_k, _round_up(Sk, 128))
+    Sq_p, Sk_p = _round_up(Sq, block_q), _round_up(Sk, block_k)
+
+    def prep(x, S_p):
+        x = jnp.swapaxes(x, 1, 2)  # (B, H, S, D)
+        if x.shape[2] != S_p:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, S_p - x.shape[2]), (0, 0)))
+        return x
+
+    out, _ = _flash(prep(q, Sq_p), prep(k, Sk_p), prep(v, Sk_p), scale,
+                    causal, block_q, block_k, Sk, interpret)
+    return jnp.swapaxes(out[:, :, :Sq, :], 1, 2)
+
+
+def flash_attn_fn(*, block_q: int = 128, block_k: int = 128,
+                  interpret: bool | None = None):
+    """An ``attn_fn`` for MultiHeadAttention/TransformerBlock that routes
+    unmasked (or causal) attention through the Pallas kernel."""
+
+    def fn(q, k, v, mask=None, *, scale=None, causal=False):
+        return flash_attention(q, k, v, mask, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+
+    return fn

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build libhetu_embed.so (called automatically from hetu_tpu/embed/engine.py
+# when the library is missing or older than the source).
+set -e
+cd "$(dirname "$0")"
+mkdir -p ../../build
+g++ -O3 -march=native -fPIC -shared -std=c++17 -pthread \
+    embed_engine.cpp -o ../../build/libhetu_embed.so

@@ -1,0 +1,644 @@
+// Host-side sparse embedding engine — the TPU-native equivalent of the
+// reference's parameter-server + embedding-cache stack (HET, VLDB'22):
+//   * sharded host-memory embedding tables with per-row versions and
+//     server-side optimizers      (ps-lite/include/ps/server/{param.h,
+//     optimizer.h:25, PSFHandle.h:17} re-designed, not ported)
+//   * client cache with LRU/LFU/LFUOpt policies and pull/push staleness
+//     bounds                      (src/hetu_cache/include/{cache.h:21,
+//     lru_cache.h:17, lfu_cache.h:17, lfuopt_cache.h:18, hetu_client.h:19})
+//   * async pull/push thread pool (python/hetu/cstable.py:19 async lookup
+//     returning a waitable timestamp)
+//   * SSP bounded-staleness barrier (ps-lite/include/ps/server/ssp_handler.h)
+//   * partial-reduce partner matching (ps-lite/src/preduce_handler.cc,
+//     SIGMOD'21 straggler mitigation)
+//
+// Design notes (why this is not a port): on TPU pods the data plane for
+// dense tensors is XLA collectives over ICI; only the *sparse* path —
+// huge embedding tables that cannot live in HBM — stays on the host. One
+// engine instance serves one host; multi-host sharding is key-range over
+// hosts (the launcher wires host ids), intra-host sharding is striped locks.
+// There is no RPC stack: workers on a host share the engine in-process and
+// reach it from jit via io_callback (hetu_tpu/embed/bridge.py).
+//
+// Exposed as a flat extern "C" ctypes surface (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <list>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+using std::int64_t;
+using std::uint64_t;
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// optimizers (server-side apply; ps-lite optimizer.h:25 capability)
+// ---------------------------------------------------------------------------
+
+enum OptKind : int {
+  OPT_SGD = 0,
+  OPT_MOMENTUM = 1,
+  OPT_ADAGRAD = 2,
+  OPT_ADAM = 3,
+  OPT_ADAMW = 4,
+};
+
+struct OptConfig {
+  int kind = OPT_SGD;
+  float lr = 0.01f;
+  float momentum = 0.9f;   // momentum
+  float beta1 = 0.9f;      // adam
+  float beta2 = 0.999f;    // adam
+  float eps = 1e-8f;
+  float weight_decay = 0.0f;
+};
+
+// ---------------------------------------------------------------------------
+// table: sharded rows + versions + optimizer state
+// ---------------------------------------------------------------------------
+
+constexpr int kShards = 64;  // lock striping within one host
+
+struct Shard {
+  std::mutex mu;
+  // optimizer slots sized lazily on first touch of the shard
+  std::vector<float> m1;  // momentum / adam m / adagrad accum
+  std::vector<float> m2;  // adam v
+};
+
+struct Table {
+  int64_t rows = 0, dim = 0;
+  std::vector<float> data;         // rows x dim
+  std::vector<uint64_t> version;   // per-row update counter
+  Shard shards[kShards];
+  OptConfig opt;
+  std::atomic<uint64_t> step{0};   // global update count (adam bias corr)
+
+  int shard_of(int64_t row) const { return static_cast<int>(row % kShards); }
+
+  void ensure_slots(Shard& s) {
+    size_t need = static_cast<size_t>(rows) * dim;
+    bool needs_m1 = opt.kind != OPT_SGD;
+    bool needs_m2 = opt.kind == OPT_ADAM || opt.kind == OPT_ADAMW;
+    if (needs_m1 && s.m1.size() != need) s.m1.assign(need, 0.f);
+    if (needs_m2 && s.m2.size() != need) s.m2.assign(need, 0.f);
+  }
+
+  // apply one row's gradient under its shard lock
+  void apply_row(int64_t row, const float* g) {
+    Shard& s = shards[shard_of(row)];
+    std::lock_guard<std::mutex> lk(s.mu);
+    ensure_slots(s);
+    float* w = &data[row * dim];
+    uint64_t t = step.fetch_add(0) + 1;  // read; callers bump per batch
+    switch (opt.kind) {
+      case OPT_SGD:
+        for (int64_t j = 0; j < dim; ++j)
+          w[j] -= opt.lr * (g[j] + opt.weight_decay * w[j]);
+        break;
+      case OPT_MOMENTUM: {
+        float* v = &s.m1[row * dim];
+        for (int64_t j = 0; j < dim; ++j) {
+          float gj = g[j] + opt.weight_decay * w[j];
+          v[j] = opt.momentum * v[j] + gj;
+          w[j] -= opt.lr * v[j];
+        }
+        break;
+      }
+      case OPT_ADAGRAD: {
+        float* a = &s.m1[row * dim];
+        for (int64_t j = 0; j < dim; ++j) {
+          float gj = g[j] + opt.weight_decay * w[j];
+          a[j] += gj * gj;
+          w[j] -= opt.lr * gj / (std::sqrt(a[j]) + opt.eps);
+        }
+        break;
+      }
+      case OPT_ADAM:
+      case OPT_ADAMW: {
+        float* m = &s.m1[row * dim];
+        float* v = &s.m2[row * dim];
+        float bc1 = 1.f - std::pow(opt.beta1, static_cast<float>(t));
+        float bc2 = 1.f - std::pow(opt.beta2, static_cast<float>(t));
+        for (int64_t j = 0; j < dim; ++j) {
+          float gj = g[j];
+          if (opt.kind == OPT_ADAM) gj += opt.weight_decay * w[j];
+          m[j] = opt.beta1 * m[j] + (1.f - opt.beta1) * gj;
+          v[j] = opt.beta2 * v[j] + (1.f - opt.beta2) * gj * gj;
+          float mh = m[j] / bc1, vh = v[j] / bc2;
+          float upd = mh / (std::sqrt(vh) + opt.eps);
+          if (opt.kind == OPT_ADAMW) upd += opt.weight_decay * w[j];
+          w[j] -= opt.lr * upd;
+        }
+        break;
+      }
+    }
+    version[row]++;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// cache (HET client semantics)
+// ---------------------------------------------------------------------------
+
+enum CachePolicy : int { POLICY_LRU = 0, POLICY_LFU = 1, POLICY_LFUOPT = 2 };
+
+struct CacheEntry {
+  std::vector<float> emb;    // cached row
+  std::vector<float> grad;   // locally accumulated updates not yet pushed
+  uint64_t version = 0;      // server version when fetched/last synced
+  int64_t pending = 0;       // pushes accumulated since last flush
+  uint64_t freq = 0;         // LFU counter
+  std::list<int64_t>::iterator lru_it;  // LRU position
+};
+
+// One cache per worker (reference: one CacheSparseTable per embedding layer
+// per worker, cstable.py:19). Single-threaded access per worker + engine
+// thread pool for async ops; a mutex still guards because async tasks and
+// the worker thread may overlap.
+struct Cache {
+  Table* table = nullptr;
+  int64_t capacity = 0;
+  int policy = POLICY_LRU;
+  uint64_t pull_bound = 0;  // serve cached row while server_ver - ver <= bound
+  int64_t push_bound = 0;   // flush local grads after this many pushes
+  std::mutex mu;
+  std::unordered_map<int64_t, CacheEntry> map;
+  std::list<int64_t> lru;   // front = most recent
+  uint64_t hits = 0, misses = 0, ops = 0;
+
+  void touch(int64_t key, CacheEntry& e) {
+    if (policy == POLICY_LRU) {
+      lru.erase(e.lru_it);
+      lru.push_front(key);
+      e.lru_it = lru.begin();
+    } else {
+      e.freq++;
+      // LFUOpt: periodic aging halves counters so stale-hot rows decay
+      // (lfuopt_cache.h capability re-designed as amortized aging).
+      if (policy == POLICY_LFUOPT && (++ops % (capacity * 16 + 1)) == 0)
+        for (auto& kv : map) kv.second.freq >>= 1;
+    }
+  }
+
+  // flush entry's pending grads to the table (engine-side optimizer apply)
+  void flush_entry(int64_t key, CacheEntry& e) {
+    if (e.pending == 0) return;
+    table->step.fetch_add(1);
+    table->apply_row(key, e.grad.data());
+    std::fill(e.grad.begin(), e.grad.end(), 0.f);
+    e.pending = 0;
+    // refresh from server so the cached row sees its own update
+    const float* w = &table->data[key * table->dim];
+    std::copy(w, w + table->dim, e.emb.begin());
+    e.version = table->version[key];
+  }
+
+  int64_t pick_victim() {
+    if (policy == POLICY_LRU) return lru.back();
+    int64_t victim = -1;
+    uint64_t best = ~0ull;
+    for (auto& kv : map)  // LFU/LFUOpt: min-freq scan (capacity is modest)
+      if (kv.second.freq < best) { best = kv.second.freq; victim = kv.first; }
+    return victim;
+  }
+
+  void evict_if_needed() {
+    while (static_cast<int64_t>(map.size()) > capacity) {
+      int64_t key = pick_victim();
+      auto it = map.find(key);
+      flush_entry(key, it->second);
+      if (policy == POLICY_LRU) lru.erase(it->second.lru_it);
+      map.erase(it);
+    }
+  }
+
+  // syncEmbedding (hetu_client.h:19): serve each key, refreshing rows whose
+  // staleness exceeds pull_bound.
+  void sync(const int64_t* keys, int64_t n, float* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    int64_t dim = table->dim;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t key = keys[i];
+      auto it = map.find(key);
+      if (it != map.end()) {
+        CacheEntry& e = it->second;
+        uint64_t server_ver = table->version[key];
+        if (server_ver - e.version > pull_bound) {
+          // stale: push pending, re-pull
+          flush_entry(key, e);
+          const float* w = &table->data[key * dim];
+          std::copy(w, w + dim, e.emb.begin());
+          e.version = table->version[key];
+          misses++;
+        } else {
+          hits++;
+        }
+        touch(key, e);
+        std::copy(e.emb.begin(), e.emb.end(), out + i * dim);
+      } else {
+        misses++;
+        CacheEntry e;
+        e.emb.resize(dim);
+        e.grad.assign(dim, 0.f);
+        const float* w = &table->data[key * dim];
+        std::copy(w, w + dim, e.emb.begin());
+        e.version = table->version[key];
+        e.freq = 1;
+        if (policy == POLICY_LRU) {
+          lru.push_front(key);
+          e.lru_it = lru.begin();
+        }
+        std::copy(e.emb.begin(), e.emb.end(), out + i * dim);
+        map.emplace(key, std::move(e));
+        evict_if_needed();
+      }
+    }
+  }
+
+  // pushEmbedding (hetu_client.h:24): accumulate grads locally; rows pushed
+  // through to the server after push_bound accumulations.
+  void push(const int64_t* keys, int64_t n, const float* grads) {
+    std::lock_guard<std::mutex> lk(mu);
+    int64_t dim = table->dim;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t key = keys[i];
+      auto it = map.find(key);
+      if (it == map.end()) {
+        // not cached (evicted between fwd and bwd): apply directly
+        table->step.fetch_add(1);
+        table->apply_row(key, grads + i * dim);
+        continue;
+      }
+      CacheEntry& e = it->second;
+      const float* g = grads + i * dim;
+      for (int64_t j = 0; j < dim; ++j) e.grad[j] += g[j];
+      e.pending++;
+      if (e.pending > push_bound) flush_entry(key, e);
+    }
+  }
+
+  void flush_all() {
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto& kv : map) flush_entry(kv.first, kv.second);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// async engine: thread pool + waitable tickets (cstable.py async semantics)
+// ---------------------------------------------------------------------------
+
+struct Engine {
+  std::vector<std::thread> threads;
+  std::deque<std::pair<uint64_t, std::function<void()>>> tasks;
+  std::mutex mu;
+  std::condition_variable cv, done_cv;
+  std::unordered_map<uint64_t, bool> done;
+  std::atomic<uint64_t> next_ticket{1};
+  bool stop = false;
+
+  explicit Engine(int n_threads) {
+    for (int i = 0; i < n_threads; ++i)
+      threads.emplace_back([this] { run(); });
+  }
+
+  ~Engine() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    for (auto& t : threads) t.join();
+  }
+
+  void run() {
+    for (;;) {
+      std::pair<uint64_t, std::function<void()>> task;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return stop || !tasks.empty(); });
+        if (stop && tasks.empty()) return;
+        task = std::move(tasks.front());
+        tasks.pop_front();
+      }
+      task.second();
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        done[task.first] = true;
+      }
+      done_cv.notify_all();
+    }
+  }
+
+  uint64_t submit(std::function<void()> fn) {
+    uint64_t t = next_ticket.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      tasks.emplace_back(t, std::move(fn));
+      done[t] = false;
+    }
+    cv.notify_one();
+    return t;
+  }
+
+  void wait(uint64_t ticket) {
+    std::unique_lock<std::mutex> lk(mu);
+    done_cv.wait(lk, [&] {
+      auto it = done.find(ticket);
+      return it != done.end() && it->second;
+    });
+    done.erase(ticket);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// SSP coordinator (ssp_handler.h:12)
+// ---------------------------------------------------------------------------
+
+struct SSP {
+  int n_workers, staleness;
+  std::vector<int> clocks;
+  std::mutex mu;
+  std::condition_variable cv;
+
+  SSP(int n, int s) : n_workers(n), staleness(s), clocks(n, 0) {}
+
+  // worker reports clock `c` and blocks until the slowest worker is within
+  // `staleness` of it.
+  void sync(int worker, int clock) {
+    std::unique_lock<std::mutex> lk(mu);
+    clocks[worker] = clock;
+    cv.notify_all();
+    cv.wait(lk, [&] {
+      int min_c = *std::min_element(clocks.begin(), clocks.end());
+      return clock - min_c <= staleness;
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+// partial reduce partner matching (preduce_handler.cc, SIGMOD'21)
+// ---------------------------------------------------------------------------
+
+struct PReduce {
+  int n_workers;
+  double wait_ms;
+  int min_group;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int> arrived;   // workers in the current gathering round
+  uint64_t round = 0;
+  bool closing = false;
+  std::unordered_map<uint64_t, std::vector<int>> groups;  // round -> members
+
+  PReduce(int n, double w, int mg) : n_workers(n), wait_ms(w), min_group(mg) {}
+
+  // Returns the matched group (bitmask over workers). First arrival opens a
+  // window; the group closes when everyone arrived or the window expires
+  // (with >= min_group members).
+  uint64_t get_partner(int worker) {
+    std::unique_lock<std::mutex> lk(mu);
+    uint64_t my_round = round;
+    arrived.push_back(worker);
+    if (static_cast<int>(arrived.size()) == n_workers) {
+      close_group();
+    } else {
+      cv.notify_all();
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double, std::milli>(wait_ms);
+      cv.wait_until(lk, deadline, [&] { return round != my_round; });
+      if (round == my_round &&
+          static_cast<int>(arrived.size()) >= min_group) {
+        close_group();
+      } else if (round == my_round) {
+        // window expired without quorum: wait for the full group
+        cv.wait(lk, [&] { return round != my_round; });
+      }
+    }
+    uint64_t mask = 0;
+    for (int w : groups[my_round]) mask |= (1ull << w);
+    return mask;
+  }
+
+  void close_group() {
+    groups[round] = arrived;
+    arrived.clear();
+    round++;
+    cv.notify_all();
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// extern "C" surface (ctypes; reference ps-lite/src/python_binding.cc:6-151)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* het_table_create(int64_t rows, int64_t dim, int opt_kind, float lr,
+                       float momentum, float beta1, float beta2, float eps,
+                       float weight_decay, uint64_t seed, float init_scale) {
+  auto* t = new Table();
+  t->rows = rows;
+  t->dim = dim;
+  t->opt = OptConfig{opt_kind, lr, momentum, beta1, beta2, eps, weight_decay};
+  t->data.resize(static_cast<size_t>(rows) * dim);
+  t->version.assign(rows, 0);
+  std::mt19937_64 gen(seed);
+  std::normal_distribution<float> dist(0.f, init_scale);
+  for (auto& x : t->data) x = init_scale > 0 ? dist(gen) : 0.f;
+  return t;
+}
+
+void het_table_destroy(void* h) { delete static_cast<Table*>(h); }
+
+void het_table_set_lr(void* h, float lr) {
+  static_cast<Table*>(h)->opt.lr = lr;
+}
+
+void het_table_pull(void* h, const int64_t* keys, int64_t n, float* out) {
+  auto* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* w = &t->data[keys[i] * t->dim];
+    std::copy(w, w + t->dim, out + i * t->dim);
+  }
+}
+
+// dedup-accumulate then one optimizer apply per unique key (the server-side
+// ApplySparse path, PSFHandle.h:130; duplicates within a batch sum first,
+// matching the reference's ReduceIndexedSlice-then-update semantics).
+void het_table_push(void* h, const int64_t* keys, int64_t n,
+                    const float* grads) {
+  auto* t = static_cast<Table*>(h);
+  t->step.fetch_add(1);
+  std::unordered_map<int64_t, std::vector<float>> acc;
+  for (int64_t i = 0; i < n; ++i) {
+    auto& g = acc[keys[i]];
+    if (g.empty()) g.assign(t->dim, 0.f);
+    const float* gi = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) g[j] += gi[j];
+  }
+  for (auto& kv : acc) t->apply_row(kv.first, kv.second.data());
+}
+
+// direct dense write/read (InitTensor / SaveParam paths)
+void het_table_set_rows(void* h, const int64_t* keys, int64_t n,
+                        const float* vals) {
+  auto* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    float* w = &t->data[keys[i] * t->dim];
+    std::copy(vals + i * t->dim, vals + (i + 1) * t->dim, w);
+    t->version[keys[i]]++;
+  }
+}
+
+uint64_t het_table_version(void* h, int64_t row) {
+  return static_cast<Table*>(h)->version[row];
+}
+
+int het_table_save(void* h, const char* path) {
+  auto* t = static_cast<Table*>(h);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  std::fwrite(&t->rows, sizeof(int64_t), 1, f);
+  std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  std::fwrite(t->version.data(), sizeof(uint64_t), t->version.size(), f);
+  std::fclose(f);
+  return 0;
+}
+
+int het_table_load(void* h, const char* path) {
+  auto* t = static_cast<Table*>(h);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t rows, dim;
+  if (std::fread(&rows, sizeof(int64_t), 1, f) != 1 ||
+      std::fread(&dim, sizeof(int64_t), 1, f) != 1 ||
+      rows != t->rows || dim != t->dim) {
+    std::fclose(f);
+    return -2;
+  }
+  size_t nd = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
+  size_t nv = std::fread(t->version.data(), sizeof(uint64_t),
+                         t->version.size(), f);
+  std::fclose(f);
+  return (nd == t->data.size() && nv == t->version.size()) ? 0 : -3;
+}
+
+// ---- cache ----
+
+void* het_cache_create(void* table, int64_t capacity, int policy,
+                       uint64_t pull_bound, int64_t push_bound) {
+  auto* c = new Cache();
+  c->table = static_cast<Table*>(table);
+  c->capacity = capacity;
+  c->policy = policy;
+  c->pull_bound = pull_bound;
+  c->push_bound = push_bound;
+  return c;
+}
+
+void het_cache_destroy(void* h) { delete static_cast<Cache*>(h); }
+
+void het_cache_sync(void* h, const int64_t* keys, int64_t n, float* out) {
+  static_cast<Cache*>(h)->sync(keys, n, out);
+}
+
+void het_cache_push(void* h, const int64_t* keys, int64_t n,
+                    const float* grads) {
+  static_cast<Cache*>(h)->push(keys, n, grads);
+}
+
+void het_cache_flush(void* h) { static_cast<Cache*>(h)->flush_all(); }
+
+int64_t het_cache_size(void* h) {
+  auto* c = static_cast<Cache*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return static_cast<int64_t>(c->map.size());
+}
+
+void het_cache_stats(void* h, uint64_t* hits, uint64_t* misses) {
+  auto* c = static_cast<Cache*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  *hits = c->hits;
+  *misses = c->misses;
+}
+
+// ---- async engine ----
+
+void* het_engine_create(int n_threads) { return new Engine(n_threads); }
+void het_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+uint64_t het_cache_sync_async(void* eng, void* cache, const int64_t* keys,
+                              int64_t n, float* out) {
+  // caller keeps keys/out alive until het_wait returns (numpy arrays pinned
+  // on the python side)
+  std::vector<int64_t> k(keys, keys + n);
+  auto* c = static_cast<Cache*>(cache);
+  return static_cast<Engine*>(eng)->submit(
+      [c, k = std::move(k), n, out] { c->sync(k.data(), n, out); });
+}
+
+uint64_t het_cache_push_async(void* eng, void* cache, const int64_t* keys,
+                              int64_t n, const float* grads) {
+  auto* c = static_cast<Cache*>(cache);
+  std::vector<int64_t> k(keys, keys + n);
+  std::vector<float> g(grads, grads + n * c->table->dim);
+  return static_cast<Engine*>(eng)->submit(
+      [c, k = std::move(k), g = std::move(g), n] {
+        c->push(k.data(), n, g.data());
+      });
+}
+
+uint64_t het_table_push_async(void* eng, void* table, const int64_t* keys,
+                              int64_t n, const float* grads) {
+  auto* t = static_cast<Table*>(table);
+  std::vector<int64_t> k(keys, keys + n);
+  std::vector<float> g(grads, grads + n * t->dim);
+  return static_cast<Engine*>(eng)->submit(
+      [t, k = std::move(k), g = std::move(g), n] {
+        het_table_push(t, k.data(), n, g.data());
+      });
+}
+
+void het_wait(void* eng, uint64_t ticket) {
+  static_cast<Engine*>(eng)->wait(ticket);
+}
+
+// ---- SSP ----
+
+void* het_ssp_create(int n_workers, int staleness) {
+  return new SSP(n_workers, staleness);
+}
+void het_ssp_destroy(void* h) { delete static_cast<SSP*>(h); }
+void het_ssp_sync(void* h, int worker, int clock) {
+  static_cast<SSP*>(h)->sync(worker, clock);
+}
+
+// ---- partial reduce ----
+
+void* het_preduce_create(int n_workers, double wait_ms, int min_group) {
+  return new PReduce(n_workers, wait_ms, min_group);
+}
+void het_preduce_destroy(void* h) { delete static_cast<PReduce*>(h); }
+uint64_t het_preduce_get_partner(void* h, int worker) {
+  return static_cast<PReduce*>(h)->get_partner(worker);
+}
+
+}  // extern "C"
