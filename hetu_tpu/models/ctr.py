@@ -1,0 +1,167 @@
+"""CTR model family: Wide&Deep, DeepFM, DCN (+ cross network).
+
+TPU-native re-designs of the reference CTR examples
+(examples/ctr/models/{wdl_criteo.py,wdl_adult.py,deepfm_criteo.py,
+dcn_criteo.py}): criteo layout of 13 dense + 26 categorical fields embedded
+into a shared id space, a deep MLP tower, and the model-specific parts —
+W&D's wide concat, DeepFM's factorization-machine second-order term, DCN's
+cross layers.
+
+The embedding is pluggable: ``embedding="device"`` keeps the table on-chip
+(pure XLA gather); ``embedding="host"`` uses the HET engine
+(hetu_tpu/embed — host table + cache + server-side optimizer), matching the
+reference's Hybrid mode where embeddings always route through the PS
+(executor.py:276-283) while dense params train on-chip.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.embed import HostEmbedding
+from hetu_tpu.init import normal
+from hetu_tpu.layers import Embedding, Linear
+from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
+
+__all__ = ["CTRConfig", "WideDeep", "DeepFM", "DCN", "make_embedding"]
+
+
+class CTRConfig:
+    """Criteo-shaped feature layout (reference examples/ctr/load_data.py)."""
+
+    def __init__(self, dense_dim: int = 13, sparse_fields: int = 26,
+                 vocab: int = 26000, embed_dim: int = 16,
+                 mlp_hidden: int = 256, embedding: str = "device",
+                 host_optimizer: str = "sgd", host_lr: float = 0.01,
+                 cache_capacity: int = 0, cache_policy: str = "lru",
+                 pull_bound: int = 0, push_bound: int = 0):
+        self.dense_dim = dense_dim
+        self.sparse_fields = sparse_fields
+        self.vocab = vocab
+        self.embed_dim = embed_dim
+        self.mlp_hidden = mlp_hidden
+        self.embedding = embedding
+        self.host_optimizer = host_optimizer
+        self.host_lr = host_lr
+        self.cache_capacity = cache_capacity
+        self.cache_policy = cache_policy
+        self.pull_bound = pull_bound
+        self.push_bound = push_bound
+
+
+def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
+    dim = dim if dim is not None else cfg.embed_dim
+    if cfg.embedding == "host":
+        return HostEmbedding(
+            cfg.vocab, dim, optimizer=cfg.host_optimizer, lr=cfg.host_lr,
+            seed=seed, cache_capacity=cfg.cache_capacity,
+            policy=cfg.cache_policy, pull_bound=cfg.pull_bound,
+            push_bound=cfg.push_bound)
+    return Embedding(cfg.vocab, dim)
+
+
+class _DeepTower(Module):
+    """relu MLP tower (the shared DNN of all three models)."""
+
+    def __init__(self, in_dim: int, hidden: int, out_dim: int, depth: int = 3):
+        dims = [in_dim] + [hidden] * (depth - 1) + [out_dim]
+        self.layers = [Linear(a, b) for a, b in zip(dims[:-1], dims[1:])]
+
+    def __call__(self, x):
+        for i, l in enumerate(self.layers):
+            x = l(x)
+            if i < len(self.layers) - 1:
+                x = relu(x)
+        return x
+
+
+class WideDeep(Module):
+    """Wide&Deep (reference wdl_criteo.py:8): deep tower on dense features,
+    concat with flattened embeddings, linear head."""
+
+    def __init__(self, cfg: CTRConfig):
+        self.cfg = cfg
+        self.embed = make_embedding(cfg)
+        self.deep = _DeepTower(cfg.dense_dim, cfg.mlp_hidden, cfg.mlp_hidden)
+        self.head = Linear(
+            cfg.mlp_hidden + cfg.sparse_fields * cfg.embed_dim, 1)
+
+    def logits(self, dense, sparse):
+        emb = self.embed(sparse).reshape(dense.shape[0], -1)
+        deep = self.deep(dense)
+        return self.head(jnp.concatenate([emb, deep], axis=1))[:, 0]
+
+    def loss(self, dense, sparse, label):
+        logits = self.logits(dense, sparse)
+        loss = binary_cross_entropy_with_logits(logits, label).mean()
+        return loss, {"pred": sigmoid(logits)}
+
+
+class DeepFM(Module):
+    """DeepFM (reference deepfm_criteo.py): first-order embedding +
+    FM second-order interaction + deep tower over flattened embeddings."""
+
+    def __init__(self, cfg: CTRConfig):
+        self.cfg = cfg
+        self.embed = make_embedding(cfg)                 # second-order (k-dim)
+        self.embed1 = make_embedding(cfg, dim=1, seed=1)  # first-order
+        self.deep = _DeepTower(
+            cfg.sparse_fields * cfg.embed_dim, cfg.mlp_hidden, 1)
+        self.bias = jnp.zeros((1,), jnp.float32)
+
+    def logits(self, dense, sparse):
+        v = self.embed(sparse)                       # (b, fields, k)
+        first = self.embed1(sparse)[..., 0].sum(1)   # (b,)
+        # FM: 0.5 * ((sum_f v)^2 - sum_f v^2), summed over k
+        s = v.sum(axis=1)
+        second = 0.5 * ((s * s).sum(-1) - (v * v).sum(axis=(1, 2)))
+        deep = self.deep(v.reshape(v.shape[0], -1))[:, 0]
+        return first + second + deep + self.bias[0]
+
+    def loss(self, dense, sparse, label):
+        logits = self.logits(dense, sparse)
+        loss = binary_cross_entropy_with_logits(logits, label).mean()
+        return loss, {"pred": sigmoid(logits)}
+
+
+class CrossLayer(Module):
+    """One DCN cross layer (reference dcn_criteo.py:8 cross_layer):
+    y = x0 * (x1 @ w) + b + x1."""
+
+    def __init__(self, dim: int):
+        init = normal(stddev=0.01)
+        self.w = init(next_key(), (dim, 1), jnp.float32)
+        self.b = init(next_key(), (dim,), jnp.float32)
+
+    def __call__(self, x0, x1):
+        x1w = x1 @ self.w              # (b, 1)
+        return x0 * x1w + self.b + x1
+
+
+class DCN(Module):
+    """Deep&Cross (reference dcn_criteo.py:28): cross network + deep tower
+    over [embeddings ++ dense], concatenated into the head."""
+
+    def __init__(self, cfg: CTRConfig, num_cross: int = 3):
+        self.cfg = cfg
+        self.embed = make_embedding(cfg)
+        in_dim = cfg.sparse_fields * cfg.embed_dim + cfg.dense_dim
+        self.cross = [CrossLayer(in_dim) for _ in range(num_cross)]
+        self.deep = _DeepTower(in_dim, cfg.mlp_hidden, cfg.mlp_hidden)
+        self.head = Linear(in_dim + cfg.mlp_hidden, 1)
+
+    def logits(self, dense, sparse):
+        emb = self.embed(sparse).reshape(dense.shape[0], -1)
+        x0 = jnp.concatenate([emb, dense], axis=1)
+        x1 = x0
+        for layer in self.cross:
+            x1 = layer(x0, x1)
+        deep = self.deep(x0)
+        return self.head(jnp.concatenate([x1, deep], axis=1))[:, 0]
+
+    def loss(self, dense, sparse, label):
+        logits = self.logits(dense, sparse)
+        loss = binary_cross_entropy_with_logits(logits, label).mean()
+        return loss, {"pred": sigmoid(logits)}

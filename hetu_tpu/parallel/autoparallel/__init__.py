@@ -1,0 +1,37 @@
+"""Auto-parallel search (reference distributed_strategies/ + tools/Galvatron).
+
+The reference ships two families of automatic parallelism planners:
+search-based strategies over its op graph (FlexFlow MCMC flexflow.py:12,
+OptCNN DP optcnn.py:9, GPipe/PipeDream partitioners) and Galvatron's
+layerwise DP/TP/PP/SDP dynamic program with memory+time cost models
+(tools/Galvatron/utils/{cost_model.py:3,38, dp_utils.py:55,129}).
+
+TPU-native equivalent: profile the chip + ICI once (profiler.py, persistent
+cache like HetuSimulator's /tmp/hetu_cached_exetime.bin), feed analytic
+memory/time cost models (cost_model.py), run a per-layer dynamic program
+over pp_deg x {dp, tp, zero-dp} under the HBM budget (search.py), and emit a
+MeshSpec + ShardingStrategy the runtime consumes directly — searching over
+GSPMD configurations instead of rewriting an op graph.
+"""
+
+from hetu_tpu.parallel.autoparallel.cost_model import (
+    ClusterSpec,
+    LayerSpec,
+    MemoryCostModel,
+    ParallelChoice,
+    TimeCostModel,
+    transformer_layer_spec,
+)
+from hetu_tpu.parallel.autoparallel.profiler import CostProfiler
+from hetu_tpu.parallel.autoparallel.search import (
+    Plan,
+    dp_search,
+    mcmc_search,
+    plan_to_strategy,
+)
+
+__all__ = [
+    "ClusterSpec", "LayerSpec", "ParallelChoice", "MemoryCostModel",
+    "TimeCostModel", "transformer_layer_spec", "CostProfiler",
+    "Plan", "dp_search", "mcmc_search", "plan_to_strategy",
+]

@@ -1,0 +1,148 @@
+"""Memory and time cost models (Galvatron utils/cost_model.py re-designed).
+
+The reference's ``MemoryCostModel`` (cost_model.py:3) accounts parameters /
+activations / optimizer states per strategy, and
+``TimeCostModel_with_overlap`` (cost_model.py:38) sums compute and
+communication with DP-overlap discounting.  Same accounting here, in terms
+of TPU quantities: bf16 weights + f32 master/Adam moments, per-axis ICI
+bandwidths, MXU peak flops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+__all__ = [
+    "ClusterSpec", "LayerSpec", "ParallelChoice", "MemoryCostModel",
+    "TimeCostModel", "transformer_layer_spec",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Hardware model: one TPU slice."""
+
+    n_devices: int = 8
+    hbm_bytes: float = 16e9            # v5e: 16 GB/chip
+    peak_flops: float = 197e12         # bf16
+    ici_bandwidth: float = 4.5e10      # bytes/s per link, all-reduce effective
+    dcn_bandwidth: float = 2.5e9       # bytes/s across hosts
+    ici_latency: float = 1e-6
+
+    def allreduce_time(self, bytes_: float, axis_size: int) -> float:
+        """Ring allreduce over an ICI axis: 2(n-1)/n * bytes / bw."""
+        if axis_size <= 1:
+            return 0.0
+        return (2 * (axis_size - 1) / axis_size) * bytes_ / self.ici_bandwidth \
+            + self.ici_latency * axis_size
+
+    def allgather_time(self, bytes_: float, axis_size: int) -> float:
+        if axis_size <= 1:
+            return 0.0
+        return ((axis_size - 1) / axis_size) * bytes_ / self.ici_bandwidth \
+            + self.ici_latency * axis_size
+
+    def p2p_time(self, bytes_: float) -> float:
+        return bytes_ / self.ici_bandwidth + self.ici_latency
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """Per-layer accounting unit (Galvatron treats models as layer lists)."""
+
+    name: str
+    params: float                # parameter count
+    flops_per_sample: float      # fwd flops for one sample
+    activation_per_sample: float  # bytes of saved activations per sample
+    tp_shardable: float = 1.0    # fraction of params that TP splits
+    tp_comm_per_sample: float = 0.0  # bytes TP collectives move per sample
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelChoice:
+    """One strategy point for a layer/stage: dp x tp (dp*tp = stage devices),
+    optionally ZeRO-sharded optimizer+grads over dp (the reference's SDP)."""
+
+    dp: int = 1
+    tp: int = 1
+    zero: bool = False
+
+    def __str__(self):
+        z = "+zero" if self.zero else ""
+        return f"dp{self.dp}tp{self.tp}{z}"
+
+
+def transformer_layer_spec(hidden: int, seq: int, mlp_ratio: int = 4,
+                           name: str = "block") -> LayerSpec:
+    """Standard transformer block accounting (the Galvatron model zoo unit)."""
+    p_attn = 4 * hidden * hidden
+    p_mlp = 2 * mlp_ratio * hidden * hidden
+    flops = 2 * seq * (p_attn + p_mlp) + 4 * seq * seq * hidden
+    # bf16 activations the bwd needs: inputs of each matmul + attn maps
+    act = seq * hidden * 2 * (8 + 2 * mlp_ratio)
+    # Megatron TP: 2 allgather/reduce-scatter pairs per block fwd
+    tp_comm = 4 * seq * hidden * 2
+    return LayerSpec(name, p_attn + p_mlp, flops, act,
+                     tp_shardable=1.0, tp_comm_per_sample=tp_comm)
+
+
+class MemoryCostModel:
+    """Per-device memory of one layer under a choice
+    (Galvatron cost_model.py:3).
+
+    bf16 weights (2B) + f32 master copy (4B) + Adam m/v (8B): weights split
+    by tp; master+moments+grads additionally split by dp under ZeRO.
+    Activations split by dp (batch) and tp (hidden), x pp microbatching.
+    """
+
+    BYTES_WEIGHT = 2.0
+    BYTES_STATE = 12.0  # master + adam moments
+    BYTES_GRAD = 2.0
+
+    def __init__(self, cluster: ClusterSpec):
+        self.cluster = cluster
+
+    def layer_bytes(self, layer: LayerSpec, choice: ParallelChoice,
+                    batch_per_replica: int, n_microbatches: int = 1) -> float:
+        tp_split = choice.tp * layer.tp_shardable + (1 - layer.tp_shardable)
+        p = layer.params / tp_split
+        weights = p * self.BYTES_WEIGHT
+        state = p * self.BYTES_STATE
+        grads = p * self.BYTES_GRAD
+        if choice.zero:
+            state /= choice.dp
+            grads /= choice.dp
+        micro_batch = math.ceil(batch_per_replica / n_microbatches)
+        acts = (layer.activation_per_sample * micro_batch / choice.tp)
+        return weights + state + grads + acts
+
+
+class TimeCostModel:
+    """Per-layer step time under a choice (cost_model.py:38 semantics):
+    compute + TP collectives on the critical path + DP gradient allreduce
+    discounted by overlap."""
+
+    def __init__(self, cluster: ClusterSpec, *, mfu: float = 0.4,
+                 dp_overlap: float = 0.7):
+        self.cluster = cluster
+        self.mfu = mfu
+        self.dp_overlap = dp_overlap
+
+    def layer_time(self, layer: LayerSpec, choice: ParallelChoice,
+                   batch_per_replica: int) -> float:
+        c = self.cluster
+        # fwd + bwd = 3x fwd flops, spread over tp
+        compute = 3 * layer.flops_per_sample * batch_per_replica \
+            / choice.tp / (c.peak_flops * self.mfu)
+        tp_comm = 3 * layer.tp_comm_per_sample * batch_per_replica
+        tp_time = c.allreduce_time(tp_comm, choice.tp)
+        # DP allreduce of bf16 grads (or reduce-scatter+allgather for zero —
+        # same ring volume)
+        grad_bytes = layer.params / max(choice.tp * layer.tp_shardable, 1) \
+            * self.BYTES_GRAD
+        dp_time = c.allreduce_time(grad_bytes, choice.dp) \
+            * (1 - self.dp_overlap)
+        return compute + tp_time + dp_time
+
+    BYTES_GRAD = 2.0

@@ -1,0 +1,214 @@
+"""Strategy search (Galvatron dp_utils.py:55,129 + FlexFlow flexflow.py:12).
+
+``dp_search``: exact enumeration over pp_deg x per-stage ParallelChoice with
+a per-layer dynamic program under the HBM budget — the Galvatron ``DpOnModel``
+algorithm reshaped for GSPMD: the result is a MeshSpec + uniform-or-per-layer
+choice list, not a rewritten graph.
+
+``mcmc_search``: simulated-annealing walk over per-layer choices (the
+FlexFlow MCMC capability, flexflow.py:136) against the same cost models —
+useful when the choice space is non-uniform (mixed layer types).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Optional, Sequence
+
+from hetu_tpu.parallel.autoparallel.cost_model import (
+    ClusterSpec,
+    LayerSpec,
+    MemoryCostModel,
+    ParallelChoice,
+    TimeCostModel,
+)
+
+__all__ = ["Plan", "dp_search", "mcmc_search", "plan_to_strategy"]
+
+
+@dataclasses.dataclass
+class Plan:
+    pp: int
+    n_microbatches: int
+    choices: list          # per-layer ParallelChoice
+    time: float            # modeled step time (s)
+    peak_bytes: float      # modeled per-device memory
+    feasible: bool
+
+    @property
+    def dominant(self) -> ParallelChoice:
+        """Most common per-layer choice (drives the global mesh)."""
+        from collections import Counter
+        return Counter(self.choices).most_common(1)[0][0]
+
+    def describe(self) -> str:
+        d = self.dominant
+        return (f"pp={self.pp} micro={self.n_microbatches} {d} "
+                f"time={self.time * 1e3:.2f}ms "
+                f"mem={self.peak_bytes / 1e9:.2f}GB")
+
+
+def _choices_for(devices_per_stage: int) -> list[ParallelChoice]:
+    out = []
+    tp = 1
+    while tp <= devices_per_stage:
+        dp = devices_per_stage // tp
+        if dp * tp == devices_per_stage:
+            out.append(ParallelChoice(dp=dp, tp=tp, zero=False))
+            if dp > 1:
+                out.append(ParallelChoice(dp=dp, tp=tp, zero=True))
+        tp *= 2
+    return out
+
+
+def _stage_layers(n_layers: int, pp: int) -> list[int]:
+    base, rem = divmod(n_layers, pp)
+    return [base + (1 if i < rem else 0) for i in range(pp)]
+
+
+def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
+              pp: int, n_micro: int, global_batch: int,
+              cluster: ClusterSpec, mem_model: MemoryCostModel,
+              time_model: TimeCostModel) -> tuple[float, float]:
+    """(step_time, peak_stage_bytes) for a per-layer assignment."""
+    counts = _stage_layers(len(layers), pp)
+    idx = 0
+    stage_times, stage_mems = [], []
+    p2p_bytes = 0.0
+    for stage, cnt in enumerate(counts):
+        t = m = 0.0
+        for li in range(idx, idx + cnt):
+            ch = choices[li]
+            bpr = math.ceil(global_batch / ch.dp)
+            t += time_model.layer_time(layers[li], ch, bpr)
+            m += mem_model.layer_bytes(layers[li], ch, bpr, n_micro)
+            if li + 1 == idx + cnt and stage + 1 < pp:
+                # activation bytes crossing the stage boundary per microbatch
+                p2p_bytes = layers[li].activation_per_sample \
+                    * math.ceil(bpr / n_micro) / 8
+        idx += cnt
+        stage_times.append(t)
+        stage_mems.append(m)
+    if pp == 1:
+        return stage_times[0], stage_mems[0]
+    # GPipe/1F1B schedule: (n_micro + pp - 1) slots of the slowest stage
+    slot = max(stage_times) / n_micro
+    bubble_time = (n_micro + pp - 1) * slot
+    bubble_time += 2 * pp * cluster.p2p_time(p2p_bytes)
+    return bubble_time, max(stage_mems)
+
+
+def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+              global_batch: int, *, mem_model: MemoryCostModel | None = None,
+              time_model: TimeCostModel | None = None,
+              microbatch_options: Sequence[int] = (1, 2, 4, 8),
+              uniform: bool = False) -> Plan:
+    """Search pp_deg x per-layer choices; returns the fastest feasible plan.
+
+    With ``uniform=False`` a dynamic program picks each layer's choice
+    independently (Galvatron's per-layer DP, dp_utils.py:55): state =
+    (layer index), value = (time, mem) per candidate choice — since memory
+    adds and time adds within a stage, greedy-per-layer minimization under
+    the budget is exact for uniform stages; feasibility is re-checked on the
+    assembled plan.
+    """
+    mem_model = mem_model or MemoryCostModel(cluster)
+    time_model = time_model or TimeCostModel(cluster)
+    best: Optional[Plan] = None
+    pp = 1
+    while pp <= cluster.n_devices and pp <= len(layers):
+        per_stage = cluster.n_devices // pp
+        if per_stage * pp != cluster.n_devices:
+            pp *= 2
+            continue
+        cands = _choices_for(per_stage)
+        for n_micro in microbatch_options:
+            if pp == 1 and n_micro > 1:
+                continue
+            if uniform:
+                assignments = [[c] * len(layers) for c in cands]
+            else:
+                # per-layer: pick the fastest choice that fits a pro-rata
+                # memory slice; fall back to min-memory choice
+                budget = cluster.hbm_bytes
+                counts = _stage_layers(len(layers), pp)
+                per_layer_budget = [budget / counts[s]
+                                    for s in range(pp) for _ in range(counts[s])]
+                chosen = []
+                for li, layer in enumerate(layers):
+                    def key(c):
+                        bpr = math.ceil(global_batch / c.dp)
+                        return time_model.layer_time(layer, c, bpr)
+                    fits = [c for c in cands
+                            if mem_model.layer_bytes(
+                                layer, c, math.ceil(global_batch / c.dp),
+                                n_micro) <= per_layer_budget[li]]
+                    pool = fits or cands
+                    chosen.append(min(pool, key=key))
+                assignments = [chosen]
+            for choices in assignments:
+                t, m = _evaluate(layers, choices, pp, n_micro, global_batch,
+                                 cluster, mem_model, time_model)
+                plan = Plan(pp, n_micro, list(choices), t, m,
+                            m <= cluster.hbm_bytes)
+                if plan.feasible and (best is None or t < best.time):
+                    best = plan
+        pp *= 2
+    if best is None:  # nothing fits: return min-memory plan, flagged
+        pp = min(cluster.n_devices, len(layers))
+        per_stage = max(cluster.n_devices // pp, 1)
+        c = ParallelChoice(dp=1, tp=per_stage, zero=False)
+        choices = [c] * len(layers)
+        t, m = _evaluate(layers, choices, pp, 8, global_batch, cluster,
+                         mem_model, time_model)
+        best = Plan(pp, 8, choices, t, m, False)
+    return best
+
+
+def mcmc_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+                global_batch: int, *, iters: int = 2000,
+                temperature: float = 0.1, seed: int = 0,
+                pp: int = 1, n_micro: int = 1) -> Plan:
+    """FlexFlow-style MCMC (flexflow.py:12): random per-layer proposal,
+    accept if better or with exp(-dT/T) probability; infeasible states pay a
+    large penalty instead of being rejected outright."""
+    rng = random.Random(seed)
+    mem_model = MemoryCostModel(cluster)
+    time_model = TimeCostModel(cluster)
+    per_stage = cluster.n_devices // pp
+    cands = _choices_for(per_stage)
+
+    def cost(choices):
+        t, m = _evaluate(layers, choices, pp, n_micro, global_batch,
+                         cluster, mem_model, time_model)
+        penalty = max(0.0, m - cluster.hbm_bytes) / cluster.hbm_bytes
+        return t * (1 + 10 * penalty), t, m
+
+    cur = [rng.choice(cands) for _ in layers]
+    cur_cost, cur_t, cur_m = cost(cur)
+    best = (cur_cost, list(cur), cur_t, cur_m)
+    for _ in range(iters):
+        prop = list(cur)
+        prop[rng.randrange(len(layers))] = rng.choice(cands)
+        c, t, m = cost(prop)
+        if c < cur_cost or rng.random() < math.exp(
+                -(c - cur_cost) / (temperature * max(cur_cost, 1e-12))):
+            cur, cur_cost = prop, c
+            if c < best[0]:
+                best = (c, list(prop), t, m)
+    _, choices, t, m = best
+    return Plan(pp, n_micro, choices, t, m, m <= cluster.hbm_bytes)
+
+
+def plan_to_strategy(plan: Plan, *, rules=None, devices=None):
+    """Materialize a Plan as (MeshSpec, ShardingStrategy kwargs) for the
+    runtime (hetu_tpu/parallel/strategies.py)."""
+    from hetu_tpu.parallel.mesh import MeshSpec
+    from hetu_tpu.parallel.spec import MEGATRON_RULES
+    d = plan.dominant
+    mesh_spec = MeshSpec(dp=d.dp, tp=d.tp, pp=plan.pp)
+    kwargs = dict(rules=rules or MEGATRON_RULES, batch_axes="dp",
+                  zero_stage=1 if d.zero else 0)
+    return mesh_spec, kwargs

@@ -1,0 +1,53 @@
+"""CTR model family: W&D / DeepFM / DCN train on criteo-shaped synthetic
+data (reference examples/ctr oracle: loss decreases, AUC beats chance),
+in both device-embedding and host-engine (HET hybrid) modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.data.datasets import synthetic_ctr
+from hetu_tpu.exec import Trainer
+from hetu_tpu.exec.metrics import auc_roc
+from hetu_tpu.models import DCN, CTRConfig, DeepFM, WideDeep
+from hetu_tpu.optim import AdamOptimizer
+
+
+def _train(model_cls, cfg, steps=40, batch=256):
+    set_random_seed(0)
+    model = model_cls(cfg)
+    data = synthetic_ctr(n=batch * 8, sparse_fields=cfg.sparse_fields,
+                         vocab_per_field=cfg.vocab // cfg.sparse_fields)
+    trainer = Trainer(
+        model, AdamOptimizer(1e-2),
+        lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))
+    losses, preds, labels = [], None, None
+    for i in range(steps):
+        lo = (i * batch) % (batch * 8)
+        b = {k: jnp.asarray(v[lo:lo + batch]) for k, v in data.items()}
+        m = trainer.step(b)
+        losses.append(float(m["loss"]))
+        preds, labels = m["pred"], b["label"]
+    return losses, np.asarray(preds), np.asarray(labels)
+
+
+@pytest.mark.parametrize("model_cls", [WideDeep, DeepFM, DCN])
+def test_ctr_trains_device_embedding(model_cls):
+    cfg = CTRConfig(vocab=2600, embed_dim=8, mlp_hidden=64)
+    losses, preds, labels = _train(model_cls, cfg)
+    assert losses[-1] < losses[0]
+    assert auc_roc(preds, labels) > 0.65  # synthetic signal is learnable
+
+
+def test_ctr_host_embedding_hybrid():
+    """Hybrid mode: dense params on-chip Adam, embeddings on the host engine
+    with cache (the HET configuration, executor.py:276-283)."""
+    cfg = CTRConfig(vocab=2600, embed_dim=8, mlp_hidden=64,
+                    embedding="host", host_optimizer="adagrad", host_lr=0.05,
+                    cache_capacity=1024, cache_policy="lfuopt")
+    losses, preds, labels = _train(WideDeep, cfg, steps=30)
+    assert losses[-1] < losses[0]
+    assert auc_roc(preds, labels) > 0.6
