@@ -1,2 +1,9 @@
 from hetu_tpu.data.dataloader import Dataloader
 from hetu_tpu.data.datasets import cifar10, mnist, synthetic_ctr, synthetic_lm
+from hetu_tpu.data.tokenizer import (
+    BasicTokenizer,
+    BertTokenizer,
+    WordPieceTokenizer,
+    build_vocab,
+    load_vocab,
+)

@@ -1,0 +1,239 @@
+"""BERT WordPiece tokenizer.
+
+Capability parity with the reference tokenizer stack
+(reference: python/hetu/tokenizers/bert_tokenizer.py — BertTokenizer:76,
+BasicTokenizer:160, WordpieceTokenizer:270), written fresh from the
+WordPiece algorithm: unicode cleanup → basic tokenization (lowercase,
+accent stripping, punctuation splits, CJK isolation) → greedy
+longest-match-first subword segmentation against a vocab.  Adds the
+conveniences modern pipelines expect: ``encode`` with special tokens,
+sentence pairs, truncation, padding, and batch encoding to numpy arrays
+ready for the dataloader.
+"""
+
+from __future__ import annotations
+
+import collections
+import unicodedata
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["BertTokenizer", "BasicTokenizer", "WordPieceTokenizer",
+           "load_vocab", "build_vocab"]
+
+
+def load_vocab(vocab_file: str) -> Dict[str, int]:
+    """One token per line; id = line number (BERT vocab.txt format)."""
+    vocab = collections.OrderedDict()
+    with open(vocab_file, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def build_vocab(texts: Iterable[str], *, max_size: int = 30000,
+                specials: Sequence[str] = ("[PAD]", "[UNK]", "[CLS]",
+                                           "[SEP]", "[MASK]")) -> Dict[str, int]:
+    """Whole-word frequency vocab builder for tests/small corpora (the
+    reference ships a fixed vocab.txt; this replaces the download)."""
+    basic = BasicTokenizer()
+    counts: collections.Counter = collections.Counter()
+    for t in texts:
+        counts.update(basic.tokenize(t))
+    vocab = collections.OrderedDict((s, i) for i, s in enumerate(specials))
+    for tok, _ in counts.most_common(max_size - len(specials)):
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+    return vocab
+
+
+def _is_whitespace(ch: str) -> bool:
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII non-alphanumeric ranges count as punctuation even when unicode
+    # classifies them otherwise ($, +, ~ ...), matching WordPiece behavior
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting with optional lowercasing."""
+
+    def __init__(self, do_lower_case: bool = True,
+                 never_split: Sequence[str] = ("[UNK]", "[SEP]", "[PAD]",
+                                               "[CLS]", "[MASK]")):
+        self.do_lower_case = do_lower_case
+        self.never_split = set(never_split)
+
+    def tokenize(self, text: str) -> List[str]:
+        text = self._clean(text)
+        text = self._isolate_cjk(text)
+        out: List[str] = []
+        for tok in text.split():
+            if tok in self.never_split:
+                out.append(tok)
+                continue
+            if self.do_lower_case:
+                tok = self._strip_accents(tok.lower())
+            out.extend(self._split_punc(tok))
+        return [t for t in out if t]
+
+    def _clean(self, text: str) -> str:
+        return "".join(
+            " " if _is_whitespace(c) else c
+            for c in text
+            if ord(c) != 0 and ord(c) != 0xFFFD and not _is_control(c)
+        )
+
+    def _isolate_cjk(self, text: str) -> str:
+        return "".join(f" {c} " if _is_cjk(ord(c)) else c for c in text)
+
+    @staticmethod
+    def _strip_accents(text: str) -> str:
+        return "".join(c for c in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(c) != "Mn")
+
+    @staticmethod
+    def _split_punc(tok: str) -> List[str]:
+        pieces: List[str] = []
+        word: List[str] = []
+        for c in tok:
+            if _is_punctuation(c):
+                if word:
+                    pieces.append("".join(word))
+                    word = []
+                pieces.append(c)
+            else:
+                word.append(c)
+        if word:
+            pieces.append("".join(word))
+        return pieces
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword segmentation; continuation pieces
+    carry the ``##`` prefix; unsegmentable words map to ``unk_token``."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars_per_word = max_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+
+class BertTokenizer:
+    """End-to-end text → ids (reference BertTokenizer:76 plus encode/pad).
+
+    ``vocab`` may be a path to a vocab.txt or a dict.  ``encode`` renders
+    ``[CLS] a [SEP]`` or ``[CLS] a [SEP] b [SEP]`` with truncation to
+    ``max_len``; ``batch_encode`` pads to a rectangle and returns
+    ``input_ids / token_type_ids / attention_mask`` numpy arrays.
+    """
+
+    def __init__(self, vocab, do_lower_case: bool = True,
+                 max_len: Optional[int] = None):
+        self.vocab = load_vocab(vocab) if isinstance(vocab, str) else dict(vocab)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case=do_lower_case)
+        self.wordpiece = WordPieceTokenizer(self.vocab)
+        self.max_len = max_len or int(1e12)
+
+    # -- reference API ------------------------------------------------------
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for tok in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(tok))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab.get("[UNK]", 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: Sequence[int]) -> List[str]:
+        return [self.inv_vocab[int(i)] for i in ids]
+
+    # -- conveniences -------------------------------------------------------
+    @property
+    def pad_id(self) -> int:
+        return self.vocab.get("[PAD]", 0)
+
+    def encode(self, text: str, pair: Optional[str] = None,
+               max_len: Optional[int] = None) -> Tuple[List[int], List[int]]:
+        """Returns (input_ids, token_type_ids) with [CLS]/[SEP] framing."""
+        max_len = min(max_len or self.max_len, self.max_len)
+        a = self.tokenize(text)
+        b = self.tokenize(pair) if pair is not None else []
+        n_special = 3 if b else 2
+        budget = max(max_len - n_special, 0)  # specials always fit
+        if b:
+            # longest-first truncation over the pair budget
+            while len(a) + len(b) > budget and (a or b):
+                (a if len(a) >= len(b) else b).pop()
+        else:
+            a = a[:budget]
+        toks = ["[CLS]"] + a + ["[SEP]"]
+        types = [0] * len(toks)
+        if b:
+            toks += b + ["[SEP]"]
+            types += [1] * (len(b) + 1)
+        return self.convert_tokens_to_ids(toks), types
+
+    def batch_encode(self, texts: Sequence[str],
+                     pairs: Optional[Sequence[str]] = None,
+                     max_len: int = 128) -> Dict[str, np.ndarray]:
+        pairs = pairs or [None] * len(texts)
+        enc = [self.encode(t, p, max_len) for t, p in zip(texts, pairs)]
+        width = min(max(len(ids) for ids, _ in enc), max_len)
+        n = len(enc)
+        input_ids = np.full((n, width), self.pad_id, np.int32)
+        token_type = np.zeros((n, width), np.int32)
+        mask = np.zeros((n, width), np.int32)
+        for i, (ids, types) in enumerate(enc):
+            L = min(len(ids), width)
+            input_ids[i, :L] = ids[:L]
+            token_type[i, :L] = types[:L]
+            mask[i, :L] = 1
+        return {"input_ids": input_ids, "token_type_ids": token_type,
+                "attention_mask": mask}

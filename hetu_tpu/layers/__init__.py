@@ -12,9 +12,12 @@ from hetu_tpu.layers.norm import (
 from hetu_tpu.layers.attention import MultiHeadAttention, dot_product_attention
 from hetu_tpu.layers.transformer import TransformerBlock, TransformerMLP
 from hetu_tpu.layers.moe import (
+    BalanceGate,
     ExpertMLP,
     HashGate,
+    KTop1Gate,
     MoELayer,
+    SAMGate,
     TopKGate,
     moe_transformer_mlp,
 )

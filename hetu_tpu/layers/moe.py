@@ -38,7 +38,8 @@ from hetu_tpu.init import normal, zeros
 from hetu_tpu.ops import gelu
 
 __all__ = [
-    "TopKGate", "HashGate", "ExpertMLP", "MoELayer", "moe_transformer_mlp",
+    "TopKGate", "HashGate", "KTop1Gate", "SAMGate", "BalanceGate",
+    "ExpertMLP", "MoELayer", "moe_transformer_mlp",
 ]
 
 
@@ -147,6 +148,207 @@ class HashGate(Module):
         mask = _one_hot(indices, E)
         dispatch, _, _ = _assign_slots(mask, C)
         return dispatch, dispatch, jnp.float32(0.0)
+
+
+class KTop1Gate(Module):
+    """k independent top-1 routers over disjoint expert prototypes
+    (reference layers/KTop1Gate.py:14 ``ktop1gating``): the E experts are
+    split into k prototype groups of E/k; each group gets its own softmax
+    over the corresponding logit slice and routes top-1 within the group, so
+    every token is dispatched to exactly k experts — one per prototype.
+    Balance loss is summed per prototype (KTop1Gate.py:32-35).
+
+    Prototype expert sets are disjoint, so capacity slots never interact
+    across choices (the reference's commented-out ``acc_base`` carries no
+    fill either).  Returns ``(dispatch [T,E,C], combine [T,E,C], aux)``.
+    """
+
+    def __init__(self, dim: int, num_experts: int, k: int = 2, *,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: Optional[float] = None,
+                 dtype=jnp.float32):
+        if num_experts % k:
+            raise ValueError(f"{num_experts} experts not divisible by k={k}")
+        self.w = normal(stddev=0.02)(next_key(), (dim, num_experts), dtype)
+        self.w_axes = ("embed", None)
+        self.b = zeros(None, (num_experts,), dtype)
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+
+    def capacity(self, n_tokens: int, training: bool = True) -> int:
+        import math
+        cf = self.capacity_factor if training else self.eval_capacity_factor
+        return max(1, self.k * math.ceil(n_tokens / self.num_experts * cf))
+
+    def __call__(self, x, *, training: bool = True):
+        T, E, k = x.shape[0], self.num_experts, self.k
+        Ep = E // k                                   # experts per prototype
+        C = self.capacity(T, training)
+        logits = x @ self.w.astype(x.dtype) + self.b.astype(x.dtype)
+        # [T, k, Ep]: per-prototype softmax (KTop1Gate.py:19-21 split+softmax)
+        pgates = jax.nn.softmax(
+            logits.astype(jnp.float32).reshape(T, k, Ep), axis=-1)
+        idx = jnp.argmax(pgates, axis=-1)             # [T, k] local top-1
+        pmask = _one_hot(idx, Ep)                     # [T, k, Ep]
+        gate_val = jnp.sum(pgates * pmask, axis=-1)   # [T, k]
+
+        # per-prototype balance loss vs its own softmax (Ep experts)
+        me = jnp.mean(pgates, axis=0)                 # [k, Ep]
+        ce = jnp.mean(pmask, axis=0)                  # [k, Ep]
+        aux = jnp.sum(me * ce, axis=-1) * Ep          # [k]
+        aux = jnp.sum(aux)
+
+        # slot assignment per prototype (expert columns are disjoint, so
+        # fills never interact; _assign_slots expects one choice per row)
+        dispatch = jnp.zeros((T, E, C), jnp.float32)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        for i in range(k):
+            mask_i = jnp.zeros((T, k, Ep), jnp.float32).at[:, i].set(
+                pmask[:, i]).reshape(T, E)
+            disp_i, _, _ = _assign_slots(mask_i, C)
+            dispatch = dispatch + disp_i
+            combine = combine + gate_val[:, i, None, None] * disp_i
+        return dispatch, combine, aux
+
+
+class SAMGate(Module):
+    """Switch-and-mix locality-aware gate (reference layers/SAMGate.py:21
+    ``samgating``): softmax over all E experts, sum gates within each of G
+    contiguous expert groups (one group per node; SamGroupSum.cu), route the
+    token to its top-1 *group*, then take the top-k experts inside that
+    group (GroupTopKIdx.cu).  All k choices land on one node, so the
+    all-to-all stays intra-node.
+
+    Aux = summed balance loss per choice (SAMGate.py:40,56) plus
+    ``alignment_weight`` × the alignment loss (SamMax.cu: for each token,
+    sum of relu(gate_j − gate_thresh) over experts *outside* the chosen
+    group, thresh = the k-th chosen expert's gate — penalises out-of-group
+    experts that outscore the selection).
+    """
+
+    def __init__(self, dim: int, num_experts: int, k: int = 2, *,
+                 num_groups: int, capacity_factor: float = 1.0,
+                 eval_capacity_factor: Optional[float] = None,
+                 alignment_weight: float = 1.0, dtype=jnp.float32):
+        if num_experts % num_groups:
+            raise ValueError(f"{num_experts} experts not divisible into "
+                             f"{num_groups} groups")
+        if k > num_experts // num_groups:
+            raise ValueError("k exceeds experts per group")
+        self.w = normal(stddev=0.02)(next_key(), (dim, num_experts), dtype)
+        self.w_axes = ("embed", None)
+        self.b = zeros(None, (num_experts,), dtype)
+        self.num_experts = num_experts
+        self.k = k
+        self.num_groups = num_groups
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        self.alignment_weight = alignment_weight
+
+    def capacity(self, n_tokens: int, training: bool = True) -> int:
+        import math
+        cf = self.capacity_factor if training else self.eval_capacity_factor
+        return max(1, self.k * math.ceil(n_tokens / self.num_experts * cf))
+
+    def __call__(self, x, *, training: bool = True):
+        T, E, G = x.shape[0], self.num_experts, self.num_groups
+        Eg = E // G                                    # experts per group
+        C = self.capacity(T, training)
+        logits = x @ self.w.astype(x.dtype) + self.b.astype(x.dtype)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T,E]
+
+        group_sum = jnp.sum(gates.reshape(T, G, Eg), axis=-1)        # [T,G]
+        top1_group = jnp.argmax(group_sum, axis=-1)                  # [T]
+        in_group = _one_hot(top1_group, G)[:, :, None] * jnp.ones((1, 1, Eg))
+        in_group = in_group.reshape(T, E)              # [T,E] group member
+        masked_gates = jnp.where(in_group > 0, gates, -jnp.inf)
+
+        dispatch = jnp.zeros((T, E, C), jnp.float32)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        aux = 0.0
+        remaining = masked_gates
+        fill = None                                    # shared acc_base fill
+        last_gate = None
+        for _ in range(self.k):
+            idx = jnp.argmax(remaining, axis=-1)
+            mask = _one_hot(idx, E)
+            remaining = jnp.where(mask > 0, -jnp.inf, remaining)
+            disp_i, in_cap, fill = _assign_slots(mask, C, fill)
+            gate_i = jnp.sum(gates * mask, axis=-1)
+            last_gate = gate_i
+            dispatch = dispatch + disp_i
+            combine = combine + gate_i[:, None, None] * disp_i
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(mask, axis=0)
+            aux = aux + jnp.sum(me * ce) * E
+        # alignment: out-of-chosen-group gates above the k-th chosen gate,
+        # averaged over tokens so its scale is batch-invariant like the
+        # balance term (means over T) and alignment_weight transfers
+        # across batch/sequence sizes
+        overflow = jnp.maximum(gates - last_gate[:, None], 0.0)
+        alignment = jnp.sum(overflow * (1.0 - in_group)) / T
+        return dispatch, combine, aux + self.alignment_weight * alignment
+
+
+class BalanceGate(Module):
+    """BASE-layer balanced assignment (reference layers/BalanceGate.py:25
+    ``BalanceAssignmentGate`` + BalanceAssignment.cu auction solver): tokens
+    are scored against fixed orthogonal expert centroids and assigned so
+    every expert receives exactly T/E tokens; output is weighted by
+    sigmoid(score) (BASE, Lewis et al. '21).
+
+    TPU redesign: the reference solves the assignment with a sequential
+    auction algorithm — a data-dependent loop that is hostile to XLA.  Here
+    the balanced transport plan comes from ``sinkhorn_iters`` rounds of
+    Sinkhorn row/column normalisation (the S-BASE formulation) followed by
+    capacity-bucketed argmax with C = ceil(T/E), which is a fixed unrollable
+    compute graph of matmul-shaped reductions.  Aux loss is 0 — balance is
+    enforced structurally, exactly as in the reference.
+    """
+
+    _state_fields = ("centroids",)
+
+    def __init__(self, dim: int, num_experts: int, *,
+                 sinkhorn_iters: int = 8, temperature: float = 1.0,
+                 dtype=jnp.float32):
+        key = next_key()
+        # orthogonal, non-trainable centroids (BalanceGate.py:6
+        # generate_orthogonal, gain 0.1)
+        w = jax.random.normal(key, (num_experts, dim), jnp.float32)
+        q, r = jnp.linalg.qr(w.T if num_experts < dim else w)
+        q = q * jnp.sign(jnp.diag(r))
+        self.centroids = (q.T if num_experts < dim else q).astype(dtype) * 0.1
+        self.num_experts = num_experts
+        self.k = 1
+        self.sinkhorn_iters = sinkhorn_iters
+        self.temperature = temperature
+
+    def capacity(self, n_tokens: int, training: bool = True) -> int:
+        import math
+        return max(1, math.ceil(n_tokens / self.num_experts))
+
+    def __call__(self, x, *, training: bool = True):
+        T, E = x.shape[0], self.num_experts
+        C = self.capacity(T, training)
+        scores = (x @ self.centroids.astype(x.dtype).T).astype(jnp.float32)
+
+        # Sinkhorn to a doubly-balanced plan (rows sum 1, cols sum T/E)
+        logp = scores / self.temperature
+        f = jnp.zeros((T, 1), jnp.float32)
+        g = jnp.zeros((1, E), jnp.float32)
+        for _ in range(self.sinkhorn_iters):
+            f = -jax.nn.logsumexp(logp + g, axis=1, keepdims=True)
+            g = (jnp.log(T / E)
+                 - jax.nn.logsumexp(logp + f, axis=0, keepdims=True))
+        plan = logp + f + g                            # balanced log-plan
+        idx = jnp.argmax(plan, axis=-1)                # [T]
+        mask = _one_hot(idx, E)
+        dispatch, in_cap, _ = _assign_slots(mask, C)
+        weight = jax.nn.sigmoid(jnp.sum(scores * mask, axis=-1))  # BASE
+        combine = weight[:, None, None] * dispatch
+        return dispatch, combine, jnp.float32(0.0)
 
 
 class ExpertMLP(Module):

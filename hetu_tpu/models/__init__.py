@@ -10,3 +10,13 @@ from hetu_tpu.models.gpt import GPT, GPTConfig, gpt2_large, gpt2_medium, gpt2_sm
 from hetu_tpu.models.moe_lm import MoEBlock, MoELM, MoELMConfig
 from hetu_tpu.models.resnet import BasicBlock, ResNet, resnet18, resnet34
 from hetu_tpu.models.simple import MLP, LeNet, LogReg, vgg16
+from hetu_tpu.models.swin import Swin, SwinConfig, swin_base, swin_large, swin_tiny
+from hetu_tpu.models.t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    T5Model,
+    t5_base,
+    t5_large,
+    t5_small,
+)
+from hetu_tpu.models.vit import ViT, ViTConfig, vit_base, vit_huge, vit_large

@@ -1,0 +1,240 @@
+"""Swin Transformer.
+
+Capability parity with the Galvatron Swin family (reference:
+tools/Galvatron/swin/hybrid_parallel_model.py over HF Swin — SURVEY §2.5),
+TPU-first: window partitioning is pure static reshape/transpose (XLA fuses
+it into the attention einsums), shifted windows via ``jnp.roll`` with an
+additive shift mask (no gather), relative-position bias indexed from a
+static table, and patch merging as reshape + matmul.  All shapes static per
+stage, so every stage jits to a fixed MXU-tiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import truncated_normal, zeros
+from hetu_tpu.layers import LayerNorm, Linear
+from hetu_tpu.layers.transformer import TransformerMLP
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+__all__ = ["SwinConfig", "Swin", "swin_tiny", "swin_base", "swin_large"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SwinConfig:
+    image_size: int = 224
+    patch_size: int = 4
+    num_channels: int = 3
+    embed_dim: int = 96
+    depths: Sequence[int] = (2, 2, 6, 2)
+    num_heads: Sequence[int] = (3, 6, 12, 24)
+    window_size: int = 7
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dtype: object = jnp.float32
+
+
+def swin_tiny(**kw) -> SwinConfig:
+    return SwinConfig(**kw)
+
+
+def swin_base(**kw) -> SwinConfig:
+    return SwinConfig(embed_dim=128, depths=(2, 2, 18, 2),
+                      num_heads=(4, 8, 16, 32), **kw)
+
+
+def swin_large(**kw) -> SwinConfig:
+    return SwinConfig(embed_dim=192, depths=(2, 2, 18, 2),
+                      num_heads=(6, 12, 24, 48), **kw)
+
+
+def _window_partition(x, ws: int):
+    """[B,H,W,C] -> [B*nW, ws*ws, C] (static reshapes only)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // ws, ws, w // ws, ws, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(-1, ws * ws, c)
+
+
+def _window_reverse(wins, ws: int, h: int, w: int):
+    b = wins.shape[0] // ((h // ws) * (w // ws))
+    x = wins.reshape(b, h // ws, w // ws, ws, ws, -1)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, h, w, -1)
+
+
+def _relative_index(ws: int) -> np.ndarray:
+    """Static [ws*ws, ws*ws] index into the (2ws-1)^2 bias table."""
+    coords = np.stack(np.meshgrid(np.arange(ws), np.arange(ws),
+                                  indexing="ij")).reshape(2, -1)
+    rel = coords[:, :, None] - coords[:, None, :]
+    rel = rel.transpose(1, 2, 0) + (ws - 1)
+    return (rel[..., 0] * (2 * ws - 1) + rel[..., 1]).astype(np.int32)
+
+
+def _shift_mask(h: int, w: int, ws: int, shift: int) -> np.ndarray:
+    """Additive attention mask for shifted windows: -inf between tokens from
+    different pre-shift regions (computed statically at trace time)."""
+    img = np.zeros((h, w))
+    cnt = 0
+    for hs in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+        for vs in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+            img[hs, vs] = cnt
+            cnt += 1
+    wins = img.reshape(h // ws, ws, w // ws, ws).transpose(0, 2, 1, 3)
+    wins = wins.reshape(-1, ws * ws)
+    diff = wins[:, None, :] - wins[:, :, None]
+    return np.where(diff != 0, -1e9, 0.0).astype(np.float32)  # [nW,wsq,wsq]
+
+
+class WindowAttention(Module):
+    """MHA inside ws×ws windows with learned relative-position bias
+    (HF SwinSelfAttention capability, static-shape formulation)."""
+
+    def __init__(self, dim: int, num_heads: int, ws: int, dtype=jnp.float32):
+        init = truncated_normal(stddev=0.02)
+        self.wqkv = init(next_key(), (dim, 3 * dim), dtype)
+        self.wqkv_axes = ("embed", "qkv_three_heads")
+        self.bqkv = zeros(None, (3 * dim,), dtype)
+        self.wo = init(next_key(), (dim, dim), dtype)
+        self.wo_axes = ("heads_merged", "embed")
+        self.bo = zeros(None, (dim,), dtype)
+        self.bias_table = init(
+            next_key(), ((2 * ws - 1) ** 2, num_heads), jnp.float32)
+        self.bias_table_axes = (None, "heads")
+        self.num_heads = num_heads
+        self.ws = ws
+
+    def __call__(self, wins, shift_mask=None):
+        """wins: [nB, wsq, C]; shift_mask: [nW, wsq, wsq] additive or None."""
+        nb, wsq, c = wins.shape
+        H, Dh = self.num_heads, c // self.num_heads
+        qkv = wins @ self.wqkv.astype(wins.dtype) + self.bqkv.astype(wins.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(nb, wsq, H, Dh)
+        k = k.reshape(nb, wsq, H, Dh)
+        v = v.reshape(nb, wsq, H, Dh)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = logits * (Dh ** -0.5)
+        bias = self.bias_table[jnp.asarray(_relative_index(self.ws))]
+        logits = logits + jnp.transpose(bias, (2, 0, 1))[None]
+        if shift_mask is not None:
+            nw = shift_mask.shape[0]
+            logits = logits.reshape(nb // nw, nw, H, wsq, wsq)
+            logits = logits + shift_mask[None, :, None]
+            logits = logits.reshape(nb, H, wsq, wsq)
+        probs = jax.nn.softmax(logits, axis=-1).astype(wins.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(nb, wsq, c)
+        return out @ self.wo.astype(wins.dtype) + self.bo.astype(wins.dtype)
+
+
+class SwinBlock(Module):
+    def __init__(self, dim: int, num_heads: int, ws: int, shift: int,
+                 mlp_ratio: int, resolution: int, dtype=jnp.float32):
+        if resolution <= ws:
+            # feature map no bigger than one window: whole-map attention,
+            # shifting would only mask out in-window pairs (official Swin
+            # sets shift_size=0 and window_size=resolution in this case)
+            ws, shift = resolution, 0
+        self.ln1 = LayerNorm(dim)
+        self.attn = WindowAttention(dim, num_heads, ws, dtype=dtype)
+        self.ln2 = LayerNorm(dim)
+        self.mlp = TransformerMLP(dim, mlp_ratio * dim, dtype=dtype)
+        self.ws = ws
+        self.shift = shift
+
+    def __call__(self, x):
+        """x: [B, H, W, C] feature map."""
+        b, h, w, c = x.shape
+        ws, shift = self.ws, self.shift
+        shortcut = x
+        x = self.ln1(x)
+        if shift:
+            x = jnp.roll(x, (-shift, -shift), axis=(1, 2))
+            mask = jnp.asarray(_shift_mask(h, w, ws, shift))
+        else:
+            mask = None
+        wins = _window_partition(x, ws)
+        wins = self.attn(wins, mask)
+        x = _window_reverse(wins, ws, h, w)
+        if shift:
+            x = jnp.roll(x, (shift, shift), axis=(1, 2))
+        x = shortcut + x
+        return x + self.mlp(self.ln2(x))
+
+
+class PatchMerging(Module):
+    """2x2 neighborhood concat + linear 4C->2C downsample (Swin stage
+    transition), as reshape + matmul."""
+
+    def __init__(self, dim: int, dtype=jnp.float32):
+        self.ln = LayerNorm(4 * dim)
+        self.proj = Linear(4 * dim, 2 * dim, bias=False,
+                           initializer=truncated_normal(stddev=0.02),
+                           dtype=dtype, axes=(None, "embed"))
+
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+            b, h // 2, w // 2, 4 * c)
+        return self.proj(self.ln(x))
+
+
+class Swin(Module):
+    """Swin classifier (HF SwinForImageClassification capability)."""
+
+    def __init__(self, cfg: SwinConfig):
+        p, c = cfg.patch_size, cfg.num_channels
+        self.patch_proj = Linear(p * p * c, cfg.embed_dim,
+                                 initializer=truncated_normal(stddev=0.02),
+                                 dtype=cfg.dtype, axes=(None, "embed"))
+        self.patch_ln = LayerNorm(cfg.embed_dim)
+        self.stages = []
+        self.merges = []
+        dim = cfg.embed_dim
+        resolution = cfg.image_size // cfg.patch_size
+        for si, (depth, heads) in enumerate(zip(cfg.depths, cfg.num_heads)):
+            blocks = [
+                SwinBlock(dim, heads, cfg.window_size,
+                          shift=0 if i % 2 == 0 else cfg.window_size // 2,
+                          mlp_ratio=cfg.mlp_ratio, resolution=resolution,
+                          dtype=cfg.dtype)
+                for i in range(depth)
+            ]
+            self.stages.append(blocks)
+            if si < len(cfg.depths) - 1:
+                self.merges.append(PatchMerging(dim, dtype=cfg.dtype))
+                dim *= 2
+                resolution //= 2
+        self.final_ln = LayerNorm(dim)
+        self.head = Linear(dim, cfg.num_classes,
+                           initializer=truncated_normal(stddev=0.02),
+                           dtype=cfg.dtype, axes=("embed", None))
+        self.config = cfg
+
+    def __call__(self, images, *, key=None, training=False):
+        b, h, w, c = images.shape
+        p = self.config.patch_size
+        x = images.reshape(b, h // p, p, w // p, p, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+            b, h // p, w // p, p * p * c)
+        x = self.patch_ln(self.patch_proj(x))
+        for si, blocks in enumerate(self.stages):
+            for blk in blocks:
+                x = blk(x)
+            if si < len(self.stages) - 1:
+                x = self.merges[si](x)
+        x = self.final_ln(x)
+        return self.head(jnp.mean(x, axis=(1, 2)))
+
+    def loss(self, images, labels, *, key=None, training=True):
+        logits = self(images, key=key, training=training)
+        loss = softmax_cross_entropy_sparse(logits, labels).mean()
+        return loss, {"cls_loss": loss}

@@ -1,0 +1,252 @@
+"""T5 encoder-decoder LM.
+
+Capability parity with the Galvatron T5 family (reference:
+tools/Galvatron/t5/hybrid_parallel_model.py and its vendored
+huggingface/megatron T5 stack — SURVEY §2.5), re-designed TPU-first rather
+than wrapping torch modules: RMSNorm pre-LN blocks, bias-free projections,
+bucketed relative-position bias shared across layers, tied embedding/LM
+head with the d_model**-0.5 rescale, fp32 softmax statistics, and logical
+sharding axes on every weight so the strategy layer can place DP/TP/ZeRO
+(Galvatron's dp/tp/sdp choices) without touching the model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import normal
+from hetu_tpu.layers import Embedding, RMSNorm
+from hetu_tpu.ops import dropout as dropout_op
+from hetu_tpu.ops import relu, softmax_cross_entropy_sparse
+
+__all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration", "t5_small",
+           "t5_base", "t5_large"]
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6            # encoder layers (= decoder layers)
+    num_heads: int = 8
+    relative_buckets: int = 32
+    relative_max_distance: int = 128
+    dropout_rate: float = 0.1
+    dtype: object = jnp.float32
+
+
+def t5_small(**kw) -> T5Config:
+    return T5Config(**kw)
+
+
+def t5_base(**kw) -> T5Config:
+    return T5Config(d_model=768, d_ff=3072, num_layers=12, num_heads=12, **kw)
+
+
+def t5_large(**kw) -> T5Config:
+    return T5Config(d_model=1024, d_ff=4096, num_layers=24, num_heads=16, **kw)
+
+
+def relative_position_bucket(relative_position, *, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """T5's log-spaced relative position bucketing: half the buckets are
+    exact small offsets, the rest span up to ``max_distance``
+    logarithmically (HF T5 `_relative_position_bucket` semantics)."""
+    ret = 0
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5RelativeBias(Module):
+    """Per-head learned bias over bucketed relative positions; lives on the
+    first layer of each stack and is shared by all layers (T5 design)."""
+
+    def __init__(self, cfg: T5Config, *, bidirectional: bool):
+        self.table = normal(stddev=0.02)(
+            next_key(), (cfg.relative_buckets, cfg.num_heads), jnp.float32)
+        self.table_axes = (None, "heads")
+        self.bidirectional = bidirectional
+        self.num_buckets = cfg.relative_buckets
+        self.max_distance = cfg.relative_max_distance
+
+    def __call__(self, q_len: int, k_len: int):
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        bucket = relative_position_bucket(
+            mem - ctx, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance)
+        bias = self.table[bucket]                    # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]  # [1, heads, q, k]
+
+
+class T5Attention(Module):
+    """Self- or cross-attention, bias-free, unscaled QK^T (T5 folds the
+    scale into the init), with optional shared relative-position bias."""
+
+    def __init__(self, cfg: T5Config, *, causal: bool = False):
+        d_inner = cfg.num_heads * cfg.d_kv
+        init = normal(stddev=cfg.d_model ** -0.5)
+        self.wq = init(next_key(), (cfg.d_model, d_inner), cfg.dtype)
+        self.wq_axes = ("embed", "heads_kv")
+        self.wk = init(next_key(), (cfg.d_model, d_inner), cfg.dtype)
+        self.wk_axes = ("embed", "heads_kv")
+        self.wv = init(next_key(), (cfg.d_model, d_inner), cfg.dtype)
+        self.wv_axes = ("embed", "heads_kv")
+        self.wo = init(next_key(), (d_inner, cfg.d_model), cfg.dtype)
+        self.wo_axes = ("heads_kv", "embed")
+        self.num_heads = cfg.num_heads
+        self.d_kv = cfg.d_kv
+        self.causal = causal
+
+    def __call__(self, x, kv=None, mask=None, pos_bias=None):
+        b, qs, _ = x.shape
+        kv = x if kv is None else kv
+        ks = kv.shape[1]
+        H, Dh = self.num_heads, self.d_kv
+        q = (x @ self.wq.astype(x.dtype)).reshape(b, qs, H, Dh)
+        k = (kv @ self.wk.astype(x.dtype)).reshape(b, ks, H, Dh)
+        v = (kv @ self.wv.astype(x.dtype)).reshape(b, ks, H, Dh)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if pos_bias is not None:
+            logits = logits + pos_bias
+        if self.causal:
+            cmask = jnp.tril(jnp.ones((qs, ks), bool), k=ks - qs)
+            logits = jnp.where(cmask, logits, -1e30)
+        if mask is not None:
+            logits = jnp.where(mask.astype(bool), logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, qs, H * Dh)
+        return out @ self.wo.astype(x.dtype)
+
+
+class T5MLP(Module):
+    def __init__(self, cfg: T5Config):
+        init = normal(stddev=cfg.d_model ** -0.5)
+        self.w_in = init(next_key(), (cfg.d_model, cfg.d_ff), cfg.dtype)
+        self.w_in_axes = ("embed", "mlp")
+        self.w_out = init(next_key(), (cfg.d_ff, cfg.d_model), cfg.dtype)
+        self.w_out_axes = ("mlp", "embed")
+
+    def __call__(self, x):
+        return relu(x @ self.w_in.astype(x.dtype)) @ self.w_out.astype(x.dtype)
+
+
+class T5Block(Module):
+    def __init__(self, cfg: T5Config, *, decoder: bool):
+        self.ln1 = RMSNorm(cfg.d_model)
+        self.attn = T5Attention(cfg, causal=decoder)
+        self.cross_ln = RMSNorm(cfg.d_model) if decoder else None
+        self.cross = T5Attention(cfg) if decoder else None
+        self.ln2 = RMSNorm(cfg.d_model)
+        self.mlp = T5MLP(cfg)
+        self.dropout_rate = cfg.dropout_rate
+
+    def __call__(self, x, *, enc=None, mask=None, enc_mask=None,
+                 pos_bias=None, key=None, training=False):
+        keys = jax.random.split(key, 3) if key is not None else (None,) * 3
+        x = x + self._drop(
+            self.attn(self.ln1(x), mask=mask, pos_bias=pos_bias),
+            keys[0], training)
+        if self.cross is not None and enc is not None:
+            x = x + self._drop(
+                self.cross(self.cross_ln(x), kv=enc, mask=enc_mask),
+                keys[1], training)
+        return x + self._drop(self.mlp(self.ln2(x)), keys[2], training)
+
+    def _drop(self, x, key, training):
+        if training and self.dropout_rate > 0.0 and key is not None:
+            return dropout_op(x, self.dropout_rate, key, training=True)
+        return x
+
+
+class T5Stack(Module):
+    def __init__(self, cfg: T5Config, *, decoder: bool):
+        self.rel_bias = T5RelativeBias(cfg, bidirectional=not decoder)
+        self.blocks = [T5Block(cfg, decoder=decoder)
+                       for _ in range(cfg.num_layers)]
+        self.final_ln = RMSNorm(cfg.d_model)
+        self.decoder = decoder
+
+    def __call__(self, x, *, enc=None, mask=None, enc_mask=None, key=None,
+                 training=False):
+        s = x.shape[1]
+        pos_bias = self.rel_bias(s, s)
+        keys = (jax.random.split(key, len(self.blocks)) if key is not None
+                else [None] * len(self.blocks))
+        for blk, k in zip(self.blocks, keys):
+            x = blk(x, enc=enc, mask=mask, enc_mask=enc_mask,
+                    pos_bias=pos_bias, key=k, training=training)
+        return self.final_ln(x)
+
+
+class T5Model(Module):
+    def __init__(self, cfg: T5Config):
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model,
+                                initializer=normal(stddev=1.0),
+                                dtype=cfg.dtype)
+        self.encoder = T5Stack(cfg, decoder=False)
+        self.decoder = T5Stack(cfg, decoder=True)
+        self.config = cfg
+
+    def __call__(self, input_ids, decoder_input_ids, *,
+                 attention_mask=None, decoder_attention_mask=None,
+                 key=None, training=False):
+        ek = dk = None
+        if key is not None:
+            ek, dk = jax.random.split(key)
+        mask = (attention_mask[:, None, None, :]
+                if attention_mask is not None else None)
+        enc = self.encoder(self.shared(input_ids), mask=mask, key=ek,
+                           training=training)
+        dmask = (decoder_attention_mask[:, None, None, :]
+                 if decoder_attention_mask is not None else None)
+        dec = self.decoder(self.shared(decoder_input_ids), enc=enc,
+                           mask=dmask, enc_mask=mask, key=dk,
+                           training=training)
+        return enc, dec
+
+
+class T5ForConditionalGeneration(Module):
+    """Seq2seq LM head over T5Model; head tied to the shared embedding with
+    the d_model**-0.5 output rescale (original T5 tie)."""
+
+    def __init__(self, cfg: T5Config):
+        self.t5 = T5Model(cfg)
+        self.config = cfg
+
+    def __call__(self, input_ids, decoder_input_ids, **kw):
+        _, dec = self.t5(input_ids, decoder_input_ids, **kw)
+        dec = dec * (self.config.d_model ** -0.5)
+        return dec @ self.t5.shared.weight.T.astype(dec.dtype)
+
+    def loss(self, input_ids, decoder_input_ids, labels, *,
+             attention_mask=None, key=None, training=True):
+        logits = self(input_ids, decoder_input_ids,
+                      attention_mask=attention_mask, key=key,
+                      training=training)
+        nll = softmax_cross_entropy_sparse(logits, jnp.maximum(labels, 0))
+        m = (labels >= 0).astype(jnp.float32)
+        loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return loss, {"lm_loss": loss}

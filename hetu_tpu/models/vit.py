@@ -1,0 +1,113 @@
+"""Vision Transformer.
+
+Capability parity with the Galvatron ViT family (reference:
+tools/Galvatron/vit/hybrid_parallel_model.py over HF ViT — SURVEY §2.5),
+TPU-first: patch embedding as one reshaped matmul (MXU-friendly, no conv
+im2col), pre-LN blocks reused from the shared transformer stack, learned
+position embeddings, CLS-token classification head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import normal, truncated_normal, zeros
+from hetu_tpu.layers import LayerNorm, Linear, TransformerBlock
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+__all__ = ["ViTConfig", "ViT", "vit_base", "vit_large", "vit_huge"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dropout_rate: float = 0.0
+    dtype: object = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_base(**kw) -> ViTConfig:
+    return ViTConfig(**kw)
+
+
+def vit_large(**kw) -> ViTConfig:
+    return ViTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def vit_huge(**kw) -> ViTConfig:
+    return ViTConfig(hidden_size=1280, num_layers=32, num_heads=16, **kw)
+
+
+class PatchEmbed(Module):
+    """Non-overlapping patches -> linear projection.  Expressed as a
+    reshape + one [P*P*C, D] matmul so XLA lands it on the MXU directly."""
+
+    def __init__(self, cfg: ViTConfig):
+        p, c, d = cfg.patch_size, cfg.num_channels, cfg.hidden_size
+        self.proj = Linear(p * p * c, d, initializer=truncated_normal(stddev=0.02),
+                           dtype=cfg.dtype, axes=(None, "embed"))
+        self.patch = p
+
+    def __call__(self, images):
+        """images: [B, H, W, C] -> [B, (H/p)*(W/p), D]."""
+        b, h, w, c = images.shape
+        p = self.patch
+        x = images.reshape(b, h // p, p, w // p, p, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+            b, (h // p) * (w // p), p * p * c)
+        return self.proj(x)
+
+
+class ViT(Module):
+    """ViT classifier (HF ViTForImageClassification capability)."""
+
+    def __init__(self, cfg: ViTConfig, attn_fn=None):
+        self.patch_embed = PatchEmbed(cfg)
+        self.cls_token = zeros(None, (1, 1, cfg.hidden_size), cfg.dtype)
+        self.cls_token_axes = (None, None, "embed")
+        self.pos_embed = truncated_normal(stddev=0.02)(
+            next_key(), (1, cfg.num_patches + 1, cfg.hidden_size), cfg.dtype)
+        self.pos_embed_axes = (None, None, "embed")
+        self.blocks = [
+            TransformerBlock(cfg.hidden_size, cfg.num_heads, cfg.mlp_ratio,
+                             dropout_rate=cfg.dropout_rate, attn_fn=attn_fn,
+                             dtype=cfg.dtype)
+            for _ in range(cfg.num_layers)
+        ]
+        self.ln = LayerNorm(cfg.hidden_size)
+        self.head = Linear(cfg.hidden_size, cfg.num_classes,
+                           initializer=normal(stddev=0.02), dtype=cfg.dtype,
+                           axes=("embed", None))
+        self.config = cfg
+
+    def __call__(self, images, *, key=None, training=False):
+        x = self.patch_embed(images)
+        b = x.shape[0]
+        cls = jnp.broadcast_to(self.cls_token.astype(x.dtype),
+                               (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1) + self.pos_embed.astype(x.dtype)
+        keys = (jax.random.split(key, len(self.blocks)) if key is not None
+                else [None] * len(self.blocks))
+        for blk, k in zip(self.blocks, keys):
+            x = blk(x, key=k, training=training)
+        return self.head(self.ln(x[:, 0]))
+
+    def loss(self, images, labels, *, key=None, training=True):
+        logits = self(images, key=key, training=training)
+        loss = softmax_cross_entropy_sparse(logits, labels).mean()
+        return loss, {"cls_loss": loss}

@@ -57,6 +57,8 @@ class LayerSpec:
     activation_per_sample: float  # bytes of saved activations per sample
     tp_shardable: float = 1.0    # fraction of params that TP splits
     tp_comm_per_sample: float = 0.0  # bytes TP collectives move per sample
+    boundary_per_sample: float = 0.0  # bytes of this layer's output (what a
+    #                                   pipeline stage boundary must send)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,7 +86,8 @@ def transformer_layer_spec(hidden: int, seq: int, mlp_ratio: int = 4,
     # Megatron TP: 2 allgather/reduce-scatter pairs per block fwd
     tp_comm = 4 * seq * hidden * 2
     return LayerSpec(name, p_attn + p_mlp, flops, act,
-                     tp_shardable=1.0, tp_comm_per_sample=tp_comm)
+                     tp_shardable=1.0, tp_comm_per_sample=tp_comm,
+                     boundary_per_sample=seq * hidden * 2)
 
 
 class MemoryCostModel:

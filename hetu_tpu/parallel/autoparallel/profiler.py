@@ -108,9 +108,16 @@ class CostProfiler:
         return self._memo(f"allreduce/{axis}{size}/{nbytes}", compute)
 
     def calibrate(self, mesh=None, *, hbm_bytes: float | None = None,
-                  mfu_assumption: float = 1.0) -> ClusterSpec:
+                  mfu_assumption: float = 0.4) -> ClusterSpec:
         """Build a ClusterSpec from measurements (reference: profilers feed
-        the simulator feeding the searchers, §3.5)."""
+        the simulator feeding the searchers, §3.5).
+
+        ``matmul_flops`` measures *sustained* throughput, but
+        ``ClusterSpec.peak_flops`` is consumed by ``TimeCostModel`` which
+        re-discounts it by its own ``mfu`` factor — so the measurement is
+        divided by ``mfu_assumption`` (the utilization the benchmark matmul
+        is assumed to have achieved; keep it equal to TimeCostModel's mfu
+        so the discounts cancel back to the measured sustained rate)."""
         flops = self.matmul_flops()
         n_devices = len(jax.devices()) if mesh is None else mesh.size
         ici = 4.5e10
@@ -126,6 +133,6 @@ class CostProfiler:
         return ClusterSpec(
             n_devices=n_devices,
             hbm_bytes=hbm_bytes or default_hbm,
-            peak_flops=flops * mfu_assumption,
+            peak_flops=flops / mfu_assumption,
             ici_bandwidth=ici,
         )

@@ -76,7 +76,7 @@ def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
     counts = _stage_layers(len(layers), pp)
     idx = 0
     stage_times, stage_mems = [], []
-    p2p_bytes = 0.0
+    p2p_time = 0.0
     for stage, cnt in enumerate(counts):
         t = m = 0.0
         for li in range(idx, idx + cnt):
@@ -85,9 +85,12 @@ def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
             t += time_model.layer_time(layers[li], ch, bpr)
             m += mem_model.layer_bytes(layers[li], ch, bpr, n_micro)
             if li + 1 == idx + cnt and stage + 1 < pp:
-                # activation bytes crossing the stage boundary per microbatch
-                p2p_bytes = layers[li].activation_per_sample \
-                    * math.ceil(bpr / n_micro) / 8
+                # this boundary's output tensor crosses once per microbatch
+                # in each direction (GPipe critical path, no async overlap)
+                boundary = (layers[li].boundary_per_sample
+                            or layers[li].activation_per_sample / 16)
+                p2p_time += 2 * n_micro * cluster.p2p_time(
+                    boundary * math.ceil(bpr / n_micro))
         idx += cnt
         stage_times.append(t)
         stage_mems.append(m)
@@ -95,8 +98,7 @@ def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
         return stage_times[0], stage_mems[0]
     # GPipe/1F1B schedule: (n_micro + pp - 1) slots of the slowest stage
     slot = max(stage_times) / n_micro
-    bubble_time = (n_micro + pp - 1) * slot
-    bubble_time += 2 * pp * cluster.p2p_time(p2p_bytes)
+    bubble_time = (n_micro + pp - 1) * slot + p2p_time
     return bubble_time, max(stage_mems)
 
 

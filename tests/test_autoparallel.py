@@ -90,10 +90,10 @@ def test_plan_to_strategy_materializes():
     layers = _layers(n=8, hidden=2048, seq=512)
     plan = dp_search(layers, CLUSTER, global_batch=32)
     mesh_spec, kwargs = plan_to_strategy(plan)
-    assert mesh_spec.total <= CLUSTER.n_devices
+    assert mesh_spec.total() <= CLUSTER.n_devices
     assert "zero_stage" in kwargs
     # install it on the real (virtual CPU) mesh when sizes match
-    if mesh_spec.total == len(jax.devices()):
+    if mesh_spec.total() == len(jax.devices()):
         from hetu_tpu.parallel.mesh import make_mesh
         from hetu_tpu.parallel.strategies import ShardingStrategy
         mesh = make_mesh(mesh_spec)

@@ -12,7 +12,15 @@ import numpy as np
 import pytest
 
 from hetu_tpu.core import set_random_seed
-from hetu_tpu.layers import ExpertMLP, HashGate, MoELayer, TopKGate
+from hetu_tpu.layers import (
+    BalanceGate,
+    ExpertMLP,
+    HashGate,
+    KTop1Gate,
+    MoELayer,
+    SAMGate,
+    TopKGate,
+)
 from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
 
 
@@ -57,6 +65,70 @@ def test_hash_gate_balanced():
     # round-robin hash → perfectly balanced, nothing dropped
     np.testing.assert_allclose(np.asarray(dispatch.sum((0, 2))), T / E)
     assert float(aux) == 0.0
+
+
+def test_ktop1_gate_one_expert_per_prototype():
+    set_random_seed(5)
+    T, d, E, k = 16, 8, 8, 2
+    gate = KTop1Gate(d, E, k, capacity_factor=4.0)
+    dispatch, combine, aux = gate(_tokens(T, d, 5))
+    C = gate.capacity(T)
+    assert dispatch.shape == (T, E, C)
+    # exactly one expert chosen in each of the k disjoint prototype halves
+    per_proto = np.asarray(dispatch.sum(2)).reshape(T, k, E // k).sum(-1)
+    np.testing.assert_allclose(per_proto, 1.0, rtol=1e-6)
+    # combine weight at a chosen slot is that prototype's softmax prob
+    assert float(combine.max()) <= 1.0 + 1e-6
+    assert float(aux) > 0
+
+
+def test_sam_gate_routes_within_one_group():
+    set_random_seed(6)
+    T, d, E, G, k = 16, 8, 8, 4, 2
+    gate = SAMGate(d, E, k, num_groups=G, capacity_factor=8.0)
+    dispatch, combine, aux = gate(_tokens(T, d, 6))
+    chosen = np.asarray(dispatch.sum(2))            # [T, E]
+    # all k choices of a token land in one contiguous expert group
+    groups = chosen.reshape(T, G, E // G).sum(-1)   # [T, G]
+    assert ((groups > 0).sum(-1) == 1).all()
+    np.testing.assert_allclose(chosen.sum(-1), k, rtol=1e-6)
+    assert float(aux) >= 0
+
+
+def test_balance_gate_exactly_balanced():
+    set_random_seed(7)
+    T, d, E = 32, 16, 4
+    gate = BalanceGate(d, E, sinkhorn_iters=16)
+    dispatch, combine, aux = gate(_tokens(T, d, 7))
+    per_expert = np.asarray(dispatch.sum((0, 2)))
+    # sinkhorn + capacity C=T/E: every expert near its quota, none above
+    assert per_expert.max() <= T / E + 1e-6
+    assert per_expert.sum() >= 0.75 * T             # few tokens dropped
+    assert float(aux) == 0.0
+
+
+def test_balance_gate_centroids_not_trainable():
+    from hetu_tpu.core import trainable_mask
+    set_random_seed(8)
+    gate = BalanceGate(8, 4)
+    mask = trainable_mask(gate)
+    assert not bool(np.asarray(mask.centroids))
+
+
+@pytest.mark.parametrize("make_gate", [
+    lambda d, E: KTop1Gate(d, E, 2, capacity_factor=4.0),
+    lambda d, E: SAMGate(d, E, 2, num_groups=4, capacity_factor=8.0),
+    lambda d, E: BalanceGate(d, E),
+])
+def test_new_gates_drive_moe_layer(make_gate):
+    set_random_seed(9)
+    T, d, E = 32, 8, 8
+    gate = make_gate(d, E)
+    experts = ExpertMLP(E, d, 16)
+    moe = MoELayer(gate, experts, mesh=None)
+    y, aux = jax.jit(lambda m, v: m(v))(moe, _tokens(T, d, 9))
+    assert y.shape == (T, d)
+    assert np.isfinite(np.asarray(y)).all()
 
 
 def test_moe_ep_matches_single_group(ep_mesh):

@@ -1,0 +1,79 @@
+"""WordPiece tokenizer (reference tokenizers/bert_tokenizer.py capability)."""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.data import BasicTokenizer, BertTokenizer, build_vocab
+from hetu_tpu.data.tokenizer import WordPieceTokenizer
+
+VOCAB = {t: i for i, t in enumerate([
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "lazy",
+    "dog", "un", "##want", "##able", ",", "!", "运", "动",
+])}
+
+
+def tok():
+    return BertTokenizer(VOCAB)
+
+
+def test_basic_tokenizer_lower_punct_accents():
+    b = BasicTokenizer()
+    assert b.tokenize("The QUICK, brown!") == ["the", "quick", ",", "brown", "!"]
+    assert b.tokenize("café") == ["cafe"]
+    # CJK chars are isolated into single-char tokens
+    assert b.tokenize("运动abc") == ["运", "动", "abc"]
+
+
+def test_wordpiece_greedy_longest_match():
+    wp = WordPieceTokenizer(VOCAB)
+    assert wp.tokenize("unwantable") == ["un", "##want", "##able"]
+    assert wp.tokenize("jumped") == ["jump", "##ed"]
+    assert wp.tokenize("zzz") == ["[UNK]"]
+
+
+def test_full_tokenize_and_ids_roundtrip():
+    t = tok()
+    toks = t.tokenize("The quick brown fox jumped!")
+    assert toks == ["the", "quick", "brown", "fox", "jump", "##ed", "!"]
+    ids = t.convert_tokens_to_ids(toks)
+    assert t.convert_ids_to_tokens(ids) == toks
+
+
+def test_encode_single_and_pair():
+    t = tok()
+    ids, types = t.encode("the fox")
+    assert t.convert_ids_to_tokens(ids) == ["[CLS]", "the", "fox", "[SEP]"]
+    assert types == [0, 0, 0, 0]
+    ids, types = t.encode("the fox", "lazy dog")
+    toks = t.convert_ids_to_tokens(ids)
+    assert toks == ["[CLS]", "the", "fox", "[SEP]", "lazy", "dog", "[SEP]"]
+    assert types == [0, 0, 0, 0, 1, 1, 1]
+
+
+def test_encode_truncation_longest_first():
+    t = tok()
+    ids, types = t.encode("the quick brown fox", "lazy dog", max_len=7)
+    assert len(ids) == 7
+    # pair kept: longest-first trims the longer side
+    assert types.count(1) >= 2
+
+
+def test_batch_encode_padding_and_mask():
+    t = tok()
+    out = t.batch_encode(["the fox", "the quick brown fox jumped over"],
+                         max_len=16)
+    assert out["input_ids"].shape == out["attention_mask"].shape
+    assert out["input_ids"].dtype == np.int32
+    lens = out["attention_mask"].sum(1)
+    assert lens[0] < lens[1]
+    # padding is [PAD] beyond each row's mask
+    row = out["input_ids"][0]
+    assert (row[lens[0]:] == t.pad_id).all()
+
+
+def test_build_vocab_from_corpus():
+    vocab = build_vocab(["the dog the dog runs", "the cat"], max_size=10)
+    assert "[CLS]" in vocab and "the" in vocab
+    t = BertTokenizer(vocab)
+    assert "the" in t.tokenize("The THE the")
