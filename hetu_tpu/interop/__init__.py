@@ -1,0 +1,19 @@
+"""hetu_tpu.interop — ONNX model interchange.
+
+Covers the reference's ONNX subsystem (python/hetu/onnx/hetu2onnx.py,
+onnx2hetu.py + per-op opset handlers, SURVEY §2.3): export traces a model /
+function to a jaxpr and emits an ONNX ModelProto; import parses a ModelProto
+and rebuilds a jax-callable.  The protobuf wire format is implemented
+self-contained in ``onnx_pb`` (no ``onnx`` package dependency).
+"""
+
+from hetu_tpu.interop.onnx_pb import (  # noqa: F401
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    TensorProto,
+    ValueInfoProto,
+)
+from hetu_tpu.interop.onnx_export import export_fn, export_module, save_model  # noqa: F401
+from hetu_tpu.interop.onnx_import import import_model, load_model  # noqa: F401

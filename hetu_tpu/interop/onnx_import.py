@@ -1,0 +1,497 @@
+"""Import ONNX models as jax callables.
+
+Counterpart of the reference's ``onnx2hetu`` (python/hetu/onnx/onnx2hetu.py +
+X2hetu handlers): parses a ModelProto (via the self-contained ``onnx_pb``
+codec) and interprets the graph with jnp ops.  ``import_model`` returns
+``(fn, params)`` where ``fn(params, **inputs)`` is jittable and ``params`` is
+the initializer dict — so imported models drop straight into jit/grad/pjit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.interop import onnx_pb as pb
+
+__all__ = ["import_model", "load_model"]
+
+
+_OP_HANDLERS: dict[str, Callable] = {}
+
+
+def op_handler(*names):
+    def deco(fn):
+        for n in names:
+            _OP_HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+def _a(node: pb.NodeProto, name: str, default=None):
+    return node.attr(name, default)
+
+
+# elementwise ------------------------------------------------------------------
+
+_SIMPLE = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power, "Neg": jnp.negative,
+    "Exp": jnp.exp, "Log": jnp.log, "Sqrt": jnp.sqrt,
+    "Reciprocal": jnp.reciprocal, "Abs": jnp.abs, "Sign": jnp.sign,
+    "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+    "Sin": jnp.sin, "Cos": jnp.cos, "Tanh": jnp.tanh,
+    "Erf": jax.scipy.special.erf, "Sigmoid": jax.nn.sigmoid,
+    "Relu": jax.nn.relu, "Not": jnp.logical_not,
+    "Equal": jnp.equal, "Less": jnp.less, "LessOrEqual": jnp.less_equal,
+    "Greater": jnp.greater, "GreaterOrEqual": jnp.greater_equal,
+    "And": jnp.logical_and, "Or": jnp.logical_or, "Xor": jnp.logical_xor,
+    "Max": jnp.maximum, "Min": jnp.minimum,
+    "IsNaN": jnp.isnan, "IsInf": jnp.isinf,
+    "Identity": lambda x: x, "Softplus": jax.nn.softplus,
+    "Where": jnp.where, "MatMul": jnp.matmul,
+}
+for _name, _fn in _SIMPLE.items():
+    def _mk(_fn):
+        def h(node, ins):
+            return _fn(*ins)
+        return h
+    _OP_HANDLERS[_name] = _mk(_fn)
+
+
+@op_handler("Mod")
+def _mod(node, ins):
+    return jnp.fmod(*ins) if _a(node, "fmod", 0) else jnp.mod(*ins)
+
+
+@op_handler("LeakyRelu")
+def _leaky(node, ins):
+    return jax.nn.leaky_relu(ins[0], _a(node, "alpha", 0.01))
+
+
+@op_handler("Elu")
+def _elu(node, ins):
+    return jax.nn.elu(ins[0], _a(node, "alpha", 1.0))
+
+
+@op_handler("Gelu")
+def _gelu(node, ins):
+    approx = _a(node, "approximate", "none") == "tanh"
+    return jax.nn.gelu(ins[0], approximate=approx)
+
+
+@op_handler("HardSigmoid")
+def _hard_sigmoid(node, ins):
+    alpha, beta = _a(node, "alpha", 0.2), _a(node, "beta", 0.5)
+    return jnp.clip(alpha * ins[0] + beta, 0.0, 1.0)
+
+
+@op_handler("Clip")
+def _clip(node, ins):
+    lo = ins[1] if len(ins) > 1 and ins[1] is not None else _a(node, "min")
+    hi = ins[2] if len(ins) > 2 and ins[2] is not None else _a(node, "max")
+    return jnp.clip(ins[0], lo, hi)
+
+
+@op_handler("Cast")
+def _cast(node, ins):
+    return ins[0].astype(pb.ONNX_TO_DTYPE[_a(node, "to")])
+
+
+@op_handler("Softmax")
+def _softmax(node, ins):
+    return jax.nn.softmax(ins[0], axis=_a(node, "axis", -1))
+
+
+@op_handler("LogSoftmax")
+def _log_softmax(node, ins):
+    return jax.nn.log_softmax(ins[0], axis=_a(node, "axis", -1))
+
+
+# linear algebra ---------------------------------------------------------------
+
+
+@op_handler("Gemm")
+def _gemm(node, ins):
+    a, b = ins[0], ins[1]
+    if _a(node, "transA", 0):
+        a = a.T
+    if _a(node, "transB", 0):
+        b = b.T
+    out = _a(node, "alpha", 1.0) * (a @ b)
+    if len(ins) > 2 and ins[2] is not None:
+        out = out + _a(node, "beta", 1.0) * ins[2]
+    return out
+
+
+@op_handler("Einsum")
+def _einsum(node, ins):
+    return jnp.einsum(_a(node, "equation"), *ins)
+
+
+# shape ------------------------------------------------------------------------
+
+
+@op_handler("Reshape")
+def _reshape(node, ins):
+    shape = [int(d) for d in np.asarray(ins[1])]
+    # ONNX: 0 copies the input dim, -1 infers
+    in_shape = ins[0].shape
+    shape = [in_shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return jnp.reshape(ins[0], shape)
+
+
+@op_handler("Expand")
+def _expand(node, ins):
+    target = [int(d) for d in np.asarray(ins[1])]
+    x = ins[0]
+    # numpy-style broadcast: align trailing dims; -1/1 keeps input dim
+    shape = list(target)
+    off = len(shape) - x.ndim
+    for i in range(x.ndim):
+        if shape[off + i] == 1 and x.shape[i] != 1:
+            shape[off + i] = x.shape[i]
+    return jnp.broadcast_to(x, shape)
+
+
+@op_handler("Transpose")
+def _transpose(node, ins):
+    perm = _a(node, "perm")
+    return jnp.transpose(ins[0], perm)
+
+
+@op_handler("Concat")
+def _concat(node, ins):
+    return jnp.concatenate(ins, axis=_a(node, "axis", 0))
+
+
+@op_handler("Flatten")
+def _flatten(node, ins):
+    ax = _a(node, "axis", 1)
+    x = ins[0]
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return x.reshape(lead, -1)
+
+
+@op_handler("Unsqueeze")
+def _unsqueeze(node, ins):
+    axes = ([int(d) for d in np.asarray(ins[1])] if len(ins) > 1
+            else _a(node, "axes"))
+    x = ins[0]
+    for ax in sorted(axes):
+        x = jnp.expand_dims(x, ax)
+    return x
+
+
+@op_handler("Squeeze")
+def _squeeze(node, ins):
+    axes = ([int(d) for d in np.asarray(ins[1])] if len(ins) > 1
+            else _a(node, "axes"))
+    return jnp.squeeze(ins[0], axis=tuple(axes) if axes else None)
+
+
+@op_handler("Slice")
+def _slice(node, ins):
+    x = ins[0]
+    starts = [int(v) for v in np.asarray(ins[1])]
+    ends = [int(v) for v in np.asarray(ins[2])]
+    axes = ([int(v) for v in np.asarray(ins[3])] if len(ins) > 3
+            else list(range(len(starts))))
+    steps = ([int(v) for v in np.asarray(ins[4])] if len(ins) > 4
+             else [1] * len(starts))
+    slices = [slice(None)] * x.ndim
+    for s, e, ax, st in zip(starts, ends, axes, steps):
+        # ONNX uses INT64_MAX-ish sentinels for "to the end"
+        dim = x.shape[ax]
+        if e > dim:
+            e = dim
+        if e < -dim - 1:
+            e = None if st < 0 else -dim - 1
+        slices[ax] = slice(s, e, st)
+    return x[tuple(slices)]
+
+
+@op_handler("Pad")
+def _pad(node, ins):
+    pads = [int(v) for v in np.asarray(ins[1])]
+    rank = ins[0].ndim
+    width = [(pads[i], pads[i + rank]) for i in range(rank)]
+    cv = float(np.asarray(ins[2]).reshape(())) if len(ins) > 2 and ins[2] is not None else 0.0
+    mode = _a(node, "mode", "constant")
+    if mode == "constant":
+        return jnp.pad(ins[0], width, constant_values=cv)
+    return jnp.pad(ins[0], width, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+@op_handler("Gather")
+def _gather(node, ins):
+    return jnp.take(ins[0], ins[1].astype(jnp.int32), axis=_a(node, "axis", 0))
+
+
+@op_handler("Shape")
+def _shape(node, ins):
+    return jnp.asarray(ins[0].shape, jnp.int64)
+
+
+@op_handler("Constant")
+def _constant(node, ins):
+    t = node.attr("value")
+    return jnp.asarray(pb.tensor_to_numpy(t))
+
+
+@op_handler("ConstantOfShape")
+def _constant_of_shape(node, ins):
+    shape = [int(d) for d in np.asarray(ins[0])]
+    t = node.attr("value")
+    fill = pb.tensor_to_numpy(t).reshape(()) if t is not None else np.float32(0)
+    return jnp.full(shape, fill, dtype=fill.dtype)
+
+
+@op_handler("Range")
+def _range(node, ins):
+    start, limit, delta = (np.asarray(v).reshape(()) for v in ins)
+    return jnp.arange(start, limit, delta)
+
+
+@op_handler("Split")
+def _split(node, ins):
+    axis = _a(node, "axis", 0)
+    if len(ins) > 1 and ins[1] is not None:
+        sizes = [int(v) for v in np.asarray(ins[1])]
+        idx = np.cumsum(sizes)[:-1]
+        return tuple(jnp.split(ins[0], idx, axis=axis))
+    n = _a(node, "num_outputs")
+    return tuple(jnp.split(ins[0], n, axis=axis))
+
+
+@op_handler("Tile")
+def _tile(node, ins):
+    return jnp.tile(ins[0], [int(v) for v in np.asarray(ins[1])])
+
+
+# reductions -------------------------------------------------------------------
+
+
+def _reduce(fn):
+    def h(node, ins):
+        if len(ins) > 1 and ins[1] is not None:
+            axes = tuple(int(v) for v in np.asarray(ins[1]))
+        else:
+            axes = node.attr("axes")
+            axes = tuple(axes) if axes else None
+        keep = bool(_a(node, "keepdims", 1))
+        return fn(ins[0], axis=axes, keepdims=keep)
+    return h
+
+
+_OP_HANDLERS["ReduceSum"] = _reduce(jnp.sum)
+_OP_HANDLERS["ReduceMean"] = _reduce(jnp.mean)
+_OP_HANDLERS["ReduceMax"] = _reduce(jnp.max)
+_OP_HANDLERS["ReduceMin"] = _reduce(jnp.min)
+_OP_HANDLERS["ReduceProd"] = _reduce(jnp.prod)
+_OP_HANDLERS["ReduceL2"] = _reduce(
+    lambda x, axis, keepdims: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)))
+
+
+@op_handler("ArgMax")
+def _argmax(node, ins):
+    out = jnp.argmax(ins[0], axis=_a(node, "axis", 0))
+    if _a(node, "keepdims", 1):
+        out = jnp.expand_dims(out, _a(node, "axis", 0))
+    return out
+
+
+@op_handler("ArgMin")
+def _argmin(node, ins):
+    out = jnp.argmin(ins[0], axis=_a(node, "axis", 0))
+    if _a(node, "keepdims", 1):
+        out = jnp.expand_dims(out, _a(node, "axis", 0))
+    return out
+
+
+@op_handler("CumSum")
+def _cumsum(node, ins):
+    ax = int(np.asarray(ins[1]).reshape(()))
+    x = ins[0]
+    if _a(node, "reverse", 0):
+        x = jnp.flip(x, ax)
+    out = jnp.cumsum(x, axis=ax)
+    if _a(node, "reverse", 0):
+        out = jnp.flip(out, ax)
+    return out
+
+
+@op_handler("TopK")
+def _topk(node, ins):
+    k = int(np.asarray(ins[1]).reshape(()))
+    axis = _a(node, "axis", -1)
+    largest = _a(node, "largest", 1)
+    x = ins[0] if largest else -ins[0]
+    x_moved = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x_moved, k)
+    vals = jnp.moveaxis(vals if largest else -vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+# NN ---------------------------------------------------------------------------
+
+
+@op_handler("Conv")
+def _conv(node, ins):
+    x, w = ins[0], ins[1]
+    strides = _a(node, "strides") or [1] * (x.ndim - 2)
+    dilations = _a(node, "dilations") or [1] * (x.ndim - 2)
+    pads = _a(node, "pads") or [0] * (2 * (x.ndim - 2))
+    nd = x.ndim - 2
+    padding = [(pads[i], pads[i + nd]) for i in range(nd)]
+    groups = _a(node, "group", 1)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW") if nd == 2 else None)
+    if len(ins) > 2 and ins[2] is not None:
+        bias = ins[2].reshape((1, -1) + (1,) * nd)
+        out = out + bias
+    return out
+
+
+def _pool(node, ins, reducer, init):
+    x = ins[0]
+    nd = x.ndim - 2
+    kernel = _a(node, "kernel_shape")
+    strides = _a(node, "strides") or [1] * nd  # ONNX spec default: stride 1
+    pads = _a(node, "pads") or [0] * (2 * nd)
+    window = (1, 1) + tuple(kernel)
+    strd = (1, 1) + tuple(strides)
+    padding = [(0, 0), (0, 0)] + [(pads[i], pads[i + nd]) for i in range(nd)]
+    return jax.lax.reduce_window(x, init, reducer, window, strd, padding)
+
+
+@op_handler("MaxPool")
+def _maxpool(node, ins):
+    return _pool(node, ins, jax.lax.max, -jnp.inf)
+
+
+@op_handler("AveragePool")
+def _avgpool(node, ins):
+    kernel = _a(node, "kernel_shape")
+    s = _pool(node, ins, jax.lax.add, 0.0)
+    nd = ins[0].ndim - 2
+    pads = _a(node, "pads") or [0] * (2 * nd)
+    if _a(node, "count_include_pad", 0) or not any(pads):
+        return s / float(np.prod(kernel))
+    # spec default: divide each window by its count of non-pad elements
+    ones = jnp.ones_like(ins[0])
+    counts = _pool(node, [ones], jax.lax.add, 0.0)
+    return s / counts
+
+
+@op_handler("GlobalAveragePool")
+def _gap(node, ins):
+    axes = tuple(range(2, ins[0].ndim))
+    return jnp.mean(ins[0], axis=axes, keepdims=True)
+
+
+@op_handler("BatchNormalization")
+def _bn(node, ins):
+    x, scale, bias, mean, var = ins[:5]
+    eps = _a(node, "epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+    return (x - mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+
+
+@op_handler("LayerNormalization")
+def _ln(node, ins):
+    x = ins[0]
+    axis = _a(node, "axis", -1)
+    eps = _a(node, "epsilon", 1e-5)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if len(ins) > 1 and ins[1] is not None:
+        out = out * ins[1]
+    if len(ins) > 2 and ins[2] is not None:
+        out = out + ins[2]
+    return out
+
+
+@op_handler("Dropout")
+def _dropout(node, ins):
+    return ins[0]  # inference
+
+
+# interpreter ------------------------------------------------------------------
+
+# (op_type, input position) pairs whose operand is structural (shape, axes,
+# pads, k, ...) and must stay concrete for jittability.
+_STATIC_ARGS: dict[str, tuple[int, ...]] = {
+    "Reshape": (1,), "Expand": (1,), "Unsqueeze": (1,), "Squeeze": (1,),
+    "Slice": (1, 2, 3, 4), "Pad": (1, 2), "Tile": (1,), "CumSum": (1,),
+    "TopK": (1,), "Split": (1,), "ConstantOfShape": (0,), "Range": (0, 1, 2),
+    "ReduceSum": (1,), "ReduceMean": (1,), "ReduceMax": (1,),
+    "ReduceMin": (1,), "ReduceProd": (1,), "ReduceL2": (1,),
+}
+
+
+def import_model(model: pb.ModelProto | bytes):
+    """Build ``(fn, params)`` from an ONNX model.
+
+    ``fn(params, **inputs)`` (inputs keyed by graph input names; positional
+    also accepted in graph order) runs the graph.  ``params`` maps initializer
+    names to jnp arrays.
+    """
+    if isinstance(model, (bytes, bytearray)):
+        model = pb.ModelProto.decode(bytes(model))
+    graph = model.graph
+    params = {t.name: jnp.asarray(pb.tensor_to_numpy(t))
+              for t in graph.initializers}
+    # shape/axes operands must stay static (concrete) so the interpreted
+    # function remains jittable even when params arrive as tracers
+    static_vals = {t.name: pb.tensor_to_numpy(t) for t in graph.initializers}
+    for node in graph.nodes:
+        if node.op_type == "Constant" and node.outputs:
+            static_vals[node.outputs[0]] = pb.tensor_to_numpy(node.attr("value"))
+    input_names = [vi.name for vi in graph.inputs if vi.name not in params]
+    output_names = [vi.name for vi in graph.outputs]
+
+    def fn(params: dict, *pos, **inputs) -> Any:
+        env: dict[str, Any] = dict(params)
+        for name, val in zip(input_names, pos):
+            env[name] = jnp.asarray(val)
+        for name, val in inputs.items():
+            env[name] = jnp.asarray(val)
+        missing = [n for n in input_names if n not in env]
+        if missing:
+            raise ValueError(f"missing graph inputs: {missing}")
+        for node in graph.nodes:
+            h = _OP_HANDLERS.get(node.op_type)
+            if h is None:
+                raise NotImplementedError(
+                    f"ONNX import: unsupported op '{node.op_type}'")
+            static_pos = _STATIC_ARGS.get(node.op_type, ())
+            ins = [
+                (static_vals[name] if i in static_pos and name in static_vals
+                 else env[name]) if name else None
+                for i, name in enumerate(node.inputs)
+            ]
+            out = h(node, ins)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for name, val in zip(node.outputs, out):
+                if name:
+                    env[name] = val
+        outs = [env[n] for n in output_names]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    return fn, params
+
+
+def load_model(path: str):
+    with open(path, "rb") as f:
+        data = f.read()
+    return import_model(data)

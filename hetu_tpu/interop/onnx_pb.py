@@ -1,0 +1,514 @@
+"""Self-contained ONNX protobuf wire codec.
+
+The environment ships no ``onnx`` package, so this module implements the
+subset of the public ONNX schema (onnx/onnx.proto, Apache-2.0) needed for
+model interchange: ModelProto / GraphProto / NodeProto / AttributeProto /
+TensorProto / ValueInfoProto, encoded and decoded directly at the protobuf
+wire level (varints + length-delimited fields).
+
+Reference counterpart: python/hetu/onnx/ uses the ``onnx`` python package;
+here the codec itself is part of the framework so interchange works in
+hermetic TPU environments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "ModelProto", "GraphProto", "NodeProto", "AttributeProto",
+    "TensorProto", "ValueInfoProto", "OperatorSetId",
+    "tensor_from_numpy", "tensor_to_numpy", "DTYPE_TO_ONNX", "ONNX_TO_DTYPE",
+]
+
+# --- wire-level helpers -------------------------------------------------------
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, _WIRE_LEN) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, _WIRE_VARINT) + _varint(value)
+
+
+def _str_field(field: int, value: str) -> bytes:
+    return _len_field(field, value.encode("utf-8"))
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _tag(field, _WIRE_I32) + struct.pack("<f", value)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed64(n: int) -> int:
+    return n - (1 << 64) if n >= 1 << 63 else n
+
+
+def _scan(data: bytes):
+    """Yield (field_number, wire_type, value) triples from a message body."""
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _WIRE_VARINT:
+            value, pos = _read_varint(data, pos)
+        elif wire == _WIRE_I64:
+            value = data[pos:pos + 8]
+            pos += 8
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(data, pos)
+            value = data[pos:pos + ln]
+            pos += ln
+        elif wire == _WIRE_I32:
+            value = data[pos:pos + 4]
+            pos += 4
+        else:  # pragma: no cover - malformed input
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+def _packed_int64s(payload: bytes) -> list[int]:
+    out, pos = [], 0
+    while pos < len(payload):
+        v, pos = _read_varint(payload, pos)
+        out.append(_signed64(v))
+    return out
+
+
+def _repeated_int64(field: int, values) -> bytes:
+    # packed encoding (proto3 default for repeated scalars)
+    payload = b"".join(_varint(v) for v in values)
+    return _len_field(field, payload) if values else b""
+
+
+# --- ONNX dtype table ---------------------------------------------------------
+
+# TensorProto.DataType enum values from the public ONNX schema.
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+DTYPE_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.uint16): UINT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64,
+    np.dtype(np.bool_): BOOL,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}
+# bfloat16 has no numpy builtin; ml_dtypes ships with jax.
+try:  # pragma: no cover - always present alongside jax
+    import ml_dtypes
+
+    DTYPE_TO_ONNX[np.dtype(ml_dtypes.bfloat16)] = BFLOAT16
+    ONNX_TO_DTYPE[BFLOAT16] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:
+    pass
+
+
+# --- message dataclasses ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TensorProto:
+    name: str = ""
+    dims: tuple = ()
+    data_type: int = FLOAT
+    raw_data: bytes = b""
+
+    def encode(self) -> bytes:
+        out = _repeated_int64(1, list(self.dims))
+        out += _int_field(2, self.data_type)
+        if self.name:
+            out += _str_field(8, self.name)
+        out += _len_field(9, self.raw_data)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TensorProto":
+        t = cls()
+        dims: list[int] = []
+        int64_data: list[int] = []
+        float_data: list[float] = []
+        int32_data: list[int] = []
+        for field, wire, value in _scan(data):
+            if field == 1:
+                dims += _packed_int64s(value) if wire == _WIRE_LEN else [_signed64(value)]
+            elif field == 2:
+                t.data_type = value
+            elif field == 8:
+                t.name = value.decode("utf-8")
+            elif field == 9:
+                t.raw_data = value
+            elif field == 4:  # float_data (non-raw encoders)
+                if wire == _WIRE_LEN:
+                    float_data += list(struct.unpack(f"<{len(value)//4}f", value))
+                else:
+                    float_data.append(struct.unpack("<f", value)[0])
+            elif field == 5:  # int32_data
+                int32_data += _packed_int64s(value) if wire == _WIRE_LEN else [_signed64(value)]
+            elif field == 7:  # int64_data
+                int64_data += _packed_int64s(value) if wire == _WIRE_LEN else [_signed64(value)]
+        t.dims = tuple(dims)
+        if not t.raw_data:
+            if float_data:
+                t.raw_data = np.asarray(float_data, np.float32).tobytes()
+            elif int64_data:
+                t.raw_data = np.asarray(int64_data, np.int64).tobytes()
+            elif int32_data:
+                t.raw_data = np.asarray(int32_data, np.int32).tobytes()
+        return t
+
+
+def tensor_from_numpy(name: str, arr: np.ndarray) -> TensorProto:
+    arr = np.ascontiguousarray(arr)
+    return TensorProto(name=name, dims=tuple(arr.shape),
+                       data_type=DTYPE_TO_ONNX[arr.dtype],
+                       raw_data=arr.tobytes())
+
+
+def tensor_to_numpy(t: TensorProto) -> np.ndarray:
+    dtype = ONNX_TO_DTYPE[t.data_type]
+    return np.frombuffer(t.raw_data, dtype=dtype).reshape(t.dims).copy()
+
+
+# AttributeProto.AttributeType enum values.
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
+
+
+@dataclasses.dataclass
+class AttributeProto:
+    name: str = ""
+    type: int = 0
+    f: float = 0.0
+    i: int = 0
+    s: bytes = b""
+    t: Optional[TensorProto] = None
+    floats: tuple = ()
+    ints: tuple = ()
+    strings: tuple = ()
+
+    @classmethod
+    def make(cls, name: str, value: Any) -> "AttributeProto":
+        a = cls(name=name)
+        if isinstance(value, TensorProto):
+            a.type, a.t = _AT_TENSOR, value
+        elif isinstance(value, bool):
+            a.type, a.i = _AT_INT, int(value)
+        elif isinstance(value, (int, np.integer)):
+            a.type, a.i = _AT_INT, int(value)
+        elif isinstance(value, (float, np.floating)):
+            a.type, a.f = _AT_FLOAT, float(value)
+        elif isinstance(value, str):
+            a.type, a.s = _AT_STRING, value.encode("utf-8")
+        elif isinstance(value, bytes):
+            a.type, a.s = _AT_STRING, value
+        elif isinstance(value, (list, tuple)):
+            if all(isinstance(v, (int, np.integer)) for v in value):
+                a.type, a.ints = _AT_INTS, tuple(int(v) for v in value)
+            elif all(isinstance(v, str) for v in value):
+                a.type, a.strings = _AT_STRINGS, tuple(v.encode() for v in value)
+            else:
+                a.type, a.floats = _AT_FLOATS, tuple(float(v) for v in value)
+        else:
+            raise TypeError(f"unsupported attribute value {value!r}")
+        return a
+
+    @property
+    def value(self) -> Any:
+        if self.type == _AT_FLOAT:
+            return self.f
+        if self.type == _AT_INT:
+            return self.i
+        if self.type == _AT_STRING:
+            return self.s.decode("utf-8")
+        if self.type == _AT_TENSOR:
+            return self.t
+        if self.type == _AT_FLOATS:
+            return list(self.floats)
+        if self.type == _AT_INTS:
+            return list(self.ints)
+        if self.type == _AT_STRINGS:
+            return [s.decode("utf-8") for s in self.strings]
+        return None
+
+    def encode(self) -> bytes:
+        out = _str_field(1, self.name)
+        if self.type == _AT_FLOAT:
+            out += _float_field(2, self.f)
+        elif self.type == _AT_INT:
+            out += _int_field(3, self.i)
+        elif self.type == _AT_STRING:
+            out += _len_field(4, self.s)
+        elif self.type == _AT_TENSOR:
+            out += _len_field(5, self.t.encode())
+        elif self.type == _AT_FLOATS:
+            out += b"".join(_tag(7, _WIRE_I32) + struct.pack("<f", v) for v in self.floats)
+        elif self.type == _AT_INTS:
+            out += _repeated_int64(8, list(self.ints))
+        elif self.type == _AT_STRINGS:
+            out += b"".join(_len_field(9, s) for s in self.strings)
+        out += _int_field(20, self.type)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "AttributeProto":
+        a = cls()
+        ints: list[int] = []
+        floats: list[float] = []
+        strings: list[bytes] = []
+        for field, wire, value in _scan(data):
+            if field == 1:
+                a.name = value.decode("utf-8")
+            elif field == 2:
+                a.f = struct.unpack("<f", value)[0]
+            elif field == 3:
+                a.i = _signed64(value)
+            elif field == 4:
+                a.s = value
+            elif field == 5:
+                a.t = TensorProto.decode(value)
+            elif field == 7:
+                if wire == _WIRE_LEN:
+                    floats += list(struct.unpack(f"<{len(value)//4}f", value))
+                else:
+                    floats.append(struct.unpack("<f", value)[0])
+            elif field == 8:
+                ints += _packed_int64s(value) if wire == _WIRE_LEN else [_signed64(value)]
+            elif field == 9:
+                strings.append(value)
+            elif field == 20:
+                a.type = value
+        a.ints, a.floats, a.strings = tuple(ints), tuple(floats), tuple(strings)
+        if a.type == 0:  # infer for writers that omit the type field
+            if a.t is not None:
+                a.type = _AT_TENSOR
+            elif ints:
+                a.type = _AT_INTS
+            elif floats:
+                a.type = _AT_FLOATS
+            elif strings:
+                a.type = _AT_STRINGS
+        return a
+
+
+@dataclasses.dataclass
+class NodeProto:
+    op_type: str = ""
+    inputs: tuple = ()
+    outputs: tuple = ()
+    name: str = ""
+    attributes: tuple = ()
+    domain: str = ""
+
+    def attr(self, name: str, default=None):
+        for a in self.attributes:
+            if a.name == name:
+                return a.value
+        return default
+
+    def encode(self) -> bytes:
+        out = b"".join(_str_field(1, s) for s in self.inputs)
+        out += b"".join(_str_field(2, s) for s in self.outputs)
+        if self.name:
+            out += _str_field(3, self.name)
+        out += _str_field(4, self.op_type)
+        out += b"".join(_len_field(5, a.encode()) for a in self.attributes)
+        if self.domain:
+            out += _str_field(7, self.domain)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeProto":
+        n = cls()
+        inputs, outputs, attrs = [], [], []
+        for field, _wire, value in _scan(data):
+            if field == 1:
+                inputs.append(value.decode("utf-8"))
+            elif field == 2:
+                outputs.append(value.decode("utf-8"))
+            elif field == 3:
+                n.name = value.decode("utf-8")
+            elif field == 4:
+                n.op_type = value.decode("utf-8")
+            elif field == 5:
+                attrs.append(AttributeProto.decode(value))
+            elif field == 7:
+                n.domain = value.decode("utf-8")
+        n.inputs, n.outputs, n.attributes = tuple(inputs), tuple(outputs), tuple(attrs)
+        return n
+
+
+@dataclasses.dataclass
+class ValueInfoProto:
+    name: str = ""
+    elem_type: int = FLOAT
+    shape: tuple = ()  # ints or str (symbolic dim)
+
+    def encode(self) -> bytes:
+        dims = b""
+        for d in self.shape:
+            if isinstance(d, str):
+                dim = _str_field(2, d)
+            else:
+                dim = _int_field(1, int(d))
+            dims += _len_field(1, dim)
+        tensor_type = _int_field(1, self.elem_type) + _len_field(2, dims)
+        type_proto = _len_field(1, tensor_type)
+        return _str_field(1, self.name) + _len_field(2, type_proto)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValueInfoProto":
+        v = cls()
+        for field, _wire, value in _scan(data):
+            if field == 1:
+                v.name = value.decode("utf-8")
+            elif field == 2:
+                for f2, _w2, v2 in _scan(value):
+                    if f2 != 1:  # tensor_type
+                        continue
+                    shape: list = []
+                    for f3, _w3, v3 in _scan(v2):
+                        if f3 == 1:
+                            v.elem_type = v3
+                        elif f3 == 2:  # TensorShapeProto
+                            for f4, _w4, v4 in _scan(v3):
+                                if f4 == 1:  # Dimension
+                                    dim: Any = 0
+                                    for f5, _w5, v5 in _scan(v4):
+                                        if f5 == 1:
+                                            dim = _signed64(v5)
+                                        elif f5 == 2:
+                                            dim = v5.decode("utf-8")
+                                    shape.append(dim)
+                    v.shape = tuple(shape)
+        return v
+
+
+@dataclasses.dataclass
+class GraphProto:
+    name: str = "hetu_tpu"
+    nodes: tuple = ()
+    initializers: tuple = ()
+    inputs: tuple = ()
+    outputs: tuple = ()
+
+    def encode(self) -> bytes:
+        out = b"".join(_len_field(1, n.encode()) for n in self.nodes)
+        out += _str_field(2, self.name)
+        out += b"".join(_len_field(5, t.encode()) for t in self.initializers)
+        out += b"".join(_len_field(11, v.encode()) for v in self.inputs)
+        out += b"".join(_len_field(12, v.encode()) for v in self.outputs)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "GraphProto":
+        g = cls()
+        nodes, inits, inputs, outputs = [], [], [], []
+        for field, _wire, value in _scan(data):
+            if field == 1:
+                nodes.append(NodeProto.decode(value))
+            elif field == 2:
+                g.name = value.decode("utf-8")
+            elif field == 5:
+                inits.append(TensorProto.decode(value))
+            elif field == 11:
+                inputs.append(ValueInfoProto.decode(value))
+            elif field == 12:
+                outputs.append(ValueInfoProto.decode(value))
+        g.nodes, g.initializers = tuple(nodes), tuple(inits)
+        g.inputs, g.outputs = tuple(inputs), tuple(outputs)
+        return g
+
+
+@dataclasses.dataclass
+class OperatorSetId:
+    domain: str = ""
+    version: int = 17
+
+    def encode(self) -> bytes:
+        return _str_field(1, self.domain) + _int_field(2, self.version)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "OperatorSetId":
+        o = cls()
+        for field, _wire, value in _scan(data):
+            if field == 1:
+                o.domain = value.decode("utf-8")
+            elif field == 2:
+                o.version = _signed64(value)
+        return o
+
+
+@dataclasses.dataclass
+class ModelProto:
+    graph: GraphProto = dataclasses.field(default_factory=GraphProto)
+    ir_version: int = 8
+    producer_name: str = "hetu_tpu"
+    opset_version: int = 17
+
+    def encode(self) -> bytes:
+        out = _int_field(1, self.ir_version)
+        out += _str_field(2, self.producer_name)
+        out += _len_field(7, self.graph.encode())
+        out += _len_field(8, OperatorSetId(version=self.opset_version).encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ModelProto":
+        m = cls()
+        for field, _wire, value in _scan(data):
+            if field == 1:
+                m.ir_version = _signed64(value)
+            elif field == 2:
+                m.producer_name = value.decode("utf-8")
+            elif field == 7:
+                m.graph = GraphProto.decode(value)
+            elif field == 8:
+                opset = OperatorSetId.decode(value)
+                if opset.domain in ("", "ai.onnx"):  # default domain only
+                    m.opset_version = opset.version
+        return m

@@ -22,6 +22,7 @@ from hetu_tpu.core.module import Module
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import truncated_normal, zeros
 from hetu_tpu.layers import LayerNorm, Linear
+from hetu_tpu.models.vit import PatchEmbed
 from hetu_tpu.layers.transformer import TransformerMLP
 from hetu_tpu.ops import softmax_cross_entropy_sparse
 
@@ -142,6 +143,11 @@ class SwinBlock(Module):
             # shifting would only mask out in-window pairs (official Swin
             # sets shift_size=0 and window_size=resolution in this case)
             ws, shift = resolution, 0
+        if resolution % ws:
+            raise ValueError(
+                f"stage resolution {resolution} is not divisible by "
+                f"window_size {ws}; pick image_size/patch_size/window_size "
+                f"so every stage's feature map tiles into whole windows")
         self.ln1 = LayerNorm(dim)
         self.attn = WindowAttention(dim, num_heads, ws, dtype=dtype)
         self.ln2 = LayerNorm(dim)
@@ -191,10 +197,13 @@ class Swin(Module):
     """Swin classifier (HF SwinForImageClassification capability)."""
 
     def __init__(self, cfg: SwinConfig):
-        p, c = cfg.patch_size, cfg.num_channels
-        self.patch_proj = Linear(p * p * c, cfg.embed_dim,
-                                 initializer=truncated_normal(stddev=0.02),
-                                 dtype=cfg.dtype, axes=(None, "embed"))
+        if cfg.image_size % cfg.patch_size:
+            raise ValueError(
+                f"image_size {cfg.image_size} not divisible by "
+                f"patch_size {cfg.patch_size}")
+        self.patch_embed = PatchEmbed(cfg.patch_size, cfg.num_channels,
+                                      cfg.embed_dim, dtype=cfg.dtype,
+                                      flatten=False)
         self.patch_ln = LayerNorm(cfg.embed_dim)
         self.stages = []
         self.merges = []
@@ -220,12 +229,7 @@ class Swin(Module):
         self.config = cfg
 
     def __call__(self, images, *, key=None, training=False):
-        b, h, w, c = images.shape
-        p = self.config.patch_size
-        x = images.reshape(b, h // p, p, w // p, p, c)
-        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
-            b, h // p, w // p, p * p * c)
-        x = self.patch_ln(self.patch_proj(x))
+        x = self.patch_ln(self.patch_embed(images))
         for si, blocks in enumerate(self.stages):
             for blk in blocks:
                 x = blk(x)

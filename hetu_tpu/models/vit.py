@@ -55,21 +55,38 @@ def vit_huge(**kw) -> ViTConfig:
 
 class PatchEmbed(Module):
     """Non-overlapping patches -> linear projection.  Expressed as a
-    reshape + one [P*P*C, D] matmul so XLA lands it on the MXU directly."""
+    reshape + one [P*P*C, D] matmul so XLA lands it on the MXU directly.
 
-    def __init__(self, cfg: ViTConfig):
-        p, c, d = cfg.patch_size, cfg.num_channels, cfg.hidden_size
-        self.proj = Linear(p * p * c, d, initializer=truncated_normal(stddev=0.02),
-                           dtype=cfg.dtype, axes=(None, "embed"))
+    Shared by ViT and Swin (``flatten=False`` keeps the [B, H/p, W/p, D]
+    feature-map layout Swin's windowed stages consume).
+    """
+
+    def __init__(self, patch_size: int, num_channels: int, dim: int,
+                 dtype=jnp.float32, flatten: bool = True):
+        p, c = patch_size, num_channels
+        self.proj = Linear(p * p * c, dim, initializer=truncated_normal(stddev=0.02),
+                           dtype=dtype, axes=(None, "embed"))
         self.patch = p
+        self.flatten = flatten
+
+    @classmethod
+    def from_config(cls, cfg: ViTConfig) -> "PatchEmbed":
+        return cls(cfg.patch_size, cfg.num_channels, cfg.hidden_size,
+                   dtype=cfg.dtype)
 
     def __call__(self, images):
-        """images: [B, H, W, C] -> [B, (H/p)*(W/p), D]."""
+        """images: [B, H, W, C] -> [B, (H/p)*(W/p), D] (or [B, H/p, W/p, D])."""
         b, h, w, c = images.shape
         p = self.patch
+        if h % p or w % p:
+            raise ValueError(
+                f"image size {(h, w)} not divisible by patch size {p}")
         x = images.reshape(b, h // p, p, w // p, p, c)
-        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
-            b, (h // p) * (w // p), p * p * c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        if self.flatten:
+            x = x.reshape(b, (h // p) * (w // p), p * p * c)
+        else:
+            x = x.reshape(b, h // p, w // p, p * p * c)
         return self.proj(x)
 
 
@@ -77,7 +94,7 @@ class ViT(Module):
     """ViT classifier (HF ViTForImageClassification capability)."""
 
     def __init__(self, cfg: ViTConfig, attn_fn=None):
-        self.patch_embed = PatchEmbed(cfg)
+        self.patch_embed = PatchEmbed.from_config(cfg)
         self.cls_token = zeros(None, (1, 1, cfg.hidden_size), cfg.dtype)
         self.cls_token_axes = (None, None, "embed")
         self.pos_embed = truncated_normal(stddev=0.02)(

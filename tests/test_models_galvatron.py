@@ -114,6 +114,18 @@ def test_swin_forward_and_grads():
     assert float(jnp.abs(g.merges[0].proj.w).sum()) > 0
 
 
+def test_swin_rejects_untileable_config():
+    import dataclasses as dc
+    import pytest
+    set_random_seed(4)
+    cfg = dc.replace(_swin_tiny(), window_size=6)  # 16 % 6 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        Swin(cfg)
+    cfg = dc.replace(_swin_tiny(), patch_size=5)  # 32 % 5 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        Swin(cfg)
+
+
 def test_swin_shifted_window_mask_blocks_cross_region():
     from hetu_tpu.models.swin import _shift_mask
     m = _shift_mask(8, 8, 4, 2)

@@ -1,0 +1,220 @@
+"""ONNX interop: protobuf codec round-trip, export→import numeric equality.
+
+Mirrors the reference's tests/onnx round-trip strategy (hetu→onnx→TF and
+back, tests/onnx/test_nodes.py) with the oracle being the original jax
+function itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.interop import (
+    ModelProto, export_fn, export_module, import_model, load_model, save_model,
+)
+from hetu_tpu.interop import onnx_pb as pb
+
+
+def roundtrip(fn, *args, atol=1e-5):
+    proto = export_fn(fn, *args)
+    data = proto.encode()
+    fn2, params = import_model(data)
+    want = fn(*args)
+    got = fn2(params, *args)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a, np.float32),
+                                                np.asarray(b, np.float32),
+                                                atol=atol, rtol=1e-4),
+        want, got)
+    return proto
+
+
+class TestCodec:
+    def test_tensor_roundtrip(self):
+        for arr in [np.random.randn(3, 4).astype(np.float32),
+                    np.arange(6, dtype=np.int64).reshape(2, 3),
+                    np.array(True)]:
+            t = pb.tensor_from_numpy("x", arr)
+            back = pb.tensor_to_numpy(pb.TensorProto.decode(t.encode()))
+            np.testing.assert_array_equal(arr, back)
+
+    def test_model_roundtrip(self):
+        node = pb.NodeProto(op_type="Add", inputs=("a", "b"), outputs=("c",),
+                            attributes=(pb.AttributeProto.make("axis", 1),
+                                        pb.AttributeProto.make("f", 2.5),
+                                        pb.AttributeProto.make("name", "hi"),
+                                        pb.AttributeProto.make("ints", [1, 2])))
+        graph = pb.GraphProto(
+            nodes=(node,),
+            initializers=(pb.tensor_from_numpy("b", np.ones((2,), np.float32)),),
+            inputs=(pb.ValueInfoProto("a", pb.FLOAT, (2,)),),
+            outputs=(pb.ValueInfoProto("c", pb.FLOAT, (2,)),))
+        m = pb.ModelProto(graph=graph)
+        m2 = ModelProto.decode(m.encode())
+        assert m2.graph.nodes[0].op_type == "Add"
+        assert m2.graph.nodes[0].attr("axis") == 1
+        assert m2.graph.nodes[0].attr("f") == 2.5
+        assert m2.graph.nodes[0].attr("name") == "hi"
+        assert m2.graph.nodes[0].attr("ints") == [1, 2]
+        assert m2.graph.inputs[0].shape == (2,)
+        np.testing.assert_array_equal(
+            pb.tensor_to_numpy(m2.graph.initializers[0]), np.ones((2,)))
+
+
+class TestExportImport:
+    def test_elementwise_chain(self):
+        x = jnp.asarray(np.random.randn(4, 5), jnp.float32)
+        roundtrip(lambda x: jnp.tanh(x) * 2.0 + jnp.exp(-x * x), x)
+
+    def test_matmul_bias_relu(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((4,)), jnp.float32)
+        roundtrip(lambda x: jax.nn.relu(x @ w + b), x)
+
+    def test_reductions_softmax(self):
+        x = jnp.asarray(np.random.randn(3, 7), jnp.float32)
+        roundtrip(lambda x: jax.nn.softmax(x, axis=-1).sum(axis=0), x)
+        roundtrip(lambda x: x.max(axis=1) - x.min(axis=1), x)
+        roundtrip(lambda x: jnp.mean(x * x, axis=-1, keepdims=True), x)
+
+    def test_shape_ops(self):
+        x = jnp.asarray(np.random.randn(2, 3, 4), jnp.float32)
+        roundtrip(lambda x: jnp.transpose(x, (2, 0, 1)).reshape(4, 6), x)
+        roundtrip(lambda x: jnp.concatenate([x, x], axis=1), x)
+        roundtrip(lambda x: x[:, 1:3, ::2], x)
+        roundtrip(lambda x: jnp.pad(x, ((0, 0), (1, 1), (2, 0))), x)
+        roundtrip(lambda x: jnp.flip(x, axis=2), x)
+
+    def test_comparisons_where(self):
+        x = jnp.asarray(np.random.randn(5, 5), jnp.float32)
+        roundtrip(lambda x: jnp.where(x > 0, x, 0.1 * x), x)
+
+    def test_cast_clamp(self):
+        x = jnp.asarray(np.random.randn(6), jnp.float32)
+        roundtrip(lambda x: jnp.clip(x, -0.5, 0.5).astype(jnp.float32), x)
+
+    def test_gather_embedding(self):
+        table = jnp.asarray(np.random.randn(10, 4), jnp.float32)
+        ids = jnp.asarray([[1, 3], [5, 7]], jnp.int32)
+        roundtrip(lambda ids: jnp.take(table, ids, axis=0), ids)
+
+    def test_layernorm_pattern(self):
+        from hetu_tpu.ops import nn as hnn
+        x = jnp.asarray(np.random.randn(4, 16), jnp.float32)
+        g = jnp.ones((16,), jnp.float32)
+        b = jnp.zeros((16,), jnp.float32)
+        roundtrip(lambda x: hnn.layer_norm(x, g, b), x)
+
+    def test_argmax_cumsum(self):
+        x = jnp.asarray(np.random.randn(3, 9), jnp.float32)
+        roundtrip(lambda x: jnp.argmax(x, axis=1).astype(jnp.int32), x)
+        roundtrip(lambda x: jnp.cumsum(x, axis=1), x)
+
+    def test_dynamic_slice(self):
+        x = jnp.asarray(np.random.randn(4, 8), jnp.float32)
+        i = jnp.asarray(2, jnp.int32)
+        f = lambda x, i: jax.lax.dynamic_slice(x, (0, i), (4, 3))
+        proto = export_fn(f, x, i)
+        fn, params = import_model(proto.encode())
+        np.testing.assert_allclose(np.asarray(f(x, i)),
+                                   np.asarray(fn(params, x, i)), atol=1e-6)
+        # out-of-bounds start: jax clamps; export must match
+        big = jnp.asarray(7, jnp.int32)
+        np.testing.assert_allclose(np.asarray(f(x, big)),
+                                   np.asarray(fn(params, x, big)), atol=1e-6)
+        # and stays jittable
+        jitted = jax.jit(lambda p, a, b: fn(p, a, b))
+        np.testing.assert_allclose(np.asarray(jitted(params, x, i)),
+                                   np.asarray(f(x, i)), atol=1e-6)
+
+    def test_rem_sign_and_is_finite(self):
+        x = jnp.asarray([-5.0, 5.0, -7.5], jnp.float32)
+        y = jnp.asarray([3.0, -3.0, 2.0], jnp.float32)
+        roundtrip(lambda x, y: jax.lax.rem(x, y), x, y)
+        z = jnp.asarray([1.0, jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+        proto = export_fn(lambda z: jnp.isfinite(z), z)
+        fn, params = import_model(proto.encode())
+        np.testing.assert_array_equal(np.asarray(jnp.isfinite(z)),
+                                      np.asarray(fn(params, z)))
+
+    def test_avg_pool_padded_external_model(self):
+        """External-style AveragePool with pads and default count_include_pad=0."""
+        node = pb.NodeProto(op_type="AveragePool", inputs=("x",), outputs=("y",),
+                            attributes=(pb.AttributeProto.make("kernel_shape", [2, 2]),
+                                        pb.AttributeProto.make("pads", [1, 1, 0, 0])))
+        graph = pb.GraphProto(nodes=(node,),
+                              inputs=(pb.ValueInfoProto("x", pb.FLOAT, (1, 1, 3, 3)),),
+                              outputs=(pb.ValueInfoProto("y", pb.FLOAT, (1, 1, 3, 3)),))
+        fn, params = import_model(pb.ModelProto(graph=graph).encode())
+        x = jnp.ones((1, 1, 3, 3), jnp.float32)
+        y = np.asarray(fn(params, x))
+        # every window must average to 1.0 when divisor excludes padding
+        np.testing.assert_allclose(y, np.ones_like(y), atol=1e-6)
+        # default strides must be 1 (not kernel_shape)
+        assert y.shape == (1, 1, 3, 3)
+
+    def test_dot_general_einsum_path(self):
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.standard_normal((2, 3, 4)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((2, 4, 5)), jnp.float32)
+        roundtrip(lambda a, b: jnp.einsum("bij,bjk->bik", a, b), a, b)
+        # contraction over a middle dim forces the Einsum fallback
+        c = jnp.asarray(rng.standard_normal((4, 5, 2)), jnp.float32)
+        roundtrip(lambda a, c: jnp.einsum("bij,jkb->bik", a, c), a, c)
+        # rank-3 rhs with NO batch dims: jax puts lhs free dims first,
+        # ONNX MatMul would broadcast — must take the Einsum path
+        d = jnp.asarray(rng.standard_normal((2, 3)), jnp.float32)
+        e = jnp.asarray(rng.standard_normal((4, 3, 5)), jnp.float32)
+        roundtrip(lambda d, e: jnp.dot(d, e), d, e)
+
+
+class TestModels:
+    def test_mlp_module(self):
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.layers import Linear, Sequential
+        from hetu_tpu.layers.base import Lambda
+
+        set_random_seed(0)
+        model = Sequential(Linear(8, 16), Lambda(jax.nn.relu), Linear(16, 2))
+        x = jnp.asarray(np.random.randn(4, 8), jnp.float32)
+        proto = export_module(model, x)
+        fn, params = import_model(proto.encode())
+        np.testing.assert_allclose(np.asarray(model(x)),
+                                   np.asarray(fn(params, x)),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_cnn_conv_pool(self):
+        from hetu_tpu.ops import nn as hnn
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((3, 3, 3, 4)) * 0.1, jnp.float32)
+
+        def f(x):
+            h = hnn.conv2d(x, w, stride=1, padding="SAME")
+            h = jax.nn.relu(h)
+            h = hnn.max_pool2d(h, window=2)
+            return hnn.avg_pool2d(h, window=2)
+
+        roundtrip(f, x, atol=1e-4)
+
+    def test_save_load_file(self, tmp_path):
+        x = jnp.asarray(np.random.randn(3, 3), jnp.float32)
+        proto = export_fn(lambda x: jnp.tanh(x) @ jnp.eye(3), x)
+        p = tmp_path / "m.onnx"
+        save_model(proto, str(p))
+        fn, params = load_model(str(p))
+        np.testing.assert_allclose(np.asarray(jnp.tanh(x) @ jnp.eye(3)),
+                                   np.asarray(fn(params, x)), atol=1e-5)
+
+    def test_jit_imported(self):
+        """Imported fn must be jittable (pure jnp interpreter)."""
+        x = jnp.asarray(np.random.randn(4, 4), jnp.float32)
+        proto = export_fn(lambda x: jax.nn.softmax(x @ x.T), x)
+        fn, params = import_model(proto.encode())
+        jitted = jax.jit(lambda p, x: fn(p, x))
+        np.testing.assert_allclose(np.asarray(jitted(params, x)),
+                                   np.asarray(jax.nn.softmax(x @ x.T)),
+                                   atol=1e-5, rtol=1e-4)
