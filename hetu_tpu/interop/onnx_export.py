@@ -25,11 +25,12 @@ __all__ = ["export_fn", "export_module", "save_model"]
 
 
 class _Exporter:
-    def __init__(self):
+    def __init__(self, const_names: dict | None = None):
         self.nodes: list[pb.NodeProto] = []
         self.initializers: dict[str, np.ndarray] = {}
         self.names: dict[int, str] = {}   # id(jaxpr var) -> onnx name
         self.consts: dict[int, np.ndarray] = {}  # id(var) -> known value
+        self.const_names = const_names or {}  # id(array) -> preferred name
         self.counter = itertools.count()
 
     # -- naming / plumbing -----------------------------------------------------
@@ -71,8 +72,14 @@ class _Exporter:
 
     def run(self, jaxpr, consts, input_names: list[str]) -> list[str]:
         for v, c in zip(jaxpr.constvars, consts):
-            self.consts[id(v)] = np.asarray(c)
-            self.names[id(v)] = self.const(np.asarray(c), "w")
+            arr = np.asarray(c)
+            self.consts[id(v)] = arr
+            preferred = self.const_names.get(id(c))
+            if preferred is not None and preferred not in self.initializers:
+                self.initializers[preferred] = arr
+                self.names[id(v)] = preferred
+            else:
+                self.names[id(v)] = self.const(arr, "w")
         for v, name in zip(jaxpr.invars, input_names):
             self.names[id(v)] = name
         for eqn in jaxpr.eqns:
@@ -80,8 +87,14 @@ class _Exporter:
         return [self.var_name(v) for v in jaxpr.outvars]
 
     def _inline(self, eqn, inner):
+        # inner may be a ClosedJaxpr (pjit/custom_jvp) or an open core.Jaxpr
+        # (remat2 stores params['jaxpr'] unclosed)
+        if hasattr(inner, "jaxpr"):
+            jaxpr, consts = inner.jaxpr, inner.consts
+        else:
+            jaxpr, consts = inner, ()
         in_names = [self.var_name(v) for v in eqn.invars]
-        sub_outs = self.run_sub(inner.jaxpr, inner.consts, in_names)
+        sub_outs = self.run_sub(jaxpr, consts, in_names)
         for v, name in zip(eqn.outvars, sub_outs):
             self.names[id(v)] = name
 
@@ -97,7 +110,8 @@ class _Exporter:
 
         # inline wrappers
         if prim in ("pjit", "jit", "closed_call", "core_call", "remat",
-                    "checkpoint", "custom_vjp_call_jaxpr", "xla_call"):
+                    "remat2", "checkpoint", "custom_vjp_call_jaxpr",
+                    "xla_call"):
             inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
             self._inline(eqn, inner)
             return
@@ -592,11 +606,13 @@ def _sort(ex, eqn, ins):
 
 
 def export_fn(fn: Callable, *example_args, name: str = "hetu_tpu",
-              param_names: dict[int, str] | None = None) -> pb.ModelProto:
+              const_names: dict | None = None) -> pb.ModelProto:
     """Trace ``fn(*example_args)`` and convert the jaxpr to an ONNX model.
 
     All traced-constant arrays (closure captures) become initializers;
-    positional args become graph inputs.
+    positional args become graph inputs.  ``const_names`` optionally maps
+    ``id(array)`` of a closure constant to the initializer name to use
+    (export_module passes parameter paths this way).
     """
     flat_args, in_tree = jax.tree_util.tree_flatten(example_args)
 
@@ -606,7 +622,7 @@ def export_fn(fn: Callable, *example_args, name: str = "hetu_tpu",
         return jax.tree_util.tree_leaves(out)
 
     closed = jax.make_jaxpr(flat_fn)(*flat_args)
-    ex = _Exporter()
+    ex = _Exporter(const_names)
     input_names = [f"input_{i}" for i in range(len(flat_args))]
     out_names = ex.run(closed.jaxpr, closed.consts, input_names)
 
@@ -629,12 +645,14 @@ def export_fn(fn: Callable, *example_args, name: str = "hetu_tpu",
 
 def export_module(model: Module, *example_inputs, name: str | None = None,
                   apply: Callable | None = None) -> pb.ModelProto:
-    """Export a ``Module``: parameters become named initializers, the example
-    inputs become graph inputs.  ``apply(model, *inputs)`` defaults to
-    ``model(*inputs)``."""
+    """Export a ``Module``: parameters become initializers named by their
+    qualified parameter path, the example inputs become graph inputs.
+    ``apply(model, *inputs)`` defaults to ``model(*inputs)``."""
     apply = apply or (lambda m, *xs: m(*xs))
     fn = lambda *xs: apply(model, *xs)  # model enters via closure -> constvars
-    return export_fn(fn, *example_inputs, name=name or type(model).__name__)
+    const_names = {id(leaf): pname for pname, leaf in named_parameters(model)}
+    return export_fn(fn, *example_inputs, name=name or type(model).__name__,
+                     const_names=const_names)
 
 
 def save_model(proto: pb.ModelProto, path: str) -> None:

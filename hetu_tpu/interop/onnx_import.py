@@ -148,8 +148,11 @@ def _reshape(node, ins):
 def _expand(node, ins):
     target = [int(d) for d in np.asarray(ins[1])]
     x = ins[0]
-    # numpy-style broadcast: align trailing dims; -1/1 keeps input dim
+    # bidirectional numpy-style broadcast: the result rank is
+    # max(input rank, shape rank); 1s take the other side's dim
     shape = list(target)
+    if len(shape) < x.ndim:
+        shape = [1] * (x.ndim - len(shape)) + shape
     off = len(shape) - x.ndim
     for i in range(x.ndim):
         if shape[off + i] == 1 and x.shape[i] != 1:
@@ -263,7 +266,8 @@ def _split(node, ins):
         sizes = [int(v) for v in np.asarray(ins[1])]
         idx = np.cumsum(sizes)[:-1]
         return tuple(jnp.split(ins[0], idx, axis=axis))
-    n = _a(node, "num_outputs")
+    # equal split: 'num_outputs' attr (opset 18+) or the output count itself
+    n = _a(node, "num_outputs") or len(node.outputs)
     return tuple(jnp.split(ins[0], n, axis=axis))
 
 

@@ -1,0 +1,215 @@
+"""Cluster configuration + multi-host launcher (the ``heturun`` capability).
+
+Reference: ``bin/heturun`` → python/runner.py:150 parses a cluster yaml
+(DistConfig, python/hetu/context.py:2204), spawns PS roles locally/via SSH and
+workers under mpirun.  TPU-native: there is no PS process tree or mpirun —
+each host runs ONE process per chip-set, `jax.distributed.initialize` forms
+the world over the coordinator, and XLA's collectives ride ICI/DCN.  The
+launcher therefore reduces to: parse the cluster spec, compose per-process
+environments, exec the training script on every host (ssh for remote ones),
+and wire coordinator discovery.
+
+CPU simulation: ``simulate_workers`` launches N local processes with a
+virtual device count so multi-process logic is testable on one machine
+(the reference gets the same effect by mpirun on localhost).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["DistConfig", "HostSpec", "initialize", "launch", "simulate_workers",
+           "worker_env", "main"]
+
+ENV_COORD = "HETU_TPU_COORD"
+ENV_NPROC = "HETU_TPU_NPROC"
+ENV_PROC_ID = "HETU_TPU_PROC_ID"
+
+
+@dataclasses.dataclass
+class HostSpec:
+    host: str
+    workers: int = 1          # processes to start on this host
+    chief: bool = False
+
+
+@dataclasses.dataclass
+class DistConfig:
+    """Cluster spec.  YAML schema (reference context.py:2204-2247 analogue)::
+
+        nodes:
+          - host: localhost     # or DNS/IP
+            workers: 1          # processes on this host
+            chief: true         # coordinator host (default: first)
+        port: 23456             # coordinator port
+    """
+
+    hosts: list
+    port: int = 23456
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "DistConfig":
+        import yaml
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        nodes = raw.get("nodes") or raw.get("hosts") or []
+        hosts = []
+        for item in nodes:
+            if isinstance(item, str):
+                hosts.append(HostSpec(host=item))
+            else:
+                hosts.append(HostSpec(host=item.get("host", "localhost"),
+                                      workers=int(item.get("workers", 1)),
+                                      chief=bool(item.get("chief", False))))
+        if hosts and not any(h.chief for h in hosts):
+            hosts[0].chief = True
+        return cls(hosts=hosts, port=int(raw.get("port", 23456)))
+
+    @property
+    def chief(self) -> HostSpec:
+        return next(h for h in self.hosts if h.chief)
+
+    @property
+    def num_processes(self) -> int:
+        return sum(h.workers for h in self.hosts)
+
+    @property
+    def coordinator_address(self) -> str:
+        return f"{self.chief.host}:{self.port}"
+
+    def process_table(self) -> list:
+        """[(host, local_rank, global_process_id)] in launch order."""
+        table, pid = [], 0
+        for h in self.hosts:
+            for lr in range(h.workers):
+                table.append((h.host, lr, pid))
+                pid += 1
+        return table
+
+
+def worker_env(cfg: DistConfig, process_id: int,
+               base_env: Optional[dict] = None) -> dict:
+    """Compose the environment for one worker process."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env[ENV_COORD] = cfg.coordinator_address
+    env[ENV_NPROC] = str(cfg.num_processes)
+    env[ENV_PROC_ID] = str(process_id)
+    return env
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the distributed world.  Arguments default from the environment
+    set by the launcher; on TPU pods with no env set, jax's own automatic
+    discovery applies (jax.distributed.initialize with no args)."""
+    import jax
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORD)
+    if num_processes is None and ENV_NPROC in os.environ:
+        num_processes = int(os.environ[ENV_NPROC])
+    if process_id is None and ENV_PROC_ID in os.environ:
+        process_id = int(os.environ[ENV_PROC_ID])
+    if coordinator_address is None:
+        jax.distributed.initialize()  # TPU pod metadata discovery
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+
+def _remote_cmd(host: str, env: dict, argv: Sequence[str],
+                env_keys: Sequence[str]) -> list:
+    """ssh command carrying the launcher env vars (runner.py:57-70 uses
+    paramiko; plain ssh keeps the dependency surface zero)."""
+    exports = " ".join(f"{k}={shlex.quote(env[k])}" for k in env_keys if k in env)
+    remote = f"cd {shlex.quote(os.getcwd())} && {exports} {' '.join(map(shlex.quote, argv))}"
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+
+
+def launch(cfg: DistConfig, argv: Sequence[str],
+           extra_env: Optional[dict] = None, dry_run: bool = False):
+    """Start every worker in the cluster; local processes directly, remote
+    ones over ssh.  Returns the list of (process_id, Popen|command)."""
+    procs = []
+    carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, "JAX_PLATFORMS", "XLA_FLAGS",
+             "PYTHONPATH"]
+    for host, _local_rank, pid in cfg.process_table():
+        env = worker_env(cfg, pid)
+        if extra_env:
+            env.update(extra_env)
+        local = host in ("localhost", "127.0.0.1", os.uname().nodename)
+        if local:
+            cmd = list(argv)
+        else:
+            cmd = _remote_cmd(host, env, argv, carry)
+        if dry_run:
+            procs.append((pid, cmd))
+        else:
+            procs.append((pid, subprocess.Popen(
+                cmd, env=env if local else os.environ.copy())))
+    return procs
+
+
+def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
+                     timeout: float = 120.0, port: int = 0) -> list:
+    """Run ``script`` in ``n`` local CPU processes joined into one jax
+    distributed world.  Returns each process's stdout.  The CPU analogue of
+    the reference's mpirun-on-localhost test pattern (tests/test_comm.py)."""
+    import socket
+    if port == 0:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+    cfg = DistConfig(hosts=[HostSpec("127.0.0.1", workers=n, chief=True)],
+                     port=port)
+    procs = []
+    for _host, _lr, pid in cfg.process_table():
+        env = worker_env(cfg, pid)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU jax (sitecustomize)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count={cpu_devices_per_proc}").strip()
+        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out)
+        if p.returncode != 0:
+            raise RuntimeError(f"worker failed (rc={p.returncode}):\n{out}")
+    return outs
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``heturun -c cluster.yml [--dry-run] python train.py ...``."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="heturun", description="hetu-tpu multi-host launcher")
+    parser.add_argument("-c", "--config", required=True, help="cluster yaml")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print the per-host commands instead of running")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cfg = DistConfig.from_yaml(args.config)
+    if not args.command:
+        parser.error("no command given")
+    procs = launch(cfg, args.command, dry_run=args.dry_run)
+    if args.dry_run:
+        for pid, cmd in procs:
+            print(f"[{pid}] {cmd if isinstance(cmd, list) else shlex.join(cmd)}")
+        return 0
+    rc = 0
+    for _pid, p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

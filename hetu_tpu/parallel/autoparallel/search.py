@@ -25,7 +25,9 @@ from hetu_tpu.parallel.autoparallel.cost_model import (
     TimeCostModel,
 )
 
-__all__ = ["Plan", "dp_search", "mcmc_search", "plan_to_strategy"]
+__all__ = ["Plan", "dp_search", "mcmc_search", "plan_to_strategy",
+           "partition_stages", "gpipe_search", "pipedream_search",
+           "pipeopt_search"]
 
 
 @dataclasses.dataclass
@@ -202,6 +204,133 @@ def mcmc_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                 best = (c, list(prop), t, m)
     _, choices, t, m = best
     return Plan(pp, n_micro, choices, t, m, m <= cluster.hbm_bytes)
+
+
+def partition_stages(costs: Sequence[float], pp: int) -> list[int]:
+    """Balanced contiguous partition of per-layer costs into ``pp`` stages,
+    minimizing the max stage cost (the GPipe/PipeDream stage-partition
+    problem, reference distributed_strategies/gpipe.py:6 /pipedream.py:7).
+
+    Classic linear-partition dynamic program; returns per-stage layer counts.
+    """
+    n = len(costs)
+    pp = min(pp, n)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def span(i, j):  # cost of layers [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # best[k][j] = minimal max-stage-cost partitioning layers[:j] into k stages
+    best = [[INF] * (n + 1) for _ in range(pp + 1)]
+    cut = [[0] * (n + 1) for _ in range(pp + 1)]
+    best[0][0] = 0.0
+    for k in range(1, pp + 1):
+        for j in range(k, n + 1):
+            for i in range(k - 1, j):
+                cand = max(best[k - 1][i], span(i, j))
+                if cand < best[k][j]:
+                    best[k][j] = cand
+                    cut[k][j] = i
+    bounds = []
+    j = n
+    for k in range(pp, 0, -1):
+        i = cut[k][j]
+        bounds.append(j - i)
+        j = i
+    return list(reversed(bounds))
+
+
+def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+                     global_batch: int, *, schedule: str,
+                     microbatch_options: Sequence[int]) -> tuple[Plan, list[int]]:
+    """Shared machinery for GPipe/PipeDream/PipeOpt searching: pick pp, a
+    cost-balanced stage partition, a uniform per-stage choice, and the
+    microbatch count.  Both schedules share the (n_micro + pp - 1) x slot
+    critical-path time bound; 1F1B ('pipedream') additionally charges
+    weight-stash memory for in-flight microbatches, which changes which
+    plans are feasible."""
+    mem_model = MemoryCostModel(cluster)
+    time_model = TimeCostModel(cluster)
+    best: Optional[Plan] = None
+    best_bounds: list[int] = [len(layers)]
+    pp = 1
+    while pp <= cluster.n_devices and pp <= len(layers):
+        per_stage = cluster.n_devices // pp
+        if per_stage * pp != cluster.n_devices:
+            pp *= 2
+            continue
+        cands = _choices_for(per_stage)
+        for n_micro in microbatch_options:
+            if pp == 1 and n_micro > 1:
+                continue
+            for c in cands:
+                bpr = math.ceil(global_batch / c.dp)
+                costs = [time_model.layer_time(l, c, bpr) for l in layers]
+                bounds = partition_stages(costs, pp)
+                # stage times under this balanced partition
+                idx, stage_times, stage_mems = 0, [], []
+                for cnt in bounds:
+                    t = sum(costs[idx:idx + cnt])
+                    m = sum(mem_model.layer_bytes(layers[li], c, bpr, n_micro)
+                            for li in range(idx, idx + cnt))
+                    if schedule == "pipedream":
+                        # weight stashing keeps up to pp weight versions of
+                        # the stage (pipedream_subexecutor.py:130)
+                        m += m / max(n_micro, 1) * (pp - 1) * 0.1
+                    stage_times.append(t)
+                    stage_mems.append(m)
+                    idx += cnt
+                slot = max(stage_times) / n_micro
+                t_total = (n_micro + pp - 1) * slot
+                plan = Plan(pp, n_micro, [c] * len(layers), t_total,
+                            max(stage_mems), max(stage_mems) <= cluster.hbm_bytes)
+                if plan.feasible and (best is None or plan.time < best.time):
+                    best, best_bounds = plan, bounds
+        pp *= 2
+    if best is None:
+        plan = dp_search(layers, cluster, global_batch,
+                         microbatch_options=microbatch_options)
+        return plan, _stage_layers(len(layers), plan.pp)
+    return best, best_bounds
+
+
+def gpipe_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+                 global_batch: int,
+                 microbatch_options: Sequence[int] = (1, 2, 4, 8, 16)):
+    """GPipe partitioner (reference GPipeSearching, gpipe.py:6): balanced
+    stages + microbatch count under the memory budget."""
+    return _pipeline_search(layers, cluster, global_batch, schedule="gpipe",
+                            microbatch_options=microbatch_options)
+
+
+def pipedream_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+                     global_batch: int,
+                     microbatch_options: Sequence[int] = (1, 2, 4, 8, 16)):
+    """PipeDream partitioner (reference PipeDreamSearching, pipedream.py:7):
+    1F1B steady-state objective + weight-stash memory."""
+    return _pipeline_search(layers, cluster, global_batch,
+                            schedule="pipedream",
+                            microbatch_options=microbatch_options)
+
+
+def pipeopt_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
+                   global_batch: int,
+                   microbatch_options: Sequence[int] = (1, 2, 4, 8, 16)):
+    """Joint pipeline + intra-layer search (reference PipeOptSearching,
+    pipeopt.py:9): compare the balanced-pipeline plans against dp_search's
+    per-layer plans and take the faster feasible one."""
+    pipe_plan, bounds = _pipeline_search(
+        layers, cluster, global_batch, schedule="pipedream",
+        microbatch_options=microbatch_options)
+    flat_plan = dp_search(layers, cluster, global_batch,
+                          microbatch_options=microbatch_options)
+    if flat_plan.feasible and (not pipe_plan.feasible
+                               or flat_plan.time < pipe_plan.time):
+        return flat_plan, _stage_layers(len(layers), flat_plan.pp)
+    return pipe_plan, bounds
 
 
 def plan_to_strategy(plan: Plan, *, rules=None, devices=None):

@@ -32,7 +32,11 @@ from hetu_tpu.parallel.spec import (
     resolve_specs,
 )
 
-__all__ = ["ShardingStrategy", "DataParallel", "MegatronTP", "ZeRO"]
+__all__ = [
+    "ShardingStrategy", "DataParallel", "MegatronTP", "ZeRO",
+    "ModelParallel4CNN", "ModelParallel4LM", "OneWeirdTrick4CNN",
+    "MegatronLM",
+]
 
 
 def _is_spec(x):
@@ -142,3 +146,55 @@ def ZeRO(stage: int = 1, *, mesh: Optional[Mesh] = None) -> ShardingStrategy:
     (reference 'partial' NodeStatus axis + AllGather, context.py:304-317)."""
     return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(), rules=DP_RULES,
                             zero_stage=stage)
+
+
+# Named presets matching the reference's manual strategies
+# (distributed_strategies/simple.py).  Each is a rules table: the single
+# model definition + GSPMD replaces the reference's per-strategy graph
+# rewriting.
+
+# Full model parallel for CNNs (simple.py:46 ModelParallel4CNN): conv output
+# channels and FC outputs sharded over tp; activations reshard between.
+CNN_MP_RULES = AxisRules({
+    "conv_out": "tp", "out": "tp", "mlp": "tp",
+    "layers": "pp", "experts": "ep",
+})
+
+# One-weird-trick (simple.py:119 OneWeirdTrick4CNN, Krizhevsky 2014): conv
+# layers data-parallel (replicated weights, batch sharded), FC layers
+# tensor-parallel — conv is compute-bound, FC is parameter-bound.
+OWT_RULES = AxisRules({
+    "out": "tp", "mlp": "tp",
+    "layers": "pp", "experts": "ep",
+})
+
+
+def ModelParallel4CNN(tp: int, *, dp: int = 1,
+                      mesh: Optional[Mesh] = None) -> ShardingStrategy:
+    """Channel/FC-sharded CNN (reference simple.py:46)."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(dp=dp, tp=tp),
+                            rules=CNN_MP_RULES)
+
+
+def ModelParallel4LM(tp: int, *, dp: int = 1,
+                     mesh: Optional[Mesh] = None,
+                     zero_stage: int = 0) -> ShardingStrategy:
+    """Layer-sharded LM (reference simple.py:113 ModelParallel4LM) — on TPU
+    the same Megatron column/row placement, minus pipeline stages."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(dp=dp, tp=tp),
+                            rules=MEGATRON_RULES, zero_stage=zero_stage)
+
+
+def OneWeirdTrick4CNN(tp: int, *, dp: int = 1,
+                      mesh: Optional[Mesh] = None) -> ShardingStrategy:
+    """DP convs + TP fully-connected (reference simple.py:119)."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(dp=dp, tp=tp),
+                            rules=OWT_RULES)
+
+
+def MegatronLM(tp: int, *, dp: int = 1, pp: int = 1,
+               mesh: Optional[Mesh] = None,
+               zero_stage: int = 0) -> ShardingStrategy:
+    """Megatron placement incl. pipeline axis (reference simple.py:174)."""
+    return ShardingStrategy(mesh=mesh, mesh_spec=MeshSpec(dp=dp, tp=tp, pp=pp),
+                            rules=MEGATRON_RULES, zero_stage=zero_stage)

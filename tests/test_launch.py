@@ -1,0 +1,105 @@
+"""Launcher: DistConfig yaml parsing, env composition, command building, and
+a real 2-process jax.distributed world on local CPU (the reference's
+mpirun-on-localhost test pattern, tests/test_comm.py:23)."""
+
+import os
+import textwrap
+
+import pytest
+
+from hetu_tpu.launch import (
+    DistConfig, ENV_COORD, ENV_NPROC, ENV_PROC_ID, HostSpec, launch,
+    main, simulate_workers, worker_env,
+)
+
+
+@pytest.fixture
+def cluster_yaml(tmp_path):
+    p = tmp_path / "cluster.yml"
+    p.write_text(textwrap.dedent("""
+        nodes:
+          - host: hostA
+            workers: 2
+            chief: true
+          - host: hostB
+            workers: 2
+        port: 29876
+    """))
+    return str(p)
+
+
+class TestDistConfig:
+    def test_parse(self, cluster_yaml):
+        cfg = DistConfig.from_yaml(cluster_yaml)
+        assert cfg.num_processes == 4
+        assert cfg.chief.host == "hostA"
+        assert cfg.coordinator_address == "hostA:29876"
+        assert cfg.process_table() == [
+            ("hostA", 0, 0), ("hostA", 1, 1), ("hostB", 0, 2), ("hostB", 1, 3)]
+
+    def test_default_chief_is_first(self, tmp_path):
+        p = tmp_path / "c.yml"
+        p.write_text("nodes:\n  - host: x\n  - host: y\n")
+        cfg = DistConfig.from_yaml(str(p))
+        assert cfg.chief.host == "x"
+        assert cfg.port == 23456
+
+    def test_string_nodes(self, tmp_path):
+        p = tmp_path / "c.yml"
+        p.write_text("nodes: [localhost]\n")
+        cfg = DistConfig.from_yaml(str(p))
+        assert cfg.hosts[0].workers == 1
+
+    def test_worker_env(self):
+        cfg = DistConfig(hosts=[HostSpec("h", workers=3, chief=True)], port=1234)
+        env = worker_env(cfg, 2, base_env={})
+        assert env[ENV_COORD] == "h:1234"
+        assert env[ENV_NPROC] == "3"
+        assert env[ENV_PROC_ID] == "2"
+
+
+class TestLaunch:
+    def test_dry_run_remote_ssh(self):
+        cfg = DistConfig(hosts=[HostSpec("farhost", workers=1, chief=True)],
+                         port=7777)
+        procs = launch(cfg, ["python", "train.py"], dry_run=True)
+        (pid, cmd), = procs
+        assert pid == 0
+        assert cmd[0] == "ssh"
+        assert "farhost" in cmd
+        assert "train.py" in cmd[-1]
+
+    def test_dry_run_local(self):
+        cfg = DistConfig(hosts=[HostSpec("localhost", workers=2, chief=True)])
+        procs = launch(cfg, ["python", "-c", "pass"], dry_run=True)
+        assert [p for p, _ in procs] == [0, 1]
+        assert all(cmd == ["python", "-c", "pass"] for _, cmd in procs)
+
+    def test_cli_dry_run(self, cluster_yaml, capsys):
+        rc = main(["-c", cluster_yaml, "--dry-run", "python", "t.py"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "[0]" in out and "[3]" in out
+
+
+@pytest.mark.slow
+class TestRealWorld:
+    def test_two_process_cpu_world(self):
+        """Two local processes form a jax.distributed world; each sees the
+        global device count and its own process_index."""
+        script = textwrap.dedent("""
+            import hetu_tpu.launch as L
+            L.initialize()
+            import jax
+            n = jax.device_count()
+            i = jax.process_index()
+            print(f"RESULT pid={i} global_devices={n}")
+        """)
+        outs = simulate_workers(2, script, cpu_devices_per_proc=2,
+                                timeout=180.0)
+        results = sorted(line for out in outs for line in out.splitlines()
+                         if line.startswith("RESULT"))
+        assert results == [
+            "RESULT pid=0 global_devices=4",
+            "RESULT pid=1 global_devices=4",
+        ]

@@ -1,0 +1,139 @@
+"""Named strategy presets (ModelParallel4CNN/LM, OneWeirdTrick, MegatronLM)
+and pipeline searchers (partition_stages, gpipe/pipedream/pipeopt_search);
+graphboard dot generation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.parallel.autoparallel import ClusterSpec, transformer_layer_spec
+from hetu_tpu.parallel.autoparallel.search import (
+    gpipe_search, partition_stages, pipedream_search, pipeopt_search,
+)
+
+CLUSTER = ClusterSpec(n_devices=8, hbm_bytes=16e9)
+
+
+class TestPartitionStages:
+    def test_uniform_costs_split_evenly(self):
+        assert partition_stages([1.0] * 8, 4) == [2, 2, 2, 2]
+
+    def test_skewed_costs_balance_max(self):
+        # one huge layer: it must sit alone in its stage
+        costs = [1, 1, 1, 10, 1, 1]
+        bounds = partition_stages(costs, 3)
+        assert sum(bounds) == 6
+        # compute stage sums
+        sums, idx = [], 0
+        for c in bounds:
+            sums.append(sum(costs[idx:idx + c]))
+            idx += c
+        assert max(sums) == 10  # optimal: the 10 dominates but isn't paired
+
+    def test_more_stages_than_layers(self):
+        assert partition_stages([1.0, 2.0], 5) == [1, 1]
+
+
+class TestPipelineSearch:
+    def _big_layers(self, n=16):
+        return [transformer_layer_spec(4096, 1024, name=f"l{i}")
+                for i in range(n)]
+
+    def test_gpipe_search_returns_feasible_partition(self):
+        plan, bounds = gpipe_search(self._big_layers(), CLUSTER,
+                                    global_batch=16)
+        assert sum(bounds) == 16
+        assert len(bounds) == plan.pp
+        assert plan.feasible
+
+    def test_pipedream_search_runs(self):
+        plan, bounds = pipedream_search(self._big_layers(), CLUSTER,
+                                        global_batch=16)
+        assert plan.feasible
+        assert sum(bounds) == 16
+
+    def test_pipeopt_no_slower_than_components(self):
+        small = [transformer_layer_spec(512, 128, name=f"l{i}")
+                 for i in range(4)]
+        plan, bounds = pipeopt_search(small, CLUSTER, global_batch=64)
+        assert plan.feasible
+        assert sum(bounds) == 4
+        from hetu_tpu.parallel.autoparallel import dp_search as _dp
+        flat = _dp(small, CLUSTER, global_batch=64)
+        pipe, _ = pipedream_search(small, CLUSTER, global_batch=64)
+        assert plan.time <= min(flat.time, pipe.time) + 1e-12
+
+
+class TestPresets:
+    def test_presets_construct_and_shard(self):
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.parallel.mesh import make_mesh, MeshSpec
+        from hetu_tpu.parallel.strategies import (
+            MegatronLM, ModelParallel4CNN, ModelParallel4LM, OneWeirdTrick4CNN,
+        )
+        from hetu_tpu.layers import Linear
+
+        set_random_seed(0)
+        for factory in (lambda m: ModelParallel4CNN(2, dp=4, mesh=m),
+                        lambda m: ModelParallel4LM(2, dp=4, mesh=m),
+                        lambda m: OneWeirdTrick4CNN(2, dp=4, mesh=m),
+                        lambda m: MegatronLM(2, dp=4, mesh=m)):
+            mesh = make_mesh(MeshSpec(dp=4, tp=2))
+            strat = factory(mesh)
+            model = Linear(16, 32, axes=(None, "mlp"))
+            specs = strat.model_specs(model)
+            leaves = jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+            assert leaves  # produced PartitionSpecs without error
+
+    def test_owt_replicates_conv_shards_fc(self):
+        from hetu_tpu.parallel.strategies import CNN_MP_RULES, OWT_RULES
+        from jax.sharding import PartitionSpec as P
+        # conv weights: logical axis 'conv_out'
+        conv_spec = P(None, None, "conv_in", "conv_out")
+        assert OWT_RULES.physical(conv_spec) == P(None, None, None, None)
+        assert CNN_MP_RULES.physical(conv_spec) == P(None, None, None, "tp")
+        fc_spec = P("in", "out")
+        assert OWT_RULES.physical(fc_spec) == P(None, "tp")
+
+
+class TestGraphboard:
+    def test_to_dot_basic(self):
+        from hetu_tpu.exec.graphboard import to_dot
+        x = jnp.ones((4, 8))
+        w = jnp.ones((8, 2))
+        dot = to_dot(lambda x: jax.nn.relu(x @ w).sum(), x)
+        assert dot.startswith("digraph")
+        assert "dot_general" in dot
+        assert "reduce_sum" in dot
+        assert "out0" in dot
+        assert dot.count("->") >= 3
+
+    def test_to_dot_inline_calls(self):
+        from hetu_tpu.exec.graphboard import to_dot
+        x = jnp.ones((4,))
+        # custom_jvp (relu) exercises the sub-jaxpr machinery on every
+        # jax version; jit may or may not stage out a pjit eqn
+        fn = lambda x: jax.nn.relu(jnp.tanh(x) * 2) + 1
+        collapsed = to_dot(fn, x, collapse_calls=True)
+        inlined = to_dot(fn, x, collapse_calls=False)
+        assert collapsed.startswith("digraph")
+        assert "tanh" in inlined
+        assert "max" in inlined or "custom_jvp" in collapsed
+
+    def test_http_server_serves_dot(self):
+        import threading
+        import urllib.request
+        from hetu_tpu.exec.graphboard import show
+        x = jnp.ones((2, 2))
+        server = show(lambda x: x @ x, x, port=0, blocking=False)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.handle_request)
+        t.start()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/dot", timeout=10).read().decode()
+        t.join(timeout=10)
+        server.server_close()
+        assert body.startswith("digraph")
