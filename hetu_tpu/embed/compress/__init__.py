@@ -1,0 +1,57 @@
+"""Embedding memory compression suite (VLDB'24 artifact capability).
+
+Reference: tools/EmbeddingMemoryCompression/methods/layers/*.py — 19 methods
+spanning hashing, quantization, pruning, NAS/dimension reduction, tensor
+decomposition, deduplication and frequency-adaptive storage, each paired with
+a training scheduler (methods/scheduler/*.py, multistage.py).
+
+TPU-native design: every method is a pure-pytree ``Module`` whose lookup is
+expressed in jnp ops XLA fuses around the gather (the reference backs each
+with custom CUDA kernels — CompressedEmbedding.cu, QuantizeEmbedding.cu,
+PruneMask.cu...).  Straight-through estimators use ``stop_gradient``;
+call-time stochasticity (DPQ sampling, OptEmbed field masks) takes an
+explicit jax PRNG key.  The multi-stage training flows live in
+``scheduler.py``.
+"""
+
+from hetu_tpu.embed.compress.hashed import (  # noqa: F401
+    HashEmbedding, CompositionalEmbedding, RobeEmbedding, DeepHashEmbedding,
+)
+from hetu_tpu.embed.compress.quant import (  # noqa: F401
+    QuantizedEmbedding, ALPTEmbedding, DPQEmbedding, MGQEmbedding,
+)
+from hetu_tpu.embed.compress.prune import (  # noqa: F401
+    DeepLightEmbedding, PEPEmbedding, PEPRetrainEmbedding,
+    OptEmbedding, AutoSrhEmbedding,
+)
+from hetu_tpu.embed.compress.dim import (  # noqa: F401
+    MDEmbedding, AutoDimEmbedding, md_solver,
+)
+from hetu_tpu.embed.compress.tt import TensorTrainEmbedding  # noqa: F401
+from hetu_tpu.embed.compress.dedup import (  # noqa: F401
+    DedupEmbedding, AdaptiveEmbedding,
+)
+from hetu_tpu.embed.compress.scheduler import (  # noqa: F401
+    CompressionSchedule, Stage,
+)
+
+ALL_METHODS = {
+    "hash": HashEmbedding,
+    "compo": CompositionalEmbedding,
+    "robe": RobeEmbedding,
+    "dhe": DeepHashEmbedding,
+    "quantize": QuantizedEmbedding,
+    "alpt": ALPTEmbedding,
+    "dpq": DPQEmbedding,
+    "mgqe": MGQEmbedding,
+    "deeplight": DeepLightEmbedding,
+    "pep": PEPEmbedding,
+    "pep_retrain": PEPRetrainEmbedding,
+    "optembed": OptEmbedding,
+    "autosrh": AutoSrhEmbedding,
+    "md": MDEmbedding,
+    "autodim": AutoDimEmbedding,
+    "tt": TensorTrainEmbedding,
+    "dedup": DedupEmbedding,
+    "adapt": AdaptiveEmbedding,
+}

@@ -1,0 +1,108 @@
+"""Deduplication and frequency-adaptive embeddings.
+
+Reference: methods/layers/deduplication.py (block-dedup via remap indices)
+and adapt.py (DeepRec adaptive: full rows for frequent ids, a small hashed
+table for rare ids).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import xavier_normal
+
+__all__ = ["DedupEmbedding", "AdaptiveEmbedding"]
+
+
+class DedupEmbedding(Module):
+    """Block deduplication (methods/layers/deduplication.py:6): rows are
+    split into blocks of ``nemb_per_block``; identical blocks are stored
+    once and addressed through a remap table."""
+
+    def __init__(self, unique_blocks, remap_indices, embedding_dim: int,
+                 nemb_per_block: int = 1, trainable: bool = True,
+                 dtype=jnp.float32):
+        self.weight = jnp.asarray(unique_blocks, dtype)  # [n_unique, block*D]
+        self.weight_axes = ("vocab", None)
+        if not trainable:
+            self._state_fields = ("weight", "remap")
+        else:
+            self._state_fields = ("remap",)
+        self.remap = jnp.asarray(remap_indices, jnp.int32).reshape(-1)
+        self.remap_axes = (None,)
+        self.nemb_per_block = nemb_per_block
+        self.embedding_dim = embedding_dim
+
+    @classmethod
+    def from_dense(cls, table, nemb_per_block: int = 1,
+                   decimals: int = 4, **kw) -> "DedupEmbedding":
+        """Build by deduplicating a trained dense table (the reference's
+        compressor does this offline with float rounding)."""
+        table = np.asarray(table)
+        n, d = table.shape
+        nb = nemb_per_block
+        pad = (-n) % nb
+        if pad:
+            table = np.concatenate([table, np.zeros((pad, d), table.dtype)])
+        blocks = table.reshape(-1, nb * d)
+        rounded = np.round(blocks, decimals)
+        uniq, remap = np.unique(rounded, axis=0, return_inverse=True)
+        return cls(uniq, remap, d, nemb_per_block=nb, **kw)
+
+    def __call__(self, ids):
+        block = ids // self.nemb_per_block
+        offset = ids % self.nemb_per_block
+        rows = jnp.take(self.remap, block, axis=0)
+        vals = jnp.take(self.weight, rows, axis=0)       # [..., block*D]
+        vals = vals.reshape(*vals.shape[:-1], self.nemb_per_block,
+                            self.embedding_dim)
+        return jnp.take_along_axis(
+            vals, offset[..., None, None].astype(jnp.int32), axis=-2
+        )[..., 0, :]
+
+    def compression_ratio(self) -> float:
+        dense = self.remap.shape[0] * self.nemb_per_block * self.embedding_dim
+        return dense / float(np.prod(self.weight.shape))
+
+
+class AdaptiveEmbedding(Module):
+    """DeepRec adaptive embedding (methods/layers/adapt.py:6): a remap sends
+    frequent ids to dedicated rows of ``freq_emb``; every id also hits a
+    small mod-hashed ``rare_emb``; the two are summed, so rare ids rely on
+    the shared hashed rows while frequent ids learn a private correction."""
+
+    def __init__(self, num_freq_emb: int, num_rare_emb: int,
+                 remap_indices, embedding_dim: int,
+                 initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.freq_emb = init(next_key(), (num_freq_emb, embedding_dim), dtype)
+        self.freq_emb_axes = ("vocab", "embed")
+        self.rare_emb = init(next_key(), (num_rare_emb, embedding_dim), dtype)
+        self.rare_emb_axes = ("vocab", "embed")
+        # remap_indices[id] = row in freq_emb for frequent ids, -1 for rare
+        self.remap = jnp.asarray(remap_indices, jnp.int32).reshape(-1)
+        self.remap_axes = (None,)
+        self._state_fields = ("remap",)
+        self.num_freq_emb = num_freq_emb
+        self.num_rare_emb = num_rare_emb
+        self.embedding_dim = embedding_dim
+
+    @classmethod
+    def from_frequency(cls, frequencies, num_freq_emb: int,
+                       num_rare_emb: int, embedding_dim: int, **kw):
+        freq = np.asarray(frequencies)
+        order = np.argsort(-freq)
+        remap = np.full((len(freq),), -1, np.int32)
+        remap[order[:num_freq_emb]] = np.arange(num_freq_emb, dtype=np.int32)
+        return cls(num_freq_emb, num_rare_emb, remap, embedding_dim, **kw)
+
+    def __call__(self, ids):
+        r = jnp.take(self.remap, ids, axis=0)
+        is_freq = r >= 0
+        high = jnp.take(self.freq_emb, jnp.maximum(r, 0), axis=0)
+        high = high * is_freq[..., None].astype(high.dtype)
+        low = jnp.take(self.rare_emb, ids % self.num_rare_emb, axis=0)
+        return high + low

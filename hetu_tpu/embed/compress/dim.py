@@ -1,0 +1,131 @@
+"""Dimension-reduction compressed embeddings.
+
+Reference methods: mde.py (mixed-dimension embedding + md solver in
+scheduler/md.py, the MD paper's popularity^-alpha allocation), autodim.py
+(AutoDim NAS over candidate dims with gumbel-softmax slot weights, KDD'21).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import xavier_normal, zeros
+
+__all__ = ["MDEmbedding", "AutoDimEmbedding", "md_solver"]
+
+
+def md_solver(num_embed_fields: Sequence[int], alpha: float,
+              base_dim: int, round_dim: bool = True) -> list:
+    """Mixed-dimension allocation (scheduler/md.py:12 _md_solver): field f
+    gets d_f = lambda * n_f^(-alpha) with lambda fixed so the most popular
+    (smallest) field gets ``base_dim``; optionally rounded to powers of 2."""
+    n = np.asarray(num_embed_fields, np.float64)
+    lamb = base_dim * (n.min() ** alpha)
+    dims = lamb * n ** (-alpha)
+    if round_dim:
+        dims = 2 ** np.round(np.log2(np.clip(dims, 1, None)))
+    return [int(max(1, min(base_dim, d))) for d in dims]
+
+
+class MDEmbedding(Module):
+    """Mixed-dimension embedding (methods/layers/mde.py:5): table stored at
+    ``compressed_dim``, projected up to ``embedding_dim`` by one matmul."""
+
+    def __init__(self, num_embeddings: int, compressed_dim: int,
+                 embedding_dim: int, initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, compressed_dim), dtype)
+        self.weight_axes = ("vocab", None)
+        if compressed_dim < embedding_dim:
+            self.proj = init(next_key(), (compressed_dim, embedding_dim), dtype)
+            self.proj_axes = (None, "embed")
+        else:
+            self.proj = None
+        self.num_embeddings = num_embeddings
+        self.compressed_dim = compressed_dim
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        v = jnp.take(self.weight, ids, axis=0)
+        if self.proj is not None:
+            v = v @ self.proj.astype(v.dtype)
+        return v
+
+
+class AutoDimEmbedding(Module):
+    """AutoDim NAS supernet (methods/layers/autodim.py:5): one table per
+    candidate dim, each projected to max_dim per slot, mixed by
+    gumbel-softmax over per-slot architecture logits alpha.  After search,
+    ``selected_dims`` reads off the argmax candidate per slot and
+    ``materialize`` builds the final MDEmbedding-style tables."""
+
+    def __init__(self, num_embeddings: int, dim_candidates: Sequence[int],
+                 num_slot: int, initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.dim_candidates = tuple(sorted(dim_candidates))
+        self.max_dim = self.dim_candidates[-1]
+        self.num_slot = num_slot
+        self.num_embeddings = num_embeddings
+        self.tables = [init(next_key(), (num_embeddings, d), dtype)
+                       for d in self.dim_candidates]
+        self.tables_axes = [("vocab", None)] * len(self.dim_candidates)
+        # per-slot projection [slot, d, max_dim] + bias per candidate
+        self.projs = [init(next_key(), (num_slot, d, self.max_dim), dtype)
+                      for d in self.dim_candidates]
+        self.projs_axes = [(None, None, None)] * len(self.dim_candidates)
+        self.proj_biases = [zeros(None, (num_slot, 1, self.max_dim), dtype)
+                            for _ in self.dim_candidates]
+        self.alpha = zeros(None, (num_slot, len(self.dim_candidates)), dtype)
+        self.alpha_axes = (None, None)
+
+    def arch_weights(self, key=None, temperature: float = 1.0):
+        """Gumbel-softmax weights over candidates per slot (autodim
+        temperature annealed toward hard selection in the reference)."""
+        logits = self.alpha
+        if key is not None:
+            g = -jnp.log(-jnp.log(
+                jax.random.uniform(key, logits.shape, minval=1e-10, maxval=1.0)
+            ) + 1e-10)
+            logits = logits + g
+        return jax.nn.softmax(logits / temperature, axis=-1)
+
+    def __call__(self, ids, *, key=None, temperature: float = 1.0):
+        """ids: [B, num_slot] -> [B, num_slot, max_dim]."""
+        w = self.arch_weights(key, temperature)           # [slot, cands]
+        mixed = None
+        for ci, d in enumerate(self.dim_candidates):
+            v = jnp.take(self.tables[ci], ids, axis=0)    # [B, slot, d]
+            v = jnp.einsum("bsd,sdm->bsm", v, self.projs[ci].astype(v.dtype))
+            v = v + self.proj_biases[ci].astype(v.dtype)[None, :, 0, :]
+            # normalize candidate branches before mixing (bn in reference;
+            # scale-free layernorm keeps it stateless)
+            mean = jnp.mean(v, axis=-1, keepdims=True)
+            var = jnp.var(v, axis=-1, keepdims=True)
+            v = (v - mean) * jax.lax.rsqrt(var + 1e-5)
+            contrib = v * w[None, :, ci, None]
+            mixed = contrib if mixed is None else mixed + contrib
+        return mixed
+
+    def selected_dims(self) -> list:
+        """Per-slot winning candidate dim after the search stage."""
+        idx = np.asarray(jnp.argmax(self.alpha, axis=-1))
+        return [self.dim_candidates[i] for i in idx]
+
+    def materialize(self) -> list:
+        """Final per-slot MDEmbedding tables at the selected dims
+        (the reference's retrain stage constructs these)."""
+        out = []
+        for slot, d in enumerate(self.selected_dims()):
+            ci = self.dim_candidates.index(d)
+            m = MDEmbedding(self.num_embeddings, d, self.max_dim)
+            m = m.replace(weight=self.tables[ci],
+                          proj=self.projs[ci][slot] if d < self.max_dim else None)
+            out.append(m)
+        return out

@@ -1,0 +1,179 @@
+"""Hashing-based compressed embeddings.
+
+Reference methods: hash.py (mod hash, MLSys'20 HierPS), compo.py
+(quotient-remainder compositional hash, KDD'20), robe.py (ROBE-Z weight
+sharing, MLSys'22), dhe.py (Deep Hash Embedding, KDD'21).
+
+All hash arithmetic runs in uint32 on-device so the id->slot mapping fuses
+into the lookup gather (the reference uses custom kernels RobeHash.cu etc.).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import xavier_normal, zeros
+from hetu_tpu.layers import Linear
+from hetu_tpu.layers.norm import LayerNorm
+
+__all__ = ["HashEmbedding", "CompositionalEmbedding", "RobeEmbedding",
+           "DeepHashEmbedding"]
+
+_MERSENNE = np.uint32(2038074743)  # prime used for universal hashing
+
+
+def _universal_hash(x, a, b, prime, m):
+    """((a*x + b) mod p) mod m in uint32 (overflow wraps, fine for hashing)."""
+    x = x.astype(jnp.uint32)
+    return (((a * x + b) % prime) % jnp.uint32(m)).astype(jnp.int32)
+
+
+class HashEmbedding(Module):
+    """ids mod N into a smaller table (methods/layers/hash.py:5)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        return jnp.take(self.weight, ids % self.num_embeddings, axis=0)
+
+
+class CompositionalEmbedding(Module):
+    """Quotient-remainder composition (methods/layers/compo.py:5; DLRM
+    QREmbeddingBag): two small tables combined by sum or mul."""
+
+    def __init__(self, num_quotient: int, num_remainder: int,
+                 embedding_dim: int, aggregator: str = "mul",
+                 initializer=None, dtype=jnp.float32):
+        if aggregator[:3] not in ("sum", "mul"):
+            raise ValueError("aggregator must be 'sum' or 'mul'")
+        init = initializer or xavier_normal()
+        self.qemb = init(next_key(), (num_quotient, embedding_dim), dtype)
+        self.remb = init(next_key(), (num_remainder, embedding_dim), dtype)
+        self.qemb_axes = ("vocab", "embed")
+        self.remb_axes = ("vocab", "embed")
+        self.aggregator = aggregator[:3]
+        self.num_quotient = num_quotient
+        self.num_remainder = num_remainder
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        q = jnp.take(self.qemb, (ids // self.num_remainder) % self.num_quotient,
+                     axis=0)
+        r = jnp.take(self.remb, ids % self.num_remainder, axis=0)
+        return q + r if self.aggregator == "sum" else q * r
+
+
+class RobeEmbedding(Module):
+    """ROBE-Z (methods/layers/robe.py:6): one flat weight array; element
+    (id, d) maps to position hash(id, d // Z) + d mod Z with a random sign —
+    Z-length chunks shared across the whole table."""
+
+    def __init__(self, robe_array_size: int, embedding_dim: int, Z: int = 1,
+                 use_slot_coef: bool = False, seed: int = 0,
+                 initializer=None, dtype=jnp.float32):
+        if Z > embedding_dim:
+            raise ValueError("Z must divide/fit within embedding_dim")
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (robe_array_size, 1), dtype)
+        self.weight_axes = ("vocab", None)
+        self.robe_array_size = robe_array_size
+        self.embedding_dim = embedding_dim
+        self.Z = Z
+        self.use_slot_coef = use_slot_coef
+        rng = np.random.default_rng(seed)
+        # universal-hash coefficients (random_numbers in robe.py:17-19)
+        self.hash_coefs = jnp.asarray(
+            rng.integers(1, int(_MERSENNE), size=(8,), dtype=np.int64),
+            jnp.uint32)
+        self.hash_coefs_axes = (None,)
+
+    def __call__(self, ids):
+        shape = jnp.shape(ids)
+        flat = ids.reshape(-1, 1).astype(jnp.uint32)          # [B, 1]
+        d = jnp.arange(self.embedding_dim, dtype=jnp.uint32)  # [D]
+        chunk = d // jnp.uint32(self.Z)
+        a0, b0, a1, b1, a2, b2, *_ = self.hash_coefs
+        # position: h(id, chunk) + (d mod Z)
+        mixed = flat * a0 + chunk[None, :] * a1 + b0
+        pos = ((mixed % _MERSENNE) % jnp.uint32(self.robe_array_size - self.Z + 1))
+        pos = pos + (d % jnp.uint32(self.Z))[None, :]
+        # sign: h2(id, d) parity
+        smix = flat * a2 + d[None, :] * b1 + b2
+        sign = ((smix % _MERSENNE) % jnp.uint32(2)).astype(jnp.float32) * 2.0 - 1.0
+        vals = jnp.take(self.weight[:, 0], pos.astype(jnp.int32), axis=0)
+        out = vals * sign.astype(vals.dtype)
+        return out.reshape(*shape, self.embedding_dim)
+
+
+class Mish(Module):
+    """x * tanh(softplus(x)) (reference hetu.layers.mish used by DHE)."""
+
+    def __call__(self, x):
+        return x * jnp.tanh(jax.nn.softplus(x))
+
+
+class DeepHashEmbedding(Module):
+    """DHE (methods/layers/dhe.py:7, KDD'21): k universal hashes of the id,
+    normalized to a dense code vector, decoded by a deep MLP (Mish + norm).
+    No embedding table at all — memory is the MLP.  The reference
+    normalizes with BatchNorm; here LayerNorm keeps the layer stateless
+    (batch-size independent, jit-friendly) with the same conditioning role."""
+
+    def __init__(self, embedding_dim: int, mlp_dim: int = 512,
+                 num_buckets: int = 1_000_000, num_hash: int = 1024,
+                 dist: str = "uniform", seed: int = 0,
+                 initializer=None, dtype=jnp.float32, num_layers: int = 4):
+        if dist not in ("uniform", "normal"):
+            raise ValueError("dist must be 'uniform' or 'normal'")
+        self.distribution = dist
+        self.embedding_dim = embedding_dim
+        self.num_buckets = num_buckets
+        self.num_hash = num_hash
+        rng = np.random.default_rng(seed)
+        self.slopes = jnp.asarray(
+            rng.integers(1, int(_MERSENNE), (num_hash,), dtype=np.int64),
+            jnp.uint32)
+        self.slopes_axes = (None,)
+        self.biases = jnp.asarray(
+            rng.integers(0, int(_MERSENNE), (num_hash,), dtype=np.int64),
+            jnp.uint32)
+        self.biases_axes = (None,)
+        layers = [Linear(num_hash, mlp_dim, initializer=initializer or xavier_normal(),
+                         dtype=dtype), LayerNorm(mlp_dim), Mish()]
+        for _ in range(num_layers):
+            layers += [Linear(mlp_dim, mlp_dim,
+                              initializer=initializer or xavier_normal(),
+                              dtype=dtype), LayerNorm(mlp_dim), Mish()]
+        layers += [Linear(mlp_dim, embedding_dim,
+                          initializer=initializer or xavier_normal(),
+                          dtype=dtype)]
+        self.layers = layers
+
+    def encode(self, ids):
+        flat = ids.reshape(-1, 1).astype(jnp.uint32)
+        h = ((flat * self.slopes[None, :] + self.biases[None, :]) % _MERSENNE
+             ) % jnp.uint32(self.num_buckets)
+        code = h.astype(jnp.float32) / float(self.num_buckets)  # [B, k] in [0,1)
+        if self.distribution == "uniform":
+            code = code * 2.0 - 1.0
+        else:  # approximate normal via inverse-erf of uniform
+            code = jax.scipy.special.erfinv(
+                jnp.clip(code * 2.0 - 1.0, -0.999999, 0.999999)) * np.sqrt(2.0)
+        return code
+
+    def __call__(self, ids, *, training: bool = False):
+        shape = jnp.shape(ids)
+        x = self.encode(ids)
+        for layer in self.layers:
+            x = layer(x)
+        return x.reshape(*shape, self.embedding_dim)

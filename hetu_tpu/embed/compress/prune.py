@@ -1,0 +1,216 @@
+"""Pruning / gating compressed embeddings.
+
+Reference methods: deeplight.py (adaptive magnitude pruning, WSDM'21),
+pep.py (learnable soft thresholds + retrain with frozen mask, ICLR'21),
+optembed.py (row-norm masks + stochastic field-dim supernet, CIKM'22),
+autosrh.py (per-group per-dim learnable gates, TOIS'23 / VLDB'24 grouping).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import constant, ones, xavier_normal, zeros
+
+__all__ = ["DeepLightEmbedding", "PEPEmbedding", "PEPRetrainEmbedding",
+           "OptEmbedding", "AutoSrhEmbedding"]
+
+
+class DeepLightEmbedding(Module):
+    """DeepLight adaptive magnitude pruning (methods/layers/deeplight.py:5):
+    lookups read the dense table; ``prune(step)`` returns a new module whose
+    smallest-magnitude entries are zeroed at the schedule's current rate
+    (reference prune_low_magnitude_op + make_adaptive_rate)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 prune_rate: float = 0.9, warmup_steps: int = 0,
+                 initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.prune_rate = prune_rate
+        self.warmup_steps = warmup_steps
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        return jnp.take(self.weight, ids, axis=0)
+
+    def adaptive_rate(self, step: int) -> float:
+        """deeplight.py:23 make_adaptive_rate: rate ramps toward prune_rate
+        as 1 - 0.99^(step/100)."""
+        if step <= self.warmup_steps:
+            return 0.0
+        real = step - self.warmup_steps
+        return float(self.prune_rate * (1 - 0.99 ** (real / 100.0)))
+
+    def prune(self, step: int) -> "DeepLightEmbedding":
+        rate = self.adaptive_rate(step)
+        if rate <= 0.0:
+            return self
+        mag = jnp.abs(self.weight)
+        k = int(rate * mag.size)
+        if k == 0:
+            return self
+        threshold = jnp.sort(mag.reshape(-1))[k - 1]
+        pruned = jnp.where(mag > threshold, self.weight,
+                           jnp.zeros_like(self.weight))
+        return self.replace(weight=pruned)
+
+    def sparsity(self) -> float:
+        return float(jnp.mean(self.weight == 0.0))
+
+
+class PEPEmbedding(Module):
+    """PEP learnable soft-threshold pruning (methods/layers/pep.py:7):
+    lookup = sign(v) * relu(|v| - sigmoid(s)), with threshold s shaped by
+    ``threshold_type`` in {global, dimension, feature, feature_dimension}."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 threshold_type: str = "feature_dimension",
+                 threshold_init: float = -8.0,
+                 initializer=None, dtype=jnp.float32):
+        if threshold_type not in ("dimension", "feature", "global",
+                                  "feature_dimension"):
+            raise ValueError(f"bad threshold_type {threshold_type}")
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        shape = {"feature_dimension": (num_embeddings, embedding_dim),
+                 "dimension": (embedding_dim,),
+                 "feature": (num_embeddings, 1),
+                 "global": (1,)}[threshold_type]
+        self.threshold = constant(threshold_init)(None, shape, dtype)
+        self.threshold_axes = ("vocab", "embed")[:len(shape)] if \
+            threshold_type.startswith("feature") else (None,) * len(shape)
+        self.threshold_type = threshold_type
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def _row_threshold(self, ids):
+        if self.threshold_type.startswith("feature"):
+            return jnp.take(self.threshold, ids, axis=0)
+        return self.threshold
+
+    def __call__(self, ids):
+        v = jnp.take(self.weight, ids, axis=0)
+        g = jax.nn.sigmoid(self._row_threshold(ids))
+        return jnp.sign(v) * jax.nn.relu(jnp.abs(v) - g)
+
+    def make_mask(self):
+        """Binary keep-mask at the learned thresholds (for retraining)."""
+        g = jax.nn.sigmoid(self.threshold)
+        return (jnp.abs(self.weight) > g).astype(jnp.int32)
+
+
+class PEPRetrainEmbedding(Module):
+    """PEP retrain stage (pep.py:46 PEPRetrainEmbedding): fresh table, the
+    frozen binary mask from the search stage multiplies every lookup."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, mask,
+                 initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.mask = jnp.asarray(mask, jnp.int32)
+        self.mask_axes = ("vocab", "embed")
+        self._state_fields = ("mask",)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        v = jnp.take(self.weight, ids, axis=0)
+        m = jnp.take(self.mask, ids, axis=0)
+        return v * m.astype(v.dtype)
+
+
+class OptEmbedding(Module):
+    """OptEmbed supernet (methods/layers/optembed.py:6): row kept when its
+    L1 norm exceeds a learned per-slot threshold (binary step w/ STE);
+    training also samples a random per-sample embedding-dim mask from the
+    triangular mask bank (the dimension search space)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 num_slot: int = 1, initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.threshold = zeros(None, (num_slot, 1), dtype)
+        self.threshold_axes = (None, None)
+        self.num_slot = num_slot
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def _feature_mask(self, v):
+        """binary_step(|v|_1 - t) with straight-through gradient
+        (optembed.py get_batch_feature_mask)."""
+        norm = jnp.sum(jnp.abs(v), axis=-1, keepdims=True)  # [B, slot, 1]
+        t = self.threshold[None, :, :]
+        raw = norm - t
+        hard = (raw >= 0).astype(v.dtype)
+        soft = jax.nn.sigmoid(raw)  # STE surrogate gradient
+        return soft + jax.lax.stop_gradient(hard - soft)
+
+    def _field_mask(self, key, batch: int, dtype):
+        """random prefix-length dim masks (optembed.py pre_potential_field_mask
+        + randint_sample): mask[i] keeps dims [0..k_i]."""
+        k = jax.random.randint(key, (batch, self.num_slot), 0,
+                               self.embedding_dim)
+        d = jnp.arange(self.embedding_dim)
+        return (d[None, None, :] <= k[:, :, None]).astype(dtype)
+
+    def __call__(self, ids, *, key=None, training: bool = False):
+        # ids: [B, num_slot] (one feature id per slot)
+        v = jnp.take(self.weight, ids, axis=0)            # [B, slot, D]
+        out = v * self._feature_mask(v)
+        if training and key is not None:
+            out = out * self._field_mask(key, v.shape[0], v.dtype)
+        return out
+
+    def row_mask(self):
+        """Rows surviving the threshold (for the row-pruned retrain stage)."""
+        norm = jnp.sum(jnp.abs(self.weight), axis=-1)
+        t = jnp.max(self.threshold)
+        return norm >= t
+
+
+class AutoSrhEmbedding(Module):
+    """AutoSrh (methods/layers/autosrh.py:6): rows are bucketed into
+    frequency groups; a learnable [nsplit, dim] gate multiplies lookups,
+    sparsified/rounded after the search stage."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 nsplit: int = 8, group_indices=None,
+                 initializer=None, dtype=jnp.float32):
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        if group_indices is None:
+            group_indices = np.zeros((num_embeddings,), np.int32)
+        self.group_indices = jnp.asarray(group_indices, jnp.int32).reshape(-1)
+        self.group_indices_axes = (None,)
+        self._state_fields = ("group_indices",)
+        self.alpha = ones(None, (nsplit, embedding_dim), dtype)
+        self.alpha_axes = (None, "embed")
+        self.nsplit = nsplit
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        v = jnp.take(self.weight, ids, axis=0)
+        g = jnp.take(self.group_indices, ids, axis=0)
+        a = jnp.take(self.alpha, g, axis=0)
+        return v * a
+
+    def harden(self, keep_rate: float = 0.5) -> "AutoSrhEmbedding":
+        """Binarize alpha by global magnitude quantile (retrain stage)."""
+        flat = jnp.abs(self.alpha).reshape(-1)
+        k = int((1 - keep_rate) * flat.size)
+        thr = jnp.sort(flat)[k] if k > 0 else -jnp.inf
+        hard = jnp.where(jnp.abs(self.alpha) >= thr,
+                         jnp.ones_like(self.alpha), jnp.zeros_like(self.alpha))
+        return self.replace(alpha=hard)

@@ -1,0 +1,201 @@
+"""Quantization-based compressed embeddings.
+
+Reference methods: quantize.py (uniform fake-quant lookup, backed by
+QuantizeEmbedding.cu), alpt.py (ALPT: learned per-row scale, AAAI'23),
+dpq.py (differentiable product quantization, ICML'20), mgqe.py
+(multi-granular quantized embedding — frequency-dependent code count).
+
+All quantizers use the straight-through estimator
+(``x + stop_gradient(q - x)``) so the forward sees quantized values while
+the backward flows full-precision gradients — the same trick the reference
+bakes into its CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.core.rng import next_key
+from hetu_tpu.init import constant, xavier_normal
+from hetu_tpu.layers.norm import LayerNorm
+
+__all__ = ["QuantizedEmbedding", "ALPTEmbedding", "DPQEmbedding",
+           "MGQEmbedding"]
+
+
+def _ste(x, q):
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _fake_quant(x, scale, middle, digit):
+    lo = -(2 ** (digit - 1))
+    hi = 2 ** (digit - 1) - 1
+    q = jnp.clip(jnp.round((x - middle) / scale), lo, hi)
+    return q * scale + middle
+
+
+class QuantizedEmbedding(Module):
+    """Uniform fake-quantized lookup (methods/layers/quantize.py:5): the
+    table is stored full-precision for training but every lookup passes
+    through digit-bit quantization, so trained weights are deployable as
+    int8/int16 (the reference's unified_quantized_embedding_lookup_op)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 digit: int = 8, scale: float = 0.01, middle: float = 0.0,
+                 initializer=None, dtype=jnp.float32):
+        if digit not in (8, 16):
+            raise ValueError("digit must be 8 or 16")
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.digit = digit
+        self.scale = scale
+        self.middle = middle
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        x = jnp.take(self.weight, ids, axis=0)
+        return _ste(x, _fake_quant(x, self.scale, self.middle, self.digit))
+
+    def quantized_table(self):
+        """int8/int16 deployment view of the table."""
+        lo = -(2 ** (self.digit - 1))
+        hi = 2 ** (self.digit - 1) - 1
+        q = jnp.clip(jnp.round((self.weight - self.middle) / self.scale), lo, hi)
+        return q.astype(jnp.int8 if self.digit == 8 else jnp.int16)
+
+
+class ALPTEmbedding(Module):
+    """ALPT (methods/layers/alpt.py:5): per-row learned scale; lookups are
+    quantized with the row's scale, STE on both weight and scale."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 digit: int = 8, init_scale: float = 0.01,
+                 initializer=None, dtype=jnp.float32):
+        if digit not in (8, 16):
+            raise ValueError("digit must be 8 or 16")
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        self.scale = constant(init_scale)(None, (num_embeddings, 1), dtype)
+        self.scale_axes = ("vocab", None)
+        self.digit = digit
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, ids):
+        x = jnp.take(self.weight, ids, axis=0)
+        s = jnp.take(self.scale, ids, axis=0)
+        return _ste(x, _fake_quant(x, s, 0.0, self.digit))
+
+
+class DPQEmbedding(Module):
+    """Differentiable product quantization, 'vq' mode
+    (methods/layers/dpq.py:6, ICML'20): the query table is chunked into
+    ``num_parts``; each chunk snaps to its nearest key vector and emits the
+    paired value vector, with an STE forward and a commitment regularizer.
+    ``codes()`` gives the compressed int codebook for deployment."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 num_choices: int = 256, num_parts: int = 4,
+                 share_weights: bool = False, mode: str = "vq",
+                 initializer=None, dtype=jnp.float32):
+        if mode not in ("vq", "sx"):
+            raise ValueError("mode must be 'vq' or 'sx'")
+        if embedding_dim % num_parts:
+            raise ValueError("embedding_dim must divide into num_parts")
+        init = initializer or xavier_normal()
+        self.weight = init(next_key(), (num_embeddings, embedding_dim), dtype)
+        self.weight_axes = ("vocab", "embed")
+        pdim = embedding_dim // num_parts
+        nkey = 1 if share_weights else num_parts
+        # 'vq' ties keys and values (dpq.py: value_matrix = key_matrix), so
+        # only one codebook leaf exists in that mode; 'sx' keeps a separate
+        # value matrix.
+        self.keys = init(next_key(), (nkey, num_choices, pdim), dtype)
+        self.keys_axes = (None, None, None)
+        if mode == "sx":
+            self.values = init(next_key(), (nkey, num_choices, pdim), dtype)
+            self.values_axes = (None, None, None)
+        self.norm = LayerNorm(num_choices)
+        self.mode = mode
+        self.share_weights = share_weights
+        self.num_choices = num_choices
+        self.num_parts = num_parts
+        self.part_dim = pdim
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def _codebook(self, which: str):
+        m = self.keys if (self.mode == "vq" or which == "keys") else self.values
+        if m.shape[0] == 1 and self.num_parts > 1:
+            m = jnp.broadcast_to(
+                m, (self.num_parts, self.num_choices, self.part_dim))
+        return m
+
+    def _responses(self, ids):
+        x = jnp.take(self.weight, ids, axis=0)           # [..., D]
+        shape = x.shape
+        q = x.reshape(-1, self.num_parts, 1, self.part_dim)
+        keys = self._codebook("keys")[None]              # [1, parts, K, pdim]
+        resp = -jnp.sum((q - keys) ** 2, axis=-1)        # [B, parts, K]
+        resp = self.norm(resp)
+        return x, resp, shape
+
+    def _decode(self, x, codes, shape, with_reg):
+        vals = self._codebook("values")
+        out = jnp.take_along_axis(
+            vals[None], codes[:, :, None, None].astype(jnp.int32), axis=2
+        )[:, :, 0, :]                                     # [B, parts, pdim]
+        out = out.reshape(shape)
+        final = _ste(x, out)
+        if with_reg:
+            reg = jnp.mean((out - jax.lax.stop_gradient(x)) ** 2)
+            return final, reg
+        return final
+
+    def __call__(self, ids, *, with_reg: bool = False):
+        x, resp, shape = self._responses(ids)
+        codes = jnp.argmax(resp, axis=-1)                # [B, parts]
+        return self._decode(x, codes, shape, with_reg)
+
+    def codes(self, ids):
+        """Compressed per-row codes (deployment: codes + value matrix)."""
+        _, resp, _ = self._responses(ids)
+        return jnp.argmax(resp, axis=-1).astype(jnp.int32)
+
+
+class MGQEmbedding(DPQEmbedding):
+    """MGQE (methods/layers/mgqe.py:6): frequent rows may use all
+    ``num_choices`` codes, infrequent rows only the first ``low_num_choices``
+    — the argmax is masked per-row by a frequency table."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 high_num_choices: int = 256, low_num_choices: int = 64,
+                 num_parts: int = 4, frequency=None,
+                 initializer=None, dtype=jnp.float32):
+        super().__init__(num_embeddings, embedding_dim,
+                         num_choices=high_num_choices, num_parts=num_parts,
+                         share_weights=False, mode="vq",
+                         initializer=initializer, dtype=dtype)
+        self.low_num_choices = low_num_choices
+        if frequency is None:
+            frequency = np.ones((num_embeddings,), np.int32)
+        self.frequency = jnp.asarray(frequency, jnp.int32).reshape(-1)
+        self.frequency_axes = (None,)
+
+    def __call__(self, ids, *, with_reg: bool = False):
+        x, resp, shape = self._responses(ids)
+        freq = jnp.take(self.frequency, ids, axis=0).reshape(-1)   # [B]
+        # infrequent rows (frequency == 0) restricted to low_num_choices
+        choice_idx = jnp.arange(self.num_choices)
+        allowed_hi = jnp.ones((self.num_choices,), bool)
+        allowed_lo = choice_idx < self.low_num_choices
+        allowed = jnp.where(freq[:, None] > 0, allowed_hi[None], allowed_lo[None])
+        masked = jnp.where(allowed[:, None, :], resp, -jnp.inf)
+        codes = jnp.argmax(masked, axis=-1)
+        return self._decode(x, codes, shape, with_reg)

@@ -1,0 +1,118 @@
+"""Multi-stage compression training schedules.
+
+Reference: methods/scheduler/multistage.py + per-method schedulers — each
+compression method trains in stages (e.g. PEP: threshold search -> mask
+freeze -> retrain; AutoDim: supernet search -> dim selection -> retrain;
+DeepLight: train with periodic magnitude pruning).
+
+TPU-native shape: a ``CompressionSchedule`` is a list of ``Stage``s; each
+stage declares its step budget, an optional per-step hook (e.g. DeepLight's
+prune cadence) and a ``transition`` that maps the finished stage's embedding
+module to the next stage's (mask extraction, table materialization).  The
+trainer loop stays a plain jit step; only stage boundaries re-trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+__all__ = ["Stage", "CompressionSchedule", "deeplight_schedule",
+           "pep_schedule", "autosrh_schedule"]
+
+
+@dataclasses.dataclass
+class Stage:
+    name: str
+    steps: int
+    # hook(model, step) -> model, called every `hook_every` steps in-stage
+    hook: Optional[Callable] = None
+    hook_every: int = 100
+    # transition(model) -> next stage's model, at stage end
+    transition: Optional[Callable] = None
+
+
+class CompressionSchedule:
+    """Drives an embedding module through its stages.
+
+    >>> sched = CompressionSchedule([Stage("search", 1000, transition=f),
+    ...                              Stage("retrain", 2000)])
+    >>> while not sched.done:
+    ...     model = train_step(model, batch)         # user's jit step
+    ...     model = sched.step(model)                # hooks + transitions
+    """
+
+    def __init__(self, stages: Sequence[Stage]):
+        if not stages:
+            raise ValueError("schedule needs at least one stage")
+        self.stages = list(stages)
+        self.stage_idx = 0
+        self.step_in_stage = 0
+
+    @property
+    def stage(self) -> Stage:
+        return self.stages[self.stage_idx]
+
+    @property
+    def done(self) -> bool:
+        return self.stage_idx >= len(self.stages)
+
+    @property
+    def total_steps(self) -> int:
+        return sum(s.steps for s in self.stages)
+
+    def step(self, model: Any) -> Any:
+        """Advance one trained step: run the stage hook when due, apply the
+        transition when the stage's budget is exhausted."""
+        if self.done:
+            return model
+        st = self.stage
+        self.step_in_stage += 1
+        if (st.hook is not None and st.hook_every > 0
+                and self.step_in_stage % st.hook_every == 0):
+            model = st.hook(model, self.step_in_stage)
+        if self.step_in_stage >= st.steps:
+            if st.transition is not None:
+                model = st.transition(model)
+            self.stage_idx += 1
+            self.step_in_stage = 0
+        return model
+
+
+# -- canonical schedules (scheduler/<method>.py equivalents) -------------------
+
+
+def deeplight_schedule(train_steps: int, prune_every: int = 100):
+    """DeepLight: single stage, periodic adaptive magnitude pruning
+    (scheduler/deeplight.py)."""
+    def hook(model, step):
+        return model.prune(step)
+    return CompressionSchedule([
+        Stage("train+prune", train_steps, hook=hook, hook_every=prune_every)])
+
+
+def pep_schedule(search_steps: int, retrain_steps: int,
+                 make_retrain: Optional[Callable] = None):
+    """PEP: soft-threshold search, then retrain from scratch under the
+    frozen mask (scheduler/pep.py)."""
+    def transition(model):
+        from hetu_tpu.embed.compress.prune import PEPRetrainEmbedding
+        mask = model.make_mask()
+        if make_retrain is not None:
+            return make_retrain(model, mask)
+        return PEPRetrainEmbedding(model.num_embeddings, model.embedding_dim,
+                                   mask)
+    return CompressionSchedule([
+        Stage("search", search_steps, transition=transition),
+        Stage("retrain", retrain_steps)])
+
+
+def autosrh_schedule(search_steps: int, retrain_steps: int,
+                     keep_rate: float = 0.5):
+    """AutoSrh: gate search, then harden alpha and retrain
+    (scheduler/autosrh.py)."""
+    def transition(model):
+        return model.harden(keep_rate)
+    return CompressionSchedule([
+        Stage("search", search_steps, transition=transition),
+        Stage("retrain", retrain_steps)])

@@ -62,7 +62,7 @@ def to_dot(fn_or_jaxpr: Any, *example_args, name: str = "hetu_tpu",
         lines.append(f'  {nid} [label="{html.escape(label)}", '
                      f'fillcolor="{color}", shape={shape}];')
 
-    def walk(jaxpr, consts, prefix: str):
+    def walk(jaxpr, prefix: str):
         for v in jaxpr.constvars:
             nid = node_id()
             node_of[id(v)] = nid
@@ -79,8 +79,7 @@ def to_dot(fn_or_jaxpr: Any, *example_args, name: str = "hetu_tpu",
                                  "remat", "checkpoint") else None)
             if inner is not None and not collapse_calls:
                 inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
-                inner_consts = getattr(inner, "consts", ())
-                walk(inner_jaxpr, inner_consts, prefix + prim + ".")
+                walk(inner_jaxpr, prefix + prim + ".")
                 # connect call boundary by aliasing vars
                 for outer_v, inner_v in zip(eqn.invars, inner_jaxpr.invars):
                     if not isinstance(outer_v, Literal) and id(outer_v) in node_of:
@@ -108,7 +107,7 @@ def to_dot(fn_or_jaxpr: Any, *example_args, name: str = "hetu_tpu",
                 node_of[id(v)] = nid
         return jaxpr.outvars
 
-    outvars = walk(closed.jaxpr, closed.consts, "")
+    outvars = walk(closed.jaxpr, "")
     for i, v in enumerate(outvars):
         nid = node_id()
         declare(nid, f"out{i}\n{_avals(v)}", "#fcbba1", "ellipse")
@@ -155,6 +154,9 @@ def show(fn: Callable, *example_args, port: int = 9001,
             pass
 
     server = HTTPServer(("127.0.0.1", port), Handler)
+    if open_browser:
+        import webbrowser
+        webbrowser.open(f"http://127.0.0.1:{server.server_address[1]}/")
     if blocking:
         try:
             server.serve_forever()

@@ -56,8 +56,12 @@ class DistConfig:
     def from_yaml(cls, path: str) -> "DistConfig":
         import yaml
         with open(path) as f:
-            raw = yaml.safe_load(f)
+            raw = yaml.safe_load(f) or {}
+        if not isinstance(raw, dict):
+            raise ValueError(f"cluster config {path} must be a yaml mapping")
         nodes = raw.get("nodes") or raw.get("hosts") or []
+        if not nodes:
+            raise ValueError(f"cluster config {path} lists no nodes")
         hosts = []
         for item in nodes:
             if isinstance(item, str):
@@ -137,7 +141,7 @@ def launch(cfg: DistConfig, argv: Sequence[str],
     ones over ssh.  Returns the list of (process_id, Popen|command)."""
     procs = []
     carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, "JAX_PLATFORMS", "XLA_FLAGS",
-             "PYTHONPATH"]
+             "PYTHONPATH"] + sorted(extra_env or ())
     for host, _local_rank, pid in cfg.process_table():
         env = worker_env(cfg, pid)
         if extra_env:
@@ -179,11 +183,19 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        outs.append(out)
-        if p.returncode != 0:
-            raise RuntimeError(f"worker failed (rc={p.returncode}):\n{out}")
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            if p.returncode != 0:
+                raise RuntimeError(f"worker failed (rc={p.returncode}):\n{out}")
+    finally:
+        # a failed/timed-out peer leaves the others blocked in distributed
+        # init — reap everything before surfacing the error
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     return outs
 
 
@@ -203,12 +215,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     procs = launch(cfg, args.command, dry_run=args.dry_run)
     if args.dry_run:
         for pid, cmd in procs:
-            print(f"[{pid}] {cmd if isinstance(cmd, list) else shlex.join(cmd)}")
+            print(f"[{pid}] {shlex.join(cmd) if isinstance(cmd, list) else cmd}")
         return 0
-    rc = 0
-    for _pid, p in procs:
-        rc = rc or p.wait()
-    return rc
+    # wait on every worker (reap all children), then report the first failure
+    rcs = [p.wait() for _pid, p in procs]
+    return next((r for r in rcs if r), 0)
 
 
 if __name__ == "__main__":
