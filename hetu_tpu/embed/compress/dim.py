@@ -58,6 +58,21 @@ class MDEmbedding(Module):
             v = v @ self.proj.astype(v.dtype)
         return v
 
+    @classmethod
+    def from_arrays(cls, weight, proj, embedding_dim: int) -> "MDEmbedding":
+        """Wrap existing arrays without allocating fresh tables or consuming
+        RNG keys (used by AutoDimEmbedding.materialize)."""
+        m = object.__new__(cls)
+        m.weight = weight
+        m.weight_axes = ("vocab", None)
+        m.proj = proj
+        if proj is not None:
+            m.proj_axes = (None, "embed")
+        m.num_embeddings = int(weight.shape[0])
+        m.compressed_dim = int(weight.shape[1])
+        m.embedding_dim = embedding_dim
+        return m
+
 
 class AutoDimEmbedding(Module):
     """AutoDim NAS supernet (methods/layers/autodim.py:5): one table per
@@ -124,8 +139,7 @@ class AutoDimEmbedding(Module):
         out = []
         for slot, d in enumerate(self.selected_dims()):
             ci = self.dim_candidates.index(d)
-            m = MDEmbedding(self.num_embeddings, d, self.max_dim)
-            m = m.replace(weight=self.tables[ci],
-                          proj=self.projs[ci][slot] if d < self.max_dim else None)
-            out.append(m)
+            proj = self.projs[ci][slot] if d < self.max_dim else None
+            out.append(MDEmbedding.from_arrays(
+                self.tables[ci], proj, self.max_dim))
         return out

@@ -26,12 +26,6 @@ __all__ = ["HashEmbedding", "CompositionalEmbedding", "RobeEmbedding",
 _MERSENNE = np.uint32(2038074743)  # prime used for universal hashing
 
 
-def _universal_hash(x, a, b, prime, m):
-    """((a*x + b) mod p) mod m in uint32 (overflow wraps, fine for hashing)."""
-    x = x.astype(jnp.uint32)
-    return (((a * x + b) % prime) % jnp.uint32(m)).astype(jnp.int32)
-
-
 class HashEmbedding(Module):
     """ids mod N into a smaller table (methods/layers/hash.py:5)."""
 

@@ -85,8 +85,10 @@ class PEPEmbedding(Module):
                  "feature": (num_embeddings, 1),
                  "global": (1,)}[threshold_type]
         self.threshold = constant(threshold_init)(None, shape, dtype)
-        self.threshold_axes = ("vocab", "embed")[:len(shape)] if \
-            threshold_type.startswith("feature") else (None,) * len(shape)
+        self.threshold_axes = {"feature_dimension": ("vocab", "embed"),
+                               "feature": ("vocab", None),
+                               "dimension": ("embed",),
+                               "global": (None,)}[threshold_type]
         self.threshold_type = threshold_type
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim

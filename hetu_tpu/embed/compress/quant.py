@@ -188,8 +188,7 @@ class MGQEmbedding(DPQEmbedding):
         self.frequency = jnp.asarray(frequency, jnp.int32).reshape(-1)
         self.frequency_axes = (None,)
 
-    def __call__(self, ids, *, with_reg: bool = False):
-        x, resp, shape = self._responses(ids)
+    def _masked_codes(self, ids, resp):
         freq = jnp.take(self.frequency, ids, axis=0).reshape(-1)   # [B]
         # infrequent rows (frequency == 0) restricted to low_num_choices
         choice_idx = jnp.arange(self.num_choices)
@@ -197,5 +196,15 @@ class MGQEmbedding(DPQEmbedding):
         allowed_lo = choice_idx < self.low_num_choices
         allowed = jnp.where(freq[:, None] > 0, allowed_hi[None], allowed_lo[None])
         masked = jnp.where(allowed[:, None, :], resp, -jnp.inf)
-        codes = jnp.argmax(masked, axis=-1)
+        return jnp.argmax(masked, axis=-1)
+
+    def __call__(self, ids, *, with_reg: bool = False):
+        x, resp, shape = self._responses(ids)
+        codes = self._masked_codes(ids, resp)
         return self._decode(x, codes, shape, with_reg)
+
+    def codes(self, ids):
+        """Deployment codes under the same frequency restriction the model
+        trained with (overrides the unmasked DPQ argmax)."""
+        _, resp, _ = self._responses(ids)
+        return self._masked_codes(ids, resp).astype(jnp.int32)

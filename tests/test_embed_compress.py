@@ -126,17 +126,24 @@ class TestQuantFamily:
         layer = MGQEmbedding(VOCAB, DIM, high_num_choices=16,
                              low_num_choices=2, num_parts=2, frequency=freq)
         check_forward_and_grad(layer)
+        # the layer's own deployment codes for rare rows stay < low_num_choices
         rare_ids = jnp.asarray([50, 60, 70, 99], jnp.int32)
-        x, resp, shape = layer._responses(rare_ids)
-        # recompute codes the layer would pick
-        out = layer(rare_ids)
-        # rare rows may only use the first 2 codes: check against codes()
-        # restricted manually
-        masked = np.asarray(resp)[:, :, 2:]
-        full = np.asarray(resp)
-        codes_manual = np.argmax(
-            np.where(np.arange(16)[None, None, :] < 2, full, -np.inf), axis=-1)
-        assert codes_manual.max() < 2
+        assert int(layer.codes(rare_ids).max()) < 2
+        # frequent rows can (in general) use the full range; at minimum the
+        # mask must not corrupt them vs the unmasked DPQ argmax
+        freq_ids = jnp.asarray([0, 5, 9], jnp.int32)
+        _, resp, _ = layer._responses(freq_ids)
+        np.testing.assert_array_equal(
+            np.asarray(layer.codes(freq_ids)),
+            np.argmax(np.asarray(resp), axis=-1))
+        # forward decode for rare rows uses only the restricted codebook rows
+        out = np.asarray(layer(rare_ids)).reshape(-1, 2, DIM // 2)
+        codes = np.asarray(layer.codes(rare_ids))
+        vals = np.asarray(layer._codebook("values"))
+        for b in range(out.shape[0]):
+            for p in range(2):
+                np.testing.assert_allclose(out[b, p], vals[p, codes[b, p]],
+                                           atol=1e-5)
 
 
 class TestPruneFamily:
