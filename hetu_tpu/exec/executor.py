@@ -109,6 +109,15 @@ class Trainer:
     def evaluate(self, batch) -> dict:
         return self._eval_step(self._state, batch)
 
+    def profile(self, batch, key=None, iters: int = 10) -> dict:
+        """Wall-time + cost profile of one train step on the given batch
+        (reference executor.profile, executor.py:501)."""
+        from hetu_tpu.exec.profiler import profile_fn
+        if key is None:
+            key = next_key()
+        return profile_fn(self._train_step, self._state, batch, key,
+                          iters=iters)
+
 
 class Executor:
     """Named-subgraph facade for reference API parity (executor.py:430).

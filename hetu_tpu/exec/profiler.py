@@ -1,0 +1,184 @@
+"""Execution-layer profiling: per-section timers, per-primitive graph
+profiles, compiled-cost analysis, and trace capture.
+
+Reference surfaces being covered (SURVEY §5.1):
+- ``HetuTimer`` — the timer subexecutor's per-node/per-type accumulation
+  (timer_subexecutor.py:21, ``logOut`` with node/type granularity);
+- ``HetuProfiler`` — per-op re-execution profiling behind
+  ``executor.profile(...)`` (profiler.py:55, executor.py:501);
+- XLA-native extras the reference lacks: ``compiled_cost`` reads the
+  compiler's own flop/byte analysis, ``trace`` captures a profile for
+  TensorBoard/XProf (jax.profiler), which replaces CUDA-event timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["HetuTimer", "profile_fn", "compiled_cost", "primitive_counts",
+           "trace"]
+
+
+class HetuTimer:
+    """Named-section wall timer with accumulation.
+
+    >>> timer = HetuTimer()
+    >>> with timer("forward"):
+    ...     out = model(x)
+    >>> timer.log_out()
+    """
+
+    def __init__(self, sync: bool = True):
+        self.totals: dict = defaultdict(float)
+        self.counts: dict = defaultdict(int)
+        self.sync = sync
+        self._last_result: Any = None
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, result: Any = None):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            if self.sync and self._last_result is not None:
+                jax.block_until_ready(self._last_result)
+                self._last_result = None
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def observe(self, result: Any) -> Any:
+        """Register a jax value to block on at section exit (async dispatch
+        means exit-time sync is needed for honest timings)."""
+        self._last_result = result
+        return result
+
+    def mean(self, name: str) -> float:
+        return self.totals[name] / max(self.counts[name], 1)
+
+    def log_out(self, printer: Callable = print) -> dict:
+        """Per-section totals/means (timer_subexecutor logOut)."""
+        stats = {name: {"total_s": self.totals[name],
+                        "count": self.counts[name],
+                        "mean_s": self.mean(name)}
+                 for name in sorted(self.totals)}
+        for name, s in stats.items():
+            printer(f"[hetu-timer] {name}: total {s['total_s']*1e3:.2f}ms "
+                    f"count {s['count']} mean {s['mean_s']*1e3:.3f}ms")
+        return stats
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+
+def primitive_counts(fn: Callable, *example_args) -> dict:
+    """Per-primitive equation counts + analytic flops where known — the
+    node/type granularity of the reference's timer subexecutor, read off
+    the jaxpr instead of timed per-op replays."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    counts: dict = defaultdict(int)
+    flops: dict = defaultdict(float)
+
+    def visit(jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            inner = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr"))
+            if inner is not None and prim in (
+                    "pjit", "jit", "closed_call", "core_call",
+                    "custom_jvp_call", "custom_vjp_call", "remat",
+                    "remat2", "checkpoint"):
+                visit(inner.jaxpr if hasattr(inner, "jaxpr") else inner)
+                continue
+            counts[prim] += 1
+            if prim == "dot_general":
+                ((lc, _rc), (lb, _rb)) = eqn.params["dimension_numbers"]
+                lhs = eqn.invars[0].aval
+                out = eqn.outvars[0].aval
+                k = np.prod([lhs.shape[d] for d in lc], initial=1.0)
+                flops[prim] += 2.0 * k * np.prod(out.shape, initial=1.0)
+            elif prim == "conv_general_dilated":
+                rhs = eqn.invars[1].aval
+                out = eqn.outvars[0].aval
+                # 2 * out_elems * (kernel spatial * in_channels)
+                per_out = 2.0 * np.prod(rhs.shape, initial=1.0) / rhs.shape[
+                    eqn.params["dimension_numbers"][1][0]]
+                flops[prim] += per_out * np.prod(out.shape, initial=1.0)
+
+    visit(closed.jaxpr)
+    return {"counts": dict(counts), "flops": dict(flops),
+            "total_flops": float(sum(flops.values()))}
+
+
+def compiled_cost(fn: Callable, *example_args, static_argnums=()) -> dict:
+    """XLA's own cost analysis of the compiled executable (flops, bytes
+    accessed, peak memory when the backend reports it)."""
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*example_args)
+    compiled = lowered.compile()
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out["flops"] = float(ca.get("flops", 0.0))
+        out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:  # backend without cost analysis
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["temp_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0))
+            out["argument_bytes"] = float(
+                getattr(mem, "argument_size_in_bytes", 0))
+            out["output_bytes"] = float(getattr(mem, "output_size_in_bytes", 0))
+    except Exception:
+        pass
+    return out
+
+
+def profile_fn(fn: Callable, *example_args, iters: int = 10,
+               warmup: int = 2) -> dict:
+    """Wall-time + cost profile of a jitted function — the
+    ``executor.profile(feed_shapes, ...)`` capability (executor.py:501).
+
+    Returns {mean_s, p50_s, min_s, flops, achieved_flops, counts...}.
+    """
+    jitted = jax.jit(fn)
+    for _ in range(max(warmup, 1)):
+        out = jitted(*example_args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jitted(*example_args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    prof = {"mean_s": float(np.mean(times)),
+            "p50_s": float(np.median(times)),
+            "min_s": float(np.min(times)),
+            "iters": iters}
+    prof.update(compiled_cost(fn, *example_args))
+    prims = primitive_counts(fn, *example_args)
+    prof["primitive_counts"] = prims["counts"]
+    if "flops" not in prof or not prof["flops"]:
+        prof["flops"] = prims["total_flops"]
+    if prof.get("flops"):
+        prof["achieved_flops"] = prof["flops"] / prof["p50_s"]
+    return prof
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XProf/TensorBoard trace of the enclosed block
+    (replaces the reference's CUDA-event timing paths on TPU)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -29,7 +29,7 @@ from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import xavier_uniform, zeros
 
 __all__ = ["normalize_adjacency", "spmm_edges", "GraphConv", "GCN",
-           "dist_spmm_15d", "DistGCN15D", "sample_subgraph"]
+           "dist_spmm_15d", "DistGCN15D", "GraphIndex", "sample_subgraph"]
 
 
 def normalize_adjacency(edge_index, num_nodes: int, *, add_self_loops=True):
@@ -102,10 +102,9 @@ class GCN(Module):
             if i < len(self.convs) - 1:
                 x = jax.nn.relu(x)
                 if training and key is not None and self.dropout_rate > 0:
+                    from hetu_tpu.ops.nn import dropout
                     key, sub = jax.random.split(key)
-                    keep = jax.random.bernoulli(
-                        sub, 1 - self.dropout_rate, x.shape)
-                    x = jnp.where(keep, x / (1 - self.dropout_rate), 0.0)
+                    x = dropout(x, self.dropout_rate, sub, training=True)
         return x
 
 
@@ -177,50 +176,79 @@ class DistGCN15D(Module):
 # -- host-side neighbor sampling (GraphMix-server capability, light) ----------
 
 
+class GraphIndex:
+    """CSR-style in-neighbor index built ONCE per graph and reused across
+    minibatch sampling calls (the per-call work then touches only the
+    sampled neighborhood, not the whole edge list)."""
+
+    def __init__(self, edge_index):
+        self.src, self.dst = (np.asarray(a) for a in edge_index)
+        if self.src.size:
+            self.order = np.argsort(self.dst, kind="stable")
+            sorted_dst = self.dst[self.order]
+            self.starts = np.searchsorted(
+                sorted_dst, np.arange(int(sorted_dst.max()) + 2))
+        else:
+            self.order = np.zeros((0,), np.int64)
+            self.starts = np.zeros((1,), np.int64)
+
+    def in_neighbors(self, v: int) -> np.ndarray:
+        if v + 1 >= len(self.starts):
+            return self.src[:0]
+        lo, hi = self.starts[v], self.starts[v + 1]
+        return self.src[self.order[lo:hi]]
+
+
 def sample_subgraph(edge_index, seed_nodes, num_hops: int = 2,
-                    fanout: int = 10, rng: Optional[np.random.Generator] = None):
+                    fanout: int = 10,
+                    rng: Optional[np.random.Generator] = None,
+                    index: Optional[GraphIndex] = None):
     """Uniform neighbor sampling producing an induced subgraph + relabeled
     edges (the role GraphMix sampling servers play for examples/gnn;
     dataloader.py:253 GNNDataLoaderOp feeds such blocks).
 
-    Returns (node_ids [M], sub_edge_index [2, E'], mapping of seed positions).
+    Pass a prebuilt ``GraphIndex`` when sampling repeatedly from the same
+    graph — building it is the only O(E log E) step.
+    Returns (node_ids [M], sub_edge_index [2, E'], seed positions).
     """
     rng = rng or np.random.default_rng()
-    src, dst = np.asarray(edge_index)
+    index = index or GraphIndex(edge_index)
+    src, dst = index.src, index.dst
     seeds = np.unique(np.asarray(seed_nodes))
     if src.size == 0:
         node_ids = np.sort(seeds).astype(np.int64)
-        pos = {int(v): i for i, v in enumerate(node_ids)}
-        seed_pos = np.asarray([pos[int(v)] for v in np.asarray(seed_nodes)])
+        seed_pos = np.searchsorted(node_ids, np.asarray(seed_nodes))
         return node_ids, np.zeros((2, 0), np.int32), seed_pos.astype(np.int32)
-    # adjacency list by dst (in-neighbors aggregate into dst)
-    order = np.argsort(dst, kind="stable")
-    sorted_dst = dst[order]
-    starts = np.searchsorted(sorted_dst, np.arange(sorted_dst.max() + 2))
     frontier = seeds
     nodes = set(frontier.tolist())
     for _ in range(num_hops):
         nxt = []
         for v in frontier:
-            if v + 1 >= len(starts):
-                continue
-            lo, hi = starts[v], starts[v + 1]
-            neigh = src[order[lo:hi]]
+            neigh = index.in_neighbors(v)
             if len(neigh) > fanout:
                 neigh = rng.choice(neigh, fanout, replace=False)
-            nxt.append(neigh)
+            if len(neigh):
+                nxt.append(neigh)
         if not nxt:
             break
         frontier = np.unique(np.concatenate(nxt))
         frontier = frontier[~np.isin(frontier, list(nodes))]
         nodes.update(frontier.tolist())
     node_ids = np.sort(np.fromiter(nodes, dtype=np.int64))
-    # size the relabel table to cover seeds beyond any edge endpoint
-    # (isolated nodes are normal in sampled mini-batches)
-    relabel = -np.ones(int(max(src.max(), dst.max(), node_ids.max())) + 1,
-                       np.int64)
-    relabel[node_ids] = np.arange(len(node_ids))
-    keep = np.isin(src, node_ids) & np.isin(dst, node_ids)
-    sub_edges = np.stack([relabel[src[keep]], relabel[dst[keep]]])
-    seed_pos = relabel[np.asarray(seed_nodes)]
+    # relabel via binary search over the (small) sampled node set — no
+    # O(max_node_id) table allocation
+    sub_src_parts, sub_dst_parts = [], []
+    for v in node_ids:
+        neigh = index.in_neighbors(int(v))
+        keep = np.isin(neigh, node_ids, assume_unique=False)
+        kept = neigh[keep]
+        sub_src_parts.append(np.searchsorted(node_ids, kept))
+        sub_dst_parts.append(
+            np.full(len(kept), np.searchsorted(node_ids, v), np.int64))
+    if sub_src_parts:
+        sub_edges = np.stack([np.concatenate(sub_src_parts),
+                              np.concatenate(sub_dst_parts)])
+    else:
+        sub_edges = np.zeros((2, 0), np.int64)
+    seed_pos = np.searchsorted(node_ids, np.asarray(seed_nodes))
     return node_ids, sub_edges.astype(np.int32), seed_pos.astype(np.int32)

@@ -108,13 +108,17 @@ def test_distgcn15d_forward_grad_on_mesh():
     devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
     mesh = Mesh(devices, ("gr", "gc"))
     model = DistGCN15D(f, 16, 4, mesh)
+    # nonzero biases so the oracle actually verifies bias placement
+    # (A(XW) + b, not A(XW + b))
+    rng = np.random.default_rng(7)
+    model = model.replace(bs=[jnp.asarray(rng.normal(size=b.shape), jnp.float32)
+                              for b in model.bs])
     out = jax.jit(lambda m, a, x: m(a, x))(model, a, x)
     assert out.shape == (n, 4)
     # distributed forward == single-device oracle
     def oracle(m, a, x):
         for i, (wgt, b) in enumerate(zip(m.ws, m.bs)):
-            x = x @ wgt + b
-            x = a @ x
+            x = a @ (x @ wgt) + b
             if i < len(m.ws) - 1:
                 x = jax.nn.relu(x)
         return x
@@ -136,3 +140,11 @@ def test_sample_subgraph():
     orig = set(map(tuple, np.asarray(ei).T))
     back = {(int(nodes[s]), int(nodes[d])) for s, d in sub_edges.T}
     assert back <= orig
+    # a prebuilt GraphIndex gives identical results for the same rng stream
+    from hetu_tpu.models.gnn import GraphIndex
+    idx = GraphIndex(ei)
+    n2, e2, p2 = sample_subgraph(ei, [0, 1], num_hops=2, fanout=5,
+                                 rng=np.random.default_rng(0), index=idx)
+    np.testing.assert_array_equal(nodes, n2)
+    np.testing.assert_array_equal(sub_edges, e2)
+    np.testing.assert_array_equal(seed_pos, p2)

@@ -1,0 +1,92 @@
+"""Execution profiling surface: HetuTimer accumulation, primitive counting,
+compiled cost analysis, profile_fn wall stats, Trainer.profile
+(reference: timer_subexecutor.py, profiler.py:55, executor.py:501)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.exec.profiler import (
+    HetuTimer, compiled_cost, primitive_counts, profile_fn,
+)
+
+
+def test_timer_accumulates():
+    timer = HetuTimer()
+    x = jnp.ones((64, 64))
+    for _ in range(3):
+        with timer("matmul"):
+            timer.observe(x @ x)
+    with timer("add"):
+        timer.observe(x + x)
+    stats = timer.log_out(printer=lambda *_: None)
+    assert stats["matmul"]["count"] == 3
+    assert stats["add"]["count"] == 1
+    assert stats["matmul"]["total_s"] > 0
+    assert timer.mean("matmul") == pytest.approx(
+        stats["matmul"]["total_s"] / 3)
+    timer.reset()
+    assert not timer.totals
+
+
+def test_primitive_counts_matmul_flops():
+    a = jnp.ones((32, 16))
+    b = jnp.ones((16, 8))
+    prof = primitive_counts(lambda a, b: jax.nn.relu(a @ b).sum(), a, b)
+    assert prof["counts"]["dot_general"] == 1
+    # 2*M*N*K flops
+    assert prof["flops"]["dot_general"] == pytest.approx(2 * 32 * 8 * 16)
+    assert prof["total_flops"] >= 2 * 32 * 8 * 16
+
+
+def test_primitive_counts_descends_wrappers():
+    x = jnp.ones((8, 8))
+    f = jax.checkpoint(lambda x: jnp.tanh(x @ x))
+    prof = primitive_counts(lambda x: f(x) + 1, x)
+    assert prof["counts"].get("dot_general", 0) >= 1
+    assert prof["counts"].get("tanh", 0) >= 1
+
+
+def test_compiled_cost_reports_flops():
+    a = jnp.ones((64, 64))
+    cost = compiled_cost(lambda a: a @ a, a)
+    # CPU backend reports flops; tolerate absence but require dict shape
+    assert isinstance(cost, dict)
+    if "flops" in cost:
+        assert cost["flops"] >= 2 * 64**3 * 0.5
+
+
+def test_profile_fn_stats():
+    a = jnp.ones((128, 128))
+    prof = profile_fn(lambda a: (a @ a).sum(), a, iters=3, warmup=1)
+    assert prof["mean_s"] > 0
+    assert prof["min_s"] <= prof["mean_s"]
+    assert prof["primitive_counts"]["dot_general"] == 1
+    assert prof.get("flops", 0) > 0
+    assert prof["achieved_flops"] > 0
+
+
+def test_trainer_profile():
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.core.module import Module
+    from hetu_tpu.exec.executor import Trainer
+    from hetu_tpu.layers import Linear
+    from hetu_tpu.optim import SGDOptimizer
+
+    set_random_seed(0)
+
+    class M(Module):
+        def __init__(self):
+            self.lin = Linear(4, 2)
+
+    def loss_fn(model, batch, key):
+        x, y = batch
+        return jnp.mean((model.lin(x) - y) ** 2), {}
+
+    trainer = Trainer(M(), SGDOptimizer(learning_rate=0.1), loss_fn)
+    batch = (jnp.ones((8, 4)), jnp.zeros((8, 2)))
+    trainer.step(batch)  # smoke the normal path first
+    prof = trainer.profile(batch, iters=2)
+    assert prof["mean_s"] > 0
+    assert "dot_general" in prof["primitive_counts"]
