@@ -21,6 +21,10 @@ from hetu_tpu.parallel.pipeline import (
     stack_modules,
     stage_partition,
 )
+from hetu_tpu.parallel.pipedream import (
+    pipedream_grads,
+    pipedream_train_step,
+)
 from hetu_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attn_fn,

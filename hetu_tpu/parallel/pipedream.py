@@ -1,0 +1,306 @@
+"""PipeDream 1F1B pipeline schedules — weight stashing under a functional runtime.
+
+Reference machinery rebuilt here (reference: python/hetu/):
+- PipeDream subexecutor: the 1F1B scheduler (pipedream_subexecutor.py:25
+  ``pipedream_scheduler``), weight stashing ``copy_latest_weight``:130, and
+  local gradient apply ``update_gradient_local``:149;
+- HetPipe = PipeDream + gradient sync across DP replicas via partial reduce
+  (pipedream_subexecutor.py:312).
+
+TPU-native design: the 1F1B schedule is ONE jitted SPMD program over the
+``pp`` mesh axis.  A ``lax.scan`` over ticks runs, per stage, (up to) one
+microbatch forward AND one microbatch backward each tick — the 1F1B steady
+state.  Stage ``s`` forwards microbatch ``m`` at tick ``m + s`` and runs its
+backward at tick ``m + 2S - 2 - s``; activations travel one stage per tick
+along a ``lax.ppermute`` ring, activation *gradients* travel the reverse
+ring.  Because the runtime is functional, PipeDream's mutable weight
+versions become explicit scan carries:
+
+- ``stash_W``: ring buffer of the last ``2S - 1`` weight versions — forward
+  of microbatch m records the version it used; backward of m replays the
+  stage vjp against exactly that version (weight stashing);
+- ``stash_h``: the stage's input activation per in-flight microbatch; the
+  backward *recomputes* the stage forward under ``jax.vjp`` (rematerialised
+  — the TPU-idiomatic memory/compute trade) instead of retaining per-op
+  residuals the way the reference's graph executor does;
+- gradients are applied to the stage-local weights immediately at each
+  backward tick (``update_gradient_local``), so stages intentionally run at
+  different weight "times" — the asynchronous-pipeline semantics;
+- HetPipe: pass ``dp_axis`` to ``lax.pmean`` each local gradient across
+  data-parallel replicas before applying.  The reference does this with its
+  partial-reduce server because GPU workers straggle; TPU SPMD replicas run
+  in lockstep, so the full-participation reduce is the faithful equivalent
+  (straggler-driven dynamic grouping only exists host-side — see
+  native/embed's preduce).
+
+``pipedream_grads`` runs the same 1F1B schedule *synchronously* (weights
+frozen, gradients accumulated): gradients identical to the GPipe pipeline
+(parallel/pipeline.py) but with 1F1B's O(S) — not O(M) — peak in-flight
+activation footprint, the reason Megatron-LM-style trainers default to it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipedream_grads", "pipedream_train_step"]
+
+
+def _tree_index(tree, i):
+    return jtu.tree_map(
+        lambda b: lax.dynamic_index_in_dim(b, i, 0, keepdims=False), tree)
+
+
+def _tree_stash(tree, val, i, pred):
+    """tree[i] = val where pred (pred is a traced scalar bool)."""
+
+    def upd(b, v):
+        cur = lax.dynamic_index_in_dim(b, i, 0, keepdims=False)
+        new = jnp.where(pred, v.astype(b.dtype), cur)
+        return lax.dynamic_update_index_in_dim(b, new, i, 0)
+
+    return jtu.tree_map(upd, tree, val)
+
+
+def _tree_where(pred, a, b):
+    return jtu.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _microbatch(x, M, name):
+    if x.shape[0] % M:
+        raise ValueError(
+            f"{name} batch {x.shape[0]} not divisible by {M} microbatches")
+    return x.reshape(M, x.shape[0] // M, *x.shape[1:])
+
+
+def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
+              *, mesh: Mesh, axis: str, n_microbatches: int,
+              dp_axis: Optional[str], mode: str):
+    S = mesh.shape[axis]
+    M = n_microbatches
+    K = max(2 * S - 1, 1)  # max in-flight microbatches at stage 0
+    manual = (axis,) if dp_axis is None else (axis, dp_axis)
+
+    xs = _microbatch(x, M, "x")
+    ys = _microbatch(y, M, "y")
+    exs = jtu.tree_map(lambda e: _microbatch(e, M, "extras"),
+                       () if extras is None else extras)
+    has_ex = extras is not None
+
+    data_spec = P() if dp_axis is None else P(None, dp_axis)
+    ex_specs = jtu.tree_map(lambda _: data_spec, exs)
+    if mode == "async":
+        # Classify optimizer-state subtrees: slots that mirror the params
+        # pytree (every leaf stage-stacked, leading dim S) are split over the
+        # pp axis like the params; everything else (step counters etc.) is
+        # replicated.  Matching the params treedef (not just leaf shapes)
+        # avoids mis-sharding a non-mirroring leaf whose leading dim happens
+        # to equal S.
+        p_def = jtu.tree_structure(stage_params)
+
+        def _mirrors_params(v):
+            if jtu.tree_structure(v) != p_def:
+                return False
+            return all(getattr(l, "ndim", 0) > 0 and l.shape[0] == S
+                       for l in jtu.tree_leaves(v))
+
+        if isinstance(opt_state, dict):
+            ost_specs = {}
+            for k, v in opt_state.items():
+                spec = P(axis) if _mirrors_params(v) else P()
+                ost_specs[k] = jtu.tree_map(lambda _, s=spec: s, v)
+        else:  # non-dict custom state: fall back to per-leaf shape inference
+            ost_specs = jtu.tree_map(
+                lambda l: P(axis) if (getattr(l, "ndim", 0) > 0
+                                      and l.shape[0] == S) else P(),
+                opt_state)
+
+    def inner(params, opt_state, xs, ys, exs):
+        W0 = jtu.tree_map(lambda p: p[0], params)  # [1, ...] -> [...]
+        if mode == "async":
+            ost0 = jtu.tree_map(
+                lambda l, sp: l[0] if sp == P(axis) else
+                lax.pcast(l, (axis,), to="varying"),
+                opt_state, ost_specs)
+        stage = lax.axis_index(axis)
+        is_last = stage == S - 1
+        fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+        bwd_ring = [(i, (i - 1) % S) for i in range(S)]
+
+        def V(t):
+            return lax.pcast(t, manual, to="varying")
+
+        h_shape, h_dtype = xs.shape[1:], xs.dtype
+        stash_h0 = V(jnp.zeros((K,) + h_shape, h_dtype))
+        fmsg0 = V(jnp.zeros(h_shape, h_dtype))
+        bmsg0 = V(jnp.zeros(h_shape, h_dtype))
+        loss0 = V(jnp.zeros((), jnp.float32))
+        # weight-shaped carries are dp-INVARIANT (the vjp psum-reduces dW
+        # over dp), so they vary over the pp axis only
+        def Vpp(t):
+            return lax.pcast(t, (axis,), to="varying")
+
+        if mode == "async":
+            stash_W0 = jtu.tree_map(
+                lambda p: Vpp(jnp.zeros((K,) + p.shape, p.dtype)), W0)
+            carry0 = (W0, ost0, stash_W0, stash_h0, fmsg0, bmsg0, loss0)
+        else:
+            gsum0 = jtu.tree_map(
+                lambda p: Vpp(jnp.zeros(p.shape, jnp.float32)), W0)
+            carry0 = (stash_h0, fmsg0, bmsg0, loss0, gsum0)
+
+        def tick(carry, t):
+            if mode == "async":
+                W, ost, stash_W, stash_h, fmsg, bmsg, loss_acc = carry
+            else:
+                stash_h, fmsg, bmsg, loss_acc, gsum = carry
+                W = W0
+
+            # ---- forward: microbatch m_f = t - stage (GPipe wavefront) ----
+            m_f = t - stage
+            vf = (m_f >= 0) & (m_f < M)
+            mf = jnp.clip(m_f, 0, M - 1)
+            slot_f = mf % K
+            x0 = lax.dynamic_index_in_dim(xs, mf, 0, keepdims=False)
+            h_in = jnp.where(stage == 0, x0, fmsg)
+            stash_h = _tree_stash(stash_h, h_in, slot_f, vf)
+            if mode == "async":
+                stash_W = _tree_stash(stash_W, W, slot_f, vf)
+            ex_f = _tree_index(exs, mf) if has_ex else None
+            y_out = stage_fn(W, h_in, ex_f)
+
+            # ---- backward: microbatch m_b = t - (2S - 2 - stage) ----
+            m_b = t - (2 * S - 2 - stage)
+            vb = (m_b >= 0) & (m_b < M)
+            mb = jnp.clip(m_b, 0, M - 1)
+            slot_b = mb % K
+            W_b = _tree_index(stash_W, slot_b) if mode == "async" else W
+            h_b = lax.dynamic_index_in_dim(stash_h, slot_b, 0, keepdims=False)
+            y_tgt = lax.dynamic_index_in_dim(ys, mb, 0, keepdims=False)
+            ex_b = _tree_index(exs, mb) if has_ex else None
+
+            # one vjp serves every stage: the loss output is seeded 1 only at
+            # the last stage, the activation output is seeded with the ring
+            # message only at non-last stages.
+            def f(Wm, hm):
+                out = stage_fn(Wm, hm, ex_b)
+                return out, loss_fn(out, y_tgt).astype(jnp.float32)
+
+            (out, loss), vjp_fn = jax.vjp(f, W_b, h_b)
+            # derive cotangents arithmetically from the outputs so they carry
+            # the outputs' exact varying-axes (vma) signature
+            g_out = jnp.where(is_last, out * 0, bmsg.astype(out.dtype))
+            g_loss = jnp.where(is_last, loss * 0 + 1, loss * 0)
+            dW, dh = vjp_fn((g_out, g_loss))
+            dW = jtu.tree_map(lambda g: g * vb.astype(g.dtype), dW)
+            dh = dh * vb.astype(dh.dtype)
+            loss_acc = loss_acc + jnp.where(is_last & vb, loss, 0.0)
+
+            # messages for tick t+1 (wrap-around entries are masked above)
+            fmsg = lax.ppermute(y_out, axis, fwd_ring)
+            bmsg = lax.ppermute(dh.astype(h_dtype), axis, bwd_ring)
+
+            if mode == "async":
+                if dp_axis is not None:
+                    # W is dp-invariant, so the vjp has already psum-reduced
+                    # dW over dp; rescale the sum to the HetPipe mean.
+                    dW = jtu.tree_map(
+                        lambda g: g / mesh.shape[dp_axis], dW)
+                newW, newost = opt.update(dW, ost, W)
+                W = _tree_where(vb, newW, W)
+                ost = _tree_where(vb, newost, ost)
+                return (W, ost, stash_W, stash_h, fmsg, bmsg, loss_acc), None
+            gsum = jtu.tree_map(lambda a, g: a + g, gsum, dW)
+            return (stash_h, fmsg, bmsg, loss_acc, gsum), None
+
+        T = M + 2 * S - 2 if S > 1 else M
+        carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+
+        if mode == "async":
+            W, ost, loss_acc = carry[0], carry[1], carry[-1]
+        else:
+            loss_acc, gsum = carry[3], carry[4]
+
+        loss_out = lax.psum(loss_acc, axis) / M  # nonzero only on last stage
+        if dp_axis is not None:
+            loss_out = lax.pmean(loss_out, dp_axis)
+
+        if mode == "async":
+            newW = jtu.tree_map(lambda w: w[None], W)
+            newost = jtu.tree_map(
+                lambda l, sp: l[None] if sp == P(axis) else lax.pmax(l, axis),
+                ost, ost_specs)
+            return loss_out, newW, newost
+        if dp_axis is not None:
+            # the vjp already psum-reduced dW over dp (W is dp-invariant);
+            # rescale the sum to the mean over replicas.
+            gsum = jtu.tree_map(lambda g: g / mesh.shape[dp_axis], gsum)
+        grads = jtu.tree_map(lambda g: g[None] / M, gsum)
+        return loss_out, grads
+
+    if mode == "sync":
+        def wrapped(params, xs, ys, exs):
+            return inner(params, None, xs, ys, exs)
+
+        return jax.shard_map(
+            wrapped, mesh=mesh,
+            in_specs=(P(axis), data_spec, data_spec, ex_specs),
+            out_specs=(P(), jtu.tree_map(lambda _: P(axis), stage_params)),
+            axis_names=frozenset(manual),
+        )(stage_params, xs, ys, exs)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(axis), ost_specs, data_spec, data_spec, ex_specs),
+        out_specs=(P(), jtu.tree_map(lambda _: P(axis), stage_params),
+                   ost_specs),
+        axis_names=frozenset(manual),
+    )(stage_params, opt_state, xs, ys, exs)
+
+
+def pipedream_grads(stage_fn, loss_fn, stage_params, x, y, extras=None, *,
+                    mesh: Mesh, axis: str = "pp", n_microbatches: int,
+                    dp_axis: Optional[str] = None):
+    """Synchronous 1F1B: gradients of the mean-over-microbatches loss.
+
+    ``stage_fn(stage_params_local, h, extras_mb) -> h'`` is the per-stage
+    computation (``stage_params`` leaves are ``[S, ...]``, split over
+    ``axis``); ``loss_fn(out, y_mb) -> scalar`` is evaluated on the LAST
+    stage's output (it runs shape-uniformly on every stage, but only the
+    last stage's cotangent is seeded).  Returns ``(loss, grads)`` with
+    ``grads`` shaped/sharded like ``stage_params``.  Numerically equal to
+    differentiating the GPipe pipeline; peak activation memory is O(S)
+    in-flight microbatches instead of O(M).
+    """
+    return _run_1f1b(stage_fn, loss_fn, stage_params, None, None, x, y,
+                     extras, mesh=mesh, axis=axis,
+                     n_microbatches=n_microbatches, dp_axis=dp_axis,
+                     mode="sync")
+
+
+def pipedream_train_step(stage_fn, loss_fn, opt, stage_params, opt_state, x,
+                         y, extras=None, *, mesh: Mesh, axis: str = "pp",
+                         n_microbatches: int, dp_axis: Optional[str] = None):
+    """Asynchronous PipeDream step: per-microbatch local updates with weight
+    stashing.
+
+    Each stage applies ``opt.update`` to its local weights immediately at
+    every microbatch backward (the reference's ``update_gradient_local``),
+    forwarding subsequent microbatches with the freshest local weights while
+    backwards replay against the stashed version that produced them.  With
+    ``dp_axis`` set, local gradients are ``pmean``-ed across the DP axis
+    before each apply (HetPipe).  Returns ``(mean_loss, new_params,
+    new_opt_state)``; scalar optimizer state (e.g. ``step``) advances by
+    ``n_microbatches`` per call — every microbatch is an optimizer step,
+    matching the reference's semantics.
+    """
+    return _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y,
+                     extras, mesh=mesh, axis=axis,
+                     n_microbatches=n_microbatches, dp_axis=dp_axis,
+                     mode="async")
