@@ -25,6 +25,11 @@ from hetu_tpu.parallel.pipedream import (
     pipedream_grads,
     pipedream_train_step,
 )
+from hetu_tpu.parallel.hetero import (
+    HeteroPipeline,
+    HeteroStage,
+    plan_hetero_dp,
+)
 from hetu_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attn_fn,
