@@ -9,7 +9,14 @@ from hetu_tpu.models.ctr import DCN, CTRConfig, DeepFM, WideDeep
 from hetu_tpu.models.gpt import GPT, GPTConfig, gpt2_large, gpt2_medium, gpt2_small
 from hetu_tpu.models.moe_lm import MoEBlock, MoELM, MoELMConfig
 from hetu_tpu.models.resnet import BasicBlock, ResNet, resnet18, resnet34
-from hetu_tpu.models.simple import MLP, LeNet, LogReg, vgg16
+from hetu_tpu.models.rnn import (
+    GRUCell,
+    LSTMCell,
+    RNN,
+    RNNCell,
+    RNNClassifier,
+)
+from hetu_tpu.models.simple import MLP, LeNet, LogReg, alexnet, vgg16
 from hetu_tpu.models.swin import Swin, SwinConfig, swin_base, swin_large, swin_tiny
 from hetu_tpu.models.t5 import (
     T5Config,

@@ -18,7 +18,7 @@ from hetu_tpu.layers import (
 from hetu_tpu.layers.base import Lambda
 from hetu_tpu.ops import relu
 
-__all__ = ["MLP", "LeNet", "VGGBlock", "vgg16", "LogReg"]
+__all__ = ["MLP", "LeNet", "VGGBlock", "vgg16", "LogReg", "alexnet"]
 
 
 class MLP(Module):
@@ -90,3 +90,22 @@ class LogReg(Module):
 
     def __call__(self, x):
         return self.fc(x)
+
+
+def alexnet(num_classes: int = 10, in_ch: int = 3) -> Sequential:
+    """AlexNet sized for 32x32 inputs (reference
+    examples/cnn/models/AlexNet.py uses the CIFAR-scale variant)."""
+    return Sequential(
+        Conv2d(in_ch, 64, 3, stride=1, padding=1), Lambda(relu),
+        MaxPool2d(2),
+        Conv2d(64, 192, 3, padding=1), Lambda(relu),
+        MaxPool2d(2),
+        Conv2d(192, 384, 3, padding=1), Lambda(relu),
+        Conv2d(384, 256, 3, padding=1), Lambda(relu),
+        Conv2d(256, 256, 3, padding=1), Lambda(relu),
+        MaxPool2d(2),
+        Flatten(),
+        Linear(256 * 4 * 4, 1024), Lambda(relu),
+        Linear(1024, 512), Lambda(relu),
+        Linear(512, num_classes),
+    )
