@@ -8,6 +8,9 @@ HET cache (hybrid mode: on-chip dense + host sparse).
 """
 
 import argparse
+import sys
+
+sys.path.insert(0, ".")
 
 import jax.numpy as jnp
 import numpy as np

@@ -101,6 +101,13 @@ class HeteroStage:
     def take(self, h):
         """Reshard an activation produced by another stage onto this stage's
         submesh — the round-robin cross-group transfer of the reference."""
+        if (self.act_sharding.spec and self.act_sharding.spec[0] == "dp"
+                and getattr(h, "ndim", 0) > 0 and h.shape[0] % self.dp):
+            raise ValueError(
+                f"(micro)batch dim {h.shape[0]} not divisible by this "
+                f"stage's dp={self.dp}; pick dp degrees that divide the "
+                f"microbatch size (plan_hetero_dp output may need rounding "
+                f"to divisors)")
         return jax.device_put(h, self.act_sharding)
 
     def forward(self, h, extras=None):
