@@ -76,7 +76,8 @@ def main():
     params = params0
     sync_losses = []
     grads_fn = jax.jit(lambda p: pipedream_grads(
-        stage_fn, loss_fn, p, x, y, mesh=mesh, n_microbatches=M))
+        stage_fn, loss_fn, p, x, y, mesh=mesh, n_microbatches=M,
+        dp_axis="dp" if dp > 1 else None))
     state = opt.init(params)
     upd = jax.jit(opt.update)
     for _ in range(args.steps):
