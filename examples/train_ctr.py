@@ -51,6 +51,10 @@ def main():
     for step in range(args.steps):
         lo = (step * args.batch) % (len(data["label"]) - args.batch)
         b = {k: jnp.asarray(v[lo:lo + args.batch]) for k, v in data.items()}
+        # staged host bridge (auto on backends without host callbacks):
+        # pull this batch's rows before the step; push happens inside step
+        for m_ in trainer.staged_modules():
+            m_.stage(b["sparse"])
         m = trainer.step(b)
         if step % 20 == 0 or step == args.steps - 1:
             auc = auc_roc(np.asarray(m["pred"]), np.asarray(b["label"]))

@@ -17,10 +17,10 @@ from hetu_tpu.embed.engine import (
     SSPBarrier,
 )
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
-from hetu_tpu.embed.layer import HostEmbedding
+from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
-    "HostEmbedding",
+    "HostEmbedding", "StagedHostEmbedding",
 ]

@@ -25,9 +25,35 @@ from jax.experimental import io_callback
 
 from hetu_tpu.embed.engine import AsyncEngine, CacheTable, HostEmbeddingTable
 
-__all__ = ["make_host_lookup", "Prefetcher"]
+__all__ = ["make_host_lookup", "Prefetcher", "host_callbacks_supported"]
 
 Store = Union[HostEmbeddingTable, CacheTable]
+
+
+_CALLBACK_PROBE: dict = {}
+
+
+def host_callbacks_supported() -> bool:
+    """Whether the default backend supports host send/recv callbacks
+    (jax io_callback / pure_callback).  Feature-probed by compiling and
+    running a trivial callback once (cached per process): tunneled PJRT
+    plugins (e.g. the axon TPU proxy) reject host callbacks with
+    UNIMPLEMENTED.  Used to pick the host-embedding bridge (io_callback vs
+    staged) automatically."""
+    key = jax.default_backend()
+    if key not in _CALLBACK_PROBE:
+        try:
+            # probe with pure_callback: backends lacking host-callback
+            # support reject it fast with UNIMPLEMENTED, whereas an
+            # unsupported ORDERED io_callback can hang instead of erroring
+            # (observed on the axon proxy) — same capability either way.
+            out = jax.jit(lambda x: jax.pure_callback(
+                lambda a: np.asarray(a), jax.ShapeDtypeStruct((), jnp.int32),
+                x))(jnp.int32(7))
+            _CALLBACK_PROBE[key] = int(out) == 7
+        except Exception:
+            _CALLBACK_PROBE[key] = False
+    return _CALLBACK_PROBE[key]
 
 
 def _sync_fn(store: Store):

@@ -4,28 +4,37 @@ Reference semantics (executor.py:276-283 + optimizer.py:170-178): dense
 params train on-chip with allreduce DP; embedding tables route through the
 PS — always PS in hybrid mode, with the HET cache when a policy is set.
 Here the dense model is ordinary on-chip pytree params and this layer holds
-a host-side table (optionally cached) reached through the io_callback
-bridge, so one jitted train step does on-chip compute + host sparse update.
+a host-side table (optionally cached), reached one of two ways:
+
+- ``HostEmbedding``: io_callback bridge — the lookup/push happen INSIDE the
+  jitted step (hetu_tpu/embed/bridge.py).  Needs a backend with host
+  send/recv callback support (CPU, direct TPU).
+- ``StagedHostEmbedding``: pull-outside/push-outside — ``stage(ids)`` pulls
+  the batch's rows on the host and installs them as a pytree leaf, the
+  jitted step consumes the leaf and returns its gradient, and the caller
+  (exec.Trainer does it automatically) pushes the gradient back to the host
+  engine.  Works on ANY backend (the tunneled axon TPU in this container
+  rejects host callbacks), and is closest to the reference's actual
+  sequencing: SparsePull before compute, SparsePush after
+  (EmbeddingLookUp.py:34-40, ParameterServerCommunicate.py).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from hetu_tpu.core.module import Module
-from hetu_tpu.embed.bridge import make_host_lookup
+from hetu_tpu.embed.bridge import _sync_fn, make_host_lookup
 from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
 
-__all__ = ["HostEmbedding"]
+__all__ = ["HostEmbedding", "StagedHostEmbedding"]
 
 
-class HostEmbedding(Module):
-    """Embedding whose rows live in host memory (HET capability).
-
-    No on-chip parameters: lookups and gradient pushes go through the host
-    engine, whose server-side optimizer owns the update rule.  ``cache``
-    enables the worker-side cache with staleness bounds.
-    """
+class _HostEmbeddingBase(Module):
+    """Shared host-engine plumbing: table/cache construction, flush,
+    save/load.  Subclasses differ only in how lookups/pushes cross the
+    host<->device boundary."""
 
     def __init__(self, num_embeddings: int, dim: int, *,
                  optimizer: str = "sgd", lr: float = 0.01,
@@ -45,13 +54,6 @@ class HostEmbedding(Module):
                                     push_bound=push_bound)
         else:
             self.store = self.table
-        self._lookup = make_host_lookup(self.store, dim)
-        # Differentiable anchor keeping the lookup's backward (the host grad
-        # push) alive in every grad trace; receives zero gradient itself.
-        self.anchor = jnp.zeros((), jnp.float32)
-
-    def __call__(self, ids):
-        return self._lookup(ids, self.anchor).astype(self.dtype)
 
     def flush(self):
         if isinstance(self.store, CacheTable):
@@ -63,3 +65,92 @@ class HostEmbedding(Module):
 
     def load(self, path: str):
         self.table.load(path)
+
+
+class HostEmbedding(_HostEmbeddingBase):
+    """Embedding whose rows live in host memory (HET capability).
+
+    No on-chip parameters: lookups and gradient pushes go through the host
+    engine, whose server-side optimizer owns the update rule.  ``cache``
+    enables the worker-side cache with staleness bounds.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, **kw):
+        super().__init__(num_embeddings, dim, **kw)
+        self._lookup = make_host_lookup(self.store, dim)
+        # Differentiable anchor keeping the lookup's backward (the host grad
+        # push) alive in every grad trace; receives zero gradient itself.
+        self.anchor = jnp.zeros((), jnp.float32)
+
+    def __call__(self, ids):
+        return self._lookup(ids, self.anchor).astype(self.dtype)
+
+
+class _HostHandle:
+    """Mutable host-side bookkeeping shared across pytree unflattens.
+
+    Not an array and not a Module, so it lands in the static-aux partition
+    of the pytree (compared by identity — the object never changes, only its
+    contents, which are read exclusively OUTSIDE jit)."""
+
+    __slots__ = ("ids",)
+
+    def __init__(self):
+        self.ids = None
+
+
+class StagedHostEmbedding(_HostEmbeddingBase):
+    """Host-engine embedding with the pull/push staged OUTSIDE the jitted
+    step — no host-callback support required from the backend.
+
+    Per step: call ``stage(ids)`` (host pull → ``self.rows`` leaf), run the
+    jitted step (it reads ``rows`` and produces its gradient), then
+    ``push_grads(grad_rows)`` (host push; ``exec.Trainer`` detects staged
+    embeddings and does this automatically).  ``__call__`` ignores its
+    ``ids`` argument inside jit — the staged rows ARE that batch's rows;
+    callers must stage the same ids they feed the model.
+
+    Not compatible with sharding strategies that repartition the model
+    (each worker owns its own host store, as in the reference's PS workers).
+    """
+
+    is_staged_host_embedding = True
+    _state_fields = ("rows",)  # excluded from optimizer updates
+
+    def __init__(self, num_embeddings: int, dim: int, **kw):
+        super().__init__(num_embeddings, dim, **kw)
+        self._handle = _HostHandle()
+        self.rows = jnp.zeros((1, dim), jnp.float32)  # placeholder leaf
+
+    def stage(self, ids):
+        """Host-side pull of this batch's rows into the ``rows`` leaf.
+        Mutates the module in place; call OUTSIDE jit, before the step."""
+        ids = np.asarray(ids, np.int64)
+        rows = _sync_fn(self.store)(ids.ravel()).reshape(
+            ids.shape + (self.dim,))
+        self.rows = jnp.asarray(rows, jnp.float32)
+        self._handle.ids = ids
+
+    def __call__(self, ids):
+        # trace-time consistency check: the staged rows must cover exactly
+        # this ids batch (catches step/eval without a fresh stage())
+        if tuple(ids.shape) != tuple(self.rows.shape[:-1]):
+            raise ValueError(
+                f"staged rows {self.rows.shape[:-1]} do not match ids batch "
+                f"{tuple(ids.shape)}: call stage(ids) with this batch's ids "
+                f"before the jitted step")
+        return self.rows.astype(self.dtype)
+
+    def push_grads(self, grad_rows):
+        """Host-side push of the staged batch's row gradients; the engine's
+        server-side optimizer applies them.  Consumes the staged ids: a
+        second push (or a step run without a fresh ``stage``) raises instead
+        of silently corrupting the table with stale ids."""
+        ids = self._handle.ids
+        if ids is None:
+            raise RuntimeError(
+                "push_grads without a fresh stage(): call stage(ids) before "
+                "every training step")
+        self._handle.ids = None
+        self.store.push(ids.ravel(),
+                        np.asarray(grad_rows, np.float32).reshape(-1, self.dim))

@@ -26,6 +26,30 @@ from hetu_tpu.optim.optimizers import Optimizer
 __all__ = ["TrainState", "Trainer", "Executor"]
 
 
+def _find_staged(tree) -> list:
+    """Deterministic walk collecting StagedHostEmbedding modules (duck-typed
+    via the ``is_staged_host_embedding`` class marker, avoiding an import of
+    hetu_tpu.embed)."""
+    out = []
+
+    def rec(node):
+        if isinstance(node, Module):
+            if getattr(node, "is_staged_host_embedding", False):
+                out.append(node)
+            for k in sorted(node.__dict__):
+                if k != "_dyn_keys":
+                    rec(node.__dict__[k])
+        elif isinstance(node, (list, tuple)):
+            for c in node:
+                rec(c)
+        elif isinstance(node, dict):
+            for k in sorted(node):
+                rec(node[k])
+
+    rec(tree)
+    return out
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TrainState:
@@ -55,6 +79,15 @@ class Trainer:
         # Non-trainable state (BatchNorm statistics) must not see weight decay
         # or moment updates; the mask is static model structure, closed over.
         param_mask = trainable_mask(model)
+        # Staged host embeddings (embed.StagedHostEmbedding): the step must
+        # hand their rows-gradients back to the host engine (SparsePush).
+        self._has_staged = bool(_find_staged(model))
+        if self._has_staged and strategy is not None:
+            raise ValueError(
+                "StagedHostEmbedding is incompatible with sharding "
+                "strategies that repartition the model (each worker owns "
+                "its own host store, like the reference's PS workers); "
+                "drop the strategy or use the io_callback HostEmbedding")
 
         def train_step(state: TrainState, batch, key):
             def wrapped(model):
@@ -70,6 +103,9 @@ class Trainer:
                 grads, state.opt_state, base, mask=param_mask
             )
             metrics = {"loss": loss, **aux}
+            if self._has_staged:
+                metrics["_staged_rows_grads"] = [
+                    m.rows for m in _find_staged(grads)]
             return TrainState(params, opt_state), metrics
 
         def eval_step(state: TrainState, batch):
@@ -100,13 +136,28 @@ class Trainer:
     def model(self):
         return self._state.model
 
+    def staged_modules(self) -> list:
+        """StagedHostEmbedding modules of the CURRENT model (re-walk every
+        step: optimizer updates replace the module objects).  Call
+        ``m.stage(ids)`` on each before ``step``; the gradient push back to
+        the host engine happens automatically inside ``step``."""
+        return _find_staged(self._state.model)
+
     def step(self, batch, key=None) -> dict:
         if key is None:
             key = next_key()
         self._state, metrics = self._train_step(self._state, batch, key)
+        if self._has_staged:
+            gs = metrics.pop("_staged_rows_grads")
+            for m, g in zip(_find_staged(self._state.model), gs):
+                m.push_grads(g)
         return metrics
 
     def evaluate(self, batch) -> dict:
+        """Eval step.  With staged host embeddings (StagedHostEmbedding) the
+        caller must ``stage`` the EVAL batch's ids on each module from
+        ``staged_modules()`` first — the jitted program reads the staged
+        rows leaf, not the batch ids."""
         return self._eval_step(self._state, batch)
 
     def profile(self, batch, key=None, iters: int = 10) -> dict:

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module
 from hetu_tpu.core.rng import next_key
-from hetu_tpu.embed import HostEmbedding
+from hetu_tpu.embed import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.init import normal
 from hetu_tpu.layers import Embedding, Linear
 from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
@@ -36,7 +36,8 @@ class CTRConfig:
                  mlp_hidden: int = 256, embedding: str = "device",
                  host_optimizer: str = "sgd", host_lr: float = 0.01,
                  cache_capacity: int = 0, cache_policy: str = "lru",
-                 pull_bound: int = 0, push_bound: int = 0):
+                 pull_bound: int = 0, push_bound: int = 0,
+                 host_bridge: str = "auto"):
         self.dense_dim = dense_dim
         self.sparse_fields = sparse_fields
         self.vocab = vocab
@@ -49,12 +50,21 @@ class CTRConfig:
         self.cache_policy = cache_policy
         self.pull_bound = pull_bound
         self.push_bound = push_bound
+        # "callback" = io_callback bridge inside jit; "staged" = pull/push
+        # outside jit (works on backends without host callbacks, e.g. the
+        # tunneled axon TPU); "auto" picks per backend.
+        self.host_bridge = host_bridge
 
 
 def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
     dim = dim if dim is not None else cfg.embed_dim
     if cfg.embedding == "host":
-        return HostEmbedding(
+        bridge = cfg.host_bridge
+        if bridge == "auto":
+            from hetu_tpu.embed.bridge import host_callbacks_supported
+            bridge = "callback" if host_callbacks_supported() else "staged"
+        cls = StagedHostEmbedding if bridge == "staged" else HostEmbedding
+        return cls(
             cfg.vocab, dim, optimizer=cfg.host_optimizer, lr=cfg.host_lr,
             seed=seed, cache_capacity=cfg.cache_capacity,
             policy=cfg.cache_policy, pull_bound=cfg.pull_bound,
