@@ -25,7 +25,8 @@ from jax.experimental import io_callback
 
 from hetu_tpu.embed.engine import AsyncEngine, CacheTable, HostEmbeddingTable
 
-__all__ = ["make_host_lookup", "Prefetcher", "host_callbacks_supported"]
+__all__ = ["make_host_lookup", "Prefetcher", "host_callbacks_supported",
+           "sync_fn"]
 
 Store = Union[HostEmbeddingTable, CacheTable]
 
@@ -56,8 +57,13 @@ def host_callbacks_supported() -> bool:
     return _CALLBACK_PROBE[key]
 
 
-def _sync_fn(store: Store):
+def sync_fn(store: Store):
+    """The store's row-pull entry point: cache-aware ``sync`` for
+    CacheTable, plain ``pull`` otherwise."""
     return store.sync if isinstance(store, CacheTable) else store.pull
+
+
+_sync_fn = sync_fn  # internal alias
 
 
 def make_host_lookup(store: Store, dim: int):

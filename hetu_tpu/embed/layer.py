@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hetu_tpu.core.module import Module
-from hetu_tpu.embed.bridge import _sync_fn, make_host_lookup
+from hetu_tpu.embed.bridge import make_host_lookup, sync_fn
 from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
 
 __all__ = ["HostEmbedding", "StagedHostEmbedding"]
@@ -126,7 +126,7 @@ class StagedHostEmbedding(_HostEmbeddingBase):
         """Host-side pull of this batch's rows into the ``rows`` leaf.
         Mutates the module in place; call OUTSIDE jit, before the step."""
         ids = np.asarray(ids, np.int64)
-        rows = _sync_fn(self.store)(ids.ravel()).reshape(
+        rows = sync_fn(self.store)(ids.ravel()).reshape(
             ids.shape + (self.dim,))
         self.rows = jnp.asarray(rows, jnp.float32)
         self._handle.ids = ids

@@ -27,27 +27,15 @@ __all__ = ["TrainState", "Trainer", "Executor"]
 
 
 def _find_staged(tree) -> list:
-    """Deterministic walk collecting StagedHostEmbedding modules (duck-typed
-    via the ``is_staged_host_embedding`` class marker, avoiding an import of
-    hetu_tpu.embed)."""
-    out = []
+    """Collect StagedHostEmbedding modules (duck-typed via the
+    ``is_staged_host_embedding`` class marker, avoiding an import of
+    hetu_tpu.embed).  Uses jax's own flatten order so the list pairs up with
+    the same walk over the traced grads tree."""
+    def is_staged(x):
+        return getattr(x, "is_staged_host_embedding", False)
 
-    def rec(node):
-        if isinstance(node, Module):
-            if getattr(node, "is_staged_host_embedding", False):
-                out.append(node)
-            for k in sorted(node.__dict__):
-                if k != "_dyn_keys":
-                    rec(node.__dict__[k])
-        elif isinstance(node, (list, tuple)):
-            for c in node:
-                rec(c)
-        elif isinstance(node, dict):
-            for k in sorted(node):
-                rec(node[k])
-
-    rec(tree)
-    return out
+    return [x for x in jax.tree_util.tree_leaves(tree, is_leaf=is_staged)
+            if is_staged(x)]
 
 
 @jax.tree_util.register_dataclass
@@ -146,6 +134,16 @@ class Trainer:
     def step(self, batch, key=None) -> dict:
         if key is None:
             key = next_key()
+        if self._has_staged:
+            # validate freshness BEFORE the jitted step runs: a step on
+            # stale rows would advance the dense params on wrong gradients
+            # before push_grads could catch the mistake
+            for m in _find_staged(self._state.model):
+                if m._handle.ids is None:
+                    raise RuntimeError(
+                        "staged host embedding has no fresh rows: call "
+                        "stage(ids) on every module from staged_modules() "
+                        "before each training step")
         self._state, metrics = self._train_step(self._state, batch, key)
         if self._has_staged:
             gs = metrics.pop("_staged_rows_grads")

@@ -54,7 +54,7 @@ def _compiler_params(n_parallel: int):
 # --------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *,
-                scale, causal, block_q, block_k, kv_len):
+                scale, causal, block_q, block_k, kv_len, padded):
     i, j = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -67,23 +67,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *,
     # causal: kv block strictly above the diagonal band contributes nothing
     live = (j * block_k <= i * block_q + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _():
-        q = q_ref[0, 0, :, :]
-        k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < kv_len
-        if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, col <= row)
-        s = jnp.where(mask, s, _NEG_INF)
-
+    def accumulate(s):
         m_prev = m_sc[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -91,8 +75,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *,
         l_sc[:, :1] = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         m_sc[:, :1] = m_new
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0, 0, :, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    def scores():
+        return jax.lax.dot_general(
+            q_ref[0, 0, :, :], k_ref[0, 0, :, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    # mask work (two iotas + where over (block_q, block_k)) is on the hot
+    # path; only diagonal-crossing causal blocks and the final padded kv
+    # block need it — interior blocks take the maskless fast path.
+    # block contains a masked (col > row) element iff its max col exceeds
+    # its MIN row
+    crosses = (jnp.logical_and(live, j * block_k + block_k - 1
+                               > i * block_q)
+               if causal else False)
+    needs_pad = (j == nk - 1) if padded else False
+    masked = jnp.logical_or(crosses, needs_pad)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(masked)))
+    def _():
+        accumulate(scores())
+
+    @pl.when(jnp.logical_and(live, masked))
+    def _():
+        s = scores()
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        accumulate(jnp.where(mask, s, _NEG_INF))
 
     @pl.when(j == nk - 1)
     def _():
@@ -108,7 +124,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
     grid = (B, H, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=kv_len)
+        block_k=block_k, kv_len=kv_len, padded=(Sk != kv_len))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
