@@ -63,8 +63,6 @@ def sync_fn(store: Store):
     return store.sync if isinstance(store, CacheTable) else store.pull
 
 
-_sync_fn = sync_fn  # internal alias
-
 
 def make_host_lookup(store: Store, dim: int):
     """Returns ``lookup(ids, anchor) -> rows`` usable inside jit/grad.
@@ -79,7 +77,7 @@ def make_host_lookup(store: Store, dim: int):
     any differentiable input, and gradients would silently never reach the
     host table.
     """
-    pull = _sync_fn(store)
+    pull = sync_fn(store)
 
     def _raw_lookup(ids):
         shape = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
@@ -139,4 +137,4 @@ class Prefetcher:
             self._pending = None
             self.engine.wait(ticket)
             return out
-        return _sync_fn(self.store)(ids)
+        return sync_fn(self.store)(ids)

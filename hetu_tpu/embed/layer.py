@@ -141,6 +141,11 @@ class StagedHostEmbedding(_HostEmbeddingBase):
                 f"before the jitted step")
         return self.rows.astype(self.dtype)
 
+    def is_fresh(self) -> bool:
+        """True if stage() has been called since the last push_grads —
+        i.e. the rows leaf holds the current batch."""
+        return self._handle.ids is not None
+
     def push_grads(self, grad_rows):
         """Host-side push of the staged batch's row gradients; the engine's
         server-side optimizer applies them.  Consumes the staged ids: a

@@ -139,7 +139,7 @@ class Trainer:
             # stale rows would advance the dense params on wrong gradients
             # before push_grads could catch the mistake
             for m in _find_staged(self._state.model):
-                if m._handle.ids is None:
+                if not m.is_fresh():
                     raise RuntimeError(
                         "staged host embedding has no fresh rows: call "
                         "stage(ids) on every module from staged_modules() "
