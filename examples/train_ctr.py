@@ -52,10 +52,16 @@ def main():
         lo = (step * args.batch) % (len(data["label"]) - args.batch)
         b = {k: jnp.asarray(v[lo:lo + args.batch]) for k, v in data.items()}
         # staged host bridge (auto on backends without host callbacks):
-        # pull this batch's rows before the step; push happens inside step
+        # pull this batch's rows before the step (served from the prefetch
+        # buffer when warm); push happens inside step
         for m_ in trainer.staged_modules():
             m_.stage(b["sparse"])
         m = trainer.step(b)
+        if step + 1 < args.steps:
+            nxt = (step + 1) * args.batch % (len(data["label"]) - args.batch)
+            nxt_ids = data["sparse"][nxt:nxt + args.batch]
+            for m_ in trainer.staged_modules():
+                m_.prefetch(nxt_ids)
         if step % 20 == 0 or step == args.steps - 1:
             auc = auc_roc(np.asarray(m["pred"]), np.asarray(b["label"]))
             line = f"step {step:4d} loss {float(m['loss']):.4f} auc {auc:.4f}"

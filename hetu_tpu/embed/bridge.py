@@ -125,7 +125,16 @@ class Prefetcher:
         self.engine = engine or AsyncEngine(2)
         self._pending = None  # (ticket, ids_key, out)
 
+    def _drain(self):
+        """Retire the pending pull (wait + drop) — an abandoned ticket would
+        keep its buffers pinned in the engine's live set."""
+        if self._pending is not None:
+            ticket, _, _ = self._pending
+            self._pending = None
+            self.engine.wait(ticket)
+
     def prefetch(self, ids):
+        self._drain()
         ids = np.asarray(ids, np.int64).ravel()
         ticket, out = self.engine.sync_async(self.store, ids)
         self._pending = (ticket, ids.tobytes(), out)
@@ -137,4 +146,8 @@ class Prefetcher:
             self._pending = None
             self.engine.wait(ticket)
             return out
+        # mismatch: retire the stale pull NOW — matching it against a
+        # same-ids stage() many pushes later would serve rows of unbounded
+        # staleness
+        self._drain()
         return sync_fn(self.store)(ids)

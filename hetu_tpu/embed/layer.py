@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hetu_tpu.core.module import Module
-from hetu_tpu.embed.bridge import make_host_lookup, sync_fn
+from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup, sync_fn
 from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
 
 __all__ = ["HostEmbedding", "StagedHostEmbedding"]
@@ -93,10 +93,11 @@ class _HostHandle:
     of the pytree (compared by identity — the object never changes, only its
     contents, which are read exclusively OUTSIDE jit)."""
 
-    __slots__ = ("ids",)
+    __slots__ = ("ids", "prefetcher")
 
     def __init__(self):
         self.ids = None
+        self.prefetcher = None
 
 
 class StagedHostEmbedding(_HostEmbeddingBase):
@@ -122,13 +123,33 @@ class StagedHostEmbedding(_HostEmbeddingBase):
         self._handle = _HostHandle()
         self.rows = jnp.zeros((1, dim), jnp.float32)  # placeholder leaf
 
+    def prefetch(self, ids):
+        """Start an async pull of the NEXT batch's rows on the engine's
+        thread pool, overlapping with the current step (the reference's
+        ParameterServerSparsePullOp overlap, executor.py:770-775).  A
+        prefetch issued before the current step's gradient push may serve
+        rows that miss that push for overlapping ids — the reference's
+        bounded-staleness prefetch semantics; prefetch after ``step`` for
+        strict freshness.  No-op for uncached stores (the C engine's async
+        pull is cache-based).  The Prefetcher lives on the identity-stable
+        host handle, so lazy creation does not perturb the module pytree."""
+        if not isinstance(self.store, CacheTable):
+            return
+        if self._handle.prefetcher is None:
+            self._handle.prefetcher = Prefetcher(self.store)
+        self._handle.prefetcher.prefetch(np.asarray(ids, np.int64))
+
     def stage(self, ids):
-        """Host-side pull of this batch's rows into the ``rows`` leaf.
-        Mutates the module in place; call OUTSIDE jit, before the step."""
+        """Host-side pull of this batch's rows into the ``rows`` leaf
+        (serving from the prefetch buffer when the ids match).  Mutates the
+        module in place; call OUTSIDE jit, before the step."""
         ids = np.asarray(ids, np.int64)
-        rows = sync_fn(self.store)(ids.ravel()).reshape(
-            ids.shape + (self.dim,))
-        self.rows = jnp.asarray(rows, jnp.float32)
+        if self._handle.prefetcher is not None:
+            rows = self._handle.prefetcher.get(ids.ravel())
+        else:
+            rows = sync_fn(self.store)(ids.ravel())
+        self.rows = jnp.asarray(
+            np.asarray(rows).reshape(ids.shape + (self.dim,)), jnp.float32)
         self._handle.ids = ids
 
     def __call__(self, ids):

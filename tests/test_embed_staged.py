@@ -106,3 +106,33 @@ def test_push_before_stage_raises():
     emb = StagedHostEmbedding(10, 4)
     with pytest.raises(RuntimeError):
         emb.push_grads(np.zeros((2, 4), np.float32))
+
+
+def test_staged_prefetch_overlap():
+    """Prefetched stage == synchronous stage (cache path)."""
+    rng = np.random.default_rng(7)
+    batches = make_batches(5, 64, rng)
+
+    def run(prefetch):
+        set_random_seed(0)
+        cfg = CTRConfig(vocab=500, embed_dim=8, embedding="host",
+                        host_optimizer="sgd", host_lr=0.05,
+                        cache_capacity=500, host_bridge="staged")
+        model = WideDeep(cfg)
+        trainer = Trainer(
+            model, AdamOptimizer(1e-3),
+            lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))
+        losses = []
+        for i, b in enumerate(batches):
+            for m_ in trainer.staged_modules():
+                m_.stage(b["sparse"])
+            losses.append(float(trainer.step(b)["loss"]))
+            # prefetch AFTER the step's push so the comparison with the
+            # synchronous path is deterministic (prefetching before the
+            # push is allowed — bounded staleness — but racy to test)
+            if prefetch and i + 1 < len(batches):
+                for m_ in trainer.staged_modules():
+                    m_.prefetch(batches[i + 1]["sparse"])
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
