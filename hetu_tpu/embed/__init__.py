@@ -18,9 +18,10 @@ from hetu_tpu.embed.engine import (
 )
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
 from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
+from hetu_tpu.embed.sharded import ShardedHostEmbedding
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
-    "HostEmbedding", "StagedHostEmbedding",
+    "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
 ]

@@ -133,6 +133,15 @@ class Prefetcher:
             self._pending = None
             self.engine.wait(ticket)
 
+    def __del__(self):
+        # drain before teardown: Python gives no destruction order between
+        # this object's engine and the CacheTable it pulls through, so an
+        # in-flight async pull must not outlive either
+        try:
+            self._drain()
+        except Exception:
+            pass
+
     def prefetch(self, ids):
         self._drain()
         ids = np.asarray(ids, np.int64).ravel()

@@ -1,0 +1,155 @@
+"""ShardedHostEmbedding — key-partitioned host table shards (the PS-server
+sharding of the reference).
+
+The reference partitions huge embedding tables across parameter-server
+processes by key range (ps-lite partitioner, include/ps/worker/partitioner.h;
+trillion-parameter deployments per README.md:19).  TPU-native equivalent:
+the table is mod-partitioned over N host shards — each shard is a full
+engine store (its own C++ table, optional HET cache, server-side optimizer,
+versions) — and a routing adapter presents the shard set through the same
+Store interface the staged bridge already speaks, so the whole staging
+protocol (stage/push/freshness/Trainer integration) is inherited from
+``StagedHostEmbedding`` unchanged.  In multi-host training each worker
+process owns shard ``jax.process_index()`` and the same routing runs over
+``lax.all_to_all`` on the ICI mesh instead of a host loop; the in-process
+form below is the single-host (and unit-testable) degenerate case with
+identical semantics.
+
+Mod partitioning (``shard = id % N``) spreads hot keys across shards — the
+reference's range partitioner needs its load-balancer (`getLoads`) for the
+same effect.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.embed.bridge import sync_fn
+from hetu_tpu.embed.engine import AsyncEngine, CacheTable, HostEmbeddingTable
+from hetu_tpu.embed.layer import StagedHostEmbedding, _HostHandle
+
+__all__ = ["ShardedHostEmbedding"]
+
+
+class _ShardRouter:
+    """Store-interface adapter (pull/push) over N key-partitioned shards.
+
+    Cached shards are pulled concurrently on the engine thread pool — the
+    parallelism the sharding exists for; uncached shards are host memcpys
+    and stay sequential.
+    """
+
+    def __init__(self, stores, n_shards: int, dim: int):
+        self.stores = stores
+        self.n_shards = n_shards
+        self.dim = dim
+        self._cached = all(isinstance(s, CacheTable) for s in stores)
+        self._engine = (AsyncEngine(min(n_shards, 4))
+                        if self._cached and n_shards > 1 else None)
+
+    def route(self, flat_ids: np.ndarray):
+        return flat_ids % self.n_shards, flat_ids // self.n_shards
+
+    def pull(self, flat_ids: np.ndarray) -> np.ndarray:
+        flat_ids = np.asarray(flat_ids, np.int64)
+        shard, local = self.route(flat_ids)
+        rows = np.empty((flat_ids.size, self.dim), np.float32)
+        if self._engine is not None:
+            pending = []
+            for s in range(self.n_shards):
+                m = shard == s
+                if m.any():
+                    t, out = self._engine.sync_async(self.stores[s], local[m])
+                    pending.append((t, m, out))
+            for t, m, out in pending:
+                self._engine.wait(t)
+                rows[m] = out
+        else:
+            for s in range(self.n_shards):
+                m = shard == s
+                if m.any():
+                    rows[m] = sync_fn(self.stores[s])(local[m])
+        return rows
+
+    def push(self, flat_ids: np.ndarray, grads: np.ndarray):
+        flat_ids = np.asarray(flat_ids, np.int64)
+        shard, local = self.route(flat_ids)
+        grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
+        for s in range(self.n_shards):
+            m = shard == s
+            if m.any():
+                self.stores[s].push(local[m], grads[m])
+
+
+class ShardedHostEmbedding(StagedHostEmbedding):
+    """Staged host embedding over N key-partitioned shard stores.
+
+    Drop-in for ``StagedHostEmbedding`` — the staging protocol (stage /
+    __call__ / is_fresh / push_grads, Trainer auto-push) is inherited; only
+    construction, persistence, and the store routing differ.  ``prefetch``
+    is inherited as a no-op (the router is not a CacheTable); shard pulls
+    already overlap on the engine pool inside ``stage``.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *, n_shards: int = 2,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01, cache_capacity: int = 0,
+                 policy: str = "lru", pull_bound: int = 0,
+                 push_bound: int = 0, dtype=jnp.float32):
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        # deliberately NOT calling super().__init__: the single table/store
+        # pair of the base is replaced by the shard set + router
+        self.num_embeddings = num_embeddings
+        self.dim = dim
+        self.dtype = dtype
+        self.n_shards = n_shards
+        rows_per = -(-num_embeddings // n_shards)  # ceil
+        self.tables = [
+            HostEmbeddingTable(rows_per, dim, optimizer=optimizer, lr=lr,
+                               weight_decay=weight_decay, seed=seed + s,
+                               init_scale=init_scale)
+            for s in range(n_shards)
+        ]
+        if cache_capacity > 0:
+            per = -(-cache_capacity // n_shards)
+            self.stores = [
+                CacheTable(t, per, policy=policy, pull_bound=pull_bound,
+                           push_bound=push_bound) for t in self.tables]
+        else:
+            self.stores = list(self.tables)
+        self.store = _ShardRouter(self.stores, n_shards, dim)
+        self._handle = _HostHandle()
+        self.rows = jnp.zeros((1, dim), jnp.float32)  # placeholder leaf
+
+    # -- persistence ---------------------------------------------------------
+    def flush(self):
+        for st in self.stores:
+            if isinstance(st, CacheTable):
+                st.flush()
+
+    def save(self, path: str):
+        self.flush()
+        for s, t in enumerate(self.tables):
+            t.save(f"{path}.shard{s}")
+
+    def load(self, path: str):
+        for s, t in enumerate(self.tables):
+            t.load(f"{path}.shard{s}")
+
+    def pull_rows(self, ids) -> np.ndarray:
+        """Direct (cache-bypassing) host pull, e.g. for eval/export."""
+        ids = np.asarray(ids, np.int64).ravel()
+        shard, local = self.store.route(ids)
+        rows = np.empty((ids.size, self.dim), np.float32)
+        for s in range(self.n_shards):
+            m = shard == s
+            if m.any():
+                rows[m] = self.tables[s].pull(local[m])
+        return rows
+
+    # test hook kept from the pre-router API
+    def _route(self, flat_ids: np.ndarray):
+        return self.store.route(np.asarray(flat_ids, np.int64))
