@@ -91,15 +91,25 @@ class TestRealWorld:
             import hetu_tpu.launch as L
             L.initialize()
             import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, PartitionSpec as P
             n = jax.device_count()
             i = jax.process_index()
-            print(f"RESULT pid={i} global_devices={n}")
+            # cross-process collective: psum over the 4-device global mesh
+            mesh = Mesh(jax.devices(), ("dp",))
+            def f(x):
+                return jax.lax.psum(x, "dp")
+            y = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                                      out_specs=P("dp")))(jnp.arange(4.0))
+            # local shard of the replicated psum result: 0+1+2+3 = 6
+            local = float(y.addressable_shards[0].data[0])
+            print(f"RESULT pid={i} global_devices={n} psum={local}")
         """)
         outs = simulate_workers(2, script, cpu_devices_per_proc=2,
                                 timeout=180.0)
         results = sorted(line for out in outs for line in out.splitlines()
                          if line.startswith("RESULT"))
         assert results == [
-            "RESULT pid=0 global_devices=4",
-            "RESULT pid=1 global_devices=4",
+            "RESULT pid=0 global_devices=4 psum=6.0",
+            "RESULT pid=1 global_devices=4 psum=6.0",
         ]
