@@ -390,17 +390,25 @@ class MoELayer(Module):
     """
 
     def __init__(self, gate: Module, experts: ExpertMLP, *,
-                 mesh: Optional[Mesh] = None, axis: str = "ep"):
+                 mesh: Optional[Mesh] = None,
+                 axis: "str | Sequence[str]" = "ep"):
         self.gate = gate
         self.experts = experts
         self.mesh = mesh
-        self.axis = axis
+        # a tuple axis, e.g. ("ep", "tp") or (dcn, ici), factors the expert
+        # exchange hierarchically — the reference's HAllToAll
+        # (mpi_nccl_communication.cu:152 intra-gather → inter-a2a → scatter);
+        # XLA lowers the inner axis onto ICI and the outer onto DCN.
+        self.axis = (axis,) if isinstance(axis, str) else tuple(axis)
 
     def __call__(self, x, *, training: bool = True):
         shape = x.shape
         d = shape[-1]
         mesh = self.mesh
-        ep = mesh.shape[self.axis] if mesh is not None else 1
+        ep = 1
+        if mesh is not None:
+            for a in self.axis:
+                ep *= mesh.shape[a]
         E = self.experts.num_experts          # global expert count
         if E % max(ep, 1):
             raise ValueError(f"{E} experts not divisible over ep={ep}")
@@ -437,7 +445,7 @@ class MoELayer(Module):
             mesh=mesh,
             in_specs=(P(), P(self.axis), P(self.axis)),
             out_specs=(P(self.axis), P()),
-            axis_names=frozenset({self.axis}),
+            axis_names=frozenset(self.axis),
         )(self.gate, self.experts, x)
 
 

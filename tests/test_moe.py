@@ -204,3 +204,30 @@ def test_moe_in_train_step(ep_mesh):
     }
     losses = [float(tr.step(batch)["loss"]) for _ in range(30)]
     assert losses[-1] < losses[0]
+
+
+def test_moe_hierarchical_ep_matches_flat():
+    """MoE with a factored (ep, tp) expert axis — the reference's
+    hierarchical AllToAll — must equal the flat 4-way ep run on the same
+    device order."""
+    from hetu_tpu.layers.moe import ExpertMLP, MoELayer, TopKGate
+
+    d, E, B, T = 8, 4, 4, 16
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(B, T, d)),
+                    jnp.float32)
+
+    def build(mesh, axis):
+        set_random_seed(0)
+        gate = TopKGate(d, E, k=2, capacity_factor=2.0)
+        experts = ExpertMLP(E, d, 2 * d)
+        return MoELayer(gate, experts, mesh=mesh, axis=axis)
+
+    mesh_flat = make_mesh(MeshSpec(ep=4), devices=jax.devices()[:4])
+    y_flat, aux_flat = build(mesh_flat, "ep")(x, training=False)
+
+    mesh_h = make_mesh(MeshSpec(ep=2, tp=2), devices=jax.devices()[:4])
+    y_h, aux_h = build(mesh_h, ("ep", "tp"))(x, training=False)
+
+    np.testing.assert_allclose(np.asarray(y_h), np.asarray(y_flat),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_h), float(aux_flat), rtol=1e-5)

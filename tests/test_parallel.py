@@ -162,3 +162,30 @@ def test_all_to_all_shard_map(mesh8):
     # a2a is a pure reshard: row-sharded -> column-sharded, global view fixed
     y = shard_map(a2a, mesh=mesh8, in_specs=P("dp", None), out_specs=P(None, "dp"))(x)
     np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_hierarchical_all_to_all_matches_flat(mesh8):
+    """Hierarchical a2a over a factored (outer, inner) axis pair must equal
+    the flat a2a over the single flattened axis (the reference's
+    tests/test_ha2agather.py oracle: intra-gather + inter-a2a + scatter ==
+    one big a2a)."""
+    from jax import shard_map
+    from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    x = jnp.arange(8.0 * 8).reshape(8, 8)
+
+    def flat(x):
+        return col.all_to_all(x, "dp", split_dim=1, concat_dim=0)
+
+    ref = shard_map(flat, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    # same 8 devices factored 2 (outer=dp) x 4 (inner=tp), same device order
+    mesh24 = make_mesh(MeshSpec(dp=2, tp=4), devices=jax.devices())
+
+    def hier(x):
+        return col.hierarchical_all_to_all(x, "dp", "tp", split_dim=1,
+                                           concat_dim=0)
+
+    out = shard_map(hier, mesh=mesh24, in_specs=P(("dp", "tp")),
+                    out_specs=P(("dp", "tp")))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
