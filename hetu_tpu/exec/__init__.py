@@ -1,5 +1,6 @@
 from hetu_tpu.exec.executor import Executor, Trainer, TrainState
 from hetu_tpu.exec.checkpoint import (
+    AsyncCheckpointer,
     load_checkpoint,
     load_state_dict,
     save_checkpoint,

@@ -14,7 +14,9 @@ there is no server — it is part of the functional state).
 
 from __future__ import annotations
 
+import os
 import pickle
+import threading
 from typing import Any, Optional
 
 import jax
@@ -24,22 +26,88 @@ import numpy as np
 from hetu_tpu.core import get_seed_status, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
 
-__all__ = ["save_checkpoint", "load_checkpoint", "state_dict", "load_state_dict"]
+__all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
+           "load_state_dict", "AsyncCheckpointer"]
 
 
 def _to_host(tree):
     return jtu.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def save_checkpoint(path: str, state: Any, extra: Optional[dict] = None) -> None:
-    """Pickle a host copy of ``state`` plus the global RNG (seed, seqnum)."""
-    payload = {
+def _make_payload(state: Any, extra: Optional[dict]) -> dict:
+    """Host snapshot of state + RNG + a defensive copy of extra, built on
+    the caller's thread so later mutations cannot race a background write."""
+    return {
         "state": _to_host(state),
         "rng": get_seed_status(),
-        "extra": extra or {},
+        "extra": dict(extra) if extra else {},
     }
-    with open(path, "wb") as f:
+
+
+def _atomic_write(path: str, payload: dict) -> None:
+    """tmp-write + fsync + rename + directory fsync: a crash at any point
+    leaves either the old or the new checkpoint, never a torn one."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)  # make the rename itself durable
+    finally:
+        os.close(dfd)
+
+
+def save_checkpoint(path: str, state: Any, extra: Optional[dict] = None) -> None:
+    """Pickle a host copy of ``state`` plus the global RNG (seed, seqnum);
+    atomic against crashes mid-write."""
+    _atomic_write(path, _make_payload(state, extra))
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpointing: the device→host snapshot happens on the
+    caller's thread (cheap, and consistent — arrays are immutable), the
+    pickle+fsync happens on a background thread so the train loop never
+    waits on disk.  Writes to ``path.tmp`` then atomically renames, so a
+    crash mid-write never corrupts the previous checkpoint.
+
+    (The reference blocks the worker for the whole save, executor.py:568;
+    async snapshots are beyond it — this is a rebuild extra.)
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, path: str, state: Any, extra: Optional[dict] = None):
+        """Snapshot ``state`` (and copy ``extra``) now; persist in the
+        background.  A previous in-flight save is waited on first (ordered
+        checkpoints)."""
+        self.wait()
+        payload = _make_payload(state, extra)  # caller-thread snapshot
+
+        def write():
+            try:
+                _atomic_write(path, payload)
+            except BaseException as e:  # surfaced at next wait()/save()
+                self._error = e
+
+        # non-daemon: interpreter exit joins the writer, so the final save
+        # of a script that forgets wait() still lands on disk
+        self._thread = threading.Thread(target=write, daemon=False)
+        self._thread.start()
+
+    def wait(self):
+        """Block until the in-flight save (if any) is durable; re-raise any
+        background write error."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
 
 def load_checkpoint(path: str, restore_rng: bool = True):

@@ -5,6 +5,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import hetu_tpu as ht
 from hetu_tpu.core import set_random_seed
@@ -189,3 +190,39 @@ def test_metrics():
     # pairs: (1a,0a): tie 0.5 ; (1a,0b): win; (1b,0a): lose->0.5 tie counts .5...
     auc = metrics.auc_roc(s2, t2)
     assert 0.5 < auc <= 1.0
+
+
+def test_async_checkpointer(tmp_path):
+    import os
+    from hetu_tpu.exec.checkpoint import (
+        AsyncCheckpointer, load_checkpoint, save_checkpoint,
+    )
+    set_random_seed(0)
+    state = {"w": jnp.arange(16.0).reshape(4, 4), "step": jnp.int32(7)}
+    path = str(tmp_path / "ck.pkl")
+
+    ck = AsyncCheckpointer()
+    ck.save(path, state, extra={"epoch": 3})
+    ck.wait()
+    loaded, extra = load_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.asarray(state["w"]))
+    assert extra == {"epoch": 3}
+    assert not os.path.exists(path + ".tmp")
+
+    # snapshot consistency: mutating the SAME objects after save() must not
+    # affect the in-flight write
+    d = {"w": jnp.ones((2,))}
+    ex = {"epoch": 4}
+    ck.save(path, d, extra=ex)
+    d["w"] = jnp.zeros((2,))
+    ex["epoch"] = 999
+    ck.wait()
+    loaded, extra = load_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), np.ones(2))
+    assert extra == {"epoch": 4}
+
+    # background write errors surface at wait()
+    ck.save(str(tmp_path / "nodir" / "x.pkl"), state)
+    with pytest.raises(OSError):
+        ck.wait()
