@@ -83,3 +83,35 @@ class Dataloader:
             sel = sel[lo:hi]
             batch = {k: v[sel] for k, v in self.arrays.items()}
             yield batch if self.dict_mode else batch["x"]
+
+    def prefetch(self, device=None, sharding=None):
+        """Iterate with the NEXT batch's host→device transfer in flight
+        while the current batch computes — double buffering via
+        ``jax.device_put`` (async dispatch).  ``sharding`` (a
+        ``jax.sharding.Sharding`` or pytree of them) places each batch for
+        sharded steps; default is the default device.
+
+        This subsumes the reference's pinned-buffer reuse (:168-188): XLA
+        owns the staging buffers, the loop just keeps one transfer ahead.
+        """
+        import jax
+
+        if device is not None and sharding is not None:
+            raise ValueError("pass either device or sharding, not both")
+
+        def put(batch):
+            tgt = sharding if sharding is not None else device
+            if tgt is None:
+                return jax.tree_util.tree_map(jax.device_put, batch)
+            return jax.device_put(batch, tgt)
+
+        it = iter(self)
+        try:
+            pending = put(next(it))
+        except StopIteration:
+            return
+        for nxt in it:
+            nxt_dev = put(nxt)  # async: overlaps consumer's compute
+            yield pending
+            pending = nxt_dev
+        yield pending

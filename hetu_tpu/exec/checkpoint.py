@@ -14,8 +14,10 @@ there is no server — it is part of the functional state).
 
 from __future__ import annotations
 
+import copy
 import os
 import pickle
+import sys
 import threading
 from typing import Any, Optional
 
@@ -31,7 +33,11 @@ __all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
 
 
 def _to_host(tree):
-    return jtu.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+    def snap(x):
+        if isinstance(x, np.ndarray):
+            return x.copy()  # device_get is a no-op for numpy: force a copy
+        return np.asarray(jax.device_get(x))
+    return jtu.tree_map(snap, tree)
 
 
 def _make_payload(state: Any, extra: Optional[dict]) -> dict:
@@ -40,7 +46,7 @@ def _make_payload(state: Any, extra: Optional[dict]) -> dict:
     return {
         "state": _to_host(state),
         "rng": get_seed_status(),
-        "extra": dict(extra) if extra else {},
+        "extra": copy.deepcopy(extra) if extra else {},
     }
 
 
@@ -91,7 +97,11 @@ class AsyncCheckpointer:
         def write():
             try:
                 _atomic_write(path, payload)
-            except BaseException as e:  # surfaced at next wait()/save()
+            except BaseException as e:
+                # stored for the next wait()/save(); ALSO printed so a
+                # failed final save of an exiting process is not silent
+                print(f"AsyncCheckpointer: write to {path} failed: {e!r}",
+                      file=sys.stderr)
                 self._error = e
 
         # non-daemon: interpreter exit joins the writer, so the final save

@@ -226,3 +226,15 @@ def test_async_checkpointer(tmp_path):
     ck.save(str(tmp_path / "nodir" / "x.pkl"), state)
     with pytest.raises(OSError):
         ck.wait()
+
+
+def test_dataloader_prefetch_device():
+    dl = Dataloader({"x": np.arange(40).reshape(20, 2).astype(np.float32),
+                     "y": np.arange(20).astype(np.int32)}, batch_size=5)
+    plain = [b for b in dl]
+    pre = [b for b in dl.prefetch()]
+    assert len(pre) == len(plain) == 4
+    for a, b in zip(plain, pre):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(b["x"]), a["x"])
+        np.testing.assert_array_equal(np.asarray(b["y"]), a["y"])
