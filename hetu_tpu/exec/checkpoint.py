@@ -32,12 +32,16 @@ __all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
            "load_state_dict", "AsyncCheckpointer"]
 
 
+def _snap(x):
+    """Host snapshot of one leaf; always a fresh buffer (device_get is a
+    no-op for numpy arrays, so force the copy)."""
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    return np.asarray(jax.device_get(x))
+
+
 def _to_host(tree):
-    def snap(x):
-        if isinstance(x, np.ndarray):
-            return x.copy()  # device_get is a no-op for numpy: force a copy
-        return np.asarray(jax.device_get(x))
-    return jtu.tree_map(snap, tree)
+    return jtu.tree_map(_snap, tree)
 
 
 def _make_payload(state: Any, extra: Optional[dict]) -> dict:
@@ -132,8 +136,7 @@ def load_checkpoint(path: str, restore_rng: bool = True):
 
 def state_dict(tree: Any) -> dict:
     """Flat {dotted.path: numpy array} — the reference's state_dict form."""
-    return {name: np.asarray(jax.device_get(x))
-            for name, x in named_parameters(tree)}
+    return {name: _snap(x) for name, x in named_parameters(tree)}
 
 
 def load_state_dict(tree: Any, sd: dict, *, consider_splits: bool = False):

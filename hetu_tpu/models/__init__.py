@@ -8,6 +8,7 @@ from hetu_tpu.models.bert import (
 from hetu_tpu.models.ctr import DCN, CTRConfig, DeepFM, WideDeep
 from hetu_tpu.models.gpt import GPT, GPTConfig, gpt2_large, gpt2_medium, gpt2_small
 from hetu_tpu.models.moe_lm import MoEBlock, MoELM, MoELMConfig
+from hetu_tpu.models.ncf import GMF, MF, MLPRec, NeuMF
 from hetu_tpu.models.resnet import BasicBlock, ResNet, resnet18, resnet34
 from hetu_tpu.models.rnn import (
     GRUCell,

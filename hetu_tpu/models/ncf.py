@@ -1,0 +1,130 @@
+"""Neural collaborative filtering family: MF, GMF, MLP, NeuMF.
+
+TPU-native re-designs of the reference recommendation models
+(reference: examples/rec/models/{mf,gmf,mlp,neumf}.py): user and item ids
+embed into a shared table (two sparse fields), and the heads differ —
+MF/GMF take the elementwise product of the two embeddings (MF scores its
+sum, GMF learns a linear head over it), MLP feeds the concatenation
+through a tower, NeuMF splits the embedding into a GMF factor slice and an
+MLP slice and concatenates both branches before the prediction layer
+(neumf.py:19-29).  Ratings train with logistic loss like the reference's
+``RatingModel_Head.output``.
+
+The embedding is pluggable exactly like the CTR family — pass any module
+with the ``emb(ids) -> [batch, 2, dim]`` contract (on-device ``Embedding``,
+``HostEmbedding``/``StagedHostEmbedding``, ``ShardedHostEmbedding``, or a
+compressed variant from ``embed/compress`` — the reference drives these
+models through its compression suite, examples/rec/run_compressed.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from hetu_tpu.core.module import Module
+from hetu_tpu.layers import Embedding, Linear
+from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
+
+__all__ = ["MF", "GMF", "MLPRec", "NeuMF"]
+
+
+class _RatingModel(Module):
+    """Shared skeleton: embedding over [user_id, item_id] + logistic loss."""
+
+    def __init__(self, num_embeddings: int, dim: int,
+                 embedding: Optional[Module] = None):
+        self.embed = embedding or Embedding(num_embeddings, dim)
+        self.dim = dim
+
+    def _pair(self, ids):
+        """ids [batch, 2] -> embeddings [batch, 2, dim]."""
+        return self.embed(ids).astype(jnp.float32)
+
+    def logits(self, ids):
+        raise NotImplementedError
+
+    def loss(self, ids, label):
+        logits = self.logits(ids)
+        loss = binary_cross_entropy_with_logits(logits, label).mean()
+        return loss, {"pred": sigmoid(logits)}
+
+
+class MF(_RatingModel):
+    """Plain matrix factorization: score = <user, item> (mf.py)."""
+
+    def logits(self, ids):
+        e = self._pair(ids)
+        return jnp.sum(e[:, 0] * e[:, 1], axis=-1)
+
+
+class GMF(_RatingModel):
+    """Generalized MF: learned linear head over the elementwise product
+    (gmf.py:15-17)."""
+
+    def __init__(self, num_embeddings: int, dim: int,
+                 embedding: Optional[Module] = None):
+        super().__init__(num_embeddings, dim, embedding)
+        self.predict = Linear(dim, 1)
+
+    def logits(self, ids):
+        e = self._pair(ids)
+        return self.predict(e[:, 0] * e[:, 1])[:, 0]
+
+
+class _ReluTower(Module):
+    """relu MLP over a width schedule — shared by MLPRec and NeuMF (the
+    reference's create_mlp, examples/rec/models/base.py)."""
+
+    def __init__(self, widths):
+        self.layers = [Linear(a, b) for a, b in zip(widths[:-1], widths[1:])]
+
+    def __call__(self, x):
+        for l in self.layers:
+            x = relu(l(x))
+        return x
+
+
+class MLPRec(_RatingModel):
+    """MLP head over the concatenated pair (mlp.py): tower halves the
+    width each layer down to one factor."""
+
+    def __init__(self, num_embeddings: int, dim: int,
+                 embedding: Optional[Module] = None, depth: int = 3):
+        super().__init__(num_embeddings, dim, embedding)
+        dims = [2 * dim] + [max(2 * dim // (2 ** (i + 1)), 8)
+                            for i in range(depth)]
+        self.tower = _ReluTower(dims)
+        self.predict = Linear(dims[-1], 1)
+
+    def logits(self, ids):
+        e = self._pair(ids)
+        h = self.tower(e.reshape(e.shape[0], -1))
+        return self.predict(h)[:, 0]
+
+
+class NeuMF(_RatingModel):
+    """Neural MF (neumf.py): the embedding splits into a GMF factor slice
+    (dim//5, neumf.py:9-12) and an MLP slice; the GMF product and the MLP
+    tower output concatenate into the prediction layer."""
+
+    def __init__(self, num_embeddings: int, dim: int,
+                 embedding: Optional[Module] = None):
+        if dim % 5:
+            raise ValueError("NeuMF needs embed dim divisible by 5 "
+                             "(reference neumf.py:9)")
+        super().__init__(num_embeddings, dim, embedding)
+        self.factor = dim // 5
+        # fixed 2-pair MLP: [8f, 4f, 2f, f] like neumf.py:13-14
+        self.tower = _ReluTower([8 * self.factor, 4 * self.factor,
+                                 2 * self.factor, self.factor])
+        self.predict = Linear(2 * self.factor, 1)
+
+    def logits(self, ids):
+        e = self._pair(ids)
+        gmf = e[:, :, :self.factor]
+        mlp = e[:, :, self.factor:]
+        out_gmf = gmf[:, 0] * gmf[:, 1]                     # [b, f]
+        h = self.tower(mlp.reshape(mlp.shape[0], -1))       # [b, 2*(d-f)]
+        return self.predict(jnp.concatenate([out_gmf, h], axis=-1))[:, 0]
