@@ -1,5 +1,5 @@
 from hetu_tpu.layers.base import Identity, Lambda, Sequential
-from hetu_tpu.layers.linear import Embedding, Linear
+from hetu_tpu.layers.linear import Embedding, Linear, MLPTower
 from hetu_tpu.layers.conv import AvgPool2d, Conv2d, Flatten, MaxPool2d
 from hetu_tpu.layers.norm import (
     BatchNorm2d,

@@ -15,7 +15,7 @@ from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import he_uniform, normal, zeros
 from hetu_tpu.ops import embedding_lookup, linear
 
-__all__ = ["Linear", "Embedding"]
+__all__ = ["Linear", "Embedding", "MLPTower"]
 
 
 class Linear(Module):
@@ -53,3 +53,22 @@ class Embedding(Module):
 
     def __call__(self, ids):
         return embedding_lookup(self.weight, ids)
+
+
+class MLPTower(Module):
+    """relu MLP over a width schedule (the reference's ``create_mlp``,
+    examples/rec/models/base.py / the CTR deep towers).  ``final_relu``
+    selects whether the last layer is activated."""
+
+    def __init__(self, widths, *, final_relu: bool = True):
+        self.layers = [Linear(a, b) for a, b in zip(widths[:-1], widths[1:])]
+        self.final_relu = final_relu
+
+    def __call__(self, x):
+        from hetu_tpu.ops import relu
+        last = len(self.layers) - 1
+        for i, l in enumerate(self.layers):
+            x = l(x)
+            if i < last or self.final_relu:
+                x = relu(x)
+        return x

@@ -22,8 +22,8 @@ from hetu_tpu.core.module import Module
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.embed import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.init import normal
-from hetu_tpu.layers import Embedding, Linear
-from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
+from hetu_tpu.layers import Embedding, Linear, MLPTower
+from hetu_tpu.ops import binary_cross_entropy_with_logits, sigmoid
 
 __all__ = ["CTRConfig", "WideDeep", "DeepFM", "DCN", "make_embedding"]
 
@@ -72,19 +72,13 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
     return Embedding(cfg.vocab, dim)
 
 
-class _DeepTower(Module):
-    """relu MLP tower (the shared DNN of all three models)."""
+class _DeepTower(MLPTower):
+    """relu MLP tower (the shared DNN of all three models) — the constant-
+    hidden special case of layers.MLPTower, last layer unactivated."""
 
     def __init__(self, in_dim: int, hidden: int, out_dim: int, depth: int = 3):
-        dims = [in_dim] + [hidden] * (depth - 1) + [out_dim]
-        self.layers = [Linear(a, b) for a, b in zip(dims[:-1], dims[1:])]
-
-    def __call__(self, x):
-        for i, l in enumerate(self.layers):
-            x = l(x)
-            if i < len(self.layers) - 1:
-                x = relu(x)
-        return x
+        super().__init__([in_dim] + [hidden] * (depth - 1) + [out_dim],
+                         final_relu=False)
 
 
 class WideDeep(Module):

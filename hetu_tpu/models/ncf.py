@@ -24,8 +24,8 @@ from typing import Optional
 import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module
-from hetu_tpu.layers import Embedding, Linear
-from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
+from hetu_tpu.layers import Embedding, Linear, MLPTower
+from hetu_tpu.ops import binary_cross_entropy_with_logits, sigmoid
 
 __all__ = ["MF", "GMF", "MLPRec", "NeuMF"]
 
@@ -73,19 +73,6 @@ class GMF(_RatingModel):
         return self.predict(e[:, 0] * e[:, 1])[:, 0]
 
 
-class _ReluTower(Module):
-    """relu MLP over a width schedule — shared by MLPRec and NeuMF (the
-    reference's create_mlp, examples/rec/models/base.py)."""
-
-    def __init__(self, widths):
-        self.layers = [Linear(a, b) for a, b in zip(widths[:-1], widths[1:])]
-
-    def __call__(self, x):
-        for l in self.layers:
-            x = relu(l(x))
-        return x
-
-
 class MLPRec(_RatingModel):
     """MLP head over the concatenated pair (mlp.py): tower halves the
     width each layer down to one factor."""
@@ -95,7 +82,7 @@ class MLPRec(_RatingModel):
         super().__init__(num_embeddings, dim, embedding)
         dims = [2 * dim] + [max(2 * dim // (2 ** (i + 1)), 8)
                             for i in range(depth)]
-        self.tower = _ReluTower(dims)
+        self.tower = MLPTower(dims)
         self.predict = Linear(dims[-1], 1)
 
     def logits(self, ids):
@@ -117,8 +104,8 @@ class NeuMF(_RatingModel):
         super().__init__(num_embeddings, dim, embedding)
         self.factor = dim // 5
         # fixed 2-pair MLP: [8f, 4f, 2f, f] like neumf.py:13-14
-        self.tower = _ReluTower([8 * self.factor, 4 * self.factor,
-                                 2 * self.factor, self.factor])
+        self.tower = MLPTower([8 * self.factor, 4 * self.factor,
+                               2 * self.factor, self.factor])
         self.predict = Linear(2 * self.factor, 1)
 
     def logits(self, ids):

@@ -41,6 +41,16 @@ __all__ = ["flash_attention", "flash_attn_fn"]
 _NEG_INF = -1e30  # finite: -inf - -inf = nan would poison alpha/exp paths
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-axes (vma) signature of
+    ``like`` — required when the kernel runs inside a shard_map manual
+    region (e.g. as the Ulysses local core) under check_vma."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _compiler_params(n_parallel: int):
     try:
         return pltpu.CompilerParams(
@@ -139,8 +149,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
                          lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+            _sds((B, H, Sq, D), q.dtype, q),
+            _sds((B, H, Sq, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -275,8 +285,8 @@ def _bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _sds(k.shape, k.dtype, k),
+            _sds(v.shape, v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -301,7 +311,7 @@ def _bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
         in_specs=q_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds(q.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_compiler_params(3),
         interpret=interpret,

@@ -74,3 +74,17 @@ def test_mha_with_ring_attention(sp_mesh):
     out_ref = jax.jit(lambda m, v: m(v))(mha_ref, x)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_with_flash_inner(sp_mesh, causal):
+    """Ulysses composed with the Pallas flash kernel as the local core
+    (interpret mode on CPU) matches the dense oracle."""
+    from hetu_tpu.ops.pallas import flash_attn_fn
+
+    q, k, v = _qkv(s=32, seed=2)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    attn = ulysses_attn_fn(sp_mesh, inner_fn=flash_attn_fn(interpret=True))
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
