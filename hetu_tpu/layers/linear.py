@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from hetu_tpu.core.module import Module
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import he_uniform, normal, zeros
-from hetu_tpu.ops import embedding_lookup, linear
+from hetu_tpu.ops import embedding_lookup, linear, relu
 
 __all__ = ["Linear", "Embedding", "MLPTower"]
 
@@ -65,7 +65,6 @@ class MLPTower(Module):
         self.final_relu = final_relu
 
     def __call__(self, x):
-        from hetu_tpu.ops import relu
         last = len(self.layers) - 1
         for i, l in enumerate(self.layers):
             x = l(x)
