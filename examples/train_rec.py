@@ -16,7 +16,6 @@ import sys
 
 sys.path.insert(0, ".")
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,11 +42,20 @@ def make_embedding(kind: str, vocab: int, dim: int):
     if kind == "hash":
         return ALL_METHODS["hash"](max(vocab // 8, 16), dim)
     if kind == "tt":
-        # factor vocab and dim into 3-way decompositions (tt.py contract)
+        # factor vocab (capacity >= vocab) and dim (exactly) into 3-way
+        # decompositions (tt.py contract)
         import math
+
+        def three_factor_exact(x):
+            a = max(d for d in range(1, int(round(x ** (1 / 3))) + 2)
+                    if x % d == 0)
+            rem = x // a
+            b = max(d for d in range(1, int(rem ** 0.5) + 1) if rem % d == 0)
+            return [a, b, rem // b]
+
         base = math.ceil(vocab ** (1 / 3))
-        return ALL_METHODS["tt"]([base, base, math.ceil(vocab / base**2)],
-                                 [2, 2, max(dim // 4, 1)], rank=8)
+        return ALL_METHODS["tt"]([base, base, math.ceil(vocab / base ** 2)],
+                                 three_factor_exact(dim), rank=8)
     raise SystemExit(f"unknown embedding {kind}")
 
 
