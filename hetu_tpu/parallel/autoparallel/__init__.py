@@ -26,12 +26,17 @@ from hetu_tpu.parallel.autoparallel.profiler import CostProfiler
 from hetu_tpu.parallel.autoparallel.search import (
     Plan,
     dp_search,
+    gpipe_search,
     mcmc_search,
+    partition_stages,
+    pipedream_search,
+    pipeopt_search,
     plan_to_strategy,
 )
 
 __all__ = [
     "ClusterSpec", "LayerSpec", "ParallelChoice", "MemoryCostModel",
     "TimeCostModel", "transformer_layer_spec", "CostProfiler",
-    "Plan", "dp_search", "mcmc_search", "plan_to_strategy",
+    "Plan", "dp_search", "mcmc_search", "gpipe_search", "pipedream_search",
+    "pipeopt_search", "partition_stages", "plan_to_strategy",
 ]
