@@ -352,6 +352,63 @@ def _reduce(op_type, axes_as_input):
     return h
 
 
+@handler("split")
+def _split(ex, eqn, ins):
+    sizes = list(eqn.params["sizes"])
+    axis = eqn.params["axis"]
+    split_in = ex.const(np.asarray(sizes, np.int64), "sizes")
+    return ex.emit("Split", [ins[0], split_in], n_out=len(sizes),
+                   hint="split", axis=axis)
+
+
+_SCAN_UNROLL_LIMIT = 256
+
+
+@handler("scan")
+def _scan(ex, eqn, ins):
+    """lax.scan exported by unrolling (static length): per step, Gather the
+    xs slice, inline the body jaxpr, chain the carry, and Concat the
+    stacked ys.  Covers the RNN/LSTM/GRU recurrences and scan-over-layers
+    stacks; bounded by _SCAN_UNROLL_LIMIT to keep graphs sane."""
+    p = eqn.params
+    body = p["jaxpr"]  # ClosedJaxpr: (consts, carry, x_t) -> (carry, y_t)
+    n_const, n_carry = p["num_consts"], p["num_carry"]
+    length, reverse = p["length"], p["reverse"]
+    if length > _SCAN_UNROLL_LIMIT:
+        raise NotImplementedError(
+            f"ONNX export: scan of length {length} exceeds the unroll limit "
+            f"({_SCAN_UNROLL_LIMIT})")
+    if length == 0:
+        raise NotImplementedError(
+            "ONNX export: zero-length scan has no representable ys")
+    const_names = ins[:n_const]
+    carry = list(ins[n_const:n_const + n_carry])
+    xs = ins[n_const + n_carry:]
+    n_y = len(eqn.outvars) - n_carry
+    ys_steps: list[list[str]] = [[] for _ in range(n_y)]
+    steps = range(length - 1, -1, -1) if reverse else range(length)
+    axes0 = ex.const(np.asarray([0], np.int64), "ax0")
+    for t in steps:
+        idx = ex.const(np.asarray(t, np.int64), "t")
+        # scalar-index Gather on axis 0 drops the time axis, matching the
+        # body's per-step slice
+        x_slices = [ex.emit("Gather", [xn, idx], hint="xslice", axis=0)[0]
+                    for xn in xs]
+        outs = ex.run_sub(body.jaxpr, body.consts,
+                          const_names + carry + x_slices)
+        carry = list(outs[:n_carry])
+        for i, yn in enumerate(outs[n_carry:]):
+            ys_steps[i].append(
+                ex.emit("Unsqueeze", [yn, axes0], hint="ystep")[0])
+    ys = []
+    for names in ys_steps:
+        if reverse:
+            names = list(reversed(names))  # ys align with xs order
+        ys.append(names[0] if length == 1
+                  else ex.emit("Concat", names, hint="ys", axis=0)[0])
+    return carry + ys
+
+
 _HANDLERS["reduce_sum"] = _reduce("ReduceSum", True)     # opset 13: axes input
 _HANDLERS["reduce_max"] = _reduce("ReduceMax", False)
 _HANDLERS["reduce_min"] = _reduce("ReduceMin", False)

@@ -203,8 +203,12 @@ class TensorProto:
 
 
 def tensor_from_numpy(name: str, arr: np.ndarray) -> TensorProto:
+    # record the rank BEFORE ascontiguousarray: it promotes 0-d to 1-d,
+    # which would silently turn scalar initializers (e.g. Gather indices
+    # that must drop their axis) into 1-element vectors
+    shape = tuple(np.shape(arr))
     arr = np.ascontiguousarray(arr)
-    return TensorProto(name=name, dims=tuple(arr.shape),
+    return TensorProto(name=name, dims=shape,
                        data_type=DTYPE_TO_ONNX[arr.dtype],
                        raw_data=arr.tobytes())
 

@@ -218,3 +218,72 @@ class TestModels:
         np.testing.assert_allclose(np.asarray(jitted(params, x)),
                                    np.asarray(jax.nn.softmax(x @ x.T)),
                                    atol=1e-5, rtol=1e-4)
+
+
+class TestScanExport:
+    def test_lstm_roundtrip(self):
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.models import LSTMCell, RNN
+        set_random_seed(0)
+        r = RNN(LSTMCell(4, 8))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 4)),
+                        jnp.float32)
+        proto = export_module(r, x, apply=lambda m, xx: m(xx)[0])
+        fn, params = import_model(proto)
+        np.testing.assert_allclose(np.asarray(fn(params, x)),
+                                   np.asarray(r(x)[0]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_reverse_scan_roundtrip(self):
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.models import RNN, RNNCell
+        set_random_seed(1)
+        r = RNN(RNNCell(4, 6), reverse=True)
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 5, 4)),
+                        jnp.float32)
+        proto = export_module(r, x, apply=lambda m, xx: m(xx)[0])
+        fn, params = import_model(proto)
+        np.testing.assert_allclose(np.asarray(fn(params, x)),
+                                   np.asarray(r(x)[0]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unroll_limit(self):
+        import pytest as _pytest
+        from jax import lax
+
+        def f(x):
+            return lax.scan(lambda c, t: (c + t, c), x[0], x)[0]
+
+        x = jnp.zeros((1000, 2), jnp.float32)
+        with _pytest.raises(NotImplementedError):
+            export_fn(f, x)
+
+    def test_scalar_initializer_rank_preserved(self):
+        from hetu_tpu.interop import onnx_pb as pb
+        t = pb.tensor_from_numpy("s", np.asarray(3, np.int64))
+        assert t.dims == ()
+        rt = pb.tensor_to_numpy(pb.TensorProto.decode(t.encode()))
+        assert rt.shape == () and int(rt) == 3
+
+    def test_split_roundtrip(self):
+        def f(x):
+            a, b, c = jnp.split(x, [2, 5], axis=1)
+            return a * 1.0 + a.sum() * 0, b.sum(), c.sum()
+
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(3, 8)),
+                        jnp.float32)
+        proto = export_fn(f, x)
+        fn, params = import_model(proto)
+        for got, want in zip(fn(params, x), f(x)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_zero_length_scan_rejected(self):
+        import pytest as _pytest
+        from jax import lax
+
+        def f(x):
+            return lax.scan(lambda c, t: (c + t, c), x.sum(0), x)[1]
+
+        with _pytest.raises(NotImplementedError):
+            export_fn(f, jnp.zeros((0, 3), jnp.float32))
