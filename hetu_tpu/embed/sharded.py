@@ -47,6 +47,11 @@ class _ShardRouter:
         self._cached = all(isinstance(s, CacheTable) for s in stores)
         self._engine = (AsyncEngine(min(n_shards, 4))
                         if self._cached and n_shards > 1 else None)
+        # per-shard traffic counters — the reference PS's load monitoring
+        # (startRecord/getLoads, gpu_ops/executor.py:398-401,675), used to
+        # spot hot shards needing rebalance
+        self.pull_rows_per_shard = np.zeros(n_shards, np.int64)
+        self.push_rows_per_shard = np.zeros(n_shards, np.int64)
 
     def route(self, flat_ids: np.ndarray):
         return flat_ids % self.n_shards, flat_ids // self.n_shards
@@ -59,7 +64,9 @@ class _ShardRouter:
             pending = []
             for s in range(self.n_shards):
                 m = shard == s
-                if m.any():
+                n = int(m.sum())
+                if n:
+                    self.pull_rows_per_shard[s] += n
                     t, out = self._engine.sync_async(self.stores[s], local[m])
                     pending.append((t, m, out))
             for t, m, out in pending:
@@ -68,7 +75,9 @@ class _ShardRouter:
         else:
             for s in range(self.n_shards):
                 m = shard == s
-                if m.any():
+                n = int(m.sum())
+                if n:
+                    self.pull_rows_per_shard[s] += n
                     rows[m] = sync_fn(self.stores[s])(local[m])
         return rows
 
@@ -78,7 +87,9 @@ class _ShardRouter:
         grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
         for s in range(self.n_shards):
             m = shard == s
-            if m.any():
+            n = int(m.sum())
+            if n:
+                self.push_rows_per_shard[s] += n
                 self.stores[s].push(local[m], grads[m])
 
 
@@ -149,6 +160,13 @@ class ShardedHostEmbedding(StagedHostEmbedding):
             if m.any():
                 rows[m] = self.tables[s].pull(local[m])
         return rows
+
+    def loads(self) -> dict:
+        """Per-shard pull/push row counts (the reference's getLoads)."""
+        return {
+            "pull_rows": self.store.pull_rows_per_shard.copy(),
+            "push_rows": self.store.push_rows_per_shard.copy(),
+        }
 
     # test hook kept from the pre-router API
     def _route(self, flat_ids: np.ndarray):

@@ -99,3 +99,14 @@ def test_push_before_stage_raises():
     emb = ShardedHostEmbedding(10, 4, n_shards=2)
     with pytest.raises(RuntimeError):
         emb.push_grads(np.zeros((2, 4), np.float32))
+
+
+def test_shard_loads_tracking():
+    set_random_seed(0)
+    emb = ShardedHostEmbedding(40, 4, n_shards=4, optimizer="sgd", lr=0.1)
+    ids = np.asarray([0, 1, 2, 3, 4, 5, 6, 7], np.int64)  # 2 rows per shard
+    emb.stage(jnp.asarray(ids))
+    emb.push_grads(np.zeros((8, 4), np.float32))
+    loads = emb.loads()
+    np.testing.assert_array_equal(loads["pull_rows"], [2, 2, 2, 2])
+    np.testing.assert_array_equal(loads["push_rows"], [2, 2, 2, 2])
