@@ -59,14 +59,14 @@ class _ShardRouter:
     def pull(self, flat_ids: np.ndarray) -> np.ndarray:
         flat_ids = np.asarray(flat_ids, np.int64)
         shard, local = self.route(flat_ids)
+        counts = np.bincount(shard, minlength=self.n_shards)
+        self.pull_rows_per_shard += counts
         rows = np.empty((flat_ids.size, self.dim), np.float32)
         if self._engine is not None:
             pending = []
             for s in range(self.n_shards):
-                m = shard == s
-                n = int(m.sum())
-                if n:
-                    self.pull_rows_per_shard[s] += n
+                if counts[s]:
+                    m = shard == s
                     t, out = self._engine.sync_async(self.stores[s], local[m])
                     pending.append((t, m, out))
             for t, m, out in pending:
@@ -74,22 +74,20 @@ class _ShardRouter:
                 rows[m] = out
         else:
             for s in range(self.n_shards):
-                m = shard == s
-                n = int(m.sum())
-                if n:
-                    self.pull_rows_per_shard[s] += n
+                if counts[s]:
+                    m = shard == s
                     rows[m] = sync_fn(self.stores[s])(local[m])
         return rows
 
     def push(self, flat_ids: np.ndarray, grads: np.ndarray):
         flat_ids = np.asarray(flat_ids, np.int64)
         shard, local = self.route(flat_ids)
+        counts = np.bincount(shard, minlength=self.n_shards)
+        self.push_rows_per_shard += counts
         grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
         for s in range(self.n_shards):
-            m = shard == s
-            n = int(m.sum())
-            if n:
-                self.push_rows_per_shard[s] += n
+            if counts[s]:
+                m = shard == s
                 self.stores[s].push(local[m], grads[m])
 
 
@@ -161,12 +159,22 @@ class ShardedHostEmbedding(StagedHostEmbedding):
                 rows[m] = self.tables[s].pull(local[m])
         return rows
 
-    def loads(self) -> dict:
-        """Per-shard pull/push row counts (the reference's getLoads)."""
-        return {
+    def loads(self, reset: bool = False) -> dict:
+        """Per-shard pull/push row counts (the reference's getLoads).
+
+        ``reset=True`` zeroes the counters after reading, giving windowed
+        counts like the reference's startRecord/getLoads recording window —
+        without it, long-lived cumulative totals drown out recent hot-shard
+        shifts.
+        """
+        out = {
             "pull_rows": self.store.pull_rows_per_shard.copy(),
             "push_rows": self.store.push_rows_per_shard.copy(),
         }
+        if reset:
+            self.store.pull_rows_per_shard[:] = 0
+            self.store.push_rows_per_shard[:] = 0
+        return out
 
     # test hook kept from the pre-router API
     def _route(self, flat_ids: np.ndarray):

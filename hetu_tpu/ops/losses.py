@@ -51,9 +51,17 @@ def _select_along(logp, label_ids, axis):
 
 
 def softmax_cross_entropy_sparse(logits, label_ids, axis: int = -1, ignore_index: int | None = None):
-    """Fused softmax+CE against integer labels (src/ops/SoftmaxCrossEntropySparse.cu)."""
-    logp = jax.nn.log_softmax(_f32(logits), axis=axis)
-    nll = -_select_along(logp, label_ids, axis)
+    """Fused softmax+CE against integer labels (src/ops/SoftmaxCrossEntropySparse.cu).
+
+    Computed as ``logsumexp(logits) - logits[label]`` rather than gathering
+    from a materialized log-softmax: the logsumexp reduces over the class
+    axis in fp32 without ever writing a full fp32 log-prob tensor — at LM
+    head scale (batch, seq, 30k+ vocab) that skips a multi-GB HBM buffer
+    and XLA fuses the whole thing into one pass over the bf16 logits.
+    """
+    lse = jax.scipy.special.logsumexp(_f32(logits), axis=axis)
+    label_logit = _f32(_select_along(logits, label_ids, axis))
+    nll = lse - label_logit
     if ignore_index is not None:
         nll = jnp.where(label_ids == ignore_index, 0.0, nll)
     return nll
