@@ -1,11 +1,14 @@
 from hetu_tpu.models.bert import (
     BertConfig,
+    BertForMaskedLM,
+    BertForNextSentencePrediction,
     BertForPreTraining,
+    BertForSequenceClassification,
     BertModel,
     bert_base,
     bert_large,
 )
-from hetu_tpu.models.ctr import DCN, CTRConfig, DeepFM, WideDeep
+from hetu_tpu.models.ctr import DCN, CTRConfig, DeepCrossing, DeepFM, WideDeep
 from hetu_tpu.models.gpt import GPT, GPTConfig, gpt2_large, gpt2_medium, gpt2_small
 from hetu_tpu.models.moe_lm import MoEBlock, MoELM, MoELMConfig
 from hetu_tpu.models.ncf import GMF, MF, MLPRec, NeuMF

@@ -22,11 +22,16 @@ from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import normal, zeros
 from hetu_tpu.layers import Embedding, LayerNorm, Linear, TransformerBlock
 from hetu_tpu.ops import (
+    dropout,
     gelu,
     softmax_cross_entropy_sparse,
 )
 
-__all__ = ["BertConfig", "BertModel", "BertForPreTraining", "bert_base", "bert_large"]
+__all__ = [
+    "BertConfig", "BertModel", "BertForPreTraining", "BertForMaskedLM",
+    "BertForNextSentencePrediction", "BertForSequenceClassification",
+    "bert_base", "bert_large",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -159,3 +164,87 @@ class BertForPreTraining(Module):
         mlm_loss = jnp.sum(mlm_nll * mlm_mask) / jnp.maximum(jnp.sum(mlm_mask), 1.0)
         nsp_loss = softmax_cross_entropy_sparse(nsp_logits, nsp_labels).mean()
         return mlm_loss + nsp_loss, {"mlm_loss": mlm_loss, "nsp_loss": nsp_loss}
+
+
+class BertForMaskedLM(Module):
+    """MLM-only head (reference hetu_bert.py:656 BertForMaskedLM)."""
+
+    def __init__(self, cfg: BertConfig, attn_fn=None):
+        self.bert = BertModel(cfg, attn_fn=attn_fn)
+        self.heads = BertPreTrainingHeads(cfg)
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, *,
+                 key=None, training: bool = False):
+        hidden, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                   key=key, training=training)
+        mlm_logits, _ = self.heads(hidden, pooled,
+                                   self.bert.embeddings.word.weight)
+        return mlm_logits
+
+    def loss(self, input_ids, token_type_ids, attention_mask, mlm_labels, *,
+             key=None, training: bool = True):
+        logits = self(input_ids, token_type_ids, attention_mask, key=key,
+                      training=training)
+        nll = softmax_cross_entropy_sparse(logits, jnp.maximum(mlm_labels, 0))
+        m = (mlm_labels >= 0).astype(jnp.float32)
+        loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return loss, {"mlm_loss": loss}
+
+
+class BertForNextSentencePrediction(Module):
+    """NSP-only head (reference hetu_bert.py:726)."""
+
+    def __init__(self, cfg: BertConfig, attn_fn=None):
+        self.bert = BertModel(cfg, attn_fn=attn_fn)
+        init = normal(stddev=cfg.initializer_range)
+        self.nsp = Linear(cfg.hidden_size, 2, initializer=init, dtype=cfg.dtype,
+                          axes=("embed", None))
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, *,
+                 key=None, training: bool = False):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                              key=key, training=training)
+        return self.nsp(pooled)
+
+    def loss(self, input_ids, token_type_ids, attention_mask, nsp_labels, *,
+             key=None, training: bool = True):
+        logits = self(input_ids, token_type_ids, attention_mask, key=key,
+                      training=training)
+        loss = softmax_cross_entropy_sparse(logits, nsp_labels).mean()
+        return loss, {"nsp_loss": loss}
+
+
+class BertForSequenceClassification(Module):
+    """Pooled-output classifier for GLUE-style fine-tuning
+    (reference hetu_bert.py:802 BertForSequenceClassification; GLUE scripts
+    examples/nlp/bert/scripts/test_glue_*.sh)."""
+
+    def __init__(self, cfg: BertConfig, num_labels: int, attn_fn=None):
+        self.bert = BertModel(cfg, attn_fn=attn_fn)
+        init = normal(stddev=cfg.initializer_range)
+        self.classifier = Linear(cfg.hidden_size, num_labels,
+                                 initializer=init, dtype=cfg.dtype,
+                                 axes=("embed", None))
+        self.num_labels = num_labels
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, *,
+                 key=None, training: bool = False):
+        k_bert = k_drop = None
+        if key is not None:
+            k_bert, k_drop = jax.random.split(key)
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                              key=k_bert, training=training)
+        if training and k_drop is not None:
+            pooled = dropout(pooled, self.config.dropout_rate, k_drop)
+        return self.classifier(pooled)
+
+    def loss(self, input_ids, token_type_ids, attention_mask, labels, *,
+             key=None, training: bool = True):
+        logits = self(input_ids, token_type_ids, attention_mask, key=key,
+                      training=training)
+        loss = softmax_cross_entropy_sparse(logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, {"accuracy": acc}

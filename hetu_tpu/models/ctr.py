@@ -23,9 +23,10 @@ from hetu_tpu.core.rng import next_key
 from hetu_tpu.embed import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.init import normal
 from hetu_tpu.layers import Embedding, Linear, MLPTower
-from hetu_tpu.ops import binary_cross_entropy_with_logits, sigmoid
+from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
 
-__all__ = ["CTRConfig", "WideDeep", "DeepFM", "DCN", "make_embedding"]
+__all__ = ["CTRConfig", "WideDeep", "DeepFM", "DCN", "DeepCrossing",
+           "make_embedding"]
 
 
 class CTRConfig:
@@ -123,6 +124,45 @@ class DeepFM(Module):
         second = 0.5 * ((s * s).sum(-1) - (v * v).sum(axis=(1, 2)))
         deep = self.deep(v.reshape(v.shape[0], -1))[:, 0]
         return first + second + deep + self.bias[0]
+
+    def loss(self, dense, sparse, label):
+        logits = self.logits(dense, sparse)
+        loss = binary_cross_entropy_with_logits(logits, label).mean()
+        return loss, {"pred": sigmoid(logits)}
+
+
+class _ResidualUnit(Module):
+    """DeepCrossing residual unit (reference dc_criteo.py residual_layer):
+    relu(x + W2 relu(W1 x + b1) + b2)."""
+
+    def __init__(self, dim: int, hidden: int):
+        self.fc1 = Linear(dim, hidden, initializer=normal(stddev=0.1))
+        self.fc2 = Linear(hidden, dim, initializer=normal(stddev=0.1))
+
+    def __call__(self, x):
+        return relu(x + self.fc2(relu(self.fc1(x))))
+
+
+class DeepCrossing(Module):
+    """DeepCrossing (reference examples/ctr/models/dc_criteo.py): stacked
+    residual units over [embeddings ++ dense], linear scoring head."""
+
+    def __init__(self, cfg: CTRConfig, num_residual: int = 3,
+                 residual_hidden: int | None = None):
+        self.cfg = cfg
+        self.embed = make_embedding(cfg)
+        in_dim = cfg.sparse_fields * cfg.embed_dim + cfg.dense_dim
+        hidden = residual_hidden if residual_hidden is not None else cfg.mlp_hidden
+        self.residuals = [_ResidualUnit(in_dim, hidden)
+                          for _ in range(num_residual)]
+        self.head = Linear(in_dim, 1, initializer=normal(stddev=0.1))
+
+    def logits(self, dense, sparse):
+        emb = self.embed(sparse).reshape(dense.shape[0], -1)
+        x = jnp.concatenate([emb, dense], axis=1)
+        for unit in self.residuals:
+            x = unit(x)
+        return self.head(x)[:, 0]
 
     def loss(self, dense, sparse, label):
         logits = self.logits(dense, sparse)

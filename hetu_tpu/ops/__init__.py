@@ -12,3 +12,4 @@ from hetu_tpu.ops.reduce import *  # noqa: F401,F403
 from hetu_tpu.ops.shape import *  # noqa: F401,F403
 from hetu_tpu.ops.sparse import *  # noqa: F401,F403
 from hetu_tpu.ops.embed import *  # noqa: F401,F403
+from hetu_tpu.ops.random import *  # noqa: F401,F403

@@ -19,6 +19,8 @@ __all__ = [
     "abs", "add", "add_const", "mul", "mul_const", "div", "div_const", "rdiv_const",
     "pow", "exp", "log", "sqrt", "rsqrt", "tanh", "sigmoid", "gelu", "relu",
     "leaky_relu", "sin", "cos", "floor", "ceil", "clamp", "sign", "opposite",
+    "maximum", "minimum", "bool_", "div_handle_zero", "full", "full_like",
+    "ones_like", "zeros_like", "stop_gradient", "param_clip", "matrix_dot",
     "matmul", "batch_matmul", "addmm", "baddbmm", "linear", "outer", "dot",
 ]
 
@@ -125,6 +127,64 @@ def sign(x):
 
 def opposite(x):
     return jnp.negative(x)
+
+
+def maximum(a, b):
+    """Elementwise max (reference gpu_ops/Max.py max_op)."""
+    return jnp.maximum(a, b)
+
+
+def minimum(a, b):
+    """Elementwise min (reference gpu_ops/Min.py min_op)."""
+    return jnp.minimum(a, b)
+
+
+def bool_(x):
+    """Cast to boolean 0/1 (reference gpu_ops/Bool.py bool_op)."""
+    return (x != 0).astype(jnp.float32)
+
+
+def div_handle_zero(a, b):
+    """a / b with 0 wherever b == 0 (reference gpu_ops div_handle_zero_op)."""
+    safe = jnp.where(b == 0, 1, b)
+    return jnp.where(b == 0, 0.0, a / safe)
+
+
+def full(shape, fill_value, dtype=jnp.float32):
+    return jnp.full(shape, fill_value, dtype)
+
+
+def full_like(x, fill_value):
+    return jnp.full_like(x, fill_value)
+
+
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def stop_gradient(x):
+    """Identity with zero gradient (reference gpu_ops/StopGradient.py)."""
+    return lax.stop_gradient(x)
+
+
+def param_clip(x, min_value, max_value):
+    """Value clip applied to a parameter after its update — the projection
+    step of projected SGD (reference gpu_ops/ParamClip.py param_clip_op,
+    used by AutoSrh's alpha projection).  Functionally identical to clamp;
+    kept as a named op so strategy/search code can recognize it."""
+    return jnp.clip(x, min_value, max_value)
+
+
+def matrix_dot(a, b, axes=0):
+    """tensordot (reference gpu_ops/MatrixDot.py matrix_dot_op; axes=0 is the
+    elementwise-product form the reference actually uses)."""
+    if axes == 0:
+        return a * b
+    return jnp.tensordot(a, b, axes=axes)
 
 
 # -- matmul family ------------------------------------------------------------

@@ -1,0 +1,58 @@
+"""Random-sampling ops.
+
+TPU-native equivalents of the reference's on-device sampling ops
+(reference: python/hetu/gpu_ops/Sample.py — rand_op, normal_sample_op,
+uniform_sample_op, truncated_normal_sample_op, gumbel_sample_op,
+randint_sample_op; kernels src/ops/Initializers.cu via curand).  Each takes an
+explicit jax PRNG ``key``; when omitted, a key is drawn from the global
+seed+seqnum RNG (hetu_tpu.core.rng), preserving the reference's reproducible
+seed/seqnum semantics (src/common/random.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.rng import next_key
+
+__all__ = [
+    "rand", "normal_sample", "uniform_sample", "truncated_normal_sample",
+    "gumbel_sample", "randint_sample",
+]
+
+
+def _key(key):
+    return next_key() if key is None else key
+
+
+def rand(shape, dtype=jnp.float32, key=None):
+    """U[0, 1) samples (reference rand_op)."""
+    return jax.random.uniform(_key(key), shape, dtype)
+
+
+def normal_sample(shape, mean: float = 0.0, stddev: float = 1.0,
+                  dtype=jnp.float32, key=None):
+    return mean + stddev * jax.random.normal(_key(key), shape, dtype)
+
+
+def uniform_sample(shape, low: float = 0.0, high: float = 1.0,
+                   dtype=jnp.float32, key=None):
+    return jax.random.uniform(_key(key), shape, dtype, low, high)
+
+
+def truncated_normal_sample(shape, mean: float = 0.0, stddev: float = 1.0,
+                            dtype=jnp.float32, key=None):
+    """Normal truncated to ±2σ (reference truncated_normal_sample_op)."""
+    return mean + stddev * jax.random.truncated_normal(
+        _key(key), -2.0, 2.0, shape, dtype)
+
+
+def gumbel_sample(shape, dtype=jnp.float32, key=None):
+    """Standard Gumbel(0,1) samples (reference gumbel_sample_op; noisy MoE
+    gates and Gumbel-softmax tricks)."""
+    return jax.random.gumbel(_key(key), shape, dtype)
+
+
+def randint_sample(shape, low: int, high: int, dtype=jnp.int32, key=None):
+    return jax.random.randint(_key(key), shape, low, high, dtype)

@@ -16,8 +16,11 @@ from jax import lax
 
 __all__ = [
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_norm",
-    "cumsum", "argmax", "argmin", "argsort", "topk", "topk_idx", "topk_val",
+    "reduce_mul", "reduce_norm1", "reduce_norm2",
+    "cumsum", "cumsum_with_bias", "argmax", "argmin", "argmax_partial",
+    "argsort", "topk", "topk_idx", "topk_val",
     "group_topk_idx", "unique_indices", "sam_group_sum", "sam_max", "arange",
+    "min_dist",
 ]
 
 
@@ -48,8 +51,43 @@ def reduce_norm(x, ord: int = 2, axes=None, keepdims: bool = False):  # noqa: A0
     )
 
 
+def reduce_mul(x, axes=None, keepdims: bool = False):
+    """Product reduction (reference gpu_ops reduce_mul_op)."""
+    return jnp.prod(x, axis=axes, keepdims=keepdims)
+
+
+def reduce_norm1(x, axes=None, keepdims: bool = False):
+    return reduce_norm(x, 1, axes, keepdims)
+
+
+def reduce_norm2(x, axes=None, keepdims: bool = False):
+    return reduce_norm(x, 2, axes, keepdims)
+
+
 def cumsum(x, axis: int = -1):
     return jnp.cumsum(x, axis=axis)
+
+
+def cumsum_with_bias(x, bias: float = 0.0, axis: int = 0):
+    """cumsum(x) + bias (src/ops/CumSum.cu cumsum_with_bias).  The MoE gates
+    use bias=-1 to turn a cumulative one-hot count into 0-based positions
+    within each expert's capacity bucket (reference layers/TopGate.py:33)."""
+    return jnp.cumsum(x, axis=axis) + bias
+
+
+def argmax_partial(x, use_full_mask, topk: int, axis: int = 1):
+    """Argmax where rows with mask==0 only consider the first ``topk``
+    entries along ``axis`` (src/ops/ArgmaxPartial.cu; MGQE's per-frequency
+    codebook restriction).  ``use_full_mask`` is (n,) over dim 0."""
+    n_axis = x.shape[axis]
+    in_head = jnp.arange(n_axis) < topk
+    shape = [1] * x.ndim
+    shape[axis] = n_axis
+    in_head = in_head.reshape(shape)
+    full_ok = use_full_mask.astype(bool).reshape(
+        (-1,) + (1,) * (x.ndim - 1))
+    allowed = jnp.logical_or(full_ok, in_head)
+    return jnp.argmax(jnp.where(allowed, x, -jnp.inf), axis=axis)
 
 
 def argmax(x, axis: int = -1):
@@ -119,3 +157,26 @@ def sam_max(x, group_ids, num_groups: int):
 
 def arange(start, stop=None, step=1, dtype=jnp.int32):
     return jnp.arange(start, stop, step, dtype=dtype)
+
+
+def min_dist(query, codebook, mode: str = "eu"):
+    """Nearest-codeword assignment for product quantization
+    (src/ops/MinDist.cu minimum_distance_vector; DPQ/MGQE embeddings).
+
+    ``query`` (n, d), ``codebook`` (k, d).  Returns (rows, indices): the
+    nearest codeword per query under euclidean ('eu') or inner-product ('in')
+    distance, with a straight-through gradient to the codebook rows (the
+    reference routes the gradient through an embedding-lookup-grad on the
+    selected rows, MinDist.py gradient()).
+    """
+    mode = mode[:2]
+    if mode == "eu":
+        # argmin ||q - c||^2 = argmin (||c||^2 - 2 q.c) — one matmul on the MXU
+        d2 = jnp.sum(codebook * codebook, -1)[None, :] - 2.0 * query @ codebook.T
+        idx = jnp.argmin(d2, axis=-1)
+    elif mode == "in":
+        idx = jnp.argmax(query @ codebook.T, axis=-1)
+    else:
+        raise ValueError(f"mode must be 'eu' or 'in', got {mode!r}")
+    rows = codebook[idx]
+    return rows, idx

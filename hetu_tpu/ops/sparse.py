@@ -22,7 +22,10 @@ import jax.numpy as jnp
 
 from hetu_tpu.ops.reduce import unique_indices
 
-__all__ = ["IndexedSlices", "dedup_indexed_slices", "csr_matmul", "csr_matvec", "CSRMatrix"]
+__all__ = [
+    "IndexedSlices", "dedup_indexed_slices", "csr_matmul", "csr_matvec",
+    "CSRMatrix", "dense_to_csr", "sparse_embedding_lookup",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -85,3 +88,37 @@ def csr_matmul(sp: CSRMatrix, dense, trans_sparse: bool = False):
 def csr_matvec(sp: CSRMatrix, vec):
     """CSR @ vec (src/ops/CuSparseCsrmv.cu)."""
     return csr_matmul(sp, vec[:, None])[:, 0]
+
+
+def dense_to_csr(dense, threshold: float = 0.0) -> CSRMatrix:
+    """Sparsify a dense matrix to CSR (reference ndarray.py dense_to_sparse).
+
+    Entries with |x| <= threshold become explicit zeros in ``data`` but keep
+    their slots so nnz stays static (jit-compatible); the stored layout is
+    still CSR ordered row-major.  Intended for host-side model conversion
+    (train → sparse inference form, the embedding-compression 'sparse'
+    inference path), so it runs fine outside jit too.
+    """
+    rows, cols = dense.shape
+    keep = jnp.abs(dense) > threshold
+    data = jnp.where(keep, dense, 0.0).reshape(-1)
+    indices = jnp.tile(jnp.arange(cols), rows)
+    indptr = jnp.arange(rows + 1) * cols
+    return CSRMatrix(data, indices, indptr, (rows, cols))
+
+
+def sparse_embedding_lookup(sp: CSRMatrix, ids):
+    """Row gather from a CSR-form embedding table
+    (src/ops/SparseEmbeddingLookup.cu; the compression suite's 'sparse'
+    inference-form embedding, tools/.../methods/layers/sparse.py).
+
+    Requires a fixed row stride (the dense_to_csr layout): row i occupies
+    indptr[i]..indptr[i+1] with a constant nnz per row.  Returns dense rows
+    (ids.shape + (dim,)).
+    """
+    rows, cols = sp.shape
+    # with the fixed-stride layout, columns are a tiled arange, so the CSR
+    # data block IS the dense table with explicit zeros — a plain row gather
+    table = sp.data.reshape(rows, cols)
+    out = table[ids.reshape(-1)]
+    return out.reshape(tuple(ids.shape) + (cols,))

@@ -6,6 +6,9 @@ from hetu_tpu.optim.optimizers import (
     MomentumOptimizer,
     Optimizer,
     SGDOptimizer,
+    clip_by_global_norm,
+    clip_by_value,
+    global_norm,
 )
 from hetu_tpu.optim.schedulers import (
     ExponentialScheduler,

@@ -32,6 +32,7 @@ from hetu_tpu.ops.sparse import IndexedSlices
 __all__ = [
     "Optimizer", "SGDOptimizer", "MomentumOptimizer", "AdaGradOptimizer",
     "AdamOptimizer", "AdamWOptimizer", "LambOptimizer",
+    "global_norm", "clip_by_global_norm", "clip_by_value",
 ]
 
 ScheduleOrFloat = Union[float, Callable[[Any], Any]]
@@ -49,6 +50,52 @@ def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees, is_leaf=_is_leaf)
 
 
+def _grad_sq_sum(g):
+    if isinstance(g, IndexedSlices):
+        return jnp.sum(jnp.square(g.values.astype(jnp.float32)))
+    return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+
+def global_norm(grads):
+    """L2 norm over the whole gradient pytree (IndexedSlices counted by
+    their values; None leaves skipped)."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads, is_leaf=_is_leaf)
+              if g is not None]
+    return jnp.sqrt(sum(_grad_sq_sum(g) for g in leaves))
+
+
+def _scale_grad(g, s):
+    if isinstance(g, IndexedSlices):
+        return dataclasses.replace(g, values=g.values * s.astype(g.values.dtype))
+    return g * s.astype(g.dtype)
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale the whole gradient tree so its global L2 norm is <= max_norm
+    (the standard BERT/GPT pretraining clip; reference models clip via
+    optimizer kernels' l2 machinery)."""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda g: None if g is None else _scale_grad(g, scale), grads,
+        is_leaf=lambda x: _is_leaf(x) or x is None)
+
+
+def clip_by_value(grads, min_value: float, max_value: float):
+    """Per-element value clip (reference gpu_ops/ParamClip.py semantics
+    applied to gradients)."""
+    def clip(g):
+        if g is None:
+            return None
+        if isinstance(g, IndexedSlices):
+            return dataclasses.replace(
+                g, values=jnp.clip(g.values, min_value, max_value))
+        return jnp.clip(g, min_value, max_value)
+
+    return jax.tree_util.tree_map(
+        clip, grads, is_leaf=lambda x: _is_leaf(x) or x is None)
+
+
 def _zeros_slot(p):
     # Slots live in fp32 regardless of param dtype (bf16 moments destroy Adam
     # numerics, and dtype-stable state pytrees are required for scan/donation).
@@ -63,6 +110,10 @@ class Optimizer:
 
     learning_rate: ScheduleOrFloat = 0.01
     l2reg: float = 0.0
+    # gradient clipping, applied over the whole grad tree before the update:
+    # clip_norm > 0 = global-L2-norm clip; clip_value > 0 = |g| value clip
+    clip_norm: float = 0.0
+    clip_value: float = 0.0
 
     def init(self, params) -> dict:
         return {
@@ -108,6 +159,10 @@ class Optimizer:
         step = state["step"] + 1
         lr = _lr_at(self.learning_rate, step)
         slot_names = self.slot_names()
+        if self.clip_norm > 0.0:
+            grads = clip_by_global_norm(grads, self.clip_norm)
+        if self.clip_value > 0.0:
+            grads = clip_by_value(grads, -self.clip_value, self.clip_value)
 
         # None grads mark frozen params; keep them as leaves so the treedefs
         # of grads and params stay congruent.

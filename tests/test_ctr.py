@@ -51,3 +51,12 @@ def test_ctr_host_embedding_hybrid():
     losses, preds, labels = _train(WideDeep, cfg, steps=30)
     assert losses[-1] < losses[0]
     assert auc_roc(preds, labels) > 0.6
+
+
+def test_deep_crossing_trains():
+    from hetu_tpu.models import DeepCrossing
+
+    cfg = CTRConfig(vocab=2600, embed_dim=8, mlp_hidden=32)
+    losses, preds, labels = _train(DeepCrossing, cfg)
+    assert losses[-1] < losses[0]
+    assert auc_roc(preds, labels) > 0.6

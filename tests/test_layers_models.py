@@ -138,3 +138,34 @@ def test_gpt_loss_decreases():
         model, state, loss = step(model, state)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_bert_downstream_heads():
+    import jax
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import (BertForMaskedLM,
+                                 BertForNextSentencePrediction,
+                                 BertForSequenceClassification, bert_base)
+
+    set_random_seed(0)
+    cfg = bert_base(num_layers=2, hidden_size=32, num_heads=2, vocab_size=100,
+                    max_position_embeddings=16)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 100, (2, 8)), jnp.int32)
+    tt = jnp.zeros((2, 8), jnp.int32)
+
+    mlm = BertForMaskedLM(cfg)
+    assert mlm(ids, tt).shape == (2, 8, 100)
+    labels = jnp.where(jnp.arange(8)[None] < 2, ids, -1)
+    loss, aux = mlm.loss(ids, tt, None, labels)
+    assert np.isfinite(float(loss))
+
+    nsp = BertForNextSentencePrediction(cfg)
+    assert nsp(ids, tt).shape == (2, 2)
+
+    cls = BertForSequenceClassification(cfg, num_labels=3)
+    logits = cls(ids, tt)
+    assert logits.shape == (2, 3)
+    loss, aux = cls.loss(ids, tt, None, jnp.asarray([0, 2]),
+                         key=jax.random.key(0))
+    assert np.isfinite(float(loss)) and 0.0 <= float(aux["accuracy"]) <= 1.0

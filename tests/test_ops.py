@@ -196,3 +196,74 @@ def test_interpolate(rng):
     x = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
     y = ops.interpolate(x, (8, 8))
     assert y.shape == (1, 8, 8, 2)
+
+
+def test_elementwise_extras(rng):
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.standard_normal((4, 5)).astype(np.float32)
+    assert_close(ops.maximum(x, y), np.maximum(x, y))
+    assert_close(ops.minimum(x, y), np.minimum(x, y))
+    assert_close(ops.bool_(jnp.asarray([0.0, 2.0, -1.0])), [0.0, 1.0, 1.0])
+    b = np.where(np.abs(y) < 0.5, 0.0, y).astype(np.float32)
+    assert_close(ops.div_handle_zero(x, b), np.where(b == 0, 0.0, x / np.where(b == 0, 1, b)))
+    assert_close(ops.full((2, 3), 7.0), np.full((2, 3), 7.0))
+    assert_close(ops.full_like(x, 2.0), np.full_like(x, 2.0))
+    assert_close(ops.ones_like(x), np.ones_like(x))
+    assert_close(ops.zeros_like(x), np.zeros_like(x))
+    assert_close(ops.param_clip(x, -0.2, 0.2), np.clip(x, -0.2, 0.2))
+    assert_close(ops.matrix_dot(x, y), x * y)
+    assert float(jax.grad(lambda v: ops.stop_gradient(v).sum())(jnp.asarray(x)).sum()) == 0.0
+
+
+def test_reduce_extras(rng):
+    x = np.abs(rng.standard_normal((3, 4))).astype(np.float32) + 0.1
+    assert_close(ops.reduce_mul(x, axes=1), np.prod(x, axis=1))
+    assert_close(ops.reduce_norm1(x, axes=0), np.abs(x).sum(0))
+    assert_close(ops.reduce_norm2(x, axes=0), np.sqrt((x * x).sum(0)))
+    assert_close(ops.cumsum_with_bias(jnp.ones((4,)), bias=-1.0), [0.0, 1.0, 2.0, 3.0])
+
+
+def test_argmax_partial():
+    x = jnp.asarray([[0.1, 0.9, 0.5], [0.1, 0.2, 0.9]])
+    mask = jnp.asarray([1, 0], jnp.int32)
+    out = ops.argmax_partial(x, mask, topk=2, axis=1)
+    # row 0 may use all entries (argmax=1); row 1 restricted to first 2 (argmax=1)
+    assert list(np.asarray(out)) == [1, 1]
+
+
+def test_min_dist(rng):
+    q = rng.standard_normal((6, 4)).astype(np.float32)
+    cb = rng.standard_normal((5, 4)).astype(np.float32)
+    rows, idx = ops.min_dist(q, cb, mode="eu")
+    ref = np.argmin(((q[:, None, :] - cb[None]) ** 2).sum(-1), axis=1)
+    assert list(np.asarray(idx)) == list(ref)
+    assert_close(rows, cb[ref])
+    _, idx_in = ops.min_dist(q, cb, mode="in")
+    assert list(np.asarray(idx_in)) == list(np.argmax(q @ cb.T, axis=1))
+
+
+def test_sampling_ops():
+    from hetu_tpu.core import set_random_seed
+
+    set_random_seed(0)
+    s = ops.normal_sample((2000,), mean=1.0, stddev=2.0)
+    assert abs(float(s.mean()) - 1.0) < 0.2 and abs(float(s.std()) - 2.0) < 0.2
+    u = ops.uniform_sample((2000,), -1.0, 1.0)
+    assert float(u.min()) >= -1.0 and float(u.max()) < 1.0
+    t = ops.truncated_normal_sample((2000,), stddev=1.0)
+    assert float(jnp.abs(t).max()) <= 2.0 + 1e-5
+    r = ops.randint_sample((2000,), 0, 7)
+    assert set(np.unique(np.asarray(r))) <= set(range(7))
+    g = ops.gumbel_sample((2000,))
+    assert abs(float(g.mean()) - 0.5772) < 0.15  # Euler–Mascheroni mean
+    key = jax.random.key(3)
+    assert_close(ops.rand((5,), key=key), ops.rand((5,), key=key))
+
+
+def test_sparse_inference_embedding(rng):
+    table = rng.standard_normal((9, 4)).astype(np.float32)
+    table[np.abs(table) < 0.3] = 0.0
+    sp = ops.dense_to_csr(jnp.asarray(table))
+    ids = jnp.asarray([[0, 3], [8, 3]])
+    out = ops.sparse_embedding_lookup(sp, ids)
+    assert_close(out, table[np.asarray(ids)])

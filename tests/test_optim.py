@@ -218,3 +218,28 @@ def test_schedulers():
     r.record(1.0)
     lr = r.record(1.0)
     np.testing.assert_allclose(lr, 0.1)
+
+
+def test_gradient_clipping():
+    import dataclasses as _dc
+    import jax.numpy as jnp
+    from hetu_tpu.ops import IndexedSlices
+    from hetu_tpu.optim import (SGDOptimizer, clip_by_global_norm,
+                                clip_by_value, global_norm)
+
+    g = {"a": jnp.ones((4,)) * 3.0, "frozen": None,
+         "s": IndexedSlices(jnp.asarray([1]), jnp.ones((1, 2)) * 4.0, 8)}
+    n = float(global_norm(g))
+    np.testing.assert_allclose(n, np.sqrt(4 * 9 + 2 * 16), rtol=1e-6)
+    c = clip_by_global_norm(g, 1.0)
+    np.testing.assert_allclose(float(global_norm(c)), 1.0, rtol=1e-5)
+    assert c["frozen"] is None
+    v = clip_by_value(g, -0.5, 0.5)
+    assert float(jnp.max(v["a"])) == 0.5 and float(jnp.max(v["s"].values)) == 0.5
+
+    # clip_norm wired into the optimizer: huge grad moves params by lr*unit
+    opt = SGDOptimizer(0.1, clip_norm=1.0)
+    p = {"w": jnp.zeros((4,))}
+    st = opt.init(p)
+    p2, _ = opt.update({"w": jnp.ones((4,)) * 1e6}, st, p)
+    np.testing.assert_allclose(np.asarray(p2["w"]), -0.1 / 2.0, rtol=1e-5)
