@@ -423,7 +423,30 @@ class MoELayer(Module):
 
         E_local = E // ep
 
+        def _pvary_params(tree):
+            # Mark replicated param leaves device-varying explicitly, in
+            # their storage dtype (fp32).  Without this, shard_map inserts
+            # the replicated->varying conversion lazily at first use — which
+            # is AFTER the bf16 compute cast, producing a bf16 copy-reduction
+            # all-reduce that XLA:CPU's AllReducePromotion pass cannot clone
+            # (crash: "Invalid binary instruction opcode copy").  Varying
+            # them up front keeps that collective in fp32 on every backend.
+            def pv(p):
+                if not isinstance(p, jax.Array):
+                    return p
+                missing = tuple(a for a in self.axis
+                                if a not in jax.typeof(p).vma)
+                if not missing:
+                    return p
+                pcast = getattr(lax, "pcast", None)
+                if pcast is not None:
+                    return pcast(p, missing, to="varying")
+                return lax.pvary(p, missing)
+            return jax.tree_util.tree_map(pv, tree)
+
         def inner(gate, experts, xl):
+            gate = _pvary_params(gate)
+            experts = _pvary_params(experts)
             # xl: the ep-local token shard [..., d]
             t = xl.reshape(-1, d)
             dispatch, combine, aux = gate(t, training=training)

@@ -45,20 +45,34 @@ class TransformerMLP(Module):
 class TransformerBlock(Module):
     """Attention + MLP with residuals.  ``post_ln=True`` gives the original
     BERT ordering (reference hetu_bert.py); default pre-LN trains stably at
-    scale."""
+    scale.
+
+    ``mlp`` swaps the FFN for any module with signature
+    ``(x, *, training) -> y`` or ``-> (y, aux)`` — an aux-returning FFN
+    (e.g. a MoE layer with its load-balancing loss, layers/moe.py MoELayer)
+    makes the block return ``(x, aux)`` instead of ``x``.
+    """
 
     def __init__(self, dim: int, num_heads: int, mlp_ratio: int = 4, *,
                  causal: bool = False, post_ln: bool = False,
-                 dropout_rate: float = 0.0, attn_fn=None, dtype=jnp.float32):
+                 dropout_rate: float = 0.0, attn_fn=None, mlp=None,
+                 dtype=jnp.float32):
         self.ln1 = LayerNorm(dim)
         self.attn = MultiHeadAttention(
             dim, num_heads, causal=causal, dropout_rate=dropout_rate,
             attn_fn=attn_fn, dtype=dtype,
         )
         self.ln2 = LayerNorm(dim)
-        self.mlp = TransformerMLP(dim, mlp_ratio * dim, dtype=dtype)
+        self.mlp = mlp if mlp is not None else TransformerMLP(
+            dim, mlp_ratio * dim, dtype=dtype)
+        self._mlp_takes_training = mlp is not None
         self.post_ln = post_ln
         self.dropout_rate = dropout_rate
+
+    def _ffn(self, x, training):
+        out = (self.mlp(x, training=training) if self._mlp_takes_training
+               else self.mlp(x))
+        return out if isinstance(out, tuple) else (out, None)
 
     def __call__(self, x, mask=None, *, key=None, training: bool = False):
         ka = k1 = k2 = None
@@ -66,11 +80,13 @@ class TransformerBlock(Module):
             ka, k1, k2 = jax.random.split(key, 3)
         if self.post_ln:
             x = self.ln1(x + self._drop(self.attn(x, mask, key=ka, training=training), k1, training))
-            x = self.ln2(x + self._drop(self.mlp(x), k2, training))
+            y, aux = self._ffn(x, training)
+            x = self.ln2(x + self._drop(y, k2, training))
         else:
             x = x + self._drop(self.attn(self.ln1(x), mask, key=ka, training=training), k1, training)
-            x = x + self._drop(self.mlp(self.ln2(x)), k2, training)
-        return x
+            y, aux = self._ffn(self.ln2(x), training)
+            x = x + self._drop(y, k2, training)
+        return x if aux is None else (x, aux)
 
     def _drop(self, x, key, training):
         if training and self.dropout_rate > 0.0 and key is not None:

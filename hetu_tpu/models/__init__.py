@@ -5,6 +5,8 @@ from hetu_tpu.models.bert import (
     BertForPreTraining,
     BertForSequenceClassification,
     BertModel,
+    BertMoEForPreTraining,
+    BertMoEModel,
     bert_base,
     bert_large,
 )

@@ -30,6 +30,7 @@ from hetu_tpu.ops import (
 __all__ = [
     "BertConfig", "BertModel", "BertForPreTraining", "BertForMaskedLM",
     "BertForNextSentencePrediction", "BertForSequenceClassification",
+    "BertMoEModel", "BertMoEForPreTraining",
     "bert_base", "bert_large",
 ]
 
@@ -157,13 +158,95 @@ class BertForPreTraining(Module):
             input_ids, token_type_ids, attention_mask, key=key,
             training=training, compute_dtype=compute_dtype,
         )
-        mlm_nll = softmax_cross_entropy_sparse(
-            mlm_logits, jnp.maximum(mlm_labels, 0), ignore_index=None
-        )
-        mlm_mask = (mlm_labels >= 0).astype(jnp.float32)
-        mlm_loss = jnp.sum(mlm_nll * mlm_mask) / jnp.maximum(jnp.sum(mlm_mask), 1.0)
-        nsp_loss = softmax_cross_entropy_sparse(nsp_logits, nsp_labels).mean()
+        mlm_loss, nsp_loss = _mlm_nsp_loss(
+            mlm_logits, nsp_logits, mlm_labels, nsp_labels)
         return mlm_loss + nsp_loss, {"mlm_loss": mlm_loss, "nsp_loss": nsp_loss}
+
+
+def _mlm_nsp_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+    """Masked-LM + next-sentence loss; label -1 = unmasked position
+    (reference train_hetu_bert_dp.py loss construction).  Shared by the
+    dense and MoE pretraining heads."""
+    mlm_nll = softmax_cross_entropy_sparse(
+        mlm_logits, jnp.maximum(mlm_labels, 0), ignore_index=None)
+    m = (mlm_labels >= 0).astype(jnp.float32)
+    mlm_loss = jnp.sum(mlm_nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    nsp_loss = softmax_cross_entropy_sparse(nsp_logits, nsp_labels).mean()
+    return mlm_loss, nsp_loss
+
+
+class BertMoEModel(Module):
+    """BERT encoder with MoE FFN blocks (reference hetu_bert_moe.py
+    BertModel; examples/nlp/bert/train_hetu_bert_moe.py): the standard
+    post-LN TransformerBlock with its FFN swapped for a top-k MoE layer
+    (AllToAll expert dispatch).  ``mesh`` routes the exchange over the 'ep'
+    axis for expert parallelism."""
+
+    def __init__(self, cfg: BertConfig, *, num_experts: int = 8,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 mesh=None, attn_fn=None):
+        from hetu_tpu.layers.moe import moe_transformer_mlp
+
+        self.embeddings = BertEmbeddings(cfg)
+        self.blocks = [
+            TransformerBlock(
+                cfg.hidden_size, cfg.num_heads, post_ln=True,
+                dropout_rate=cfg.dropout_rate, attn_fn=attn_fn,
+                dtype=cfg.dtype,
+                mlp=moe_transformer_mlp(
+                    cfg.hidden_size, cfg.intermediate_ratio * cfg.hidden_size,
+                    num_experts, k=top_k, capacity_factor=capacity_factor,
+                    mesh=mesh, dtype=cfg.dtype),
+            )
+            for _ in range(cfg.num_layers)
+        ]
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size, dtype=cfg.dtype,
+                             axes=("embed", None))
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, *,
+                 key=None, training: bool = False, compute_dtype=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        mask = attention_mask[:, None, None, :] if attention_mask is not None else None
+        keys = (jax.random.split(key, len(self.blocks)) if key is not None
+                else [None] * len(self.blocks))
+        aux_total = jnp.float32(0.0)
+        for blk, k in zip(self.blocks, keys):
+            x, aux = blk(x, mask, key=k, training=training)
+            aux_total = aux_total + aux
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled, aux_total / len(self.blocks)
+
+
+class BertMoEForPreTraining(Module):
+    """MLM + NSP on the MoE encoder; adds the gate load-balancing aux loss
+    (reference hetu_bert_moe.py BertForPreTraining)."""
+
+    def __init__(self, cfg: BertConfig, *, num_experts: int = 8,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 aux_weight: float = 1e-2, mesh=None, attn_fn=None):
+        self.bert = BertMoEModel(cfg, num_experts=num_experts, top_k=top_k,
+                                 capacity_factor=capacity_factor, mesh=mesh,
+                                 attn_fn=attn_fn)
+        self.heads = BertPreTrainingHeads(cfg)
+        self.aux_weight = aux_weight
+        self.config = cfg
+
+    def loss(self, input_ids, token_type_ids, attention_mask, mlm_labels,
+             nsp_labels, *, key=None, training: bool = True,
+             compute_dtype=None):
+        hidden, pooled, aux = self.bert(
+            input_ids, token_type_ids, attention_mask, key=key,
+            training=training, compute_dtype=compute_dtype)
+        mlm_logits, nsp_logits = self.heads(
+            hidden, pooled, self.bert.embeddings.word.weight)
+        mlm_loss, nsp_loss = _mlm_nsp_loss(
+            mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+        total = mlm_loss + nsp_loss + self.aux_weight * aux
+        return total, {"mlm_loss": mlm_loss, "nsp_loss": nsp_loss,
+                       "moe_aux": aux}
 
 
 class BertForMaskedLM(Module):

@@ -231,3 +231,61 @@ def test_moe_hierarchical_ep_matches_flat():
     np.testing.assert_allclose(np.asarray(y_h), np.asarray(y_flat),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(float(aux_h), float(aux_flat), rtol=1e-5)
+
+
+def test_bert_moe_pretraining_trains():
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import BertMoEForPreTraining, bert_base
+    from hetu_tpu.optim import AdamOptimizer
+
+    set_random_seed(0)
+    cfg = bert_base(num_layers=2, hidden_size=32, num_heads=2, vocab_size=96,
+                    max_position_embeddings=16)
+    model = BertMoEForPreTraining(cfg, num_experts=4, top_k=2)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 96, (8, 16)), jnp.int32)
+    tt = jnp.zeros((8, 16), jnp.int32)
+    labels = jnp.where(jnp.arange(16)[None] < 3, ids, -1)
+    nsp = jnp.asarray(rng.integers(0, 2, (8,)), jnp.int32)
+    tr = Trainer(
+        model, AdamOptimizer(3e-3),
+        lambda m, b, k: m.loss(b["ids"], b["tt"], None, b["mlm"], b["nsp"],
+                               key=k, training=False))
+    b = {"ids": ids, "tt": tt, "mlm": labels, "nsp": nsp}
+    l0 = float(tr.step(b)["loss"])
+    for _ in range(30):
+        m = tr.step(b)
+    assert float(m["loss"]) < l0
+    assert np.isfinite(float(m["moe_aux"]))
+
+
+def test_bert_moe_expert_parallel_mesh():
+    """MoE BERT over an ep mesh axis — the hetu_bert_moe distributed config."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import BertMoEForPreTraining, bert_base
+    from hetu_tpu.optim import AdamOptimizer
+    from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+    from hetu_tpu.parallel.spec import DP_RULES
+    from hetu_tpu.parallel.strategies import ShardingStrategy
+
+    set_random_seed(0)
+    mesh = make_mesh(MeshSpec(dp=2, ep=4))
+    cfg = bert_base(num_layers=1, hidden_size=32, num_heads=2, vocab_size=64,
+                    max_position_embeddings=16)
+    model = BertMoEForPreTraining(cfg, num_experts=4, top_k=1, mesh=mesh)
+    strategy = ShardingStrategy(mesh=mesh, rules=DP_RULES,
+                                batch_axes=("dp", "ep"))
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+    b = {"ids": ids, "tt": jnp.zeros((8, 16), jnp.int32),
+         "mlm": jnp.where(jnp.arange(16)[None] < 3, ids, -1),
+         "nsp": jnp.asarray(rng.integers(0, 2, (8,)), jnp.int32)}
+    tr = Trainer(
+        model, AdamOptimizer(1e-3),
+        lambda m, bt, k: m.loss(bt["ids"], bt["tt"], None, bt["mlm"],
+                                bt["nsp"], key=k, training=False),
+        strategy=strategy)
+    m = tr.step(b)
+    assert np.isfinite(float(m["loss"]))
