@@ -19,9 +19,12 @@ from hetu_tpu.embed.engine import (
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
 from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
+from hetu_tpu.embed.net import (EmbeddingServer, RemoteEmbeddingTable,
+                                RemoteHostEmbedding)
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
+    "EmbeddingServer", "RemoteEmbeddingTable", "RemoteHostEmbedding",
 ]

@@ -23,7 +23,7 @@ __all__ = [
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
 _SO = _REPO / "build" / "libhetu_embed.so"
-_SRC = _REPO / "native" / "embed" / "embed_engine.cpp"
+_SRC_DIR = _REPO / "native" / "embed"
 
 OPTIMIZERS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3, "adamw": 4}
 POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
@@ -35,9 +35,10 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not _SO.exists() or (_SRC.exists()
-                            and _SRC.stat().st_mtime > _SO.stat().st_mtime):
-        subprocess.run(["sh", str(_REPO / "native" / "embed" / "build.sh")],
+    srcs = sorted(_SRC_DIR.glob("*.cpp"))
+    if not _SO.exists() or (srcs and max(s.stat().st_mtime for s in srcs)
+                            > _SO.stat().st_mtime):
+        subprocess.run(["sh", str(_SRC_DIR / "build.sh")],
                        check=True, capture_output=True)
     lib = ctypes.CDLL(str(_SO))
     i64p = ctypes.POINTER(ctypes.c_int64)

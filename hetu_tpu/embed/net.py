@@ -1,0 +1,281 @@
+"""Network parameter server for host embeddings (TCP transport).
+
+The reference's embedding tables live in separate parameter-server processes
+reached over ps-lite's network vans (zmq_van.h:31; roles spawned by
+runner.py, workers talk typed RPCs PSFunc.h:33-57 and the SERVER runs the
+optimizer, PSFHandle.h:17).  TPU-rebuild equivalent on the native transport
+in native/embed/ps_net.cpp:
+
+- ``EmbeddingServer`` — hosts tables in this process (in-process thread; or
+  run standalone: ``python -m hetu_tpu.embed.net --port 9123``).
+- ``RemoteEmbeddingTable`` — client-side stub with the same store interface
+  as the in-process ``HostEmbeddingTable`` (pull/push/set_rows/save/load),
+  so every layer above (staged bridge, shard router, CTR models) works
+  unchanged against remote servers.
+- ``RemoteHostEmbedding`` — drop-in ``StagedHostEmbedding`` whose shards are
+  key-partitioned across N servers (the ps-lite partitioner pattern,
+  include/ps/worker/partitioner.h).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+
+import numpy as np
+
+from hetu_tpu.embed.engine import OPTIMIZERS, _load
+from hetu_tpu.embed.sharded import ShardedHostEmbedding
+
+__all__ = ["EmbeddingServer", "RemoteEmbeddingTable", "RemoteHostEmbedding"]
+
+
+def _lib():
+    lib = _load()
+    if getattr(lib, "_ps_net_bound", False):
+        return lib
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    sigs = {
+        "het_ps_server_start": ([ctypes.c_int], ctypes.c_void_p),
+        "het_ps_server_port": ([ctypes.c_void_p], ctypes.c_int),
+        "het_ps_server_stop": ([ctypes.c_void_p], None),
+        "het_ps_connect": ([ctypes.c_char_p, ctypes.c_int], ctypes.c_void_p),
+        "het_ps_disconnect": ([ctypes.c_void_p], None),
+        "het_ps_create_table": (
+            [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,
+             ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+             ctypes.c_float], ctypes.c_int64),
+        "het_ps_pull": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
+                         ctypes.c_int64, ctypes.c_int64, f32p],
+                        ctypes.c_int64),
+        "het_ps_push": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
+                         ctypes.c_int64, ctypes.c_int64, f32p],
+                        ctypes.c_int64),
+        "het_ps_set_rows": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
+                             ctypes.c_int64, ctypes.c_int64, f32p],
+                            ctypes.c_int64),
+        "het_ps_save": ([ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p],
+                        ctypes.c_int64),
+        "het_ps_load": ([ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p],
+                        ctypes.c_int64),
+        "het_ps_set_lr": ([ctypes.c_void_p, ctypes.c_uint32, ctypes.c_float],
+                          ctypes.c_int64),
+        "het_ps_barrier": ([ctypes.c_void_p, ctypes.c_uint32,
+                            ctypes.c_int64], ctypes.c_int64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    lib._ps_net_bound = True
+    return lib
+
+
+def _i64(a):
+    return np.ascontiguousarray(a, np.int64)
+
+
+def _f32(a):
+    return np.ascontiguousarray(a, np.float32)
+
+
+class EmbeddingServer:
+    """Hosts embedding tables for remote workers (reference PS server role).
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    """
+
+    def __init__(self, port: int = 0):
+        lib = _lib()
+        self._h = lib.het_ps_server_start(port)
+        if not self._h:
+            raise OSError(f"could not bind embedding server on port {port}")
+        self.port = lib.het_ps_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            _lib().het_ps_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class RemoteEmbeddingTable:
+    """Client stub for a table on an ``EmbeddingServer``; same store
+    interface as the in-process ``HostEmbeddingTable`` (engine.py:111).
+
+    The server runs the optimizer on ``push`` (PSFHandle.h ApplySparse
+    semantics); ``pull`` returns current rows.
+    """
+
+    # tells the shard router pulls block on a network RTT and should be
+    # overlapped across shards on a thread pool
+    parallel_pull = True
+
+    def __init__(self, address: str, table_id: int, rows: int, dim: int, *,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01):
+        host, _, port = address.partition(":")
+        self._lib = _lib()
+        self._c = self._lib.het_ps_connect(host.encode(), int(port))
+        if not self._c:
+            raise ConnectionError(f"cannot reach embedding server {address}")
+        self.table_id = int(table_id)
+        self.rows = rows
+        self.dim = dim
+        st = self._lib.het_ps_create_table(
+            self._c, self.table_id, rows, dim, OPTIMIZERS[optimizer], lr,
+            momentum, beta1, beta2, eps, weight_decay, seed, init_scale)
+        if st < 0:
+            raise RuntimeError(
+                f"table {table_id} exists on {address} with a different "
+                f"shape (status {st})")
+
+    def _check(self, st, what):
+        if st != 0:
+            raise RuntimeError(f"remote {what} failed (status {st})")
+
+    def pull(self, keys) -> np.ndarray:
+        keys = _i64(np.asarray(keys).ravel())
+        out = np.empty((keys.size, self.dim), np.float32)
+        st = self._lib.het_ps_pull(
+            self._c, self.table_id,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            self.dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self._check(st, "pull")
+        return out
+
+    def push(self, keys, grads):
+        keys = _i64(np.asarray(keys).ravel())
+        grads = _f32(np.asarray(grads).reshape(keys.size, self.dim))
+        st = self._lib.het_ps_push(
+            self._c, self.table_id,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            self.dim, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self._check(st, "push")
+
+    def set_rows(self, keys, values):
+        keys = _i64(np.asarray(keys).ravel())
+        values = _f32(np.asarray(values).reshape(keys.size, self.dim))
+        st = self._lib.het_ps_set_rows(
+            self._c, self.table_id,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            self.dim, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self._check(st, "set_rows")
+
+    def set_lr(self, lr: float):
+        self._check(self._lib.het_ps_set_lr(self._c, self.table_id, lr),
+                    "set_lr")
+
+    def save(self, path: str):
+        """Server-side save — the file is written where the SERVER runs
+        (reference SaveParam, PSFHandle.h:389)."""
+        self._check(self._lib.het_ps_save(self._c, self.table_id,
+                                          str(path).encode()), "save")
+
+    def load(self, path: str):
+        self._check(self._lib.het_ps_load(self._c, self.table_id,
+                                          str(path).encode()), "load")
+
+    def barrier(self, barrier_id: int, world: int):
+        """Block until ``world`` clients reach this barrier id on the same
+        server (reference BarrierWorker)."""
+        self._check(self._lib.het_ps_barrier(self._c, barrier_id, world),
+                    "barrier")
+
+    def close(self):
+        if getattr(self, "_c", None):
+            self._lib.het_ps_disconnect(self._c)
+            self._c = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# SPMD workers construct their models in the same deterministic order, so a
+# process-local counter yields matching table ids on every worker while
+# keeping two same-shaped layers in one model from aliasing one remote table.
+_next_table_id = itertools.count(0)
+
+
+class RemoteHostEmbedding(ShardedHostEmbedding):
+    """Staged host embedding whose table is key-partitioned across N
+    embedding servers — the reference's multi-server PS deployment (workers
+    mod-partition keys over servers, each server applies its shard's
+    optimizer updates).  Staging/persistence/load-monitoring are inherited
+    from ``ShardedHostEmbedding``; only the stores are remote stubs.
+
+    ``table_id=None`` auto-allocates a fresh id per constructed layer (in
+    SPMD construction order, identical across workers); pass an explicit id
+    to attach to a table another worker already created.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *, servers,
+                 table_id: int | None = None, optimizer: str = "sgd",
+                 lr: float = 0.01, weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01, dtype=None):
+        import jax.numpy as jnp
+
+        servers = list(servers)
+        if not servers:
+            raise ValueError("need at least one server address")
+        if table_id is None:
+            table_id = next(_next_table_id)
+        # deliberately NOT calling super().__init__ (same pattern as
+        # ShardedHostEmbedding over StagedHostEmbedding): the local table
+        # construction is replaced by remote stubs, everything else reused
+        self.num_embeddings = num_embeddings
+        self.dim = dim
+        self.dtype = dtype if dtype is not None else jnp.float32
+        self.n_shards = len(servers)
+        rows_per = -(-num_embeddings // self.n_shards)
+        self.tables = [
+            RemoteEmbeddingTable(addr, table_id, rows_per, dim,
+                                 optimizer=optimizer, lr=lr,
+                                 weight_decay=weight_decay, seed=seed + s,
+                                 init_scale=init_scale)
+            for s, addr in enumerate(servers)
+        ]
+        self.stores = list(self.tables)
+        self._wire()
+
+
+def main(argv=None):
+    """Standalone server process: ``python -m hetu_tpu.embed.net --port N``
+    (the reference's PS server role spawned by runner.py)."""
+    import argparse
+    import signal
+    import threading
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9123)
+    args = ap.parse_args(argv)
+    srv = EmbeddingServer(args.port)
+    print(f"embedding server listening on :{srv.port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,15 @@ class _ShardRouter:
         self._cached = all(isinstance(s, CacheTable) for s in stores)
         self._engine = (AsyncEngine(min(n_shards, 4))
                         if self._cached and n_shards > 1 else None)
+        # remote stores (embed.net.RemoteEmbeddingTable, parallel_pull=True)
+        # block on a TCP round trip per shard — overlap them on a Python
+        # thread pool (each shard has its own connection + lock, so the
+        # per-connection serialization does not cross shards)
+        self._pool = None
+        if (n_shards > 1 and not self._cached
+                and all(getattr(s, "parallel_pull", False) for s in stores)):
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(min(n_shards, 8))
         # per-shard traffic counters — the reference PS's load monitoring
         # (startRecord/getLoads, gpu_ops/executor.py:398-401,675), used to
         # spot hot shards needing rebalance
@@ -72,6 +81,15 @@ class _ShardRouter:
             for t, m, out in pending:
                 self._engine.wait(t)
                 rows[m] = out
+        elif self._pool is not None:
+            futs = []
+            for s in range(self.n_shards):
+                if counts[s]:
+                    m = shard == s
+                    futs.append((m, self._pool.submit(
+                        sync_fn(self.stores[s]), local[m])))
+            for m, f in futs:
+                rows[m] = f.result()
         else:
             for s in range(self.n_shards):
                 if counts[s]:
@@ -85,10 +103,17 @@ class _ShardRouter:
         counts = np.bincount(shard, minlength=self.n_shards)
         self.push_rows_per_shard += counts
         grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
+        futs = []
         for s in range(self.n_shards):
             if counts[s]:
                 m = shard == s
-                self.stores[s].push(local[m], grads[m])
+                if self._pool is not None:
+                    futs.append(self._pool.submit(
+                        self.stores[s].push, local[m], grads[m]))
+                else:
+                    self.stores[s].push(local[m], grads[m])
+        for f in futs:
+            f.result()
 
 
 class ShardedHostEmbedding(StagedHostEmbedding):
@@ -129,9 +154,15 @@ class ShardedHostEmbedding(StagedHostEmbedding):
                            push_bound=push_bound) for t in self.tables]
         else:
             self.stores = list(self.tables)
-        self.store = _ShardRouter(self.stores, n_shards, dim)
+        self._wire()
+
+    def _wire(self):
+        """Install the shard router + staging leaves over self.tables/
+        self.stores (shared with subclasses that build different stores,
+        e.g. embed.net.RemoteHostEmbedding)."""
+        self.store = _ShardRouter(self.stores, self.n_shards, self.dim)
         self._handle = _HostHandle()
-        self.rows = jnp.zeros((1, dim), jnp.float32)  # placeholder leaf
+        self.rows = jnp.zeros((1, self.dim), jnp.float32)  # placeholder leaf
 
     # -- persistence ---------------------------------------------------------
     def flush(self):

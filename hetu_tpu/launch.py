@@ -24,11 +24,12 @@ import sys
 from typing import Optional, Sequence
 
 __all__ = ["DistConfig", "HostSpec", "initialize", "launch", "simulate_workers",
-           "worker_env", "main"]
+           "worker_env", "embed_server_addresses", "main"]
 
 ENV_COORD = "HETU_TPU_COORD"
 ENV_NPROC = "HETU_TPU_NPROC"
 ENV_PROC_ID = "HETU_TPU_PROC_ID"
+ENV_EMBED_SERVERS = "HETU_TPU_EMBED_SERVERS"
 
 
 @dataclasses.dataclass
@@ -36,6 +37,7 @@ class HostSpec:
     host: str
     workers: int = 1          # processes to start on this host
     chief: bool = False
+    servers: int = 0          # embedding-server processes on this host
 
 
 @dataclasses.dataclass
@@ -46,11 +48,14 @@ class DistConfig:
           - host: localhost     # or DNS/IP
             workers: 1          # processes on this host
             chief: true         # coordinator host (default: first)
+            servers: 0          # embedding-server (PS) processes on host
         port: 23456             # coordinator port
+        server_port: 9123       # first embedding-server port (consecutive)
     """
 
     hosts: list
     port: int = 23456
+    server_port: int = 9123
 
     @classmethod
     def from_yaml(cls, path: str) -> "DistConfig":
@@ -69,10 +74,12 @@ class DistConfig:
             else:
                 hosts.append(HostSpec(host=item.get("host", "localhost"),
                                       workers=int(item.get("workers", 1)),
-                                      chief=bool(item.get("chief", False))))
+                                      chief=bool(item.get("chief", False)),
+                                      servers=int(item.get("servers", 0))))
         if hosts and not any(h.chief for h in hosts):
             hosts[0].chief = True
-        return cls(hosts=hosts, port=int(raw.get("port", 23456)))
+        return cls(hosts=hosts, port=int(raw.get("port", 23456)),
+                   server_port=int(raw.get("server_port", 9123)))
 
     @property
     def chief(self) -> HostSpec:
@@ -95,6 +102,19 @@ class DistConfig:
                 pid += 1
         return table
 
+    def server_table(self) -> list:
+        """[(host, port)] for every embedding-server role (consecutive
+        ports per host starting at ``server_port``)."""
+        table = []
+        for h in self.hosts:
+            for s in range(h.servers):
+                table.append((h.host, self.server_port + s))
+        return table
+
+    @property
+    def server_addresses(self) -> list:
+        return [f"{host}:{port}" for host, port in self.server_table()]
+
 
 def worker_env(cfg: DistConfig, process_id: int,
                base_env: Optional[dict] = None) -> dict:
@@ -103,7 +123,16 @@ def worker_env(cfg: DistConfig, process_id: int,
     env[ENV_COORD] = cfg.coordinator_address
     env[ENV_NPROC] = str(cfg.num_processes)
     env[ENV_PROC_ID] = str(process_id)
+    if cfg.server_addresses:
+        env[ENV_EMBED_SERVERS] = ",".join(cfg.server_addresses)
     return env
+
+
+def embed_server_addresses() -> list:
+    """Embedding-server addresses the launcher exported for this worker
+    (for ``embed.net.RemoteHostEmbedding(servers=...)``)."""
+    raw = os.environ.get(ENV_EMBED_SERVERS, "")
+    return [a for a in raw.split(",") if a]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -137,11 +166,25 @@ def _remote_cmd(host: str, env: dict, argv: Sequence[str],
 
 def launch(cfg: DistConfig, argv: Sequence[str],
            extra_env: Optional[dict] = None, dry_run: bool = False):
-    """Start every worker in the cluster; local processes directly, remote
-    ones over ssh.  Returns the list of (process_id, Popen|command)."""
+    """Start every role in the cluster; local processes directly, remote
+    ones over ssh.  Embedding-server (PS) roles start first so workers can
+    connect immediately (runner.py spawns scheduler/servers before mpirun).
+    Returns the list of (role_id, Popen|command); server roles are tagged
+    ``"server:<addr>"``."""
     procs = []
     carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, "JAX_PLATFORMS", "XLA_FLAGS",
              "PYTHONPATH"] + sorted(extra_env or ())
+    for host, port in cfg.server_table():
+        srv_argv = [sys.executable, "-m", "hetu_tpu.embed.net",
+                    "--port", str(port)]
+        local = host in ("localhost", "127.0.0.1", os.uname().nodename)
+        cmd = srv_argv if local else _remote_cmd(host, dict(os.environ),
+                                                 srv_argv, carry)
+        tag = f"server:{host}:{port}"
+        if dry_run:
+            procs.append((tag, cmd))
+        else:
+            procs.append((tag, subprocess.Popen(cmd)))
     for host, _local_rank, pid in cfg.process_table():
         env = worker_env(cfg, pid)
         if extra_env:
@@ -217,8 +260,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for pid, cmd in procs:
             print(f"[{pid}] {shlex.join(cmd) if isinstance(cmd, list) else cmd}")
         return 0
-    # wait on every worker (reap all children), then report the first failure
-    rcs = [p.wait() for _pid, p in procs]
+    # wait on every worker (server roles run until the workers finish, then
+    # are terminated — runner.py kills PS roles the same way), report the
+    # first worker failure
+    workers = [(pid, p) for pid, p in procs if not str(pid).startswith("server:")]
+    servers = [(pid, p) for pid, p in procs if str(pid).startswith("server:")]
+    rcs = [p.wait() for _pid, p in workers]
+    for _tag, p in servers:
+        p.terminate()
+        p.wait()
     return next((r for r in rcs if r), 0)
 
 

@@ -65,7 +65,16 @@ class TransformerBlock(Module):
         self.ln2 = LayerNorm(dim)
         self.mlp = mlp if mlp is not None else TransformerMLP(
             dim, mlp_ratio * dim, dtype=dtype)
-        self._mlp_takes_training = mlp is not None
+        # detect from the signature whether the FFN accepts training=
+        # (MoELayer does; a plain (x)->y FFN like TransformerMLP does not)
+        import inspect
+        try:
+            params = inspect.signature(self.mlp.__call__).parameters
+            self._mlp_takes_training = "training" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):
+            self._mlp_takes_training = False
         self.post_ln = post_ln
         self.dropout_rate = dropout_rate
 

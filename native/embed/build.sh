@@ -5,4 +5,4 @@ set -e
 cd "$(dirname "$0")"
 mkdir -p ../../build
 g++ -O3 -march=native -fPIC -shared -std=c++17 -pthread \
-    embed_engine.cpp -o ../../build/libhetu_embed.so
+    embed_engine.cpp ps_net.cpp -o ../../build/libhetu_embed.so

@@ -1,0 +1,451 @@
+// TCP parameter-server transport for the host embedding engine.
+//
+// The reference runs its embedding tables in separate parameter-server
+// processes reached over a network transport (ps-lite: ZMQVan zmq_van.h:31,
+// typed RPCs PSFunc.h:33-57, server-side optimizer PSFHandle.h:17; roles
+// wired by runner.py).  This file is the TPU-rebuild equivalent: a compact
+// length-prefixed TCP protocol exposing the SAME table operations the
+// in-process engine provides (embed_engine.cpp) — pull / push-with-
+// server-side-optimizer / set / save / load — plus a counting barrier for
+// worker coordination.  One server process can host many tables; workers
+// key-partition tables across several servers exactly like ps-lite's
+// key-range partitioner (include/ps/worker/partitioner.h).
+//
+// Concurrency: each connection gets a thread (worker counts are small);
+// table row updates are serialized by the engine's per-table apply lock,
+// and concurrent pull-during-push exhibits the usual asynchronous-PS
+// semantics (the reference's default ASP mode).
+//
+// Exposed as extern "C" for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---- engine API (defined in embed_engine.cpp, linked into the same .so) ----
+extern "C" {
+void* het_table_create(int64_t rows, int64_t dim, int opt_kind, float lr,
+                       float momentum, float beta1, float beta2, float eps,
+                       float weight_decay, uint64_t seed, float init_scale);
+void het_table_destroy(void* h);
+void het_table_set_lr(void* h, float lr);
+void het_table_pull(void* h, const int64_t* keys, int64_t n, float* out);
+void het_table_push(void* h, const int64_t* keys, int64_t n,
+                    const float* grads);
+void het_table_set_rows(void* h, const int64_t* keys, int64_t n,
+                        const float* vals);
+int het_table_save(void* h, const char* path);
+int het_table_load(void* h, const char* path);
+}
+
+namespace {
+
+enum Op : uint32_t {
+  kCreate = 1,
+  kPull = 2,
+  kPush = 3,
+  kSetRows = 4,
+  kSave = 5,
+  kLoad = 6,
+  kSetLr = 7,
+  kBarrier = 8,
+};
+
+struct ReqHeader {
+  uint32_t op;
+  uint32_t table_id;
+  int64_t nkeys;
+  int64_t nfloats;
+  int64_t nbytes;
+};
+
+struct RespHeader {
+  int64_t status;
+  int64_t nfloats;
+};
+
+bool keys_in_range(const std::vector<int64_t>& keys, int64_t rows) {
+  for (int64_t k : keys)
+    if (k < 0 || k >= rows) return false;
+  return true;
+}
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct TableEntry {
+  void* handle = nullptr;
+  int64_t rows = 0;
+  int64_t dim = 0;
+};
+
+struct Barrier {
+  int count = 0;
+  uint64_t generation = 0;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex mu;  // tables + conns + barriers
+  std::map<uint32_t, TableEntry> tables;
+  std::map<uint32_t, Barrier> barriers;
+  std::condition_variable barrier_cv;
+  std::vector<int> conn_fds;
+
+  ~Server() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    {
+      // unblock handler threads stuck in recv() on live client sockets and
+      // in barrier waits
+      std::lock_guard<std::mutex> lk(mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      barrier_cv.notify_all();
+    }
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+    for (auto& kv : tables) het_table_destroy(kv.second.handle);
+  }
+
+  void handle_conn(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<int64_t> keys;
+    std::vector<float> floats;
+    std::vector<char> bytes;
+    // a stray/corrupt client must never take the server down: bound every
+    // header field before resizing, and reject unknown ops (the reference
+    // PS survives garbage via protobuf framing; here the frame IS the check)
+    constexpr int64_t kMaxElems = int64_t(1) << 31;
+    while (!stop.load()) {
+      ReqHeader h;
+      if (!read_full(fd, &h, sizeof(h))) break;
+      if (h.op < kCreate || h.op > kBarrier || h.nkeys < 0 ||
+          h.nfloats < 0 || h.nbytes < 0 || h.nkeys > kMaxElems ||
+          h.nfloats > kMaxElems || h.nbytes > kMaxElems)
+        break;  // not our protocol — drop the connection
+      keys.resize(h.nkeys);
+      floats.resize(h.nfloats);
+      bytes.resize(h.nbytes);
+      if (h.nkeys && !read_full(fd, keys.data(), h.nkeys * 8)) break;
+      if (h.nfloats && !read_full(fd, floats.data(), h.nfloats * 4)) break;
+      if (h.nbytes && !read_full(fd, bytes.data(), h.nbytes)) break;
+
+      RespHeader resp{0, 0};
+      std::vector<float> out;
+      switch (h.op) {
+        case kCreate: {
+          // keys = [rows, dim, opt_kind, seed];
+          // floats = [lr, momentum, beta1, beta2, eps, weight_decay,
+          //           init_scale]
+          if (h.nkeys < 4 || h.nfloats < 7 || keys[0] <= 0 || keys[1] <= 0) {
+            resp.status = -3;
+            break;
+          }
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = tables.find(h.table_id);
+          if (it != tables.end()) {
+            // idempotent re-create (a second worker attaching): verify shape
+            resp.status = (it->second.rows == keys[0] &&
+                           it->second.dim == keys[1]) ? 1 : -1;
+            break;
+          }
+          TableEntry e;
+          e.rows = keys[0];
+          e.dim = keys[1];
+          e.handle = het_table_create(
+              keys[0], keys[1], static_cast<int>(keys[2]), floats[0],
+              floats[1], floats[2], floats[3], floats[4], floats[5],
+              static_cast<uint64_t>(keys[3]), floats[6]);
+          tables[h.table_id] = e;
+          break;
+        }
+        case kPull: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (!keys_in_range(keys, e.rows)) { resp.status = -4; break; }
+          out.resize(h.nkeys * e.dim);
+          het_table_pull(e.handle, keys.data(), h.nkeys, out.data());
+          resp.nfloats = static_cast<int64_t>(out.size());
+          break;
+        }
+        case kPush: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (!keys_in_range(keys, e.rows) ||
+              h.nfloats != h.nkeys * e.dim) { resp.status = -4; break; }
+          het_table_push(e.handle, keys.data(), h.nkeys, floats.data());
+          break;
+        }
+        case kSetRows: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (!keys_in_range(keys, e.rows) ||
+              h.nfloats != h.nkeys * e.dim) { resp.status = -4; break; }
+          het_table_set_rows(e.handle, keys.data(), h.nkeys, floats.data());
+          break;
+        }
+        case kSave: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          std::string path(bytes.begin(), bytes.end());
+          resp.status = het_table_save(e.handle, path.c_str());
+          break;
+        }
+        case kLoad: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          std::string path(bytes.begin(), bytes.end());
+          resp.status = het_table_load(e.handle, path.c_str());
+          break;
+        }
+        case kSetLr: {
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (h.nfloats < 1) { resp.status = -3; break; }
+          het_table_set_lr(e.handle, floats[0]);
+          break;
+        }
+        case kBarrier: {
+          // table_id = barrier id, keys[0] = world size.  Counting barrier
+          // with generations so it is reusable (ps-lite BarrierWorker).
+          if (h.nkeys < 1 || keys[0] < 1) { resp.status = -3; break; }
+          int world = static_cast<int>(keys[0]);
+          std::unique_lock<std::mutex> lk(mu);
+          Barrier& b = barriers[h.table_id];
+          uint64_t gen = b.generation;
+          if (++b.count >= world) {
+            b.count = 0;
+            b.generation++;
+            barrier_cv.notify_all();
+          } else {
+            barrier_cv.wait(lk, [&] {
+              return b.generation != gen || stop.load();
+            });
+          }
+          break;
+        }
+        default:
+          resp.status = -100;
+      }
+      if (!write_full(fd, &resp, sizeof(resp))) break;
+      if (resp.nfloats &&
+          !write_full(fd, out.data(), resp.nfloats * 4)) break;
+    }
+    {
+      // prune before close: once closed the fd number can be recycled by an
+      // unrelated socket, and the destructor must not shutdown() that one
+      std::lock_guard<std::mutex> lk(mu);
+      conn_fds.erase(std::find(conn_fds.begin(), conn_fds.end(), fd));
+    }
+    ::close(fd);
+  }
+
+  TableEntry lookup(uint32_t id) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? TableEntry{} : it->second;
+  }
+
+  void accept_loop() {
+    while (!stop.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(mu);
+      conn_fds.push_back(fd);
+      conns.emplace_back([this, fd] { handle_conn(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one in-flight request per connection
+
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  int64_t request(const ReqHeader& h, const int64_t* keys,
+                  const float* floats, const char* bytes, float* out,
+                  int64_t out_floats) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!write_full(fd, &h, sizeof(h))) return -10;
+    if (h.nkeys && !write_full(fd, keys, h.nkeys * 8)) return -10;
+    if (h.nfloats && !write_full(fd, floats, h.nfloats * 4)) return -10;
+    if (h.nbytes && !write_full(fd, bytes, h.nbytes)) return -10;
+    RespHeader r;
+    if (!read_full(fd, &r, sizeof(r))) return -11;
+    if (r.nfloats) {
+      if (r.nfloats != out_floats || !out) {
+        // drain to keep the stream consistent, then report
+        std::vector<float> sink(r.nfloats);
+        read_full(fd, sink.data(), r.nfloats * 4);
+        return -12;
+      }
+      if (!read_full(fd, out, r.nfloats * 4)) return -11;
+    }
+    return r.status;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* het_ps_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 64) != 0) {
+    delete s;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int het_ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void het_ps_server_stop(void* h) { delete static_cast<Server*>(h); }
+
+void* het_ps_connect(const char* host, int port) {
+  // resolve via getaddrinfo so yaml hostnames ("localhost", DNS names) work,
+  // not just dotted-quad IPs
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
+    return nullptr;
+  auto* c = new Client();
+  c->fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (c->fd < 0 || ::connect(c->fd, res->ai_addr, res->ai_addrlen) != 0) {
+    ::freeaddrinfo(res);
+    delete c;
+    return nullptr;
+  }
+  ::freeaddrinfo(res);
+  int one = 1;
+  ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+void het_ps_disconnect(void* h) { delete static_cast<Client*>(h); }
+
+int64_t het_ps_create_table(void* h, uint32_t table_id, int64_t rows,
+                            int64_t dim, int opt_kind, float lr,
+                            float momentum, float beta1, float beta2,
+                            float eps, float weight_decay, uint64_t seed,
+                            float init_scale) {
+  int64_t keys[4] = {rows, dim, opt_kind, static_cast<int64_t>(seed)};
+  float floats[7] = {lr, momentum, beta1, beta2, eps, weight_decay,
+                     init_scale};
+  ReqHeader hh{kCreate, table_id, 4, 7, 0};
+  return static_cast<Client*>(h)->request(hh, keys, floats, nullptr, nullptr,
+                                          0);
+}
+
+int64_t het_ps_pull(void* h, uint32_t table_id, const int64_t* keys,
+                    int64_t n, int64_t dim, float* out) {
+  ReqHeader hh{kPull, table_id, n, 0, 0};
+  return static_cast<Client*>(h)->request(hh, keys, nullptr, nullptr, out,
+                                          n * dim);
+}
+
+int64_t het_ps_push(void* h, uint32_t table_id, const int64_t* keys,
+                    int64_t n, int64_t dim, const float* grads) {
+  ReqHeader hh{kPush, table_id, n, n * dim, 0};
+  return static_cast<Client*>(h)->request(hh, keys, grads, nullptr, nullptr,
+                                          0);
+}
+
+int64_t het_ps_set_rows(void* h, uint32_t table_id, const int64_t* keys,
+                        int64_t n, int64_t dim, const float* vals) {
+  ReqHeader hh{kSetRows, table_id, n, n * dim, 0};
+  return static_cast<Client*>(h)->request(hh, keys, vals, nullptr, nullptr,
+                                          0);
+}
+
+int64_t het_ps_save(void* h, uint32_t table_id, const char* path) {
+  ReqHeader hh{kSave, table_id, 0, 0,
+               static_cast<int64_t>(std::strlen(path))};
+  return static_cast<Client*>(h)->request(hh, nullptr, nullptr, path, nullptr,
+                                          0);
+}
+
+int64_t het_ps_load(void* h, uint32_t table_id, const char* path) {
+  ReqHeader hh{kLoad, table_id, 0, 0,
+               static_cast<int64_t>(std::strlen(path))};
+  return static_cast<Client*>(h)->request(hh, nullptr, nullptr, path, nullptr,
+                                          0);
+}
+
+int64_t het_ps_set_lr(void* h, uint32_t table_id, float lr) {
+  ReqHeader hh{kSetLr, table_id, 0, 1, 0};
+  return static_cast<Client*>(h)->request(hh, nullptr, &lr, nullptr, nullptr,
+                                          0);
+}
+
+int64_t het_ps_barrier(void* h, uint32_t barrier_id, int64_t world) {
+  ReqHeader hh{kBarrier, barrier_id, 1, 0, 0};
+  return static_cast<Client*>(h)->request(hh, &world, nullptr, nullptr,
+                                          nullptr, 0);
+}
+
+}  // extern "C"

@@ -113,3 +113,37 @@ class TestRealWorld:
             "RESULT pid=0 global_devices=4 psum=6.0",
             "RESULT pid=1 global_devices=4 psum=6.0",
         ]
+
+
+def test_server_roles_in_cluster_yaml(tmp_path):
+    """Embedding-server (PS) roles: yaml -> server table -> dry-run commands
+    + worker env carrying the server addresses (runner.py role spawning)."""
+    import hetu_tpu.launch as L
+
+    cfg_file = tmp_path / "cluster.yml"
+    cfg_file.write_text(
+        "nodes:\n"
+        "  - host: localhost\n"
+        "    workers: 1\n"
+        "    chief: true\n"
+        "    servers: 2\n"
+        "  - host: otherhost\n"
+        "    workers: 1\n"
+        "    servers: 1\n"
+        "server_port: 9500\n")
+    cfg = L.DistConfig.from_yaml(str(cfg_file))
+    assert cfg.server_addresses == [
+        "localhost:9500", "localhost:9501", "otherhost:9500"]
+    procs = L.launch(cfg, ["python", "train.py"], dry_run=True)
+    tags = [t for t, _ in procs]
+    assert tags[:3] == ["server:localhost:9500", "server:localhost:9501",
+                        "server:otherhost:9500"]
+    env = L.worker_env(cfg, 0)
+    assert env[L.ENV_EMBED_SERVERS] == (
+        "localhost:9500,localhost:9501,otherhost:9500")
+    import os
+    os.environ[L.ENV_EMBED_SERVERS] = env[L.ENV_EMBED_SERVERS]
+    try:
+        assert L.embed_server_addresses() == cfg.server_addresses
+    finally:
+        del os.environ[L.ENV_EMBED_SERVERS]

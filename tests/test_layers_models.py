@@ -169,3 +169,17 @@ def test_bert_downstream_heads():
     loss, aux = cls.loss(ids, tt, None, jnp.asarray([0, 2]),
                          key=jax.random.key(0))
     assert np.isfinite(float(loss)) and 0.0 <= float(aux["accuracy"]) <= 1.0
+
+
+def test_transformer_block_custom_plain_mlp():
+    """mlp= override with a plain (x)->y FFN (no training kwarg)."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.layers import TransformerBlock
+    from hetu_tpu.layers.transformer import TransformerMLP
+
+    set_random_seed(0)
+    blk = TransformerBlock(16, 2, mlp=TransformerMLP(16, 48))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4, 16)),
+                    jnp.float32)
+    y = blk(x)
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
