@@ -21,10 +21,12 @@ from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
 from hetu_tpu.embed.net import (EmbeddingServer, RemoteEmbeddingTable,
                                 RemoteHostEmbedding)
+from hetu_tpu.embed.ps_dp import PSDataParallel
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
     "EmbeddingServer", "RemoteEmbeddingTable", "RemoteHostEmbedding",
+    "PSDataParallel",
 ]

@@ -64,6 +64,9 @@ def _lib():
                           ctypes.c_int64),
         "het_ps_barrier": ([ctypes.c_void_p, ctypes.c_uint32,
                             ctypes.c_int64], ctypes.c_int64),
+        "het_ps_ssp_sync": ([ctypes.c_void_p, ctypes.c_uint32,
+                             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                             ctypes.c_int64], ctypes.c_int64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -197,6 +200,15 @@ class RemoteEmbeddingTable:
         server (reference BarrierWorker)."""
         self._check(self._lib.het_ps_barrier(self._c, barrier_id, world),
                     "barrier")
+
+    def ssp_sync(self, group_id: int, worker: int, clock: int,
+                 staleness: int, world: int):
+        """Commit this worker's clock and block until no peer lags more than
+        ``staleness`` clocks (reference kSSPSync, ssp_handler.h:12 — over
+        the wire).  staleness 0 = BSP lockstep; large = ASP."""
+        self._check(self._lib.het_ps_ssp_sync(self._c, group_id, worker,
+                                              clock, staleness, world),
+                    "ssp_sync")
 
     def close(self):
         if getattr(self, "_c", None):

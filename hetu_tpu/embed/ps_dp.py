@@ -1,0 +1,180 @@
+"""PS-mode data parallelism: dense parameters trained through the network
+parameter server.
+
+The reference's ``comm_mode='PS'`` (HetuConfig executor.py:220-224): every
+worker computes gradients locally, pushes them to the PS (DDPushPull,
+ps-lite python_binding.cc), the SERVER applies the optimizer
+(PSFHandle.h:17, optimizer.h:25), and workers pull fresh parameters.
+Consistency is the ``bsp`` flag: -1 = ASP (no coordination), 0 = BSP
+(lockstep barrier), k>0 = SSP (bounded staleness k; ssp_handler.h:12).
+
+TPU-native shape: the jitted part is pure local compute (value_and_grad);
+the push/pull runs host-side between steps, chunked so arbitrarily-shaped
+dense params map onto PS tables partitioned across servers.  On-mesh
+allreduce DP (parallel/strategies.DataParallel) remains the fast path on
+ICI; this mode exists for the reference's asynchronous/elastic semantics
+across DCN-separated workers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.module import trainable_mask
+from hetu_tpu.embed.net import RemoteEmbeddingTable
+
+__all__ = ["PSDataParallel"]
+
+# group ids occupy the high 12 bits of the uint32 table id (leaf index in
+# the low 20), so ids can never collide across groups
+_MAX_GROUPS = 1 << 12
+_MAX_LEAVES = 1 << 20
+_next_group = itertools.count(1)
+
+MODES = ("asp", "bsp", "ssp")
+
+
+class _LeafTable:
+    """One dense param leaf chunked into rows of a PS table."""
+
+    def __init__(self, address: str, table_id: int, leaf, *, chunk: int,
+                 optimizer: str, lr: float, weight_decay: float):
+        self.shape = tuple(leaf.shape)
+        self.dtype = leaf.dtype
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+        self.chunk = min(chunk, max(self.size, 1))
+        self.rows = -(-self.size // self.chunk)
+        self.pad = self.rows * self.chunk - self.size
+        self.table = RemoteEmbeddingTable(
+            address, table_id, self.rows, self.chunk, optimizer=optimizer,
+            lr=lr, weight_decay=weight_decay, init_scale=0.0)
+        self._all_rows = np.arange(self.rows, dtype=np.int64)
+
+    def _to_rows(self, arr) -> np.ndarray:
+        flat = np.asarray(arr, np.float32).reshape(-1)
+        if self.pad:
+            flat = np.concatenate([flat, np.zeros(self.pad, np.float32)])
+        return flat.reshape(self.rows, self.chunk)
+
+    def init(self, leaf):
+        self.table.set_rows(self._all_rows, self._to_rows(leaf))
+
+    def push_grad(self, grad):
+        self.table.push(self._all_rows, self._to_rows(grad))
+
+    def pull(self):
+        flat = self.table.pull(self._all_rows).reshape(-1)
+        if self.pad:
+            flat = flat[: self.size]
+        return jnp.asarray(flat.reshape(self.shape), self.dtype)
+
+
+class PSDataParallel:
+    """Dense-parameter PS training loop (reference PS comm mode).
+
+    ``loss_fn(model, batch, key) -> (loss, aux)`` like ``exec.Trainer``.
+    ``mode``: 'asp' | 'bsp' | 'ssp' (with ``staleness``) — the reference's
+    bsp flag -1/0/k.  ``worker``/``world`` identify this process;
+    ``worker == 0`` initializes the server-side tables, everyone else
+    attaches (barriered so no one trains on uninitialized params).
+    """
+
+    def __init__(self, model, loss_fn, servers, *, optimizer: str = "sgd",
+                 lr: float = 0.01, weight_decay: float = 0.0,
+                 worker: int = 0, world: int = 1, mode: str = "asp",
+                 staleness: int = 0, chunk: int = 1024,
+                 group_id: int | None = None):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}")
+        servers = list(servers)
+        self.model = model
+        self.loss_fn = loss_fn
+        self.worker, self.world = worker, world
+        self.mode, self.staleness = mode, staleness
+        self.clock = 0
+        self.group_id = group_id if group_id is not None else next(_next_group)
+        if not 0 < self.group_id < _MAX_GROUPS:
+            raise ValueError(f"group_id must be in (0, {_MAX_GROUPS})")
+
+        mask = trainable_mask(model)
+        leaves, self._treedef = jax.tree_util.tree_flatten(model)
+        mask_leaves = self._treedef.flatten_up_to(mask)
+        self._trainable = [
+            bool(m) and hasattr(l, "dtype")
+            and jnp.issubdtype(l.dtype, jnp.floating)
+            for l, m in zip(leaves, mask_leaves)
+        ]
+        if len(leaves) >= _MAX_LEAVES:
+            raise ValueError(f"model has {len(leaves)} leaves; max "
+                             f"{_MAX_LEAVES - 1} per PS group")
+        # leaf i lives on servers[i % len(servers)] — the ps-lite key-range
+        # spread of params over servers
+        self._tables = []
+        for i, (leaf, tr) in enumerate(zip(leaves, self._trainable)):
+            self._tables.append(
+                _LeafTable(servers[i % len(servers)],
+                           (self.group_id << 20) | i, leaf, chunk=chunk,
+                           optimizer=optimizer, lr=lr,
+                           weight_decay=weight_decay) if tr else None)
+        # push/pull RTTs to different tables/servers overlap on a thread
+        # pool (each table has its own connection+lock); finalizer shuts the
+        # pool down so long-lived processes don't accumulate idle threads
+        self._pool = ThreadPoolExecutor(
+            min(max(sum(t is not None for t in self._tables), 1), 8))
+        weakref.finalize(self, self._pool.shutdown, wait=False)
+        try:
+            self._coord = next(t for t in self._tables if t is not None)
+        except StopIteration:
+            raise ValueError("model has no trainable floating-point "
+                             "parameters to train through the PS") from None
+        if worker == 0:
+            for leaf, t in zip(leaves, self._tables):
+                if t is not None:
+                    t.init(leaf)
+        if world > 1:
+            self._coord.table.barrier(self.group_id, world)  # init visible
+        self._refresh()
+
+        def grads_fn(model, batch, key):
+            def wrapped(m):
+                loss, aux = loss_fn(m, batch, key)
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                wrapped, has_aux=True)(model)
+            return loss, aux, grads
+
+        self._grads_fn = jax.jit(grads_fn)
+
+    def _refresh(self):
+        leaves = self._treedef.flatten_up_to(self.model)
+        futs = [self._pool.submit(t.pull) if t is not None else None
+                for t in self._tables]
+        new = [f.result() if f is not None else l
+               for l, f in zip(leaves, futs)]
+        self.model = jax.tree_util.tree_unflatten(self._treedef, new)
+
+    def step(self, batch, key=None) -> dict:
+        loss, aux, grads = self._grads_fn(self.model, batch, key)
+        g_leaves = self._treedef.flatten_up_to(grads)
+        futs = [self._pool.submit(t.push_grad, g)
+                for g, t in zip(g_leaves, self._tables)
+                if t is not None and g is not None]
+        for f in futs:
+            f.result()
+        self.clock += 1
+        if self.world > 1:
+            if self.mode == "bsp":
+                self._coord.table.barrier(self.group_id, self.world)
+            elif self.mode == "ssp":
+                self._coord.table.ssp_sync(self.group_id, self.worker,
+                                           self.clock, self.staleness,
+                                           self.world)
+        self._refresh()
+        return {"loss": loss, **aux}

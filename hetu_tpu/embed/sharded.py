@@ -54,8 +54,12 @@ class _ShardRouter:
         self._pool = None
         if (n_shards > 1 and not self._cached
                 and all(getattr(s, "parallel_pull", False) for s in stores)):
+            import weakref
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(min(n_shards, 8))
+            # shut the pool down when the router is collected so long-lived
+            # processes constructing many embeddings don't leak idle threads
+            weakref.finalize(self, self._pool.shutdown, wait=False)
         # per-shard traffic counters — the reference PS's load monitoring
         # (startRecord/getLoads, gpu_ops/executor.py:398-401,675), used to
         # spot hot shards needing rebalance

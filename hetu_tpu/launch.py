@@ -172,7 +172,8 @@ def launch(cfg: DistConfig, argv: Sequence[str],
     Returns the list of (role_id, Popen|command); server roles are tagged
     ``"server:<addr>"``."""
     procs = []
-    carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, "JAX_PLATFORMS", "XLA_FLAGS",
+    carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, ENV_EMBED_SERVERS,
+             "JAX_PLATFORMS", "XLA_FLAGS",
              "PYTHONPATH"] + sorted(extra_env or ())
     for host, port in cfg.server_table():
         srv_argv = [sys.executable, "-m", "hetu_tpu.embed.net",

@@ -63,6 +63,7 @@ enum Op : uint32_t {
   kLoad = 6,
   kSetLr = 7,
   kBarrier = 8,
+  kSspSync = 9,
 };
 
 struct ReqHeader {
@@ -117,6 +118,10 @@ struct Barrier {
   uint64_t generation = 0;
 };
 
+struct SspGroup {
+  std::vector<int64_t> clocks;  // per-worker committed clock
+};
+
 struct Server {
   int listen_fd = -1;
   int port = 0;
@@ -126,6 +131,7 @@ struct Server {
   std::mutex mu;  // tables + conns + barriers
   std::map<uint32_t, TableEntry> tables;
   std::map<uint32_t, Barrier> barriers;
+  std::map<uint32_t, SspGroup> ssp_groups;
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
 
@@ -155,15 +161,17 @@ struct Server {
     std::vector<float> floats;
     std::vector<char> bytes;
     // a stray/corrupt client must never take the server down: bound every
-    // header field before resizing, and reject unknown ops (the reference
-    // PS survives garbage via protobuf framing; here the frame IS the check)
-    constexpr int64_t kMaxElems = int64_t(1) << 31;
+    // header field before resizing (16M elements ≈ 128 MB keys / 64 MB
+    // floats per frame — far above any real batch, far below anything that
+    // could OOM the server), and reject unknown ops (the reference PS
+    // survives garbage via protobuf framing; here the frame IS the check)
+    constexpr int64_t kMaxElems = int64_t(1) << 24;
     while (!stop.load()) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
-      if (h.op < kCreate || h.op > kBarrier || h.nkeys < 0 ||
-          h.nfloats < 0 || h.nbytes < 0 || h.nkeys > kMaxElems ||
-          h.nfloats > kMaxElems || h.nbytes > kMaxElems)
+      if (h.op < kCreate || h.op > kSspSync || h.nkeys < 0 ||
+          h.nfloats < 0 || h.nbytes < 0 || h.nkeys >= kMaxElems ||
+          h.nfloats >= kMaxElems || h.nbytes >= kMaxElems)
         break;  // not our protocol — drop the connection
       keys.resize(h.nkeys);
       floats.resize(h.nfloats);
@@ -174,6 +182,7 @@ struct Server {
 
       RespHeader resp{0, 0};
       std::vector<float> out;
+      try {
       switch (h.op) {
         case kCreate: {
           // keys = [rows, dim, opt_kind, seed];
@@ -204,7 +213,8 @@ struct Server {
         case kPull: {
           TableEntry e = lookup(h.table_id);
           if (!e.handle) { resp.status = -2; break; }
-          if (!keys_in_range(keys, e.rows)) { resp.status = -4; break; }
+          if (!keys_in_range(keys, e.rows) ||
+              h.nkeys * e.dim >= kMaxElems) { resp.status = -4; break; }
           out.resize(h.nkeys * e.dim);
           het_table_pull(e.handle, keys.data(), h.nkeys, out.data());
           resp.nfloats = static_cast<int64_t>(out.size());
@@ -266,8 +276,44 @@ struct Server {
           }
           break;
         }
+        case kSspSync: {
+          // Bounded-staleness clock sync (ssp_handler.h:12 semantics over
+          // the wire): table_id = group id, keys = [worker, clock,
+          // staleness, world].  Worker commits `clock` and blocks until no
+          // peer is more than `staleness` clocks behind.
+          if (h.nkeys < 4 || keys[0] < 0 || keys[0] >= keys[3] ||
+              keys[3] < 1 || keys[3] > (int64_t(1) << 20)) {
+            resp.status = -3;
+            break;
+          }
+          int64_t worker = keys[0], clock = keys[1], staleness = keys[2];
+          std::unique_lock<std::mutex> lk(mu);
+          SspGroup& g = ssp_groups[h.table_id];
+          if (g.clocks.empty()) g.clocks.assign(keys[3], 0);
+          // every member must agree on the group's world size — a stray
+          // request with a larger world must not index past the clock array
+          if (worker >= static_cast<int64_t>(g.clocks.size()) ||
+              keys[3] != static_cast<int64_t>(g.clocks.size())) {
+            resp.status = -3;
+            break;
+          }
+          g.clocks[worker] = clock;
+          barrier_cv.notify_all();
+          barrier_cv.wait(lk, [&] {
+            int64_t slowest = *std::min_element(g.clocks.begin(),
+                                                g.clocks.end());
+            return clock - slowest <= staleness || stop.load();
+          });
+          break;
+        }
         default:
           resp.status = -100;
+      }
+      } catch (...) {
+        // an exception must never escape the handler thread (std::terminate
+        // would take down the server hosting every table) — drop this
+        // connection only
+        break;
       }
       if (!write_full(fd, &resp, sizeof(resp))) break;
       if (resp.nfloats &&
@@ -446,6 +492,14 @@ int64_t het_ps_barrier(void* h, uint32_t barrier_id, int64_t world) {
   ReqHeader hh{kBarrier, barrier_id, 1, 0, 0};
   return static_cast<Client*>(h)->request(hh, &world, nullptr, nullptr,
                                           nullptr, 0);
+}
+
+int64_t het_ps_ssp_sync(void* h, uint32_t group_id, int64_t worker,
+                        int64_t clock, int64_t staleness, int64_t world) {
+  int64_t keys[4] = {worker, clock, staleness, world};
+  ReqHeader hh{kSspSync, group_id, 4, 0, 0};
+  return static_cast<Client*>(h)->request(hh, keys, nullptr, nullptr, nullptr,
+                                          0);
 }
 
 }  // extern "C"
