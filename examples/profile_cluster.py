@@ -1,0 +1,98 @@
+"""Hardware profiling for the auto-parallel cost model — the Galvatron
+workflow's first step (reference tools/Galvatron/test_env: allreduce/p2p
+bandwidth scripts; profile_forward.py model timing).
+
+Measures on the LIVE backend: MXU matmul throughput, per-mesh-axis
+collective bandwidth, and per-layer forward/backward step time for a probe
+transformer block; writes a ClusterSpec the searcher consumes
+(parallel/autoparallel/search.py dp_search).
+
+    python examples/profile_cluster.py                     # one chip
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/profile_cluster.py --mesh dp=2,tp=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mesh", default=None,
+                    help="axis spec like dp=2,tp=4 (default: single device)")
+    ap.add_argument("--matmul-n", type=int, default=2048)
+    ap.add_argument("--probe-hidden", type=int, default=512)
+    ap.add_argument("--probe-batch", type=int, default=8)
+    ap.add_argument("--probe-seq", type=int, default=128)
+    ap.add_argument("--out", default=None, help="write ClusterSpec json")
+    args = ap.parse_args()
+
+    import hetu_tpu as ht
+    from hetu_tpu.exec.profiler import profile_fn
+    from hetu_tpu.layers import TransformerBlock
+    from hetu_tpu.optim import SGDOptimizer
+    from hetu_tpu.parallel.autoparallel.profiler import CostProfiler
+    from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    prof = CostProfiler()
+    flops = prof.matmul_flops(args.matmul_n)
+    print(f"matmul throughput        : {flops/1e12:.2f} TFLOP/s "
+          f"(n={args.matmul_n}, {jax.devices()[0].device_kind})")
+
+    mesh = None
+    if args.mesh:
+        kw = dict(kv.split("=") for kv in args.mesh.split(","))
+        mesh = make_mesh(MeshSpec(**{k: int(v) for k, v in kw.items()}))
+        for ax, size in mesh.shape.items():
+            if size > 1:
+                bw = prof.collective_bandwidth(mesh, ax)
+                print(f"allreduce bw over '{ax}'    : {bw/1e9:.2f} GB/s "
+                      f"(axis size {size})")
+
+    # per-layer probe: fwd+bwd wall time of one transformer block (the
+    # reference profiles per-op exec times into /tmp/hetu_cached_exetime.bin)
+    ht.set_random_seed(0)
+    blk = TransformerBlock(args.probe_hidden, 8)
+    opt = SGDOptimizer(0.01)
+    state = opt.init(blk)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(args.probe_batch, args.probe_seq, args.probe_hidden)),
+        jnp.float32)
+
+    def step(blk, state, x):
+        def loss(b):
+            return b(x).astype(jnp.float32).mean()
+        l, g = jax.value_and_grad(loss)(blk)
+        blk, state = opt.update(g, state, blk)
+        return l, blk, state
+
+    timing = profile_fn(step, blk, state, x, iters=10)
+    print(f"probe block step         : {timing['mean_s']*1e3:.2f} ms "
+          f"(hidden {args.probe_hidden}, batch {args.probe_batch}, "
+          f"seq {args.probe_seq})")
+
+    spec = prof.calibrate(mesh)
+    print(f"calibrated ClusterSpec   : peak_flops={spec.peak_flops:.3e} "
+          f"ici_bw={spec.ici_bandwidth:.3e}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({
+                "peak_flops": spec.peak_flops,
+                "ici_bandwidth": spec.ici_bandwidth,
+                "dcn_bandwidth": spec.dcn_bandwidth,
+                "probe_block_ms": timing["mean_s"] * 1e3,
+            }, f, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,13 @@ def _is_dynamic(v: Any) -> bool:
         return any(_is_dynamic(x) for x in v)
     if isinstance(v, dict):
         return any(_is_dynamic(x) for x in v.values())
+    # registered-dataclass pytrees carrying arrays (ops.CSRMatrix,
+    # ops.IndexedSlices) are children too — e.g. the CSR inference-form
+    # embedding stores one as its table
+    if dataclasses.is_dataclass(v) and any(
+            isinstance(l, (jax.Array, np.ndarray))
+            for l in jtu.tree_leaves(v)):
+        return True
     return False
 
 

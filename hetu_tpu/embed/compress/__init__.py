@@ -22,7 +22,7 @@ from hetu_tpu.embed.compress.quant import (  # noqa: F401
 )
 from hetu_tpu.embed.compress.prune import (  # noqa: F401
     DeepLightEmbedding, PEPEmbedding, PEPRetrainEmbedding,
-    OptEmbedding, AutoSrhEmbedding,
+    OptEmbedding, AutoSrhEmbedding, SparseInferenceEmbedding,
 )
 from hetu_tpu.embed.compress.dim import (  # noqa: F401
     MDEmbedding, AutoDimEmbedding, md_solver,
@@ -54,4 +54,5 @@ ALL_METHODS = {
     "tt": TensorTrainEmbedding,
     "dedup": DedupEmbedding,
     "adapt": AdaptiveEmbedding,
+    "sparse": SparseInferenceEmbedding,  # inference-only CSR form
 }

@@ -216,3 +216,39 @@ class AutoSrhEmbedding(Module):
         hard = jnp.where(jnp.abs(self.alpha) >= thr,
                          jnp.ones_like(self.alpha), jnp.zeros_like(self.alpha))
         return self.replace(alpha=hard)
+
+
+class SparseInferenceEmbedding(Module):
+    """CSR inference form of a pruned table
+    (reference methods/layers/sparse.py SparseEmbedding: after DeepLight/PEP
+    training, the dense table converts to CSR via dense_to_sparse and serves
+    lookups through sparse_embedding_lookup_op — inference only).
+
+    Build with ``from_dense(weight)`` (e.g. a pruned DeepLightEmbedding's
+    weight); lookups gather rows from the CSR data block.  No gradient path
+    — the reference marks this 'only for inference'.
+    """
+
+    def __init__(self, csr, num_embeddings: int, embedding_dim: int):
+        self.csr = csr
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self._state_fields = ("csr",)
+
+    @classmethod
+    def from_dense(cls, weight, threshold: float = 0.0):
+        from hetu_tpu.ops import dense_to_csr
+
+        weight = jnp.asarray(weight)
+        return cls(dense_to_csr(weight, threshold), weight.shape[0],
+                   weight.shape[1])
+
+    def __call__(self, ids):
+        from hetu_tpu.ops import sparse_embedding_lookup
+
+        return jax.lax.stop_gradient(
+            sparse_embedding_lookup(self.csr, ids))
+
+    def nnz(self) -> int:
+        """Stored non-zeros (the compression the CSR form realizes)."""
+        return int((self.csr.data != 0).sum())

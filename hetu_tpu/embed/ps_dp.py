@@ -40,6 +40,11 @@ _next_group = itertools.count(1)
 MODES = ("asp", "bsp", "ssp")
 
 
+# stay well under the server's per-frame element cap (ps_net.cpp kMaxElems,
+# 2^24) — big leaves (a 30k x 768 embedding is 23M floats) move in segments
+_MAX_FLOATS_PER_REQ = 1 << 22
+
+
 class _LeafTable:
     """One dense param leaf chunked into rows of a PS table."""
 
@@ -55,6 +60,7 @@ class _LeafTable:
             address, table_id, self.rows, self.chunk, optimizer=optimizer,
             lr=lr, weight_decay=weight_decay, init_scale=0.0)
         self._all_rows = np.arange(self.rows, dtype=np.int64)
+        self._rows_per_req = max(1, _MAX_FLOATS_PER_REQ // self.chunk)
 
     def _to_rows(self, arr) -> np.ndarray:
         flat = np.asarray(arr, np.float32).reshape(-1)
@@ -62,14 +68,25 @@ class _LeafTable:
             flat = np.concatenate([flat, np.zeros(self.pad, np.float32)])
         return flat.reshape(self.rows, self.chunk)
 
+    def _segments(self):
+        for lo in range(0, self.rows, self._rows_per_req):
+            yield lo, min(lo + self._rows_per_req, self.rows)
+
     def init(self, leaf):
-        self.table.set_rows(self._all_rows, self._to_rows(leaf))
+        rows = self._to_rows(leaf)
+        for lo, hi in self._segments():
+            self.table.set_rows(self._all_rows[lo:hi], rows[lo:hi])
 
     def push_grad(self, grad):
-        self.table.push(self._all_rows, self._to_rows(grad))
+        rows = self._to_rows(grad)
+        for lo, hi in self._segments():
+            self.table.push(self._all_rows[lo:hi], rows[lo:hi])
 
     def pull(self):
-        flat = self.table.pull(self._all_rows).reshape(-1)
+        out = np.empty((self.rows, self.chunk), np.float32)
+        for lo, hi in self._segments():
+            out[lo:hi] = self.table.pull(self._all_rows[lo:hi])
+        flat = out.reshape(-1)
         if self.pad:
             flat = flat[: self.size]
         return jnp.asarray(flat.reshape(self.shape), self.dtype)

@@ -29,17 +29,29 @@ __all__ = ["CostProfiler"]
 _DEFAULT_CACHE = pathlib.Path.home() / ".cache" / "hetu_tpu_profile.json"
 
 
-def _timed(fn, *args, iters: int = 5) -> float:
-    """Median wall time of fn; syncs via host transfer of a scalar."""
+def _timed(fn, *args, iters: int = 5, chain: int = 8) -> float:
+    """Per-call wall time of fn.
+
+    The device→host sync is very expensive on tunneled backends (~130 ms on
+    the axon TPU path — see bench.py), so each sample times a CHAIN of
+    data-dependent calls with ONE trailing scalar transfer and divides; the
+    min over samples drops stall outliers.  fn must map its first arg's
+    shape to an output reusable as that arg (all profiler probes do).
+    """
     out = fn(*args)
     float(jnp.asarray(out).ravel()[0])  # compile + sync
+    chained = out.shape == jnp.shape(args[0]) and out.dtype == args[0].dtype
+    if not chained:
+        chain = 1
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = fn(*args)
-        float(jnp.asarray(out).ravel()[0])
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+        a = args[0]
+        for _i in range(chain):
+            a = fn(a, *args[1:]) if chained else fn(*args)
+        float(jnp.asarray(a).ravel()[0])
+        times.append((time.perf_counter() - t0) / chain)
+    return float(np.min(times))
 
 
 class CostProfiler:
@@ -52,9 +64,14 @@ class CostProfiler:
             except (json.JSONDecodeError, OSError):
                 self._cache = {}
 
+    # bump when probe methodology changes, else old caches silently serve
+    # measurements taken with the previous (overhead-dominated) probes
+    _PROBE_VERSION = "v2"
+
     def _key(self, what: str) -> str:
         dev = jax.devices()[0]
-        return f"{getattr(dev, 'device_kind', dev.platform)}/{what}"
+        return (f"{getattr(dev, 'device_kind', dev.platform)}/{what}/"
+                f"{self._PROBE_VERSION}")
 
     def _memo(self, what: str, compute):
         key = self._key(what)
@@ -70,14 +87,25 @@ class CostProfiler:
         def compute():
             a = jnp.ones((n, n), jnp.bfloat16)
 
+            # enough matmuls per dispatch that launch/tunnel overhead is
+            # noise next to the compute (measured on v5e: 64 loops → 57
+            # TFLOP/s apparent, 512 → 155 ≈ 79% of peak); CPU runs the same
+            # probe shape at ~1000x less throughput, so scale down there
+            dev0 = jax.devices()[0]
+            on_acc = dev0.platform in ("tpu", "gpu", "axon") or \
+                "TPU" in str(getattr(dev0, "device_kind", ""))
+            loops = 512 if on_acc else 4
+
             @jax.jit
             def mm(a):
+                # returns a's shape/dtype so _timed can chain calls
+                # data-dependently and amortize the host-sync cost
                 return jax.lax.fori_loop(
-                    0, 8, lambda i, x: (x @ a).astype(jnp.bfloat16) * 0.5, a
-                ).astype(jnp.float32).mean()
+                    0, loops, lambda i, x: (x @ a).astype(jnp.bfloat16) * 0.5,
+                    a)
 
             dt = _timed(mm, a)
-            return 8 * 2 * n**3 / dt
+            return loops * 2 * n**3 / dt
 
         return self._memo(f"matmul{n}", compute)
 

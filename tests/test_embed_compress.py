@@ -271,7 +271,7 @@ class TestTTDedupAdapt:
 
 class TestScheduler:
     def test_registry_complete(self):
-        assert len(ALL_METHODS) == 18
+        assert len(ALL_METHODS) == 19
 
     def test_deeplight_schedule(self):
         layer = DeepLightEmbedding(VOCAB, DIM, prune_rate=0.5)
@@ -298,3 +298,22 @@ class TestScheduler:
             layer = sched.step(layer)
         assert sched.done
         assert set(np.unique(np.asarray(layer.alpha))) <= {0.0, 1.0}
+
+
+def test_sparse_inference_embedding():
+    """Prune -> CSR inference form roundtrip (reference layers/sparse.py)."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.embed.compress import (DeepLightEmbedding,
+                                         SparseInferenceEmbedding)
+
+    set_random_seed(0)
+    emb = DeepLightEmbedding(30, 6, prune_rate=0.8)
+    pruned = emb.prune(step=10_000)  # near-asymptotic rate
+    sp = SparseInferenceEmbedding.from_dense(pruned.weight)
+    ids = jnp.asarray([[0, 7], [29, 7]])
+    np.testing.assert_allclose(np.asarray(sp(ids)),
+                               np.asarray(pruned(ids)), rtol=1e-6)
+    assert sp.nnz() < emb.weight.size * 0.5  # actually sparse
+    # no gradient flows (inference-only)
+    g = jax.grad(lambda m: m(ids).sum(), allow_int=True)(sp)
+    assert float(jnp.abs(g.csr.data).sum()) == 0.0

@@ -117,3 +117,28 @@ def test_two_worker_processes(mode, staleness, tmp_path):
         if mode == "bsp":
             np.testing.assert_allclose(results[0][2], results[1][2],
                                        rtol=1e-4)
+
+
+def test_large_leaf_segmented_transfer():
+    """Leaves above the server's per-frame cap move in segments
+    (regression: a 23M-float embedding leaf must survive init/push/pull)."""
+    import hetu_tpu.embed.ps_dp as psdp
+
+    old = psdp._MAX_FLOATS_PER_REQ
+    psdp._MAX_FLOATS_PER_REQ = 256  # force many segments without big arrays
+    try:
+        with EmbeddingServer() as srv:
+            leaf = jnp.asarray(np.random.default_rng(0).normal(
+                size=(40, 33)).astype(np.float32))
+            t = psdp._LeafTable(f"127.0.0.1:{srv.port}", 9, leaf, chunk=33,
+                                optimizer="sgd", lr=1.0, weight_decay=0.0)
+            assert t._rows_per_req < t.rows  # actually segmented
+            t.init(leaf)
+            np.testing.assert_array_equal(np.asarray(t.pull()),
+                                          np.asarray(leaf))
+            g = np.ones((40, 33), np.float32)
+            t.push_grad(jnp.asarray(g))
+            np.testing.assert_allclose(np.asarray(t.pull()),
+                                       np.asarray(leaf) - 1.0, rtol=1e-6)
+    finally:
+        psdp._MAX_FLOATS_PER_REQ = old
