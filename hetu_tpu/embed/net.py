@@ -67,6 +67,9 @@ def _lib():
         "het_ps_ssp_sync": ([ctypes.c_void_p, ctypes.c_uint32,
                              ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                              ctypes.c_int64], ctypes.c_int64),
+        "het_ps_preduce": ([ctypes.c_void_p, ctypes.c_uint32,
+                            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                            ctypes.c_float], ctypes.c_int64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -209,6 +212,20 @@ class RemoteEmbeddingTable:
         self._check(self._lib.het_ps_ssp_sync(self._c, group_id, worker,
                                               clock, staleness, world),
                     "ssp_sync")
+
+    def preduce_get_partner(self, group_id: int, worker: int,
+                            n_workers: int, *, min_group: int = 1,
+                            wait_ms: float = 100.0) -> list:
+        """Partial-reduce partner matching over the wire (the reference's
+        preduce_get_partner RPC, python/hetu/preduce.py:8; straggler
+        mitigation, SIGMOD'21).  Returns the worker ids matched into this
+        round's reduce group — callers then run the group collective (e.g. a
+        psum over a sub-mesh) among exactly those members."""
+        mask = self._lib.het_ps_preduce(self._c, group_id, worker, n_workers,
+                                        min_group, wait_ms)
+        if mask < 0:
+            raise RuntimeError(f"remote preduce failed (status {mask})")
+        return [w for w in range(n_workers) if mask & (1 << w)]
 
     def close(self):
         if getattr(self, "_c", None):

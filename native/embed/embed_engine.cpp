@@ -409,8 +409,10 @@ struct PReduce {
 
   // Returns the matched group (bitmask over workers). First arrival opens a
   // window; the group closes when everyone arrived or the window expires
-  // (with >= min_group members).
-  uint64_t get_partner(int worker) {
+  // (with >= min_group members).  ``wait_override_ms`` < 0 keeps the
+  // configured window (the network RPC passes a per-call window).
+  uint64_t get_partner(int worker, double wait_override_ms = -1.0) {
+    double w_ms = wait_override_ms >= 0 ? wait_override_ms : wait_ms;
     std::unique_lock<std::mutex> lk(mu);
     uint64_t my_round = round;
     arrived.push_back(worker);
@@ -419,14 +421,23 @@ struct PReduce {
     } else {
       cv.notify_all();
       auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::duration<double, std::milli>(wait_ms);
+                      std::chrono::duration<double, std::milli>(w_ms);
       cv.wait_until(lk, deadline, [&] { return round != my_round; });
       if (round == my_round &&
           static_cast<int>(arrived.size()) >= min_group) {
         close_group();
       } else if (round == my_round) {
-        // window expired without quorum: wait for the full group
-        cv.wait(lk, [&] { return round != my_round; });
+        // window expired without quorum: wait for the full group, but only
+        // up to a bounded grace period — an unbounded wait would wedge the
+        // caller (and, over the network transport, the PS server's handler
+        // thread) forever if a peer died; after the grace period the group
+        // closes with whoever arrived so training makes progress (the
+        // straggler-tolerance the scheme exists for)
+        auto grace = std::chrono::steady_clock::now() +
+                     std::chrono::duration<double, std::milli>(
+                         std::max(w_ms * 50.0, 5000.0));
+        cv.wait_until(lk, grace, [&] { return round != my_round; });
+        if (round == my_round) close_group();
       }
     }
     uint64_t mask = 0;
@@ -639,6 +650,18 @@ void* het_preduce_create(int n_workers, double wait_ms, int min_group) {
 void het_preduce_destroy(void* h) { delete static_cast<PReduce*>(h); }
 uint64_t het_preduce_get_partner(void* h, int worker) {
   return static_cast<PReduce*>(h)->get_partner(worker);
+}
+
+uint64_t het_preduce_get_partner_w(void* h, int worker, double wait_ms) {
+  return static_cast<PReduce*>(h)->get_partner(worker, wait_ms);
+}
+
+// group-config introspection for the network transport's validation
+int het_preduce_n_workers(void* h) {
+  return static_cast<PReduce*>(h)->n_workers;
+}
+int het_preduce_min_group(void* h) {
+  return static_cast<PReduce*>(h)->min_group;
 }
 
 }  // extern "C"

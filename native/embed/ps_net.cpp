@@ -50,6 +50,11 @@ void het_table_set_rows(void* h, const int64_t* keys, int64_t n,
                         const float* vals);
 int het_table_save(void* h, const char* path);
 int het_table_load(void* h, const char* path);
+void* het_preduce_create(int n_workers, double wait_ms, int min_group);
+void het_preduce_destroy(void* h);
+uint64_t het_preduce_get_partner_w(void* h, int worker, double wait_ms);
+int het_preduce_n_workers(void* h);
+int het_preduce_min_group(void* h);
 }
 
 namespace {
@@ -64,6 +69,7 @@ enum Op : uint32_t {
   kSetLr = 7,
   kBarrier = 8,
   kSspSync = 9,
+  kPReduce = 10,
 };
 
 struct ReqHeader {
@@ -132,6 +138,7 @@ struct Server {
   std::map<uint32_t, TableEntry> tables;
   std::map<uint32_t, Barrier> barriers;
   std::map<uint32_t, SspGroup> ssp_groups;
+  std::map<uint32_t, void*> preduce_groups;  // het_preduce handles
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
 
@@ -152,6 +159,7 @@ struct Server {
     for (auto& t : conns)
       if (t.joinable()) t.join();
     for (auto& kv : tables) het_table_destroy(kv.second.handle);
+    for (auto& kv : preduce_groups) het_preduce_destroy(kv.second);
   }
 
   void handle_conn(int fd) {
@@ -169,7 +177,7 @@ struct Server {
     while (!stop.load()) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
-      if (h.op < kCreate || h.op > kSspSync || h.nkeys < 0 ||
+      if (h.op < kCreate || h.op > kPReduce || h.nkeys < 0 ||
           h.nfloats < 0 || h.nbytes < 0 || h.nkeys >= kMaxElems ||
           h.nfloats >= kMaxElems || h.nbytes >= kMaxElems)
         break;  // not our protocol — drop the connection
@@ -304,6 +312,44 @@ struct Server {
                                                 g.clocks.end());
             return clock - slowest <= staleness || stop.load();
           });
+          break;
+        }
+        case kPReduce: {
+          // Partial-reduce partner matching over the wire (the reference's
+          // kPReduceGetPartner RPC, preduce_handler.cc; SIGMOD'21): first
+          // arrival opens a wait window, group closes at full membership or
+          // window expiry with >= min_group.  table_id = group id,
+          // keys = [worker, n_workers, min_group], floats = [wait_ms].
+          // Response status = bitmask of matched workers (<= 63 workers).
+          if (h.nkeys < 3 || h.nfloats < 1 || keys[0] < 0 ||
+              keys[1] < 1 || keys[1] > 63 || keys[0] >= keys[1] ||
+              keys[2] < 1) {
+            resp.status = -3;
+            break;
+          }
+          void* pr;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = preduce_groups.find(h.table_id);
+            if (it == preduce_groups.end()) {
+              pr = het_preduce_create(static_cast<int>(keys[1]), floats[0],
+                                      static_cast<int>(keys[2]));
+              preduce_groups[h.table_id] = pr;
+            } else {
+              pr = it->second;
+              // every member must agree on the group shape — a stale or
+              // mistaken n_workers/min_group must error, not silently match
+              // under the first request's config
+              if (het_preduce_n_workers(pr) != static_cast<int>(keys[1]) ||
+                  het_preduce_min_group(pr) != static_cast<int>(keys[2])) {
+                resp.status = -3;
+                break;
+              }
+            }
+          }
+          // the wait window is per-call (the SIGMOD'21 scheme adapts it)
+          resp.status = static_cast<int64_t>(het_preduce_get_partner_w(
+              pr, static_cast<int>(keys[0]), floats[0]));
           break;
         }
         default:
@@ -500,6 +546,14 @@ int64_t het_ps_ssp_sync(void* h, uint32_t group_id, int64_t worker,
   ReqHeader hh{kSspSync, group_id, 4, 0, 0};
   return static_cast<Client*>(h)->request(hh, keys, nullptr, nullptr, nullptr,
                                           0);
+}
+
+int64_t het_ps_preduce(void* h, uint32_t group_id, int64_t worker,
+                       int64_t n_workers, int64_t min_group, float wait_ms) {
+  int64_t keys[3] = {worker, n_workers, min_group};
+  ReqHeader hh{kPReduce, group_id, 3, 1, 0};
+  return static_cast<Client*>(h)->request(hh, keys, &wait_ms, nullptr,
+                                          nullptr, 0);
 }
 
 }  // extern "C"

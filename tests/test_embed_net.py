@@ -185,3 +185,41 @@ def test_garbage_connection_does_not_kill_server(server):
     local = HostEmbeddingTable(8, 2, seed=5)
     np.testing.assert_array_equal(t.pull(np.arange(8)),
                                   local.pull(np.arange(8)))
+
+
+def test_preduce_over_the_wire(server):
+    """Partial-reduce partner matching via the network PS: fast workers
+    group within the window; the straggler reduces with whoever remains
+    (reference preduce.py get_partner semantics over kPReduceGetPartner)."""
+    addr = f"127.0.0.1:{server.port}"
+    clients = [RemoteEmbeddingTable(addr, 20 + i, 4, 2) for i in range(3)]
+    rounds = {w: [] for w in range(3)}
+
+    def fast(w):
+        # two training iterations: round 1 groups the fast pair inside the
+        # window; round 2 includes the straggler who arrived meanwhile
+        rounds[w].append(clients[w].preduce_get_partner(
+            33, w, 3, min_group=2, wait_ms=300.0))
+        time.sleep(2.0)
+        rounds[w].append(clients[w].preduce_get_partner(
+            33, w, 3, min_group=2, wait_ms=300.0))
+
+    def straggler(w):
+        time.sleep(1.2)  # far past round 1's 300ms window
+        rounds[w].append(clients[w].preduce_get_partner(
+            33, w, 3, min_group=2, wait_ms=300.0))
+
+    ts = [threading.Thread(target=fast, args=(0,)),
+          threading.Thread(target=fast, args=(1,)),
+          threading.Thread(target=straggler, args=(2,))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert len(rounds[0]) == 2 and len(rounds[1]) == 2 and len(rounds[2]) == 1, \
+        f"threads did not all complete: {rounds}"
+    # round 1: the fast pair proceeds without the straggler
+    assert sorted(rounds[0][0]) == [0, 1] and sorted(rounds[1][0]) == [0, 1]
+    # round 2: everyone reduces together
+    assert sorted(rounds[0][1]) == [0, 1, 2]
+    assert sorted(rounds[2][0]) == [0, 1, 2]
