@@ -19,17 +19,21 @@ from hetu_tpu.core import set_random_seed
 from hetu_tpu.data.datasets import synthetic_ctr
 from hetu_tpu.exec import Trainer
 from hetu_tpu.exec.metrics import auc_roc
-from hetu_tpu.models import DCN, CTRConfig, DeepFM, WideDeep
+from hetu_tpu.models import DCN, CTRConfig, DeepCrossing, DeepFM, WideDeep
 from hetu_tpu.optim import AdamOptimizer
 
-MODELS = {"wdl": WideDeep, "deepfm": DeepFM, "dcn": DCN}
+MODELS = {"wdl": WideDeep, "deepfm": DeepFM, "dcn": DCN, "dc": DeepCrossing}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=sorted(MODELS), default="wdl")
-    ap.add_argument("--embedding", choices=["device", "host"],
+    ap.add_argument("--embedding", choices=["device", "host", "remote"],
                     default="device")
+    ap.add_argument("--servers", default=None,
+                    help="comma-separated PS addresses for --embedding "
+                         "remote; default spawns two local in-process "
+                         "servers (heturun exports HETU_TPU_EMBED_SERVERS)")
     ap.add_argument("--cache", type=int, default=0,
                     help="host cache capacity (rows); 0 = uncached")
     ap.add_argument("--policy", choices=["lru", "lfu", "lfuopt"],
@@ -39,9 +43,21 @@ def main():
     args = ap.parse_args()
 
     set_random_seed(0)
+    servers, local_servers = [], []
+    if args.embedding == "remote":
+        if args.servers:
+            servers = [a.strip() for a in args.servers.split(",") if a.strip()]
+        else:
+            from hetu_tpu.launch import embed_server_addresses
+            servers = embed_server_addresses()
+        if not servers:  # self-contained demo: two in-process servers
+            from hetu_tpu.embed.net import EmbeddingServer
+            local_servers = [EmbeddingServer(), EmbeddingServer()]
+            servers = [f"127.0.0.1:{s.port}" for s in local_servers]
+            print(f"spawned local embedding servers: {servers}")
     cfg = CTRConfig(vocab=26000, embed_dim=16, embedding=args.embedding,
                     cache_capacity=args.cache, cache_policy=args.policy,
-                    host_optimizer="adagrad", host_lr=0.05)
+                    host_optimizer="adagrad", host_lr=0.05, servers=servers)
     model = MODELS[args.model](cfg)
     data = synthetic_ctr(n=args.batch * 32)
     trainer = Trainer(

@@ -38,7 +38,7 @@ class CTRConfig:
                  host_optimizer: str = "sgd", host_lr: float = 0.01,
                  cache_capacity: int = 0, cache_policy: str = "lru",
                  pull_bound: int = 0, push_bound: int = 0,
-                 host_bridge: str = "auto"):
+                 host_bridge: str = "auto", servers=None):
         self.dense_dim = dense_dim
         self.sparse_fields = sparse_fields
         self.vocab = vocab
@@ -55,10 +55,26 @@ class CTRConfig:
         # outside jit (works on backends without host callbacks, e.g. the
         # tunneled axon TPU); "auto" picks per backend.
         self.host_bridge = host_bridge
+        self.servers = list(servers) if servers else []  # embedding="remote"
 
 
 def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
     dim = dim if dim is not None else cfg.embed_dim
+    if cfg.embedding == "remote":
+        # key-partitioned across network PS servers (reference multi-server
+        # deployment; servers spawned by heturun or embed.net standalone)
+        from hetu_tpu.embed.net import RemoteHostEmbedding
+        if not cfg.servers:
+            raise ValueError('embedding="remote" needs CTRConfig.servers')
+        if cfg.cache_capacity:
+            # the remote stub has no client-side HET cache (yet); silently
+            # dropping the configured cache would hide a large slowdown
+            raise ValueError(
+                'embedding="remote" does not support cache_capacity; use '
+                'embedding="host" for the cached engine or drop --cache')
+        return RemoteHostEmbedding(
+            cfg.vocab, dim, servers=cfg.servers,
+            optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed)
     if cfg.embedding == "host":
         bridge = cfg.host_bridge
         if bridge == "auto":
