@@ -81,8 +81,10 @@ def main():
         if step % 20 == 0 or step == args.steps - 1:
             auc = auc_roc(np.asarray(m["pred"]), np.asarray(b["label"]))
             line = f"step {step:4d} loss {float(m['loss']):.4f} auc {auc:.4f}"
-            if args.embedding == "host" and args.cache:
-                st = model.embed.store.stats()
+            if args.embedding in ("host", "remote") and args.cache:
+                st = (model.embed.store.stats()
+                      if args.embedding == "host"
+                      else model.embed.stats())
                 line += f" cache_hit {st['hit_rate']:.3f}"
             print(line)
 

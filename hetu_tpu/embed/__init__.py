@@ -19,14 +19,14 @@ from hetu_tpu.embed.engine import (
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
 from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
-from hetu_tpu.embed.net import (EmbeddingServer, RemoteEmbeddingTable,
-                                RemoteHostEmbedding)
+from hetu_tpu.embed.net import (EmbeddingServer, RemoteCacheTable,
+                                RemoteEmbeddingTable, RemoteHostEmbedding)
 from hetu_tpu.embed.ps_dp import PSDataParallel
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
-    "EmbeddingServer", "RemoteEmbeddingTable", "RemoteHostEmbedding",
-    "PSDataParallel",
+    "EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
+    "RemoteHostEmbedding", "PSDataParallel",
 ]

@@ -27,7 +27,8 @@ import numpy as np
 from hetu_tpu.embed.engine import OPTIMIZERS, _load
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
 
-__all__ = ["EmbeddingServer", "RemoteEmbeddingTable", "RemoteHostEmbedding"]
+__all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
+           "RemoteHostEmbedding"]
 
 
 def _lib():
@@ -70,6 +71,21 @@ def _lib():
         "het_ps_preduce": ([ctypes.c_void_p, ctypes.c_uint32,
                             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                             ctypes.c_float], ctypes.c_int64),
+        "het_rcache_create": ([ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                               ctypes.c_uint64, ctypes.c_int64],
+                              ctypes.c_void_p),
+        "het_rcache_destroy": ([ctypes.c_void_p], None),
+        "het_rcache_sync": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                            ctypes.c_int64),
+        "het_rcache_push": ([ctypes.c_void_p, i64p, ctypes.c_int64, f32p],
+                            ctypes.c_int64),
+        "het_rcache_flush": ([ctypes.c_void_p], ctypes.c_int64),
+        "het_rcache_invalidate": ([ctypes.c_void_p], ctypes.c_int64),
+        "het_rcache_size": ([ctypes.c_void_p], ctypes.c_int64),
+        "het_rcache_stats": ([ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.POINTER(ctypes.c_uint64)], None),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -239,6 +255,103 @@ class RemoteEmbeddingTable:
             pass
 
 
+class RemoteCacheTable:
+    """Client-side HET cache over a ``RemoteEmbeddingTable`` — the full HET
+    architecture across processes (reference src/hetu_cache CacheBase +
+    hetu_client.h syncEmbedding/pushEmbedding over ps-lite; VLDB'22).
+
+    ``sync`` serves rows from the local cache, refreshing only rows whose
+    server version advanced past ``pull_bound`` via ONE delta-sync RPC (the
+    server returns just the stale rows); ``push`` accumulates gradients
+    locally and flushes each row after ``push_bound`` accumulations.  Same
+    facade as the in-process ``CacheTable`` (engine.py).
+    """
+
+    parallel_pull = True  # shard router: overlap per-shard RTTs
+
+    def __init__(self, table: RemoteEmbeddingTable, capacity: int, *,
+                 policy: str = "lru", pull_bound: int = 0,
+                 push_bound: int = 0):
+        from hetu_tpu.embed.engine import POLICIES
+        if capacity <= 0:
+            raise ValueError("cache capacity must be > 0")
+        self.table = table  # keeps the connection alive
+        self.dim = table.dim
+        self._lib = _lib()
+        self._h = self._lib.het_rcache_create(
+            table._c, table.table_id, table.dim, capacity, POLICIES[policy],
+            pull_bound, push_bound)
+
+    def _check(self, st, what):
+        if st != 0:
+            raise RuntimeError(f"remote cache {what} failed (status {st})")
+
+    def sync(self, keys) -> np.ndarray:
+        keys = _i64(np.asarray(keys).ravel())
+        out = np.empty((keys.size, self.dim), np.float32)
+        self._check(self._lib.het_rcache_sync(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            keys.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            "sync")
+        return out
+
+    # plain pull = cache-served read (sync without new semantics); the shard
+    # router and eval paths use whichever the bridge picks
+    pull = sync
+
+    def push(self, keys, grads):
+        keys = _i64(np.asarray(keys).ravel())
+        grads = _f32(np.asarray(grads).reshape(keys.size, self.dim))
+        self._check(self._lib.het_rcache_push(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            keys.size, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            "push")
+
+    def flush(self):
+        self._check(self._lib.het_rcache_flush(self._h), "flush")
+
+    def invalidate(self):
+        """Flush pending grads and drop every cached copy."""
+        self._check(self._lib.het_rcache_invalidate(self._h), "invalidate")
+
+    def set_rows(self, keys, values):
+        """Direct server write; cached copies are dropped so reads see the
+        new values even under a non-zero pull_bound."""
+        self.invalidate()
+        self.table.set_rows(keys, values)
+
+    def save(self, path: str):
+        self.flush()
+        self.table.save(path)
+
+    def load(self, path: str):
+        self.invalidate()
+        self.table.load(path)
+
+    def size(self) -> int:
+        return int(self._lib.het_rcache_size(self._h))
+
+    def stats(self) -> dict:
+        hits = ctypes.c_uint64()
+        misses = ctypes.c_uint64()
+        self._lib.het_rcache_stats(self._h, ctypes.byref(hits),
+                                   ctypes.byref(misses))
+        total = hits.value + misses.value
+        return {"hits": hits.value, "misses": misses.value,
+                "hit_rate": hits.value / total if total else 0.0}
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.het_rcache_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 # SPMD workers construct their models in the same deterministic order, so a
 # process-local counter yields matching table ids on every worker while
 # keeping two same-shaped layers in one model from aliasing one remote table.
@@ -260,7 +373,9 @@ class RemoteHostEmbedding(ShardedHostEmbedding):
     def __init__(self, num_embeddings: int, dim: int, *, servers,
                  table_id: int | None = None, optimizer: str = "sgd",
                  lr: float = 0.01, weight_decay: float = 0.0, seed: int = 0,
-                 init_scale: float = 0.01, dtype=None):
+                 init_scale: float = 0.01, cache_capacity: int = 0,
+                 policy: str = "lru", pull_bound: int = 0,
+                 push_bound: int = 0, dtype=None):
         import jax.numpy as jnp
 
         servers = list(servers)
@@ -283,7 +398,18 @@ class RemoteHostEmbedding(ShardedHostEmbedding):
                                  init_scale=init_scale)
             for s, addr in enumerate(servers)
         ]
-        self.stores = list(self.tables)
+        if cache_capacity > 0:
+            # full HET across processes: client-side versioned caches with
+            # delta sync over each server shard
+            per = -(-cache_capacity // self.n_shards)
+            self.stores = [
+                RemoteCacheTable(t, per, policy=policy,
+                                 pull_bound=pull_bound,
+                                 push_bound=push_bound)
+                for t in self.tables
+            ]
+        else:
+            self.stores = list(self.tables)
         self._wire()
 
 

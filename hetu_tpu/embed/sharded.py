@@ -171,7 +171,9 @@ class ShardedHostEmbedding(StagedHostEmbedding):
     # -- persistence ---------------------------------------------------------
     def flush(self):
         for st in self.stores:
-            if isinstance(st, CacheTable):
+            # engine CacheTable or net.RemoteCacheTable; bare tables have
+            # nothing to flush
+            if hasattr(st, "flush"):
                 st.flush()
 
     def save(self, path: str):
@@ -180,6 +182,13 @@ class ShardedHostEmbedding(StagedHostEmbedding):
             t.save(f"{path}.shard{s}")
 
     def load(self, path: str):
+        # a restore can move server row versions BACKWARD; caches that track
+        # versions (net.RemoteCacheTable) must drop their copies or they'd
+        # keep serving pre-load rows forever (the in-process CacheTable is
+        # immune via its unsigned staleness arithmetic, which wraps)
+        for st in self.stores:
+            if hasattr(st, "invalidate"):
+                st.invalidate()
         for s, t in enumerate(self.tables):
             t.load(f"{path}.shard{s}")
 
@@ -193,6 +202,19 @@ class ShardedHostEmbedding(StagedHostEmbedding):
             if m.any():
                 rows[m] = self.tables[s].pull(local[m])
         return rows
+
+    def stats(self) -> dict:
+        """Aggregated cache hit/miss stats over the shard caches (empty for
+        uncached stores)."""
+        hits = misses = 0
+        for st in self.stores:
+            if hasattr(st, "stats"):
+                s = st.stats()
+                hits += s["hits"]
+                misses += s["misses"]
+        total = hits + misses
+        return {"hits": hits, "misses": misses,
+                "hit_rate": hits / total if total else 0.0}
 
     def loads(self, reset: bool = False) -> dict:
         """Per-shard pull/push row counts (the reference's getLoads).

@@ -66,15 +66,11 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
         from hetu_tpu.embed.net import RemoteHostEmbedding
         if not cfg.servers:
             raise ValueError('embedding="remote" needs CTRConfig.servers')
-        if cfg.cache_capacity:
-            # the remote stub has no client-side HET cache (yet); silently
-            # dropping the configured cache would hide a large slowdown
-            raise ValueError(
-                'embedding="remote" does not support cache_capacity; use '
-                'embedding="host" for the cached engine or drop --cache')
         return RemoteHostEmbedding(
             cfg.vocab, dim, servers=cfg.servers,
-            optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed)
+            optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed,
+            cache_capacity=cfg.cache_capacity, policy=cfg.cache_policy,
+            pull_bound=cfg.pull_bound, push_bound=cfg.push_bound)
     if cfg.embedding == "host":
         bridge = cfg.host_bridge
         if bridge == "auto":

@@ -30,10 +30,12 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <list>
 #include <map>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // ---- engine API (defined in embed_engine.cpp, linked into the same .so) ----
@@ -55,6 +57,7 @@ void het_preduce_destroy(void* h);
 uint64_t het_preduce_get_partner_w(void* h, int worker, double wait_ms);
 int het_preduce_n_workers(void* h);
 int het_preduce_min_group(void* h);
+uint64_t het_table_version(void* h, int64_t row);
 }
 
 namespace {
@@ -70,7 +73,24 @@ enum Op : uint32_t {
   kBarrier = 8,
   kSspSync = 9,
   kPReduce = 10,
+  kSyncEmbed = 11,
+  kPushSync = 12,
 };
+
+// client cache version meaning "no cached copy — always refresh"
+constexpr uint64_t kNoVersion = ~uint64_t(0);
+
+inline float bits_to_float(uint32_t u) {
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint32_t float_to_bits(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  return u;
+}
 
 struct ReqHeader {
   uint32_t op;
@@ -177,7 +197,7 @@ struct Server {
     while (!stop.load()) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
-      if (h.op < kCreate || h.op > kPReduce || h.nkeys < 0 ||
+      if (h.op < kCreate || h.op > kPushSync || h.nkeys < 0 ||
           h.nfloats < 0 || h.nbytes < 0 || h.nkeys >= kMaxElems ||
           h.nfloats >= kMaxElems || h.nbytes >= kMaxElems)
         break;  // not our protocol — drop the connection
@@ -352,6 +372,62 @@ struct Server {
               pr, static_cast<int>(keys[0]), floats[0]));
           break;
         }
+        case kSyncEmbed: {
+          // HET delta sync (the reference's kSyncEmbedding PSF,
+          // psf/cachetable.h; hetu_client.h:19 syncEmbedding): client sends
+          // (keys, its cached versions); server returns ONLY the rows whose
+          // version advanced past pull_bound — the bandwidth saving the
+          // cache protocol exists for.  keys = [k0..kn-1, v0..vn-1]
+          // (versions bit-cast to int64; kNoVersion = no cached copy),
+          // floats = [pull_bound].  Response floats = per-stale-row records
+          // [idx_bits, ver_lo_bits, ver_hi_bits, row(dim)].
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (h.nkeys % 2 || h.nfloats < 1) { resp.status = -3; break; }
+          int64_t n = h.nkeys / 2;
+          std::vector<int64_t> ks(keys.begin(), keys.begin() + n);
+          if (!keys_in_range(ks, e.rows) ||
+              n * (3 + e.dim) >= kMaxElems) { resp.status = -4; break; }
+          uint64_t bound = static_cast<uint64_t>(floats[0]);
+          std::vector<float> row(e.dim);
+          for (int64_t i = 0; i < n; ++i) {
+            uint64_t cv = static_cast<uint64_t>(keys[n + i]);
+            uint64_t sv = het_table_version(e.handle, ks[i]);
+            bool stale = cv == kNoVersion || (sv > cv && sv - cv > bound);
+            if (!stale) continue;
+            het_table_pull(e.handle, &ks[i], 1, row.data());
+            out.push_back(bits_to_float(static_cast<uint32_t>(i)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(sv)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(sv >> 32)));
+            out.insert(out.end(), row.begin(), row.end());
+          }
+          resp.nfloats = static_cast<int64_t>(out.size());
+          break;
+        }
+        case kPushSync: {
+          // push + return the post-apply rows and versions (the reference's
+          // pushEmbedding returns updated versions, hetu_client.h:24), so a
+          // client cache's flushed copies stay fresh instead of forcing a
+          // re-pull next sync.  Response floats per key:
+          // [ver_lo_bits, ver_hi_bits, row(dim)].
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          if (!keys_in_range(keys, e.rows) ||
+              h.nfloats != h.nkeys * e.dim ||
+              h.nkeys * (2 + e.dim) >= kMaxElems) { resp.status = -4; break; }
+          het_table_push(e.handle, keys.data(), h.nkeys, floats.data());
+          std::vector<float> row(e.dim);
+          out.reserve(h.nkeys * (2 + e.dim));
+          for (int64_t i = 0; i < h.nkeys; ++i) {
+            uint64_t sv = het_table_version(e.handle, keys[i]);
+            het_table_pull(e.handle, &keys[i], 1, row.data());
+            out.push_back(bits_to_float(static_cast<uint32_t>(sv)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(sv >> 32)));
+            out.insert(out.end(), row.begin(), row.end());
+          }
+          resp.nfloats = static_cast<int64_t>(out.size());
+          break;
+        }
         default:
           resp.status = -100;
       }
@@ -422,6 +498,283 @@ struct Client {
       if (!read_full(fd, out, r.nfloats * 4)) return -11;
     }
     return r.status;
+  }
+
+  // request whose response length is decided by the server (delta sync)
+  int64_t request_var(const ReqHeader& h, const int64_t* keys,
+                      const float* floats, std::vector<float>& out) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!write_full(fd, &h, sizeof(h))) return -10;
+    if (h.nkeys && !write_full(fd, keys, h.nkeys * 8)) return -10;
+    if (h.nfloats && !write_full(fd, floats, h.nfloats * 4)) return -10;
+    RespHeader r;
+    if (!read_full(fd, &r, sizeof(r))) return -11;
+    out.resize(r.nfloats);
+    if (r.nfloats && !read_full(fd, out.data(), r.nfloats * 4)) return -11;
+    return r.status;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// client-side HET cache over the wire (reference src/hetu_cache: versioned
+// rows, pull/push staleness bounds, LRU/LFU/LFUOpt eviction — here the
+// backing store is a remote EmbeddingServer table reached via delta sync
+// instead of the in-process Table the engine cache wraps)
+// ---------------------------------------------------------------------------
+
+struct RCEntry {
+  std::vector<float> emb;
+  std::vector<float> grad;
+  uint64_t version = kNoVersion;
+  int64_t pending = 0;
+  uint64_t freq = 0;
+  std::list<int64_t>::iterator lru_it;
+};
+
+struct RemoteCache {
+  Client* client;  // not owned
+  uint32_t table_id;
+  int64_t dim, capacity;
+  int policy;
+  uint64_t pull_bound;
+  int64_t push_bound;
+  std::mutex mu;
+  std::unordered_map<int64_t, RCEntry> map;
+  std::list<int64_t> lru;
+  uint64_t hits = 0, misses = 0, ops = 0;
+
+  // frames stay under the server's per-frame element cap: chunk pushes so a
+  // big flush (whole-cache save) cannot trip the header guard and kill the
+  // connection
+  int64_t max_keys_per_frame() const {
+    return std::max<int64_t>(1, ((int64_t(1) << 22) / (dim + 2)));
+  }
+
+  // plain chunked push (entries not refreshed; used when the entries are
+  // being dropped anyway, i.e. eviction)
+  int64_t rpc_push(const std::vector<int64_t>& ks,
+                   const std::vector<float>& gs) {
+    int64_t step = max_keys_per_frame();
+    for (size_t lo = 0; lo < ks.size(); lo += step) {
+      size_t hi = std::min(ks.size(), lo + step);
+      ReqHeader h{kPush, table_id, static_cast<int64_t>(hi - lo),
+                  static_cast<int64_t>((hi - lo) * dim), 0};
+      int64_t st = client->request(h, ks.data() + lo, gs.data() + lo * dim,
+                                   nullptr, nullptr, 0);
+      if (st != 0) return st;
+    }
+    return 0;
+  }
+
+  // push + refresh surviving cache entries from the post-apply rows, then
+  // clear their pending grads — grads are only zeroed once the server has
+  // confirmed the chunk, so a failed RPC loses nothing
+  int64_t rpc_push_refresh(const std::vector<int64_t>& ks,
+                           const std::vector<float>& gs) {
+    size_t rec = 2 + dim;
+    int64_t step = max_keys_per_frame();
+    std::vector<float> recs;
+    for (size_t lo = 0; lo < ks.size(); lo += step) {
+      size_t hi = std::min(ks.size(), lo + step);
+      size_t n = hi - lo;
+      ReqHeader h{kPushSync, table_id, static_cast<int64_t>(n),
+                  static_cast<int64_t>(n * dim), 0};
+      recs.resize(rec * n);
+      int64_t st = client->request(h, ks.data() + lo, gs.data() + lo * dim,
+                                   nullptr, recs.data(),
+                                   static_cast<int64_t>(recs.size()));
+      if (st != 0) return st;
+      for (size_t i = 0; i < n; ++i) {
+        auto it = map.find(ks[lo + i]);
+        if (it == map.end()) continue;
+        const float* p = recs.data() + i * rec;
+        it->second.version =
+            static_cast<uint64_t>(float_to_bits(p[0])) |
+            (static_cast<uint64_t>(float_to_bits(p[1])) << 32);
+        it->second.emb.assign(p + 2, p + rec);
+        std::fill(it->second.grad.begin(), it->second.grad.end(), 0.f);
+        it->second.pending = 0;
+      }
+    }
+    return 0;
+  }
+
+  void touch(int64_t key, RCEntry& e) {
+    if (policy == 0) {  // LRU
+      lru.erase(e.lru_it);
+      lru.push_front(key);
+      e.lru_it = lru.begin();
+    } else {
+      e.freq++;
+      if (policy == 2 && (++ops % (capacity * 16 + 1)) == 0)  // LFUOpt aging
+        for (auto& kv : map) kv.second.freq >>= 1;
+    }
+  }
+
+  // stage an entry's pending grads into the batch.  Does NOT clear them —
+  // rpc_push_refresh clears per chunk after server confirmation (an entry
+  // erased before that, i.e. an eviction victim, is cleared by erasure).
+  void stage_flush(int64_t key, RCEntry& e, std::vector<int64_t>& ks,
+                   std::vector<float>& gs) {
+    if (e.pending == 0) return;
+    ks.push_back(key);
+    gs.insert(gs.end(), e.grad.begin(), e.grad.end());
+  }
+
+  int64_t evict_if_needed() {
+    std::vector<int64_t> ks;
+    std::vector<float> gs;
+    std::vector<int64_t> victims;
+    while (static_cast<int64_t>(map.size()) - static_cast<int64_t>(victims.size())
+           > capacity) {
+      int64_t victim = -1;
+      if (policy == 0) {
+        victim = lru.back();
+      } else {
+        uint64_t best = ~0ull;
+        for (auto& kv : map) {
+          bool taken = std::find(victims.begin(), victims.end(), kv.first)
+                       != victims.end();
+          if (!taken && kv.second.freq < best) {
+            best = kv.second.freq;
+            victim = kv.first;
+          }
+        }
+      }
+      auto it = map.find(victim);
+      stage_flush(victim, it->second, ks, gs);
+      if (policy == 0) {
+        // park at the front so lru.back() advances to the next victim;
+        // keep lru_it valid in case the push fails and entries survive
+        lru.erase(it->second.lru_it);
+        lru.push_front(victim);
+        it->second.lru_it = lru.begin();
+      }
+      victims.push_back(victim);
+    }
+    if (victims.empty()) return 0;
+    int64_t st = rpc_push(ks, gs);
+    if (st != 0) return st;  // entries intact; retried on the next op
+    for (int64_t v : victims) {
+      auto it = map.find(v);
+      if (policy == 0) lru.erase(it->second.lru_it);
+      map.erase(it);
+    }
+    return 0;
+  }
+
+  // syncEmbedding over the wire: one push RPC for requested rows with
+  // pending grads, one delta-sync RPC; server returns only stale rows.
+  int64_t sync(const int64_t* keys, int64_t n, float* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    // deduplicate: skewed batches repeat hot keys; one (key, version) pair
+    // and one response record per UNIQUE key keeps the delta sync at the
+    // bandwidth the protocol exists to save
+    std::vector<int64_t> uniq;
+    uniq.reserve(n);
+    {
+      std::unordered_map<int64_t, char> seen;
+      seen.reserve(n);
+      for (int64_t i = 0; i < n; ++i)
+        if (seen.emplace(keys[i], 0).second) uniq.push_back(keys[i]);
+    }
+    int64_t nu = static_cast<int64_t>(uniq.size());
+    {
+      std::vector<int64_t> ks;
+      std::vector<float> gs;
+      for (int64_t k : uniq) {
+        auto it = map.find(k);
+        if (it != map.end()) stage_flush(k, it->second, ks, gs);
+      }
+      int64_t st = rpc_push_refresh(ks, gs);
+      if (st != 0) return st;
+    }
+    std::vector<int64_t> req(2 * nu);
+    for (int64_t i = 0; i < nu; ++i) {
+      req[i] = uniq[i];
+      auto it = map.find(uniq[i]);
+      req[nu + i] = static_cast<int64_t>(
+          it == map.end() ? kNoVersion : it->second.version);
+    }
+    float bound = static_cast<float>(pull_bound);
+    ReqHeader h{kSyncEmbed, table_id, 2 * nu, 1, 0};
+    std::vector<float> records;
+    int64_t st = client->request_var(h, req.data(), &bound, records);
+    if (st != 0) return st;
+    size_t rec = 3 + dim;
+    if (records.size() % rec) return -13;
+    for (size_t r = 0; r < records.size(); r += rec) {
+      int64_t i = float_to_bits(records[r]);
+      uint64_t ver = static_cast<uint64_t>(float_to_bits(records[r + 1])) |
+                     (static_cast<uint64_t>(float_to_bits(records[r + 2])) << 32);
+      int64_t key = uniq[i];
+      auto it = map.find(key);
+      if (it == map.end()) {
+        RCEntry e;
+        e.grad.assign(dim, 0.f);
+        e.freq = 0;
+        if (policy == 0) {
+          lru.push_front(key);
+          e.lru_it = lru.begin();
+        }
+        it = map.emplace(key, std::move(e)).first;
+      }
+      it->second.emb.assign(records.begin() + r + 3,
+                            records.begin() + r + rec);
+      it->second.version = ver;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      auto it = map.find(keys[i]);
+      if (it == map.end() || it->second.emb.empty()) return -14;
+      if (it->second.version == kNoVersion) return -14;
+      std::copy(it->second.emb.begin(), it->second.emb.end(),
+                out + i * dim);
+      touch(keys[i], it->second);
+    }
+    // hit accounting over unique keys: refreshed = misses, the rest hits
+    size_t n_stale = records.size() / rec;
+    misses += n_stale;
+    hits += static_cast<uint64_t>(nu) - std::min<uint64_t>(nu, n_stale);
+    return evict_if_needed();
+  }
+
+  int64_t push(const int64_t* keys, int64_t n, const float* grads) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<int64_t> ks;
+    std::vector<float> gs;
+    // two passes: accumulate ALL of this batch's grads first, then stage
+    // each over-bound entry exactly once — staging inside the accumulation
+    // loop could stage a hot key twice (its grad copy would be applied
+    // twice server-side now that stage_flush defers the zeroing)
+    std::vector<int64_t> cached;
+    std::unordered_map<int64_t, char> seen;
+    for (int64_t i = 0; i < n; ++i) {
+      auto it = map.find(keys[i]);
+      if (it == map.end()) {
+        // not cached (evicted between fwd and bwd): push straight through
+        // (the server dedup-accumulates duplicates within the batch)
+        ks.push_back(keys[i]);
+        gs.insert(gs.end(), grads + i * dim, grads + (i + 1) * dim);
+        continue;
+      }
+      RCEntry& e = it->second;
+      for (int64_t j = 0; j < dim; ++j) e.grad[j] += grads[i * dim + j];
+      e.pending++;
+      if (seen.emplace(keys[i], 0).second) cached.push_back(keys[i]);
+    }
+    for (int64_t k : cached) {
+      RCEntry& e = map.find(k)->second;
+      if (e.pending > push_bound) stage_flush(k, e, ks, gs);
+    }
+    return rpc_push_refresh(ks, gs);
+  }
+
+  int64_t flush_all() {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<int64_t> ks;
+    std::vector<float> gs;
+    for (auto& kv : map) stage_flush(kv.first, kv.second, ks, gs);
+    return rpc_push_refresh(ks, gs);
   }
 };
 
@@ -554,6 +907,62 @@ int64_t het_ps_preduce(void* h, uint32_t group_id, int64_t worker,
   ReqHeader hh{kPReduce, group_id, 3, 1, 0};
   return static_cast<Client*>(h)->request(hh, keys, &wait_ms, nullptr,
                                           nullptr, 0);
+}
+
+// ---- client-side HET cache over a remote table ----
+
+void* het_rcache_create(void* client, uint32_t table_id, int64_t dim,
+                        int64_t capacity, int policy, uint64_t pull_bound,
+                        int64_t push_bound) {
+  auto* c = new RemoteCache();
+  c->client = static_cast<Client*>(client);
+  c->table_id = table_id;
+  c->dim = dim;
+  c->capacity = capacity;
+  c->policy = policy;
+  c->pull_bound = pull_bound;
+  c->push_bound = push_bound;
+  return c;
+}
+
+void het_rcache_destroy(void* h) { delete static_cast<RemoteCache*>(h); }
+
+int64_t het_rcache_sync(void* h, const int64_t* keys, int64_t n, float* out) {
+  return static_cast<RemoteCache*>(h)->sync(keys, n, out);
+}
+
+int64_t het_rcache_push(void* h, const int64_t* keys, int64_t n,
+                        const float* grads) {
+  return static_cast<RemoteCache*>(h)->push(keys, n, grads);
+}
+
+int64_t het_rcache_flush(void* h) {
+  return static_cast<RemoteCache*>(h)->flush_all();
+}
+
+// flush pending grads, then drop every cached copy (after a direct server
+// write like set_rows/load, cached rows within pull_bound would otherwise
+// keep serving pre-write values)
+int64_t het_rcache_invalidate(void* h) {
+  auto* c = static_cast<RemoteCache*>(h);
+  int64_t st = c->flush_all();
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->map.clear();
+  c->lru.clear();
+  return st;
+}
+
+int64_t het_rcache_size(void* h) {
+  auto* c = static_cast<RemoteCache*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return static_cast<int64_t>(c->map.size());
+}
+
+void het_rcache_stats(void* h, uint64_t* hits, uint64_t* misses) {
+  auto* c = static_cast<RemoteCache*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  *hits = c->hits;
+  *misses = c->misses;
 }
 
 }  // extern "C"

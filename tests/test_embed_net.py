@@ -223,3 +223,114 @@ def test_preduce_over_the_wire(server):
     # round 2: everyone reduces together
     assert sorted(rounds[0][1]) == [0, 1, 2]
     assert sorted(rounds[2][0]) == [0, 1, 2]
+
+
+class TestRemoteCache:
+    """Client-side HET cache over the wire (RemoteCacheTable + delta sync)."""
+
+    def test_write_through_matches_uncached_oracle(self, server):
+        from hetu_tpu.embed.net import RemoteCacheTable
+
+        addr = f"127.0.0.1:{server.port}"
+        t = RemoteEmbeddingTable(addr, 50, 64, 8, optimizer="adam",
+                                 lr=0.01, seed=7)
+        cache = RemoteCacheTable(t, capacity=16, pull_bound=0, push_bound=0)
+        local = HostEmbeddingTable(64, 8, optimizer="adam", lr=0.01, seed=7)
+        rng = np.random.default_rng(0)
+        for step in range(6):
+            ids = rng.integers(0, 64, 12)  # working set > capacity: evicts
+            np.testing.assert_array_equal(cache.sync(ids), local.pull(ids))
+            g = rng.normal(size=(12, 8)).astype(np.float32)
+            cache.push(ids, g)
+            local.push(ids, g)
+        cache.flush()
+        np.testing.assert_array_equal(t.pull(np.arange(64)),
+                                      local.pull(np.arange(64)))
+
+    def test_bounded_staleness_and_hits(self, server):
+        from hetu_tpu.embed.net import RemoteCacheTable
+
+        addr = f"127.0.0.1:{server.port}"
+        t = RemoteEmbeddingTable(addr, 51, 16, 4, optimizer="sgd", lr=1.0)
+        cache = RemoteCacheTable(t, capacity=16, pull_bound=5, push_bound=100)
+        before = cache.sync([3]).copy()
+        # another client updates the row server-side (version +1 <= bound 5)
+        other = RemoteEmbeddingTable(addr, 51, 16, 4)
+        other.push([3], np.ones((1, 4), np.float32))
+        served = cache.sync([3])
+        np.testing.assert_array_equal(served, before)  # stale-but-in-bound
+        st = cache.stats()
+        assert st["hits"] >= 1
+        # exceed the bound: six more server-side versions force a refresh
+        for _ in range(6):
+            other.push([3], np.ones((1, 4), np.float32))
+        refreshed = cache.sync([3])
+        assert not np.array_equal(refreshed, before)
+
+    def test_cached_remote_host_embedding_trains(self, server):
+        from hetu_tpu.core import set_random_seed
+
+        set_random_seed(0)
+        emb = RemoteHostEmbedding(
+            100, 4, servers=[f"127.0.0.1:{server.port}"], optimizer="sgd",
+            lr=0.5, cache_capacity=32, push_bound=2)
+        ids = np.arange(8)
+        emb.stage(ids)
+        r0 = np.asarray(emb.rows).copy()
+        emb.push_grads(np.ones((8, 4), np.float32))
+        emb.flush()
+        emb.stage(ids)
+        np.testing.assert_allclose(np.asarray(emb.rows), r0 - 0.5, rtol=1e-5)
+        assert emb.stats()["misses"] >= 8  # first stage cold
+
+    def test_load_invalidates_cached_rows(self, server, tmp_path):
+        """Checkpoint restore moves versions backward; cached copies must
+        not survive it (regression: inherited load bypassed the cache)."""
+        from hetu_tpu.core import set_random_seed
+
+        set_random_seed(0)
+        emb = RemoteHostEmbedding(
+            20, 4, servers=[f"127.0.0.1:{server.port}"], optimizer="sgd",
+            lr=1.0, cache_capacity=20, pull_bound=100)
+        ids = np.arange(6)
+        emb.stage(ids)
+        ckpt = str(tmp_path / "emb")
+        emb.save(ckpt)
+        saved = np.asarray(emb.rows).copy()
+        emb.push_grads(np.ones((6, 4), np.float32))
+        emb.flush()
+        emb.stage(ids)
+        assert not np.allclose(np.asarray(emb.rows), saved)
+        emb.load(ckpt)
+        emb.stage(ids)
+        np.testing.assert_allclose(np.asarray(emb.rows), saved, rtol=1e-6)
+
+    def test_hot_key_batches_and_eviction_chunked(self, server):
+        """Skewed batches (duplicated hot keys) with eviction churn stay
+        numerically exact vs the local oracle."""
+        from hetu_tpu.embed.net import RemoteCacheTable
+
+        addr = f"127.0.0.1:{server.port}"
+        t = RemoteEmbeddingTable(addr, 60, 32, 4, optimizer="sgd", lr=0.1,
+                                 seed=2)
+        cache = RemoteCacheTable(t, capacity=8, push_bound=3)
+        local = HostEmbeddingTable(32, 4, optimizer="sgd", lr=0.1, seed=2)
+        rng = np.random.default_rng(1)
+        for _ in range(8):
+            ids = np.concatenate([np.zeros(5, np.int64),  # hot key x5
+                                  rng.integers(0, 32, 10)])
+            cache.sync(ids)
+            g = rng.normal(size=(15, 4)).astype(np.float32)
+            cache.push(ids, g)
+            # oracle: dedup-accumulate matching the cache's local accumulate
+            acc = {}
+            for k, gr in zip(ids, g):
+                acc.setdefault(int(k), np.zeros(4, np.float32))
+                acc[int(k)] += gr
+            # local engine table applies per-push-batch dedup the same way
+            lk = np.asarray(sorted(acc))
+            local.push(lk, np.stack([acc[int(k)] for k in lk]))
+        cache.flush()
+        np.testing.assert_allclose(t.pull(np.arange(32)),
+                                   local.pull(np.arange(32)), rtol=1e-5,
+                                   atol=1e-6)
