@@ -120,10 +120,20 @@ class Prefetcher:
     synchronously.
     """
 
-    def __init__(self, store: CacheTable, engine: AsyncEngine | None = None):
+    def __init__(self, store, engine: AsyncEngine | None = None):
         self.store = store
-        self.engine = engine or AsyncEngine(2)
-        self._pending = None  # (ticket, ids_key, out)
+        # engine CacheTable: async pulls run on the C++ engine thread pool;
+        # any other store with a row-pull entry point (net.RemoteCacheTable,
+        # remote stubs) overlaps on a Python thread instead
+        self._native = isinstance(store, CacheTable)
+        if self._native:
+            self.engine = engine or AsyncEngine(2)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            import weakref
+            self._pool = ThreadPoolExecutor(1)
+            weakref.finalize(self, self._pool.shutdown, wait=False)
+        self._pending = None  # (ticket_or_future, ids_key, out_or_None)
 
     def _drain(self):
         """Retire the pending pull (wait + drop) — an abandoned ticket would
@@ -131,7 +141,10 @@ class Prefetcher:
         if self._pending is not None:
             ticket, _, _ = self._pending
             self._pending = None
-            self.engine.wait(ticket)
+            if self._native:
+                self.engine.wait(ticket)
+            else:
+                ticket.result()
 
     def __del__(self):
         # drain before teardown: Python gives no destruction order between
@@ -145,16 +158,22 @@ class Prefetcher:
     def prefetch(self, ids):
         self._drain()
         ids = np.asarray(ids, np.int64).ravel()
-        ticket, out = self.engine.sync_async(self.store, ids)
-        self._pending = (ticket, ids.tobytes(), out)
+        if self._native:
+            ticket, out = self.engine.sync_async(self.store, ids)
+            self._pending = (ticket, ids.tobytes(), out)
+        else:
+            fut = self._pool.submit(sync_fn(self.store), ids)
+            self._pending = (fut, ids.tobytes(), None)
 
     def get(self, ids) -> np.ndarray:
         ids = np.asarray(ids, np.int64).ravel()
         if self._pending is not None and self._pending[1] == ids.tobytes():
             ticket, _, out = self._pending
             self._pending = None
-            self.engine.wait(ticket)
-            return out
+            if self._native:
+                self.engine.wait(ticket)
+                return out
+            return ticket.result()
         # mismatch: retire the stale pull NOW — matching it against a
         # same-ids stage() many pushes later would serve rows of unbounded
         # staleness

@@ -51,6 +51,11 @@ class _ShardRouter:
         # block on a TCP round trip per shard — overlap them on a Python
         # thread pool (each shard has its own connection + lock, so the
         # per-connection serialization does not cross shards)
+        # routers over cached shards expose ``sync`` so the staged layer's
+        # Prefetcher treats the whole router as a cache-backed store
+        # (prefetch warms every shard cache through one call)
+        if all(hasattr(s, "sync") for s in stores):
+            self.sync = self.pull
         self._pool = None
         if (n_shards > 1 and not self._cached
                 and all(getattr(s, "parallel_pull", False) for s in stores)):

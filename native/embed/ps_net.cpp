@@ -625,6 +625,7 @@ struct RemoteCache {
     std::vector<int64_t> ks;
     std::vector<float> gs;
     std::vector<int64_t> victims;
+    std::unordered_map<int64_t, char> victim_set;
     while (static_cast<int64_t>(map.size()) - static_cast<int64_t>(victims.size())
            > capacity) {
       int64_t victim = -1;
@@ -633,9 +634,8 @@ struct RemoteCache {
       } else {
         uint64_t best = ~0ull;
         for (auto& kv : map) {
-          bool taken = std::find(victims.begin(), victims.end(), kv.first)
-                       != victims.end();
-          if (!taken && kv.second.freq < best) {
+          if (victim_set.count(kv.first)) continue;
+          if (kv.second.freq < best) {
             best = kv.second.freq;
             victim = kv.first;
           }
@@ -651,6 +651,7 @@ struct RemoteCache {
         it->second.lru_it = lru.begin();
       }
       victims.push_back(victim);
+      victim_set.emplace(victim, 0);
     }
     if (victims.empty()) return 0;
     int64_t st = rpc_push(ks, gs);
@@ -689,39 +690,49 @@ struct RemoteCache {
       int64_t st = rpc_push_refresh(ks, gs);
       if (st != 0) return st;
     }
-    std::vector<int64_t> req(2 * nu);
-    for (int64_t i = 0; i < nu; ++i) {
-      req[i] = uniq[i];
-      auto it = map.find(uniq[i]);
-      req[nu + i] = static_cast<int64_t>(
-          it == map.end() ? kNoVersion : it->second.version);
-    }
     float bound = static_cast<float>(pull_bound);
-    ReqHeader h{kSyncEmbed, table_id, 2 * nu, 1, 0};
-    std::vector<float> records;
-    int64_t st = client->request_var(h, req.data(), &bound, records);
-    if (st != 0) return st;
     size_t rec = 3 + dim;
-    if (records.size() % rec) return -13;
-    for (size_t r = 0; r < records.size(); r += rec) {
-      int64_t i = float_to_bits(records[r]);
-      uint64_t ver = static_cast<uint64_t>(float_to_bits(records[r + 1])) |
-                     (static_cast<uint64_t>(float_to_bits(records[r + 2])) << 32);
-      int64_t key = uniq[i];
-      auto it = map.find(key);
-      if (it == map.end()) {
-        RCEntry e;
-        e.grad.assign(dim, 0.f);
-        e.freq = 0;
-        if (policy == 0) {
-          lru.push_front(key);
-          e.lru_it = lru.begin();
-        }
-        it = map.emplace(key, std::move(e)).first;
+    // chunk like the push paths: one frame per max-cap slice of the unique
+    // keys so huge batches can't trip the server's response-size guard
+    int64_t sync_step = std::max<int64_t>(
+        1, ((int64_t(1) << 22) / static_cast<int64_t>(rec)));
+    std::vector<float> records;
+    size_t n_stale_total = 0;
+    for (int64_t lo = 0; lo < nu; lo += sync_step) {
+      int64_t hi = std::min(nu, lo + sync_step);
+      int64_t m = hi - lo;
+      std::vector<int64_t> req(2 * m);
+      for (int64_t i = 0; i < m; ++i) {
+        req[i] = uniq[lo + i];
+        auto it = map.find(uniq[lo + i]);
+        req[m + i] = static_cast<int64_t>(
+            it == map.end() ? kNoVersion : it->second.version);
       }
-      it->second.emb.assign(records.begin() + r + 3,
-                            records.begin() + r + rec);
-      it->second.version = ver;
+      ReqHeader h{kSyncEmbed, table_id, 2 * m, 1, 0};
+      int64_t st = client->request_var(h, req.data(), &bound, records);
+      if (st != 0) return st;
+      if (records.size() % rec) return -13;
+      n_stale_total += records.size() / rec;
+      for (size_t r = 0; r < records.size(); r += rec) {
+        int64_t i = float_to_bits(records[r]);
+        uint64_t ver = static_cast<uint64_t>(float_to_bits(records[r + 1])) |
+                       (static_cast<uint64_t>(float_to_bits(records[r + 2])) << 32);
+        int64_t key = uniq[lo + i];
+        auto it = map.find(key);
+        if (it == map.end()) {
+          RCEntry e;
+          e.grad.assign(dim, 0.f);
+          e.freq = 0;
+          if (policy == 0) {
+            lru.push_front(key);
+            e.lru_it = lru.begin();
+          }
+          it = map.emplace(key, std::move(e)).first;
+        }
+        it->second.emb.assign(records.begin() + r + 3,
+                              records.begin() + r + rec);
+        it->second.version = ver;
+      }
     }
     for (int64_t i = 0; i < n; ++i) {
       auto it = map.find(keys[i]);
@@ -732,9 +743,9 @@ struct RemoteCache {
       touch(keys[i], it->second);
     }
     // hit accounting over unique keys: refreshed = misses, the rest hits
-    size_t n_stale = records.size() / rec;
-    misses += n_stale;
-    hits += static_cast<uint64_t>(nu) - std::min<uint64_t>(nu, n_stale);
+    misses += n_stale_total;
+    hits += static_cast<uint64_t>(nu) -
+            std::min<uint64_t>(nu, n_stale_total);
     return evict_if_needed();
   }
 
@@ -946,10 +957,11 @@ int64_t het_rcache_flush(void* h) {
 int64_t het_rcache_invalidate(void* h) {
   auto* c = static_cast<RemoteCache*>(h);
   int64_t st = c->flush_all();
+  if (st != 0) return st;  // keep unconfirmed grads; caller can retry
   std::lock_guard<std::mutex> lk(c->mu);
   c->map.clear();
   c->lru.clear();
-  return st;
+  return 0;
 }
 
 int64_t het_rcache_size(void* h) {

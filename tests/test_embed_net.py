@@ -334,3 +334,20 @@ class TestRemoteCache:
         np.testing.assert_allclose(t.pull(np.arange(32)),
                                    local.pull(np.arange(32)), rtol=1e-5,
                                    atol=1e-6)
+
+    def test_remote_prefetch_overlap(self, server):
+        """Async prefetch warms the remote shard caches; a matching stage
+        serves from the prefetch buffer (the reference SparsePull overlap)."""
+        from hetu_tpu.core import set_random_seed
+
+        set_random_seed(0)
+        emb = RemoteHostEmbedding(
+            40, 4, servers=[f"127.0.0.1:{server.port}"], optimizer="sgd",
+            lr=0.5, cache_capacity=40)
+        a, b = np.arange(8), np.arange(8, 16)
+        emb.stage(a)
+        emb.prefetch(b)
+        emb.stage(b)  # served from prefetch buffer
+        direct = emb.pull_rows(b).reshape(8, 4)
+        np.testing.assert_allclose(np.asarray(emb.rows), direct, rtol=1e-6)
+        assert emb._handle.prefetcher is not None  # overlap path engaged
