@@ -133,11 +133,10 @@ class StagedHostEmbedding(_HostEmbeddingBase):
         strict freshness.  No-op for uncached stores (the C engine's async
         pull is cache-based).  The Prefetcher lives on the identity-stable
         host handle, so lazy creation does not perturb the module pytree."""
-        # cached stores only: the engine CacheTable (C++ async pool) or a
-        # remote cache with a sync entry point (net.RemoteCacheTable,
-        # Python-thread overlap); plain tables have no cache to warm
-        if not (isinstance(self.store, CacheTable)
-                or hasattr(self.store, "sync")):
+        # cached stores only — anything with a cache-aware ``sync`` entry
+        # point (engine CacheTable, net.RemoteCacheTable, cached shard
+        # routers); plain tables have no cache for a prefetch to warm
+        if not hasattr(self.store, "sync"):
             return
         if self._handle.prefetcher is None:
             self._handle.prefetcher = Prefetcher(self.store)

@@ -131,8 +131,10 @@ class ShardedHostEmbedding(StagedHostEmbedding):
     Drop-in for ``StagedHostEmbedding`` — the staging protocol (stage /
     __call__ / is_fresh / push_grads, Trainer auto-push) is inherited; only
     construction, persistence, and the store routing differ.  ``prefetch``
-    is inherited as a no-op (the router is not a CacheTable); shard pulls
-    already overlap on the engine pool inside ``stage``.
+    engages when every shard store is cache-backed (the router then exposes
+    ``sync`` and the Prefetcher warms all shard caches through one async
+    call); over bare table shards it stays a no-op — their pulls already
+    overlap on the engine pool inside ``stage``.
     """
 
     def __init__(self, num_embeddings: int, dim: int, *, n_shards: int = 2,

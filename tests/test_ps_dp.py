@@ -142,3 +142,87 @@ def test_large_leaf_segmented_transfer():
                                        np.asarray(leaf) - 1.0, rtol=1e-6)
     finally:
         psdp._MAX_FLOATS_PER_REQ = old
+
+
+def test_hybrid_mode_across_processes():
+    """The reference's Hybrid comm mode across real processes
+    (tests/hybrid_wdl_adult.sh): dense parameters data-parallel via a
+    cross-process gradient allreduce, sparse embeddings through a SHARED
+    network PS (server-side optimizer, ASP) — both workers converge and
+    agree on the dense parameters."""
+    import textwrap
+    from hetu_tpu.launch import simulate_workers
+
+    with EmbeddingServer() as srv:
+        script = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {repr(os.getcwd())})
+            import hetu_tpu.launch as L
+            L.initialize()
+            import jax, jax.numpy as jnp, numpy as np
+            from jax.experimental import multihost_utils
+            import hetu_tpu as ht
+            from hetu_tpu.core.module import Module, trainable_mask
+            from hetu_tpu.embed.net import RemoteHostEmbedding
+            from hetu_tpu.layers import Linear
+            from hetu_tpu.ops import binary_cross_entropy_with_logits
+            from hetu_tpu.optim import SGDOptimizer
+
+            pid = jax.process_index()
+            ht.set_random_seed(0)  # identical dense init on both workers
+
+            class WD(Module):
+                def __init__(self):
+                    self.embed = RemoteHostEmbedding(
+                        120, 4, servers=["127.0.0.1:{srv.port}"],
+                        optimizer="sgd", lr=0.1, table_id=5)
+                    self.head = Linear(4 * 3, 1)
+
+                def loss(self, sp, y):
+                    e = self.embed(sp).reshape(sp.shape[0], -1)
+                    return binary_cross_entropy_with_logits(
+                        self.head(e)[:, 0], y).mean()
+
+            model = WD()
+            opt = SGDOptimizer(0.05)
+            state = opt.init(model)
+            mask = trainable_mask(model)
+
+            @jax.jit
+            def grads_fn(m, sp, y):
+                return jax.value_and_grad(lambda mm: mm.loss(sp, y))(m)
+
+            rng = np.random.default_rng(pid)  # per-worker data shard
+            sp = rng.integers(0, 120, (16, 3))
+            y = (sp.sum(1) % 2).astype(np.float32)
+            spj, yj = jnp.asarray(sp), jnp.asarray(y)
+            losses = []
+            for step in range(25):
+                model.embed.stage(spj)
+                loss, g = grads_fn(model, spj, yj)
+                # hybrid: sparse rows-grad -> PS push (ASP, server applies);
+                # dense grads -> cross-process allreduce (mean)
+                model.embed.push_grads(np.asarray(g.embed.rows))
+                dense_g = multihost_utils.process_allgather(
+                    {{"w": g.head.w, "b": g.head.b}})
+                mean_g = jax.tree_util.tree_map(
+                    lambda x: jnp.mean(x, 0), dense_g)
+                head_g = g.head.replace(w=mean_g["w"], b=mean_g["b"])
+                g2 = g.replace(head=head_g)
+                model, state = opt.update(g2, state, model, mask=mask)
+                losses.append(float(loss))
+            wsum = float(jnp.sum(model.head.w))
+            print(f"RESULT pid={{pid}} l0={{losses[0]:.4f}} "
+                  f"l1={{losses[-1]:.4f}} wsum={{wsum:.6f}}")
+        """)
+        outs = simulate_workers(2, script, cpu_devices_per_proc=1,
+                                timeout=300.0)
+    results = {}
+    for out in outs:
+        line = next(l for l in out.splitlines() if l.startswith("RESULT"))
+        parts = dict(kv.split("=") for kv in line.split()[1:])
+        results[int(parts["pid"])] = parts
+    for pid in (0, 1):
+        assert float(results[pid]["l1"]) < float(results[pid]["l0"]), results
+    # dense params identical across workers (allreduce-DP invariant)
+    assert results[0]["wsum"] == results[1]["wsum"], results
