@@ -7,29 +7,20 @@ processes for any distributed test; tests/test_comm.py:23).
 """
 
 import os
-
-# Force CPU: the session environment presets JAX_PLATFORMS=axon (one real TPU
-# chip over a tunnel) and /root/.axon_site on PYTHONPATH force-registers that
-# backend regardless of JAX_PLATFORMS.  Unit tests must run on the virtual
-# 8-device CPU mesh, so drop the axon hook from sys.path before jax imports.
 import sys
 
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = ":".join(
-    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force CPU + 8 virtual devices before any jax import: the session
+# environment presets JAX_PLATFORMS=axon (one real TPU chip over a tunnel)
+# and /root/.axon_site on PYTHONPATH force-registers that backend regardless
+# of JAX_PLATFORMS.  The defense lives in __graft_entry__ (shared with the
+# driver's multi-chip dryrun).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
+
+_force_virtual_cpu_mesh(8)
 
 import jax  # noqa: E402
 
-# sitecustomize (axon PJRT hook) imports jax before this conftest runs and
-# pins jax_platforms to the axon TPU backend; point it back at CPU.
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
