@@ -14,6 +14,7 @@ from hetu_tpu.embed.engine import (
     CacheTable,
     HostEmbeddingTable,
     PartialReduceCoordinator,
+    PReduceGroup,
     SSPBarrier,
 )
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
@@ -25,7 +26,8 @@ from hetu_tpu.embed.ps_dp import PSDataParallel
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
-    "PartialReduceCoordinator", "Prefetcher", "make_host_lookup",
+    "PartialReduceCoordinator", "PReduceGroup", "Prefetcher",
+    "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
     "EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
     "RemoteHostEmbedding", "PSDataParallel",

@@ -250,5 +250,6 @@ class SparseInferenceEmbedding(Module):
             sparse_embedding_lookup(self.csr, ids))
 
     def nnz(self) -> int:
-        """Stored non-zeros (the compression the CSR form realizes)."""
-        return int((self.csr.data != 0).sum())
+        """Stored entries — with true CSR this IS the realized storage
+        (plus column ids and rows+1 pointers), not just an accounting."""
+        return int(self.csr.data.size)

@@ -18,7 +18,8 @@ import numpy as np
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
-    "PartialReduceCoordinator", "OPTIMIZERS", "POLICIES",
+    "PartialReduceCoordinator", "PReduceGroup", "decode_preduce_mask",
+    "PREDUCE_QUORUM_FAIL_BIT", "OPTIMIZERS", "POLICIES",
 ]
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
@@ -87,6 +88,9 @@ def _load():
         "het_ssp_sync": ([ctypes.c_void_p, ctypes.c_int, ctypes.c_int], None),
         "het_preduce_create": ([ctypes.c_int, ctypes.c_double, ctypes.c_int],
                                ctypes.c_void_p),
+        "het_preduce_create_g": ([ctypes.c_int, ctypes.c_double,
+                                  ctypes.c_int, ctypes.c_double],
+                                 ctypes.c_void_p),
         "het_preduce_destroy": ([ctypes.c_void_p], None),
         "het_preduce_get_partner": ([ctypes.c_void_p, ctypes.c_int],
                                     ctypes.c_uint64),
@@ -278,22 +282,52 @@ class SSPBarrier:
         self._lib.het_ssp_sync(self._h, worker, clock)
 
 
+# bit 62 of the partner mask flags a round that was force-closed below
+# min_group after the grace period (bit 63 is kept clear so the mask can
+# ride the network transport's signed status channel)
+PREDUCE_QUORUM_FAIL_BIT = 1 << 62
+
+
+class PReduceGroup(list):
+    """Worker ids matched into one partial-reduce round.  ``quorum_met`` is
+    False when the group was force-closed after the grace period with fewer
+    than ``min_group`` members (e.g. a dead peer): the caller still makes
+    progress — the straggler tolerance the scheme exists for — but can tell
+    degraded progress apart from a healthy round."""
+
+    def __init__(self, members, quorum_met: bool = True):
+        super().__init__(members)
+        self.quorum_met = quorum_met
+
+
+def decode_preduce_mask(mask: int, n_workers: int) -> PReduceGroup:
+    return PReduceGroup(
+        [w for w in range(n_workers) if mask & (1 << w)],
+        quorum_met=not (mask & PREDUCE_QUORUM_FAIL_BIT))
+
+
 class PartialReduceCoordinator:
     """Dynamic reduce-group matching (preduce_handler.cc; SIGMOD'21):
-    ``get_partner(worker)`` returns the bitmask of workers grouped with the
-    caller — whoever arrived within the wait window."""
+    ``get_partner(worker)`` returns the workers grouped with the caller —
+    whoever arrived within the wait window.  A round can close below
+    ``min_group`` only after a bounded grace period (dead-peer tolerance);
+    such rounds are flagged via ``PReduceGroup.quorum_met``."""
 
     def __init__(self, n_workers: int, wait_ms: float = 10.0,
-                 min_group: int = 2):
+                 min_group: int = 2, grace_ms: float = -1.0):
+        if not 0 < n_workers <= 62:
+            raise ValueError("n_workers must be in [1, 62] (mask bits 62/63 "
+                             "are reserved)")
         self._lib = _load()
         self.n_workers = n_workers
-        self._h = self._lib.het_preduce_create(n_workers, wait_ms, min_group)
+        self._h = self._lib.het_preduce_create_g(n_workers, wait_ms,
+                                                 min_group, grace_ms)
 
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.het_preduce_destroy(self._h)
             self._h = None
 
-    def get_partner(self, worker: int) -> list[int]:
+    def get_partner(self, worker: int) -> PReduceGroup:
         mask = self._lib.het_preduce_get_partner(self._h, worker)
-        return [w for w in range(self.n_workers) if mask & (1 << w)]
+        return decode_preduce_mask(mask, self.n_workers)

@@ -236,12 +236,16 @@ class RemoteEmbeddingTable:
         preduce_get_partner RPC, python/hetu/preduce.py:8; straggler
         mitigation, SIGMOD'21).  Returns the worker ids matched into this
         round's reduce group — callers then run the group collective (e.g. a
-        psum over a sub-mesh) among exactly those members."""
+        psum over a sub-mesh) among exactly those members.  The returned
+        ``PReduceGroup.quorum_met`` is False when the round was force-closed
+        below ``min_group`` after the grace period (dead peer)."""
+        from hetu_tpu.embed.engine import decode_preduce_mask
+
         mask = self._lib.het_ps_preduce(self._c, group_id, worker, n_workers,
                                         min_group, wait_ms)
         if mask < 0:
             raise RuntimeError(f"remote preduce failed (status {mask})")
-        return [w for w in range(n_workers) if mask & (1 << w)]
+        return decode_preduce_mask(mask, n_workers)
 
     def close(self):
         if getattr(self, "_c", None):

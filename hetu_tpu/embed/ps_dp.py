@@ -186,12 +186,22 @@ class PSDataParallel:
         for f in futs:
             f.result()
         self.clock += 1
-        if self.world > 1:
-            if self.mode == "bsp":
-                self._coord.table.barrier(self.group_id, self.world)
-            elif self.mode == "ssp":
+        if self.world > 1 and self.mode == "bsp":
+            # BSP is a two-phase lockstep: (1) everyone's step-k push has
+            # landed before anyone pulls, (2) everyone's pull is done before
+            # anyone pushes step k+1.  A single barrier only gives (1): a
+            # fast worker could pull, compute, and push its next-round
+            # gradients while a slow worker is still pulling, making the two
+            # workers compute round k+1 on different parameters.  The second
+            # barrier uses a disjoint id (high bit set; group ids are
+            # < 2^12) so the phases can't alias.
+            self._coord.table.barrier(self.group_id, self.world)
+            self._refresh()
+            self._coord.table.barrier(self.group_id | (1 << 31), self.world)
+        else:
+            if self.world > 1 and self.mode == "ssp":
                 self._coord.table.ssp_sync(self.group_id, self.worker,
                                            self.clock, self.staleness,
                                            self.world)
-        self._refresh()
+            self._refresh()
         return {"loss": loss, **aux}

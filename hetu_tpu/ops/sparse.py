@@ -61,12 +61,18 @@ def dedup_indexed_slices(s: IndexedSlices) -> IndexedSlices:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class CSRMatrix:
-    """CSR sparse matrix (reference ndarray.py:549 ND_Sparse_Array)."""
+    """CSR sparse matrix (reference ndarray.py:549 ND_Sparse_Array).
+
+    ``max_row_nnz`` (static) is the widest row's nnz; consumers that
+    reconstruct dense rows under jit (sparse_embedding_lookup) use it to
+    bound the per-row gather.  -1 = unknown (dense_to_csr always sets it;
+    0 genuinely means an all-zero matrix)."""
 
     data: Any
     indices: Any  # column ids, (nnz,)
     indptr: Any  # row pointers, (rows+1,)
     shape: tuple = dataclasses.field(metadata=dict(static=True), default=(0, 0))
+    max_row_nnz: int = dataclasses.field(metadata=dict(static=True), default=-1)
 
     def row_ids(self):
         """Expand indptr to per-nnz row ids (static nnz)."""
@@ -91,34 +97,55 @@ def csr_matvec(sp: CSRMatrix, vec):
 
 
 def dense_to_csr(dense, threshold: float = 0.0) -> CSRMatrix:
-    """Sparsify a dense matrix to CSR (reference ndarray.py dense_to_sparse).
-
-    Entries with |x| <= threshold become explicit zeros in ``data`` but keep
-    their slots so nnz stays static (jit-compatible); the stored layout is
-    still CSR ordered row-major.  Intended for host-side model conversion
-    (train → sparse inference form, the embedding-compression 'sparse'
-    inference path), so it runs fine outside jit too.
+    """Sparsify a dense matrix to true CSR (reference ndarray.py
+    dense_to_sparse): only entries with |x| > threshold are stored, so the
+    realized memory is nnz values + nnz column ids + rows+1 pointers — the
+    compression the format exists for.  Host-side conversion (numpy;
+    variable nnz can't trace under jit) — intended for train → sparse
+    inference-form model conversion; the resulting CSRMatrix has static
+    shapes and works inside jit.
     """
-    rows, cols = dense.shape
-    keep = jnp.abs(dense) > threshold
-    data = jnp.where(keep, dense, 0.0).reshape(-1)
-    indices = jnp.tile(jnp.arange(cols), rows)
-    indptr = jnp.arange(rows + 1) * cols
-    return CSRMatrix(data, indices, indptr, (rows, cols))
+    import numpy as np
+
+    d = np.asarray(dense)
+    rows, cols = d.shape
+    keep = np.abs(d) > threshold
+    per_row = keep.sum(axis=1)
+    indptr = np.zeros(rows + 1, np.int32)
+    np.cumsum(per_row, out=indptr[1:])
+    col_ids = np.nonzero(keep)[1].astype(np.int32)
+    return CSRMatrix(
+        jnp.asarray(d[keep]), jnp.asarray(col_ids), jnp.asarray(indptr),
+        (rows, cols), int(per_row.max()) if rows else 0)
 
 
 def sparse_embedding_lookup(sp: CSRMatrix, ids):
-    """Row gather from a CSR-form embedding table
+    """Dense-row reconstruction from a CSR-form embedding table
     (src/ops/SparseEmbeddingLookup.cu; the compression suite's 'sparse'
     inference-form embedding, tools/.../methods/layers/sparse.py).
 
-    Requires a fixed row stride (the dense_to_csr layout): row i occupies
-    indptr[i]..indptr[i+1] with a constant nnz per row.  Returns dense rows
-    (ids.shape + (dim,)).
+    Row i's nonzeros occupy ``indptr[i]..indptr[i+1]``; each looked-up row
+    gathers up to ``max_row_nnz`` (value, column) pairs and scatters them
+    into a dense (dim,) row, so cost scales with the widest row, not the
+    dense dim.  Returns dense rows (ids.shape + (dim,)).
     """
     rows, cols = sp.shape
-    # with the fixed-stride layout, columns are a tiled arange, so the CSR
-    # data block IS the dense table with explicit zeros — a plain row gather
-    table = sp.data.reshape(rows, cols)
-    out = table[ids.reshape(-1)]
+    k = sp.max_row_nnz
+    if k < 0:  # unknown bound: host-side fallback (outside jit)
+        import numpy as np
+
+        k = int(np.max(np.diff(np.asarray(sp.indptr)))) if rows else 0
+    if k == 0:  # all-zero matrix: every reconstructed row is zeros
+        return jnp.zeros(tuple(ids.shape) + (cols,), sp.data.dtype)
+    flat = ids.reshape(-1)
+    start = sp.indptr[flat]
+    length = sp.indptr[flat + 1] - start
+    offs = jnp.arange(k)
+    pos = start[:, None] + offs[None, :]
+    valid = offs[None, :] < length[:, None]
+    pos = jnp.where(valid, pos, 0)
+    vals = jnp.where(valid, sp.data[pos], 0)
+    col = jnp.where(valid, sp.indices[pos], 0)
+    out = jnp.zeros((flat.shape[0], cols), sp.data.dtype)
+    out = out.at[jnp.arange(flat.shape[0])[:, None], col].add(vals)
     return out.reshape(tuple(ids.shape) + (cols,))

@@ -394,18 +394,27 @@ struct SSP {
 // partial reduce partner matching (preduce_handler.cc, SIGMOD'21)
 // ---------------------------------------------------------------------------
 
+// Set in the returned member bitmask when a round was force-closed below
+// min_group (grace-period expiry, e.g. a dead peer).  Workers occupy bits
+// 0..61 (n_workers capped at 62); bit 63 stays clear so the value can ride
+// the network transport's signed status channel without aliasing errors.
+constexpr uint64_t kPReduceQuorumFailBit = 1ull << 62;
+
 struct PReduce {
   int n_workers;
   double wait_ms;
   int min_group;
+  double grace_ms;  // <= 0: default max(50 * wait_ms, 5000)
   std::mutex mu;
   std::condition_variable cv;
   std::vector<int> arrived;   // workers in the current gathering round
   uint64_t round = 0;
   bool closing = false;
-  std::unordered_map<uint64_t, std::vector<int>> groups;  // round -> members
+  struct Closed { uint64_t mask; int unread; };
+  std::unordered_map<uint64_t, Closed> closed;  // round -> result (refcounted)
 
-  PReduce(int n, double w, int mg) : n_workers(n), wait_ms(w), min_group(mg) {}
+  PReduce(int n, double w, int mg, double g = -1.0)
+      : n_workers(n), wait_ms(w), min_group(mg), grace_ms(g) {}
 
   // Returns the matched group (bitmask over workers). First arrival opens a
   // window; the group closes when everyone arrived or the window expires
@@ -433,20 +442,31 @@ struct PReduce {
         // thread) forever if a peer died; after the grace period the group
         // closes with whoever arrived so training makes progress (the
         // straggler-tolerance the scheme exists for)
+        double g_ms = grace_ms > 0 ? grace_ms
+                                   : std::max(w_ms * 50.0, 5000.0);
         auto grace = std::chrono::steady_clock::now() +
-                     std::chrono::duration<double, std::milli>(
-                         std::max(w_ms * 50.0, 5000.0));
+                     std::chrono::duration<double, std::milli>(g_ms);
         cv.wait_until(lk, grace, [&] { return round != my_round; });
         if (round == my_round) close_group();
       }
     }
-    uint64_t mask = 0;
-    for (int w : groups[my_round]) mask |= (1ull << w);
+    auto it = closed.find(my_round);
+    uint64_t mask = it->second.mask;
+    // each member reads its round's result exactly once; drop the entry
+    // after the last read so a long-lived coordinator doesn't grow a map
+    // entry per round
+    if (--it->second.unread == 0) closed.erase(it);
     return mask;
   }
 
   void close_group() {
-    groups[round] = arrived;
+    uint64_t mask = 0;
+    for (int w : arrived) mask |= (1ull << w);
+    // callers must be able to distinguish straggler-tolerant progress from
+    // a dead peer: flag rounds that closed below the min_group contract
+    if (static_cast<int>(arrived.size()) < min_group)
+      mask |= kPReduceQuorumFailBit;
+    closed[round] = Closed{mask, static_cast<int>(arrived.size())};
     arrived.clear();
     round++;
     cv.notify_all();
@@ -645,7 +665,15 @@ void het_ssp_sync(void* h, int worker, int clock) {
 // ---- partial reduce ----
 
 void* het_preduce_create(int n_workers, double wait_ms, int min_group) {
+  // bits 62/63 of the partner mask are reserved (quorum flag / sign)
+  if (n_workers < 1 || n_workers > 62) return nullptr;
   return new PReduce(n_workers, wait_ms, min_group);
+}
+
+void* het_preduce_create_g(int n_workers, double wait_ms, int min_group,
+                           double grace_ms) {
+  if (n_workers < 1 || n_workers > 62) return nullptr;
+  return new PReduce(n_workers, wait_ms, min_group, grace_ms);
 }
 void het_preduce_destroy(void* h) { delete static_cast<PReduce*>(h); }
 uint64_t het_preduce_get_partner(void* h, int worker) {

@@ -340,9 +340,11 @@ struct Server {
           // arrival opens a wait window, group closes at full membership or
           // window expiry with >= min_group.  table_id = group id,
           // keys = [worker, n_workers, min_group], floats = [wait_ms].
-          // Response status = bitmask of matched workers (<= 63 workers).
+          // Response status = bitmask of matched workers (<= 62 workers;
+          // bit 62 = below-quorum flag, bit 63 reserved for the sign of
+          // error statuses).
           if (h.nkeys < 3 || h.nfloats < 1 || keys[0] < 0 ||
-              keys[1] < 1 || keys[1] > 63 || keys[0] >= keys[1] ||
+              keys[1] < 1 || keys[1] > 62 || keys[0] >= keys[1] ||
               keys[2] < 1) {
             resp.status = -3;
             break;
@@ -377,18 +379,20 @@ struct Server {
           // psf/cachetable.h; hetu_client.h:19 syncEmbedding): client sends
           // (keys, its cached versions); server returns ONLY the rows whose
           // version advanced past pull_bound — the bandwidth saving the
-          // cache protocol exists for.  keys = [k0..kn-1, v0..vn-1]
-          // (versions bit-cast to int64; kNoVersion = no cached copy),
-          // floats = [pull_bound].  Response floats = per-stale-row records
+          // cache protocol exists for.  keys = [k0..kn-1, v0..vn-1,
+          // pull_bound] (versions and the bound bit-cast to int64 so all
+          // version arithmetic is exact — a float32 channel would round
+          // bounds above 2^24; kNoVersion = no cached copy).  Response
+          // floats = per-stale-row records
           // [idx_bits, ver_lo_bits, ver_hi_bits, row(dim)].
           TableEntry e = lookup(h.table_id);
           if (!e.handle) { resp.status = -2; break; }
-          if (h.nkeys % 2 || h.nfloats < 1) { resp.status = -3; break; }
-          int64_t n = h.nkeys / 2;
+          if (!(h.nkeys % 2) || h.nkeys < 3) { resp.status = -3; break; }
+          int64_t n = h.nkeys / 2;  // (nkeys - 1) / 2
           std::vector<int64_t> ks(keys.begin(), keys.begin() + n);
           if (!keys_in_range(ks, e.rows) ||
               n * (3 + e.dim) >= kMaxElems) { resp.status = -4; break; }
-          uint64_t bound = static_cast<uint64_t>(floats[0]);
+          uint64_t bound = static_cast<uint64_t>(keys[2 * n]);
           std::vector<float> row(e.dim);
           for (int64_t i = 0; i < n; ++i) {
             uint64_t cv = static_cast<uint64_t>(keys[n + i]);
@@ -690,7 +694,6 @@ struct RemoteCache {
       int64_t st = rpc_push_refresh(ks, gs);
       if (st != 0) return st;
     }
-    float bound = static_cast<float>(pull_bound);
     size_t rec = 3 + dim;
     // chunk like the push paths: one frame per max-cap slice of the unique
     // keys so huge batches can't trip the server's response-size guard
@@ -701,15 +704,18 @@ struct RemoteCache {
     for (int64_t lo = 0; lo < nu; lo += sync_step) {
       int64_t hi = std::min(nu, lo + sync_step);
       int64_t m = hi - lo;
-      std::vector<int64_t> req(2 * m);
+      // pull_bound rides the int64 key channel (exact; the float32
+      // channel would silently round bounds above 2^24)
+      std::vector<int64_t> req(2 * m + 1);
       for (int64_t i = 0; i < m; ++i) {
         req[i] = uniq[lo + i];
         auto it = map.find(uniq[lo + i]);
         req[m + i] = static_cast<int64_t>(
             it == map.end() ? kNoVersion : it->second.version);
       }
-      ReqHeader h{kSyncEmbed, table_id, 2 * m, 1, 0};
-      int64_t st = client->request_var(h, req.data(), &bound, records);
+      req[2 * m] = static_cast<int64_t>(pull_bound);
+      ReqHeader h{kSyncEmbed, table_id, 2 * m + 1, 0, 0};
+      int64_t st = client->request_var(h, req.data(), nullptr, records);
       if (st != 0) return st;
       if (records.size() % rec) return -13;
       n_stale_total += records.size() / rec;

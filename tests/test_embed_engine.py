@@ -217,6 +217,31 @@ def test_partial_reduce_straggler():
     t2.start(); t3.start()
     t2.join(timeout=5); t3.join(timeout=5)
     assert res[2] == res["extra"] == [0, 2]
+    # every round above met its min_group contract
+    assert all(g.quorum_met for g in (res[0], res[1], res[2], res["extra"]))
+
+
+def test_partial_reduce_below_quorum_flagged():
+    """A round force-closed after the grace period with fewer than min_group
+    members must say so: progress is allowed (a dead peer can't wedge the
+    caller) but `quorum_met` is False so callers can tell degraded progress
+    from a healthy straggler-tolerant round."""
+    pr = PartialReduceCoordinator(3, wait_ms=20.0, min_group=2,
+                                  grace_ms=100.0)
+    g = pr.get_partner(0)  # nobody else ever arrives
+    assert g == [0]
+    assert not g.quorum_met
+    # and a healthy follow-up round is unflagged
+    res = {}
+    ts = [threading.Thread(
+        target=lambda i=i: res.__setitem__(i, pr.get_partner(i)))
+        for i in range(2)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(timeout=5)
+    assert res[0] == res[1] == [0, 1]
+    assert res[0].quorum_met and res[1].quorum_met
 
 
 def test_jit_bridge_lookup_and_grad():

@@ -264,6 +264,15 @@ def test_sparse_inference_embedding(rng):
     table = rng.standard_normal((9, 4)).astype(np.float32)
     table[np.abs(table) < 0.3] = 0.0
     sp = ops.dense_to_csr(jnp.asarray(table))
+    # true CSR: storage is the actual nonzeros, not rows*cols
+    assert sp.data.shape[0] == int((table != 0).sum()) < table.size
+    assert sp.indices.shape == sp.data.shape
     ids = jnp.asarray([[0, 3], [8, 3]])
     out = ops.sparse_embedding_lookup(sp, ids)
     assert_close(out, table[np.asarray(ids)])
+    # and the lookup works under jit (static shapes via max_row_nnz)
+    out_j = jax.jit(ops.sparse_embedding_lookup)(sp, ids)
+    assert_close(out_j, table[np.asarray(ids)])
+    # csr_matmul over true CSR agrees with dense
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    assert_close(ops.csr_matmul(sp, jnp.asarray(x)), table @ x)

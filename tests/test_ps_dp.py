@@ -119,6 +119,67 @@ def test_two_worker_processes(mode, staleness, tmp_path):
                                        rtol=1e-4)
 
 
+def test_bsp_lockstep_under_straggler(tmp_path):
+    """BSP means both workers compute every round on the SAME parameters.
+
+    Regression: with a single post-push barrier, a fast worker could pull,
+    compute, and push its round-k+1 gradients while a slow worker was still
+    pulling round-k parameters — the slow worker then pulled a mix.  A
+    deliberately slow worker (sleep before its pull) makes that race near
+    certain; the per-step pulled-parameter digests must still agree."""
+    with EmbeddingServer() as srv:
+        script = textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {repr(os.getcwd())})
+            import numpy as np, jax
+            from hetu_tpu.core import set_random_seed
+            from tests.test_ps_dp import Reg, _data
+            from hetu_tpu.embed.ps_dp import PSDataParallel
+
+            worker = int(sys.argv[1])
+            set_random_seed(0)
+            model = Reg()
+            ps = PSDataParallel(
+                model, lambda m, b, k: (m.loss(b["x"], b["y"]), {{}}),
+                ["127.0.0.1:{srv.port}"], optimizer="sgd", lr=0.02,
+                worker=worker, world=2, mode="bsp", chunk=16, group_id=78)
+            if worker == 1:  # straggle between the push barrier and the pull
+                orig = ps._refresh
+                def slow_refresh():
+                    time.sleep(0.1)
+                    orig()
+                ps._refresh = slow_refresh
+            x, y = _data(seed=worker)
+            digests = []
+            for _ in range(8):
+                ps.step({{"x": x, "y": y}})
+                leaves = jax.tree_util.tree_leaves(ps.model)
+                digests.append(float(sum(float(np.sum(np.asarray(l)))
+                                         for l in leaves)))
+            print("DIGESTS", " ".join(f"{{d!r}}" for d in digests))
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs = [subprocess.Popen([sys.executable, "-c", script, str(w)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  env=env, cwd=os.getcwd())
+                 for w in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, out
+            outs.append(out)
+        digests = []
+        for out in outs:
+            line = next(l for l in out.splitlines()
+                        if l.startswith("DIGESTS"))
+            digests.append([float(v) for v in line.split()[1:]])
+        assert digests[0] == digests[1], (
+            "workers pulled different parameters within a BSP round:\n"
+            f"{digests[0]}\n{digests[1]}")
+
+
 def test_large_leaf_segmented_transfer():
     """Leaves above the server's per-frame cap move in segments
     (regression: a 23M-float embedding leaf must survive init/push/pull)."""
