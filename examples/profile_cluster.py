@@ -34,7 +34,31 @@ def main():
     ap.add_argument("--probe-batch", type=int, default=8)
     ap.add_argument("--probe-seq", type=int, default=128)
     ap.add_argument("--out", default=None, help="write ClusterSpec json")
+    ap.add_argument("--ps-loads", default=None, metavar="ADDR:TABLE",
+                    help="dump server-side load stats for a network-PS "
+                         "table (host:port:table_id), e.g. "
+                         "127.0.0.1:9000:5 — the reference's getLoads")
+    ap.add_argument("--ps-topk", type=int, default=10,
+                    help="hottest rows to list with --ps-loads")
     args = ap.parse_args()
+
+    if args.ps_loads:
+        from hetu_tpu.embed.net import attach_loads_client
+
+        host, port, table_id = args.ps_loads.rsplit(":", 2)
+        loads = attach_loads_client(f"{host}:{port}", int(table_id),
+                                    topk=args.ps_topk)
+        print(f"PS loads for table {table_id} on {host}:{port}:")
+        for k in ("pull_reqs", "push_reqs", "pull_rows", "push_rows",
+                  "sync_reqs", "sync_stale_rows"):
+            print(f"  {k:16s}: {loads[k]}")
+        if loads["hot_rows"]:
+            print("  hottest rows (row, touches):")
+            for row, cnt in loads["hot_rows"]:
+                print(f"    {row:10d}  {cnt}")
+        else:
+            print("  (no touch histogram — enable with start_record)")
+        return
 
     import hetu_tpu as ht
     from hetu_tpu.exec.profiler import profile_fn

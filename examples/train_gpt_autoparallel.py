@@ -87,8 +87,53 @@ def main():
         model, state, loss = step(model, state, ids)
         print(f"step {i}: loss {float(loss):.4f}")
     jax.block_until_ready(loss)
-    print(f"throughput: {args.steps * args.global_batch / (time.time()-t0):.1f}"
-          f" samples/s under {plan.describe()}")
+    planned_sps = args.steps * args.global_batch / (time.time() - t0)
+    print(f"throughput: {planned_sps:.1f} samples/s under {plan.describe()}")
+
+    # ---- close the loop: measure the planned config against naive DP ----
+    # (the reference grounds its searchers in measured profiles,
+    # python/hetu/profiler.py:609; a plan is only as good as its measured
+    # win over the fallback everyone would otherwise use)
+    from hetu_tpu.parallel.autoparallel.search import Plan
+    from hetu_tpu.parallel.autoparallel import ParallelChoice
+
+    naive = Plan(pp=1, n_microbatches=1,
+                 choices=[ParallelChoice(dp=n_dev)] * args.layers,
+                 time=0.0, peak_bytes=0.0, feasible=True)
+    rows = []
+    for label, p in (("planned", plan), ("naive-dp", naive)):
+        mesh_spec_c, kwargs_c = plan_to_strategy(p)
+        ht.set_random_seed(0)
+        mesh_c = make_mesh(mesh_spec_c)
+        model_c = shard_tree(GPT(cfg), mesh_c, kwargs_c["rules"])
+        state_c = jax.device_put(opt.init(model_c),
+                                 NamedSharding(mesh_c, P()))
+        sh_c = NamedSharding(mesh_c, P("dp"))
+
+        @jax.jit
+        def step_c(model, state, ids):
+            loss, grads = jax.value_and_grad(
+                lambda m: m.loss(ids).astype(jnp.float32))(model)
+            model, state = opt.update(grads, state, model)
+            return model, state, loss
+
+        ids = jax.device_put(
+            jnp.asarray(rng.integers(0, 1000,
+                                     (args.global_batch, args.seq)),
+                        jnp.int32), sh_c)
+        model_c, state_c, l = step_c(model_c, state_c, ids)  # compile
+        jax.block_until_ready(l)
+        t0 = time.time()
+        for _ in range(5):
+            model_c, state_c, l = step_c(model_c, state_c, ids)
+        jax.block_until_ready(l)
+        rows.append((label, p.describe(), (time.time() - t0) / 5))
+
+    print(f"\n{'config':10s}{'plan':44s}{'step ms':>10s}")
+    for label, desc, dt in rows:
+        print(f"{label:10s}{desc:44s}{dt * 1e3:>10.1f}")
+    win = rows[1][2] / max(rows[0][2], 1e-9)
+    print(f"planned vs naive DP: {win:.2f}x")
 
 
 if __name__ == "__main__":

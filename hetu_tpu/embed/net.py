@@ -28,7 +28,7 @@ from hetu_tpu.embed.engine import OPTIMIZERS, _load
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
 
 __all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
-           "RemoteHostEmbedding"]
+           "RemoteHostEmbedding", "attach_loads_client"]
 
 
 def _lib():
@@ -71,6 +71,14 @@ def _lib():
         "het_ps_preduce": ([ctypes.c_void_p, ctypes.c_uint32,
                             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                             ctypes.c_float], ctypes.c_int64),
+        "het_ps_start_record": ([ctypes.c_void_p, ctypes.c_int],
+                                ctypes.c_int64),
+        "het_ps_get_loads": ([ctypes.c_void_p, ctypes.c_uint32,
+                              ctypes.c_int64,
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.POINTER(ctypes.c_uint64)],
+                             ctypes.c_int64),
         "het_rcache_create": ([ctypes.c_void_p, ctypes.c_uint32,
                                ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
                                ctypes.c_uint64, ctypes.c_int64],
@@ -93,6 +101,35 @@ def _lib():
         fn.restype = restype
     lib._ps_net_bound = True
     return lib
+
+
+def _get_loads(lib, conn, table_id: int, topk: int) -> dict:
+    counters = (ctypes.c_uint64 * 6)()
+    rows = (ctypes.c_uint64 * max(topk, 1))()
+    touches = (ctypes.c_uint64 * max(topk, 1))()
+    n = lib.het_ps_get_loads(conn, table_id, topk, counters, rows, touches)
+    if n < 0:
+        raise RuntimeError(f"remote get_loads failed (status {n})")
+    names = ("pull_reqs", "push_reqs", "pull_rows", "push_rows",
+             "sync_reqs", "sync_stale_rows")
+    out = {k: int(v) for k, v in zip(names, counters)}
+    out["hot_rows"] = [(int(rows[i]), int(touches[i])) for i in range(int(n))]
+    return out
+
+
+def attach_loads_client(address: str, table_id: int, *, topk: int = 10) -> dict:
+    """One-shot load introspection against a running server WITHOUT creating
+    or attaching a table — an operator's debugging probe (the reference
+    fetches getLoads from the live executor, executor.py:675)."""
+    lib = _lib()
+    host, _, port = address.partition(":")
+    c = lib.het_ps_connect(host.encode(), int(port))
+    if not c:
+        raise ConnectionError(f"cannot reach embedding server {address}")
+    try:
+        return _get_loads(lib, c, int(table_id), topk)
+    finally:
+        lib.het_ps_disconnect(c)
 
 
 def _i64(a):
@@ -219,6 +256,19 @@ class RemoteEmbeddingTable:
         server (reference BarrierWorker)."""
         self._check(self._lib.het_ps_barrier(self._c, barrier_id, world),
                     "barrier")
+
+    def start_record(self, on: bool = True):
+        """Toggle server-side per-row touch recording on EVERY table of this
+        server (the reference's startRecord PS traffic logging,
+        executor.py:398-401).  Off frees the histograms."""
+        self._check(self._lib.het_ps_start_record(self._c, int(bool(on))),
+                    "start_record")
+
+    def get_loads(self, topk: int = 0) -> dict:
+        """Server-side load dump for this table (the reference's getLoads,
+        executor.py:675): request/row counters plus, while recording, the
+        ``topk`` hottest rows — the hot-key skew HET debugging needs."""
+        return _get_loads(self._lib, self._c, self.table_id, topk)
 
     def ssp_sync(self, group_id: int, worker: int, clock: int,
                  staleness: int, world: int):

@@ -29,9 +29,11 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <list>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -75,6 +77,8 @@ enum Op : uint32_t {
   kPReduce = 10,
   kSyncEmbed = 11,
   kPushSync = 12,
+  kStartRecord = 13,
+  kGetLoads = 14,
 };
 
 // client cache version meaning "no cached copy — always refresh"
@@ -133,10 +137,33 @@ bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// Server-side load/traffic introspection (the reference's startRecord PS
+// traffic logging + getLoads per-server load stats,
+// python/hetu/gpu_ops/executor.py:398-401,675).  Request/row counters are
+// always-on cheap atomics; the per-row touch histogram (the hot-key skew
+// signal HET debugging needs) only exists while recording is on.
+struct TableStats {
+  std::atomic<uint64_t> pull_reqs{0}, push_reqs{0}, pull_rows{0},
+      push_rows{0}, sync_reqs{0}, sync_stale_rows{0};
+  std::atomic<bool> recording{false};  // gate: skip the lock when off
+  std::mutex tmu;
+  std::vector<uint32_t> touches;  // per-row serve count while recording
+
+  void touch(const int64_t* keys, int64_t n) {
+    // steady-state training (recording off) must not take a lock here: the
+    // bulk and priority channels' handler threads would re-serialize on it
+    if (!recording.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> lk(tmu);
+    if (touches.empty()) return;
+    for (int64_t i = 0; i < n; ++i) ++touches[keys[i]];
+  }
+};
+
 struct TableEntry {
   void* handle = nullptr;
   int64_t rows = 0;
   int64_t dim = 0;
+  std::shared_ptr<TableStats> stats;  // shared: lookup() returns copies
 };
 
 struct Barrier {
@@ -159,6 +186,7 @@ struct Server {
   std::map<uint32_t, Barrier> barriers;
   std::map<uint32_t, SspGroup> ssp_groups;
   std::map<uint32_t, void*> preduce_groups;  // het_preduce handles
+  std::atomic<bool> record{false};            // per-row touch recording
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
 
@@ -197,7 +225,7 @@ struct Server {
     while (!stop.load()) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
-      if (h.op < kCreate || h.op > kPushSync || h.nkeys < 0 ||
+      if (h.op < kCreate || h.op > kGetLoads || h.nkeys < 0 ||
           h.nfloats < 0 || h.nbytes < 0 || h.nkeys >= kMaxElems ||
           h.nfloats >= kMaxElems || h.nbytes >= kMaxElems)
         break;  // not our protocol — drop the connection
@@ -231,6 +259,11 @@ struct Server {
           TableEntry e;
           e.rows = keys[0];
           e.dim = keys[1];
+          e.stats = std::make_shared<TableStats>();
+          if (record.load()) {
+            e.stats->touches.assign(e.rows, 0);
+            e.stats->recording.store(true);
+          }
           e.handle = het_table_create(
               keys[0], keys[1], static_cast<int>(keys[2]), floats[0],
               floats[1], floats[2], floats[3], floats[4], floats[5],
@@ -245,6 +278,9 @@ struct Server {
               h.nkeys * e.dim >= kMaxElems) { resp.status = -4; break; }
           out.resize(h.nkeys * e.dim);
           het_table_pull(e.handle, keys.data(), h.nkeys, out.data());
+          e.stats->pull_reqs++;
+          e.stats->pull_rows += h.nkeys;
+          e.stats->touch(keys.data(), h.nkeys);
           resp.nfloats = static_cast<int64_t>(out.size());
           break;
         }
@@ -254,6 +290,9 @@ struct Server {
           if (!keys_in_range(keys, e.rows) ||
               h.nfloats != h.nkeys * e.dim) { resp.status = -4; break; }
           het_table_push(e.handle, keys.data(), h.nkeys, floats.data());
+          e.stats->push_reqs++;
+          e.stats->push_rows += h.nkeys;
+          e.stats->touch(keys.data(), h.nkeys);
           break;
         }
         case kSetRows: {
@@ -393,6 +432,8 @@ struct Server {
           if (!keys_in_range(ks, e.rows) ||
               n * (3 + e.dim) >= kMaxElems) { resp.status = -4; break; }
           uint64_t bound = static_cast<uint64_t>(keys[2 * n]);
+          e.stats->sync_reqs++;
+          e.stats->touch(ks.data(), n);
           std::vector<float> row(e.dim);
           for (int64_t i = 0; i < n; ++i) {
             uint64_t cv = static_cast<uint64_t>(keys[n + i]);
@@ -404,6 +445,7 @@ struct Server {
             out.push_back(bits_to_float(static_cast<uint32_t>(sv)));
             out.push_back(bits_to_float(static_cast<uint32_t>(sv >> 32)));
             out.insert(out.end(), row.begin(), row.end());
+            e.stats->sync_stale_rows++;
           }
           resp.nfloats = static_cast<int64_t>(out.size());
           break;
@@ -420,6 +462,9 @@ struct Server {
               h.nfloats != h.nkeys * e.dim ||
               h.nkeys * (2 + e.dim) >= kMaxElems) { resp.status = -4; break; }
           het_table_push(e.handle, keys.data(), h.nkeys, floats.data());
+          e.stats->push_reqs++;
+          e.stats->push_rows += h.nkeys;
+          e.stats->touch(keys.data(), h.nkeys);
           std::vector<float> row(e.dim);
           out.reserve(h.nkeys * (2 + e.dim));
           for (int64_t i = 0; i < h.nkeys; ++i) {
@@ -428,6 +473,74 @@ struct Server {
             out.push_back(bits_to_float(static_cast<uint32_t>(sv)));
             out.push_back(bits_to_float(static_cast<uint32_t>(sv >> 32)));
             out.insert(out.end(), row.begin(), row.end());
+          }
+          resp.nfloats = static_cast<int64_t>(out.size());
+          break;
+        }
+        case kStartRecord: {
+          // keys[0]=1: start per-row touch recording on every table (and
+          // tables created later); 0: stop and free the histograms.  The
+          // reference's startRecord (executor.py:398-401).
+          if (h.nkeys < 1) { resp.status = -3; break; }
+          bool on = keys[0] != 0;
+          record.store(on);
+          std::lock_guard<std::mutex> lk(mu);
+          for (auto& kv : tables) {
+            std::lock_guard<std::mutex> tl(kv.second.stats->tmu);
+            if (on)
+              kv.second.stats->touches.assign(kv.second.rows, 0);
+            else
+              kv.second.stats->touches = {};
+            kv.second.stats->recording.store(on);
+          }
+          break;
+        }
+        case kGetLoads: {
+          // Per-table load dump (the reference's getLoads, executor.py:675).
+          // keys = [topk].  Response floats: 6 uint64 counters as lo/hi bit
+          // pairs [pull_reqs, push_reqs, pull_rows, push_rows, sync_reqs,
+          // sync_stale_rows], then up to topk hottest rows as
+          // (row lo/hi, touches lo/hi) — only meaningful while recording.
+          TableEntry e = lookup(h.table_id);
+          if (!e.handle) { resp.status = -2; break; }
+          // clamp like every sibling variable-length path; also bounds
+          // the time spent holding the histogram lock below
+          int64_t topk = h.nkeys >= 1 ? keys[0] : 0;
+          topk = std::min<int64_t>(topk, 4096);
+          auto put_u64 = [&](uint64_t v) {
+            out.push_back(bits_to_float(static_cast<uint32_t>(v)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(v >> 32)));
+          };
+          TableStats& st = *e.stats;
+          put_u64(st.pull_reqs.load());
+          put_u64(st.push_reqs.load());
+          put_u64(st.pull_rows.load());
+          put_u64(st.push_rows.load());
+          put_u64(st.sync_reqs.load());
+          put_u64(st.sync_stale_rows.load());
+          if (topk > 0) {
+            // snapshot under the lock, scan/sort outside it — a multi-
+            // second O(rows) scan must not stall concurrent pull/push
+            // threads in TableStats::touch
+            std::vector<uint32_t> snap;
+            {
+              std::lock_guard<std::mutex> tl(st.tmu);
+              snap = st.touches;
+            }
+            if (!snap.empty()) {
+              std::vector<int64_t> idx;
+              for (int64_t r = 0; r < static_cast<int64_t>(snap.size()); ++r)
+                if (snap[r]) idx.push_back(r);
+              topk = std::min<int64_t>(
+                  topk, static_cast<int64_t>(idx.size()));
+              std::partial_sort(
+                  idx.begin(), idx.begin() + topk, idx.end(),
+                  [&](int64_t a, int64_t b) { return snap[a] > snap[b]; });
+              for (int64_t i = 0; i < topk; ++i) {
+                put_u64(static_cast<uint64_t>(idx[i]));
+                put_u64(snap[idx[i]]);
+              }
+            }
           }
           resp.nfloats = static_cast<int64_t>(out.size());
           break;
@@ -475,33 +588,58 @@ struct Server {
 };
 
 struct Client {
-  int fd = -1;
-  std::mutex mu;  // one in-flight request per connection
+  // Two independently-locked channels to the same server (the portable
+  // core of ps-lite's priority-scheduled P3 van, p3_van.h:12): bulk
+  // traffic (pulls, prefetch delta syncs — large responses) rides ``fd``;
+  // gradient pushes and blocking control ops ride ``fd_prio`` so they are
+  // never queued behind an in-flight bulk response on one socket.  The
+  // server handles each connection on its own thread, so a push completes
+  // while a large prefetch pull is still streaming.
+  int fd = -1;       // bulk channel
+  int fd_prio = -1;  // priority channel (-1: single-channel mode)
+  std::mutex mu;       // one in-flight request per channel
+  std::mutex mu_prio;
 
   ~Client() {
     if (fd >= 0) ::close(fd);
+    if (fd_prio >= 0) ::close(fd_prio);
+  }
+
+  int64_t request_on(int sock, std::mutex& m, const ReqHeader& h,
+                     const int64_t* keys, const float* floats,
+                     const char* bytes, float* out, int64_t out_floats) {
+    std::lock_guard<std::mutex> lk(m);
+    if (!write_full(sock, &h, sizeof(h))) return -10;
+    if (h.nkeys && !write_full(sock, keys, h.nkeys * 8)) return -10;
+    if (h.nfloats && !write_full(sock, floats, h.nfloats * 4)) return -10;
+    if (h.nbytes && !write_full(sock, bytes, h.nbytes)) return -10;
+    RespHeader r;
+    if (!read_full(sock, &r, sizeof(r))) return -11;
+    if (r.nfloats) {
+      if (r.nfloats != out_floats || !out) {
+        // drain to keep the stream consistent, then report
+        std::vector<float> sink(r.nfloats);
+        read_full(sock, sink.data(), r.nfloats * 4);
+        return -12;
+      }
+      if (!read_full(sock, out, r.nfloats * 4)) return -11;
+    }
+    return r.status;
   }
 
   int64_t request(const ReqHeader& h, const int64_t* keys,
                   const float* floats, const char* bytes, float* out,
                   int64_t out_floats) {
-    std::lock_guard<std::mutex> lk(mu);
-    if (!write_full(fd, &h, sizeof(h))) return -10;
-    if (h.nkeys && !write_full(fd, keys, h.nkeys * 8)) return -10;
-    if (h.nfloats && !write_full(fd, floats, h.nfloats * 4)) return -10;
-    if (h.nbytes && !write_full(fd, bytes, h.nbytes)) return -10;
-    RespHeader r;
-    if (!read_full(fd, &r, sizeof(r))) return -11;
-    if (r.nfloats) {
-      if (r.nfloats != out_floats || !out) {
-        // drain to keep the stream consistent, then report
-        std::vector<float> sink(r.nfloats);
-        read_full(fd, sink.data(), r.nfloats * 4);
-        return -12;
-      }
-      if (!read_full(fd, out, r.nfloats * 4)) return -11;
-    }
-    return r.status;
+    return request_on(fd, mu, h, keys, floats, bytes, out, out_floats);
+  }
+
+  int64_t request_prio(const ReqHeader& h, const int64_t* keys,
+                       const float* floats, const char* bytes, float* out,
+                       int64_t out_floats) {
+    if (fd_prio < 0)  // HETU_PS_SINGLE_CHANNEL=1 (A/B benchmarking)
+      return request_on(fd, mu, h, keys, floats, bytes, out, out_floats);
+    return request_on(fd_prio, mu_prio, h, keys, floats, bytes, out,
+                      out_floats);
   }
 
   // request whose response length is decided by the server (delta sync)
@@ -563,8 +701,9 @@ struct RemoteCache {
       size_t hi = std::min(ks.size(), lo + step);
       ReqHeader h{kPush, table_id, static_cast<int64_t>(hi - lo),
                   static_cast<int64_t>((hi - lo) * dim), 0};
-      int64_t st = client->request(h, ks.data() + lo, gs.data() + lo * dim,
-                                   nullptr, nullptr, 0);
+      int64_t st = client->request_prio(h, ks.data() + lo,
+                                        gs.data() + lo * dim, nullptr,
+                                        nullptr, 0);
       if (st != 0) return st;
     }
     return 0;
@@ -584,9 +723,10 @@ struct RemoteCache {
       ReqHeader h{kPushSync, table_id, static_cast<int64_t>(n),
                   static_cast<int64_t>(n * dim), 0};
       recs.resize(rec * n);
-      int64_t st = client->request(h, ks.data() + lo, gs.data() + lo * dim,
-                                   nullptr, recs.data(),
-                                   static_cast<int64_t>(recs.size()));
+      int64_t st = client->request_prio(h, ks.data() + lo,
+                                        gs.data() + lo * dim, nullptr,
+                                        recs.data(),
+                                        static_cast<int64_t>(recs.size()));
       if (st != 0) return st;
       for (size_t i = 0; i < n; ++i) {
         auto it = map.find(ks[lo + i]);
@@ -835,16 +975,29 @@ void* het_ps_connect(const char* host, int port) {
   std::string port_s = std::to_string(port);
   if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
     return nullptr;
+  auto dial = [&]() {
+    int sock = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (sock >= 0 && ::connect(sock, res->ai_addr, res->ai_addrlen) != 0) {
+      ::close(sock);
+      sock = -1;
+    }
+    if (sock >= 0) {
+      int one = 1;
+      ::setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return sock;
+  };
   auto* c = new Client();
-  c->fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-  if (c->fd < 0 || ::connect(c->fd, res->ai_addr, res->ai_addrlen) != 0) {
-    ::freeaddrinfo(res);
+  c->fd = dial();
+  const char* single = ::getenv("HETU_PS_SINGLE_CHANNEL");
+  bool split = !(single && single[0] == '1');
+  if (split)  // see Client: separate channel for pushes/control
+    c->fd_prio = dial();
+  ::freeaddrinfo(res);
+  if (c->fd < 0 || (split && c->fd_prio < 0)) {
     delete c;
     return nullptr;
   }
-  ::freeaddrinfo(res);
-  int one = 1;
-  ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return c;
 }
 
@@ -873,8 +1026,8 @@ int64_t het_ps_pull(void* h, uint32_t table_id, const int64_t* keys,
 int64_t het_ps_push(void* h, uint32_t table_id, const int64_t* keys,
                     int64_t n, int64_t dim, const float* grads) {
   ReqHeader hh{kPush, table_id, n, n * dim, 0};
-  return static_cast<Client*>(h)->request(hh, keys, grads, nullptr, nullptr,
-                                          0);
+  return static_cast<Client*>(h)->request_prio(hh, keys, grads, nullptr,
+                                               nullptr, 0);
 }
 
 int64_t het_ps_set_rows(void* h, uint32_t table_id, const int64_t* keys,
@@ -906,7 +1059,7 @@ int64_t het_ps_set_lr(void* h, uint32_t table_id, float lr) {
 
 int64_t het_ps_barrier(void* h, uint32_t barrier_id, int64_t world) {
   ReqHeader hh{kBarrier, barrier_id, 1, 0, 0};
-  return static_cast<Client*>(h)->request(hh, &world, nullptr, nullptr,
+  return static_cast<Client*>(h)->request_prio(hh, &world, nullptr, nullptr,
                                           nullptr, 0);
 }
 
@@ -914,15 +1067,46 @@ int64_t het_ps_ssp_sync(void* h, uint32_t group_id, int64_t worker,
                         int64_t clock, int64_t staleness, int64_t world) {
   int64_t keys[4] = {worker, clock, staleness, world};
   ReqHeader hh{kSspSync, group_id, 4, 0, 0};
-  return static_cast<Client*>(h)->request(hh, keys, nullptr, nullptr, nullptr,
+  return static_cast<Client*>(h)->request_prio(hh, keys, nullptr, nullptr, nullptr,
                                           0);
+}
+
+int64_t het_ps_start_record(void* h, int on) {
+  int64_t k = on ? 1 : 0;
+  ReqHeader hh{kStartRecord, 0, 1, 0, 0};
+  return static_cast<Client*>(h)->request(hh, &k, nullptr, nullptr, nullptr,
+                                          0);
+}
+
+// counters: caller-allocated uint64[6]; top rows/touches: uint64[topk] each.
+// Returns the number of top rows filled, or a negative status.
+int64_t het_ps_get_loads(void* h, uint32_t table_id, int64_t topk,
+                         uint64_t* counters, uint64_t* rows,
+                         uint64_t* touches) {
+  int64_t k = topk;
+  ReqHeader hh{kGetLoads, table_id, 1, 0, 0};
+  std::vector<float> out;
+  int64_t st = static_cast<Client*>(h)->request_var(hh, &k, nullptr, out);
+  if (st != 0) return st;
+  if (out.size() < 12 || out.size() % 4) return -13;
+  auto get_u64 = [&](size_t i) {
+    return static_cast<uint64_t>(float_to_bits(out[i])) |
+           (static_cast<uint64_t>(float_to_bits(out[i + 1])) << 32);
+  };
+  for (int i = 0; i < 6; ++i) counters[i] = get_u64(2 * i);
+  int64_t n_top = static_cast<int64_t>((out.size() - 12) / 4);
+  for (int64_t i = 0; i < n_top; ++i) {
+    rows[i] = get_u64(12 + 4 * i);
+    touches[i] = get_u64(12 + 4 * i + 2);
+  }
+  return n_top;
 }
 
 int64_t het_ps_preduce(void* h, uint32_t group_id, int64_t worker,
                        int64_t n_workers, int64_t min_group, float wait_ms) {
   int64_t keys[3] = {worker, n_workers, min_group};
   ReqHeader hh{kPReduce, group_id, 3, 1, 0};
-  return static_cast<Client*>(h)->request(hh, keys, &wait_ms, nullptr,
+  return static_cast<Client*>(h)->request_prio(hh, keys, &wait_ms, nullptr,
                                           nullptr, 0);
 }
 

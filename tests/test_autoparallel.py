@@ -110,3 +110,86 @@ def test_profiler_cache_roundtrip(tmp_path):
     cluster = prof.calibrate()
     assert cluster.n_devices == len(jax.devices())
     assert cluster.peak_flops > 0
+
+
+def test_profile_plan_measured_loop():
+    """Close the searcher loop against reality (the reference grounds its
+    searchers in measured profiles — profiler.py:609 HetuSimulator feeding
+    FlexFlow/OptCNN): live-calibrate the cost model on this backend, search
+    a plan, materialize it, TRAIN with it on the 8-device mesh, and check
+    the planned config's measured step time against naive DP.
+
+    Also exercises the memory-constrained branch: under a budget naive DP
+    cannot fit, the planner must emit a sharded plan that still trains.
+    """
+    import dataclasses
+    import time
+
+    import jax.numpy as jnp
+
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.optim import AdamOptimizer
+    from hetu_tpu.parallel.autoparallel import (MemoryCostModel,
+                                                transformer_layer_spec)
+    from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+    from hetu_tpu.parallel.strategies import ShardingStrategy
+
+    hidden, seq, layers, batch = 128, 128, 4, 16
+    specs = [transformer_layer_spec(hidden, seq, name=f"l{i}")
+             for i in range(layers)]
+
+    # 1) live calibration: matmul throughput + allreduce bandwidth measured
+    # on THIS backend (not nominal constants)
+    probe_mesh = make_mesh(MeshSpec(dp=8))  # for the collective probe
+    cluster = dataclasses.replace(CostProfiler().calibrate(probe_mesh),
+                                  n_devices=8)
+    assert cluster.peak_flops > 0 and cluster.ici_bandwidth > 0
+
+    def measure(plan) -> float:
+        mesh_spec, kwargs = plan_to_strategy(plan)
+        set_random_seed(0)
+        cfg = GPTConfig(vocab_size=512, hidden_size=hidden,
+                        num_layers=layers, num_heads=4, max_seq_len=seq)
+        trainer = Trainer(
+            GPT(cfg), AdamOptimizer(1e-3),
+            lambda m, b, k: (m.loss(b["ids"], training=False), {}),
+            strategy=ShardingStrategy(mesh=make_mesh(mesh_spec), **kwargs))
+        rng = np.random.default_rng(0)
+        b = {"ids": jnp.asarray(rng.integers(0, 512, (batch, seq)),
+                                jnp.int32)}
+        m = trainer.step(b)  # compile
+        loss = float(m["loss"])
+        assert np.isfinite(loss)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            m = trainer.step(b)
+        float(m["loss"])
+        return (time.perf_counter() - t0) / 5
+
+    # 2) unconstrained search -> measured: must not lose to naive DP
+    plan = dp_search(specs, cluster, global_batch=batch)
+    naive = Plan(pp=1, n_microbatches=1,
+                 choices=[ParallelChoice(dp=8)] * layers,
+                 time=0.0, peak_bytes=0.0, feasible=True)
+    t_planned = measure(plan)
+    t_naive = measure(naive)
+    # 35% tolerance absorbs CPU-mesh timing noise; the real assertion is
+    # that the planner never picks something catastrophically worse than
+    # the baseline it could always fall back to
+    assert t_planned <= t_naive * 1.35, (
+        f"planned {plan.describe()} measured {t_planned*1e3:.1f}ms vs "
+        f"naive DP {t_naive*1e3:.1f}ms")
+
+    # 3) constrained search: budget too small for naive DP's per-device
+    # memory -> the planner must shard (tp/zero), and the plan must train
+    mem = MemoryCostModel(cluster)
+    dp_bytes = sum(mem.layer_bytes(s, ParallelChoice(dp=8), batch // 8)
+                   for s in specs)
+    tight = dataclasses.replace(cluster, hbm_bytes=dp_bytes * 0.6)
+    plan_tight = dp_search(specs, tight, global_batch=batch)
+    d = plan_tight.dominant
+    assert d.tp > 1 or d.zero or plan_tight.pp > 1, plan_tight.describe()
+    t_tight = measure(plan_tight)
+    assert np.isfinite(t_tight)

@@ -351,3 +351,56 @@ class TestRemoteCache:
         direct = emb.pull_rows(b).reshape(8, 4)
         np.testing.assert_allclose(np.asarray(emb.rows), direct, rtol=1e-6)
         assert emb._handle.prefetcher is not None  # overlap path engaged
+
+
+def test_server_side_load_introspection(server):
+    """startRecord/getLoads capability (reference executor.py:398-401,675):
+    the server reports per-table traffic counters, and a skewed key
+    distribution shows up as hot rows in the recorded touch histogram."""
+    addr = f"127.0.0.1:{server.port}"
+    t = RemoteEmbeddingTable(addr, 31, 100, 4, optimizer="sgd", lr=0.1)
+    t.start_record(True)
+    rng = np.random.default_rng(0)
+    # zipf-ish skew: row 7 is hot, the rest cold
+    for _ in range(20):
+        ids = np.where(rng.random(16) < 0.75, 7,
+                       rng.integers(0, 100, 16)).astype(np.int64)
+        t.pull(ids)
+        t.push(ids, np.ones((16, 4), np.float32))
+    loads = t.get_loads(topk=3)
+    assert loads["pull_reqs"] == 20 and loads["push_reqs"] == 20
+    assert loads["pull_rows"] == loads["push_rows"] == 20 * 16
+    hot = loads["hot_rows"]
+    assert hot and hot[0][0] == 7  # the skewed key is the hottest
+    # hot row dominates: ~75% of 2*320 touches
+    assert hot[0][1] > 0.5 * (2 * 20 * 16)
+    assert all(hot[i][1] >= hot[i + 1][1] for i in range(len(hot) - 1))
+    # counters survive with recording off; histogram is freed
+    t.start_record(False)
+    loads2 = t.get_loads(topk=5)
+    assert loads2["pull_reqs"] == 20
+    assert loads2["hot_rows"] == []
+
+
+def test_priority_channel_independent_of_bulk(server):
+    """The P3-style two-channel client (ps-lite p3_van.h:12 capability): a
+    blocking control op on the priority channel must not wedge bulk pulls on
+    the same client.  With the old single shared connection this deadlocked:
+    the pull waited on the connection mutex held by the in-flight barrier."""
+    addr = f"127.0.0.1:{server.port}"
+    a = RemoteEmbeddingTable(addr, 41, 32, 4, optimizer="sgd", lr=0.1)
+    got = {}
+
+    def blocked_barrier():
+        a.barrier(900, 2)  # blocks until a second client arrives
+        got["barrier"] = True
+
+    th = threading.Thread(target=blocked_barrier)
+    th.start()
+    time.sleep(0.05)  # barrier is in flight on the priority channel
+    got["pull"] = a.pull(np.arange(8))  # bulk channel: must not block
+    assert got["pull"].shape == (8, 4)
+    b = RemoteEmbeddingTable(addr, 41, 32, 4, optimizer="sgd", lr=0.1)
+    b.barrier(900, 2)  # release
+    th.join(timeout=10)
+    assert got.get("barrier") and not th.is_alive()

@@ -276,3 +276,34 @@ def test_sparse_inference_embedding(rng):
     # csr_matmul over true CSR agrees with dense
     x = rng.standard_normal((4, 3)).astype(np.float32)
     assert_close(ops.csr_matmul(sp, jnp.asarray(x)), table @ x)
+
+
+def test_dropout_mask_statistics():
+    """Counter-hash dropout: correct keep rate, scaling, determinism per
+    key, decorrelation across keys and positions."""
+    x = jnp.ones((256, 256), jnp.float32)
+    key = jax.random.key(7)
+    y = ops.dropout(x, 0.3, key)
+    kept = np.asarray(y) != 0
+    # keep rate within 1% of 0.7 over 65k draws
+    assert abs(kept.mean() - 0.7) < 0.01
+    # inverted scaling preserves the mean
+    assert abs(float(y.mean()) - 1.0) < 0.02
+    np.testing.assert_allclose(np.asarray(y)[kept],
+                               1.0 / 0.7, rtol=1e-6)
+    # deterministic given the key; different across keys
+    np.testing.assert_array_equal(np.asarray(ops.dropout(x, 0.3, key)),
+                                  np.asarray(y))
+    y2 = ops.dropout(x, 0.3, jax.random.key(8))
+    assert (np.asarray(y2) != np.asarray(y)).mean() > 0.2
+    # rows decorrelated (not a striped mask)
+    row_rates = kept.mean(axis=1)
+    assert row_rates.std() < 0.1
+    # training=False / rate 0 are identity
+    np.testing.assert_array_equal(
+        np.asarray(ops.dropout(x, 0.3, key, training=False)), np.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(ops.dropout(x, 0.0, key)), np.asarray(x))
+    # gradient flows only through kept elements
+    g = jax.grad(lambda v: ops.dropout(v, 0.3, key).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g) != 0, kept)
