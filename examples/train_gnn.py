@@ -8,6 +8,9 @@ mesh (reference DistGCN_15d), plus neighbor-sampled mini-batch training
     python examples/train_gnn.py                    # full-batch GCN
     python examples/train_gnn.py --dist             # 1.5D partitioned (mesh)
     python examples/train_gnn.py --sample           # sampled subgraphs
+    python examples/train_gnn.py --sample --graph-server   # server-side
+        # sampling: an EmbeddingServer process owns the graph and serves
+        # neighbor samples over TCP (the reference's GraphMix server role)
 """
 
 from __future__ import annotations
@@ -62,6 +65,10 @@ def main():
                     help="1.5D-partitioned spmm over the device mesh")
     ap.add_argument("--sample", action="store_true",
                     help="neighbor-sampled mini-batch training")
+    ap.add_argument("--graph-server", default=None, const="local",
+                    nargs="?", metavar="ADDR",
+                    help="with --sample: pull samples from a graph server "
+                         "(host:port, or no value to spawn one locally)")
     args = ap.parse_args()
     if args.dist and args.sample:
         ap.error("--dist and --sample are mutually exclusive")
@@ -105,13 +112,31 @@ def main():
         return model, state, loss
 
     if args.sample:
-        # sampled mini-batches: a fresh 2-hop relabeled subgraph per step
-        gi = GraphIndex(np.asarray(edge_index))
+        # sampled mini-batches: a fresh 2-hop relabeled subgraph per step,
+        # from the in-process index or a graph-server process
+        sampler = None
+        local_srv = None
+        if args.graph_server:
+            from hetu_tpu.embed.graph import RemoteGraph
+            addr = args.graph_server
+            if addr == "local":
+                from hetu_tpu.embed.net import EmbeddingServer
+                local_srv = EmbeddingServer()
+                addr = f"127.0.0.1:{local_srv.port}"
+                print(f"spawned graph server on {addr}")
+            sampler = RemoteGraph(addr, 1, edge_index, num_nodes=n)
+        # the worker only needs the O(E log E) local index when it samples
+        # itself — with a graph server the CSR lives server-side
+        gi = None if sampler else GraphIndex(np.asarray(edge_index))
         for s in range(args.steps):
             seeds = rng.integers(0, n, 128)
-            sub_nodes, sub_edges, seed_pos = sample_subgraph(
-                np.asarray(edge_index), seeds, num_hops=2, fanout=8,
-                rng=rng, index=gi)
+            if sampler is not None:
+                sub_nodes, sub_edges, seed_pos = sampler.sample_subgraph(
+                    seeds, num_hops=2, fanout=8)
+            else:
+                sub_nodes, sub_edges, seed_pos = sample_subgraph(
+                    np.asarray(edge_index), seeds, num_hops=2, fanout=8,
+                    rng=rng, index=gi)
             m_sub = len(sub_nodes)
             ei_s, ew_s = normalize_adjacency(sub_edges, m_sub)
             x_s = x[jnp.asarray(sub_nodes)]

@@ -23,6 +23,7 @@ from hetu_tpu.embed.sharded import ShardedHostEmbedding
 from hetu_tpu.embed.net import (EmbeddingServer, RemoteCacheTable,
                                 RemoteEmbeddingTable, RemoteHostEmbedding)
 from hetu_tpu.embed.ps_dp import PSDataParallel
+from hetu_tpu.embed.graph import RemoteGraph
 
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
@@ -30,5 +31,6 @@ __all__ = [
     "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
     "EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
+    "RemoteGraph",
     "RemoteHostEmbedding", "PSDataParallel",
 ]

@@ -1,0 +1,164 @@
+"""Remote graph-sampling client: the GraphMix server role over the TCP PS.
+
+The reference delegates GNN neighborhood sampling to dedicated GraphMix
+server processes that own the graph (examples/gnn; third_party/GraphMix
+submodule; SURVEY §5.9).  Here the SAME EmbeddingServer process owns the
+in-neighbor CSR (native/embed/ps_net.cpp kGraphLoad/kGraphSample/
+kGraphEdges): workers upload the graph once, then pull uniform neighbor
+samples and induced edges per minibatch — sampling compute and graph
+memory live server-side, workers only hold the sampled blocks.
+
+``RemoteGraph.sample_subgraph`` returns the same (node_ids, sub_edges,
+seed_pos) contract as the in-process ``models.gnn.sample_subgraph``, so a
+GCN training loop swaps between local and server-backed sampling with one
+line.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from hetu_tpu.embed.net import _lib
+
+__all__ = ["RemoteGraph"]
+
+_CHUNK = 1 << 20  # int64s per kGraphLoad frame (well under the server cap)
+
+
+def _bind(lib):
+    if getattr(lib, "_graph_bound", False):
+        return lib
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    sigs = {
+        "het_ps_graph_load": ([ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_int64, ctypes.c_int64,
+                               ctypes.c_int64, i64p, ctypes.c_int64],
+                              ctypes.c_int64),
+        "het_ps_graph_sample": ([ctypes.c_void_p, ctypes.c_uint32,
+                                 ctypes.c_int64, i64p, ctypes.c_int64, i64p],
+                                ctypes.c_int64),
+        "het_ps_graph_edges": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
+                                ctypes.c_int64, i64p, i64p, ctypes.c_int64],
+                               ctypes.c_int64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    lib._graph_bound = True
+    return lib
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class RemoteGraph:
+    """Client stub for a graph hosted on an ``EmbeddingServer``.
+
+    Pass ``edge_index`` to upload (in-neighbor CSR is built client-side
+    once and shipped in chunks); omit it to attach to a graph another
+    worker already uploaded.
+    """
+
+    def __init__(self, address: str, graph_id: int, edge_index=None, *,
+                 num_nodes: int | None = None):
+        self._lib = _bind(_lib())
+        host, _, port = address.partition(":")
+        self._c = self._lib.het_ps_connect(host.encode(), int(port))
+        if not self._c:
+            raise ConnectionError(f"cannot reach graph server {address}")
+        self.graph_id = int(graph_id)
+        if edge_index is not None:
+            self._upload(edge_index, num_nodes)
+
+    def close(self):
+        if getattr(self, "_c", None):
+            self._lib.het_ps_disconnect(self._c)
+            self._c = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _upload(self, edge_index, num_nodes):
+        src, dst = (np.asarray(a, np.int64) for a in edge_index)
+        n = int(num_nodes if num_nodes is not None
+                else (max(int(src.max()), int(dst.max())) + 1 if src.size
+                      else 0))
+        order = np.argsort(dst, kind="stable")
+        indices = src[order]
+        counts = np.bincount(dst, minlength=n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        self.num_nodes = n
+        for kind, arr in ((0, indptr), (1, indices.astype(np.int64))):
+            total = arr.size
+            if total == 0:
+                continue
+            for lo in range(0, total, _CHUNK):
+                part = np.ascontiguousarray(arr[lo:lo + _CHUNK])
+                st = self._lib.het_ps_graph_load(
+                    self._c, self.graph_id, kind, total, lo, _i64p(part),
+                    part.size)
+                if st != 0:
+                    raise RuntimeError(f"graph upload failed (status {st})")
+        # commit: the server validates the assembled CSR and only then
+        # serves samples — a half-uploaded graph is never sampleable
+        one = np.zeros(1, np.int64)
+        st = self._lib.het_ps_graph_load(self._c, self.graph_id, 2, 1, 0,
+                                         _i64p(one), 0)
+        if st != 0:
+            raise RuntimeError(f"graph commit rejected (status {st})")
+
+    def sample(self, seeds, fanout: int) -> np.ndarray:
+        """Uniform in-neighbor sample: (n_seeds, fanout) int64, -1 padded
+        where degree < fanout (kGraphSample, server-side Fisher-Yates)."""
+        seeds = np.ascontiguousarray(np.asarray(seeds).ravel(), np.int64)
+        out = np.empty(seeds.size * fanout, np.int64)
+        st = self._lib.het_ps_graph_sample(
+            self._c, self.graph_id, fanout, _i64p(seeds), seeds.size,
+            _i64p(out))
+        if st != 0:
+            raise RuntimeError(f"remote sample failed (status {st})")
+        return out.reshape(seeds.size, fanout)
+
+    def induced_edges(self, node_ids) -> np.ndarray:
+        """All in-edges with BOTH endpoints in ``node_ids`` (kGraphEdges),
+        as a (2, E) array of ORIGINAL node ids."""
+        nodes = np.ascontiguousarray(np.asarray(node_ids).ravel(), np.int64)
+        cap = 1 << 22
+        src = np.empty(cap, np.int64)
+        dst = np.empty(cap, np.int64)
+        ne = self._lib.het_ps_graph_edges(
+            self._c, self.graph_id, _i64p(nodes), nodes.size, _i64p(src),
+            _i64p(dst), cap)
+        if ne < 0:
+            raise RuntimeError(f"remote induced_edges failed (status {ne})")
+        return np.stack([src[:ne], dst[:ne]])
+
+    def sample_subgraph(self, seed_nodes, num_hops: int = 2,
+                        fanout: int = 10):
+        """Server-backed k-hop neighborhood sampling with the SAME return
+        contract as models.gnn.sample_subgraph: (node_ids [M] sorted,
+        sub_edge_index [2, E'] relabeled, seed positions)."""
+        seeds = np.unique(np.asarray(seed_nodes, np.int64))
+        nodes = set(seeds.tolist())
+        frontier = seeds
+        for _ in range(num_hops):
+            if frontier.size == 0:
+                break
+            samp = self.sample(frontier, fanout)
+            nxt = np.unique(samp[samp >= 0])
+            frontier = nxt[~np.isin(nxt, list(nodes))]
+            nodes.update(frontier.tolist())
+        node_ids = np.sort(np.fromiter(nodes, dtype=np.int64))
+        edges = self.induced_edges(node_ids)
+        sub = np.stack([np.searchsorted(node_ids, edges[0]),
+                        np.searchsorted(node_ids, edges[1])])
+        seed_pos = np.searchsorted(node_ids, np.asarray(seed_nodes))
+        return node_ids, sub.astype(np.int32), seed_pos.astype(np.int32)

@@ -35,9 +35,11 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 // ---- engine API (defined in embed_engine.cpp, linked into the same .so) ----
@@ -79,6 +81,9 @@ enum Op : uint32_t {
   kPushSync = 12,
   kStartRecord = 13,
   kGetLoads = 14,
+  kGraphLoad = 15,
+  kGraphSample = 16,
+  kGraphEdges = 17,
 };
 
 // client cache version meaning "no cached copy — always refresh"
@@ -175,6 +180,32 @@ struct SspGroup {
   std::vector<int64_t> clocks;  // per-worker committed clock
 };
 
+// Graph-server role (the reference delegates GNN sampling to GraphMix
+// server processes, examples/gnn + third_party/GraphMix): the server owns
+// the in-neighbor CSR and serves uniform neighbor samples and induced
+// edges over the same TCP transport as the embedding tables.
+struct GraphStore {
+  int64_t n_nodes = 0, n_edges = 0;
+  std::vector<int64_t> indptr;   // n_nodes + 1
+  std::vector<int64_t> indices;  // n_edges (in-neighbors)
+  bool ready = false;            // set by the commit op after validation
+  std::mutex gmu;                // per-graph: sampling must not block
+                                 // barrier/ssp/preduce on the server mutex
+  std::mt19937_64 rng{0x9e3779b97f4a7c15ull};
+
+  // the server must never trust client-supplied CSR: monotone indptr
+  // bounded by indices.size() is what keeps sample/edge scans in bounds
+  bool validate() const {
+    if (indptr.empty() || indptr.front() != 0) return false;
+    for (size_t i = 1; i < indptr.size(); ++i)
+      if (indptr[i] < indptr[i - 1]) return false;
+    if (indptr.back() != static_cast<int64_t>(indices.size())) return false;
+    for (int64_t u : indices)
+      if (u < 0 || u >= n_nodes) return false;
+    return true;
+  }
+};
+
 struct Server {
   int listen_fd = -1;
   int port = 0;
@@ -186,6 +217,7 @@ struct Server {
   std::map<uint32_t, Barrier> barriers;
   std::map<uint32_t, SspGroup> ssp_groups;
   std::map<uint32_t, void*> preduce_groups;  // het_preduce handles
+  std::map<uint32_t, GraphStore> graphs;      // graph-server role
   std::atomic<bool> record{false};            // per-row touch recording
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
@@ -225,7 +257,7 @@ struct Server {
     while (!stop.load()) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
-      if (h.op < kCreate || h.op > kGetLoads || h.nkeys < 0 ||
+      if (h.op < kCreate || h.op > kGraphEdges || h.nkeys < 0 ||
           h.nfloats < 0 || h.nbytes < 0 || h.nkeys >= kMaxElems ||
           h.nfloats >= kMaxElems || h.nbytes >= kMaxElems)
         break;  // not our protocol — drop the connection
@@ -475,6 +507,129 @@ struct Server {
             out.insert(out.end(), row.begin(), row.end());
           }
           resp.nfloats = static_cast<int64_t>(out.size());
+          break;
+        }
+        case kGraphLoad: {
+          // Upload the CSR in chunks: keys = [kind(0=indptr,1=indices,
+          // 2=commit), total_len, offset, payload...].  kind 0 offset 0
+          // (re)allocates; kind 2 validates the assembled CSR and marks
+          // the graph ready — sampling is refused before that, so a
+          // half-uploaded or corrupt graph can never crash the server.
+          if (h.nkeys < 3 || keys[0] < 0 || keys[0] > 2 || keys[1] < 1 ||
+              keys[2] < 0) { resp.status = -3; break; }
+          int64_t kind = keys[0], total = keys[1], off = keys[2];
+          int64_t m = h.nkeys - 3;
+          if (total > (int64_t(1) << 31) || off + m > total) {
+            resp.status = -3;
+            break;
+          }
+          GraphStore* gp;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            gp = &graphs[h.table_id];
+          }
+          std::lock_guard<std::mutex> gl(gp->gmu);
+          if (kind == 2) {
+            gp->ready = gp->validate();
+            resp.status = gp->ready ? 0 : -6;
+            break;
+          }
+          gp->ready = false;
+          std::vector<int64_t>& dst = kind == 0 ? gp->indptr : gp->indices;
+          if (off == 0) dst.assign(total, 0);
+          if (static_cast<int64_t>(dst.size()) != total) {
+            resp.status = -3;  // chunks disagree on total_len
+            break;
+          }
+          std::copy(keys.begin() + 3, keys.begin() + 3 + m,
+                    dst.begin() + off);
+          if (kind == 0) gp->n_nodes = total - 1;
+          else gp->n_edges = total;
+          break;
+        }
+        case kGraphSample: {
+          // keys = [fanout, s0, s1, ...]; per seed: uniform sample of up to
+          // fanout in-neighbors without replacement.  Response: for each
+          // seed, fanout ids as u64 lo/hi float pairs; missing slots carry
+          // ~0 (decoded as -1 client-side).
+          GraphStore* g;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = graphs.find(h.table_id);
+            if (it == graphs.end()) { resp.status = -2; break; }
+            g = &it->second;
+          }
+          // fanout bounded FIRST: an unbounded keys[0] would overflow the
+          // product check and then drive the emit loop to exhaust memory
+          if (h.nkeys < 1 || keys[0] < 1 || keys[0] > 65536 ||
+              (h.nkeys - 1) * keys[0] * 2 >= kMaxElems) {
+            resp.status = -3;
+            break;
+          }
+          int64_t fanout = keys[0], ns = h.nkeys - 1;
+          auto put_u64 = [&](uint64_t v) {
+            out.push_back(bits_to_float(static_cast<uint32_t>(v)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(v >> 32)));
+          };
+          std::vector<int64_t> pool;
+          std::lock_guard<std::mutex> gl(g->gmu);
+          if (!g->ready) { resp.status = -2; break; }
+          for (int64_t i = 0; i < ns; ++i) {
+            int64_t v = keys[1 + i];
+            if (v < 0 || v >= g->n_nodes) { resp.status = -4; break; }
+            int64_t lo = g->indptr[v], hi = g->indptr[v + 1];
+            int64_t deg = hi - lo, take = std::min(deg, fanout);
+            pool.assign(g->indices.begin() + lo, g->indices.begin() + hi);
+            // partial Fisher-Yates: first `take` entries are the sample
+            for (int64_t t = 0; t < take; ++t) {
+              int64_t r = t + static_cast<int64_t>(g->rng() % (deg - t));
+              std::swap(pool[t], pool[r]);
+            }
+            for (int64_t t = 0; t < fanout; ++t)
+              put_u64(t < take ? static_cast<uint64_t>(pool[t])
+                               : ~uint64_t(0));
+          }
+          if (resp.status == 0)
+            resp.nfloats = static_cast<int64_t>(out.size());
+          else
+            out.clear();
+          break;
+        }
+        case kGraphEdges: {
+          // keys = node set; response = induced in-edges (src, dst) with
+          // both endpoints in the set, each id as u64 lo/hi float pairs.
+          GraphStore* g;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = graphs.find(h.table_id);
+            if (it == graphs.end()) { resp.status = -2; break; }
+            g = &it->second;
+          }
+          std::unordered_set<int64_t> want(keys.begin(), keys.end());
+          auto put_u64 = [&](uint64_t v) {
+            out.push_back(bits_to_float(static_cast<uint32_t>(v)));
+            out.push_back(bits_to_float(static_cast<uint32_t>(v >> 32)));
+          };
+          std::lock_guard<std::mutex> gl(g->gmu);
+          if (!g->ready) { resp.status = -2; break; }
+          for (int64_t v : keys) {
+            if (v < 0 || v >= g->n_nodes) { resp.status = -4; break; }
+            for (int64_t e = g->indptr[v]; e < g->indptr[v + 1]; ++e) {
+              int64_t u = g->indices[e];
+              if (!want.count(u)) continue;
+              if (static_cast<int64_t>(out.size()) + 4 >= kMaxElems) {
+                resp.status = -5;  // induced subgraph too large for a frame
+                break;
+              }
+              put_u64(static_cast<uint64_t>(u));   // src (in-neighbor)
+              put_u64(static_cast<uint64_t>(v));   // dst
+            }
+            if (resp.status != 0) break;
+          }
+          if (resp.status == 0)
+            resp.nfloats = static_cast<int64_t>(out.size());
+          else
+            out.clear();
           break;
         }
         case kStartRecord: {
@@ -1069,6 +1224,62 @@ int64_t het_ps_ssp_sync(void* h, uint32_t group_id, int64_t worker,
   ReqHeader hh{kSspSync, group_id, 4, 0, 0};
   return static_cast<Client*>(h)->request_prio(hh, keys, nullptr, nullptr, nullptr,
                                           0);
+}
+
+int64_t het_ps_graph_load(void* h, uint32_t graph_id, int64_t kind,
+                          int64_t total, int64_t offset,
+                          const int64_t* data, int64_t m) {
+  std::vector<int64_t> req(3 + m);
+  req[0] = kind;
+  req[1] = total;
+  req[2] = offset;
+  std::copy(data, data + m, req.begin() + 3);
+  ReqHeader hh{kGraphLoad, graph_id, 3 + m, 0, 0};
+  return static_cast<Client*>(h)->request(hh, req.data(), nullptr, nullptr,
+                                          nullptr, 0);
+}
+
+// out: caller-allocated int64[n_seeds * fanout]; missing slots = -1.
+int64_t het_ps_graph_sample(void* h, uint32_t graph_id, int64_t fanout,
+                            const int64_t* seeds, int64_t n_seeds,
+                            int64_t* out_ids) {
+  std::vector<int64_t> req(1 + n_seeds);
+  req[0] = fanout;
+  std::copy(seeds, seeds + n_seeds, req.begin() + 1);
+  ReqHeader hh{kGraphSample, graph_id, 1 + n_seeds, 0, 0};
+  std::vector<float> out;
+  int64_t st = static_cast<Client*>(h)->request_var(hh, req.data(), nullptr,
+                                                    out);
+  if (st != 0) return st;
+  if (static_cast<int64_t>(out.size()) != n_seeds * fanout * 2) return -13;
+  for (int64_t i = 0; i < n_seeds * fanout; ++i) {
+    uint64_t v = static_cast<uint64_t>(float_to_bits(out[2 * i])) |
+                 (static_cast<uint64_t>(float_to_bits(out[2 * i + 1])) << 32);
+    out_ids[i] = static_cast<int64_t>(v);  // ~0 -> -1
+  }
+  return 0;
+}
+
+// Returns the number of edges, writing up to cap (src, dst) pairs.
+int64_t het_ps_graph_edges(void* h, uint32_t graph_id, const int64_t* nodes,
+                           int64_t n, int64_t* src, int64_t* dst,
+                           int64_t cap) {
+  ReqHeader hh{kGraphEdges, graph_id, n, 0, 0};
+  std::vector<float> out;
+  int64_t st = static_cast<Client*>(h)->request_var(hh, nodes, nullptr, out);
+  if (st != 0) return st;
+  if (out.size() % 4) return -13;
+  int64_t ne = static_cast<int64_t>(out.size() / 4);
+  if (ne > cap) return -14;
+  for (int64_t i = 0; i < ne; ++i) {
+    auto u64 = [&](size_t j) {
+      return static_cast<uint64_t>(float_to_bits(out[j])) |
+             (static_cast<uint64_t>(float_to_bits(out[j + 1])) << 32);
+    };
+    src[i] = static_cast<int64_t>(u64(4 * i));
+    dst[i] = static_cast<int64_t>(u64(4 * i + 2));
+  }
+  return ne;
 }
 
 int64_t het_ps_start_record(void* h, int on) {

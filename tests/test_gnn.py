@@ -148,3 +148,106 @@ def test_sample_subgraph():
     np.testing.assert_array_equal(nodes, n2)
     np.testing.assert_array_equal(sub_edges, e2)
     np.testing.assert_array_equal(seed_pos, p2)
+
+
+def test_remote_graph_server_sampling():
+    """The graph-server role (reference GraphMix server processes,
+    examples/gnn): server owns the CSR, workers pull neighbor samples and
+    induced edges over the TCP transport.  With fanout >= max degree the
+    sampled subgraph is deterministic and must EQUAL the in-process
+    sample_subgraph oracle."""
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+    from hetu_tpu.models.gnn import GraphIndex
+
+    edge_index = random_graph(n=40, e=160, seed=3)
+    with EmbeddingServer() as srv:
+        rg = RemoteGraph(f"127.0.0.1:{srv.port}", 11, edge_index,
+                         num_nodes=40)
+        seeds = np.array([0, 7, 21])
+        # deterministic regime: fanout above any in-degree
+        nodes_r, edges_r, pos_r = rg.sample_subgraph(seeds, num_hops=2,
+                                                     fanout=1000)
+        nodes_l, edges_l, pos_l = sample_subgraph(edge_index, seeds,
+                                                  num_hops=2, fanout=1000)
+        np.testing.assert_array_equal(nodes_r, nodes_l)
+        np.testing.assert_array_equal(pos_r, pos_l)
+        # same edge MULTISET (relabeled ids; order may differ, duplicate
+        # edges in the input graph must keep their multiplicity)
+        er = sorted(map(tuple, edges_r.T.tolist()))
+        el = sorted(map(tuple, edges_l.T.tolist()))
+        assert er == el
+
+        # stochastic regime: fanout respected, samples are real in-neighbors
+        gi = GraphIndex(edge_index)
+        samp = rg.sample(np.arange(40), fanout=3)
+        assert samp.shape == (40, 3)
+        from collections import Counter
+        for v in range(40):
+            # multigraph semantics: sampling is without replacement over
+            # adjacency SLOTS, so a duplicate edge may appear twice
+            neigh = Counter(gi.in_neighbors(v).tolist())
+            got = Counter(int(x) for x in samp[v] if x >= 0)
+            assert sum(got.values()) == min(sum(neigh.values()), 3)
+            assert all(got[k] <= neigh[k] for k in got)
+
+        # a second worker attaches without re-uploading
+        rg2 = RemoteGraph(f"127.0.0.1:{srv.port}", 11)
+        e2 = rg2.induced_edges(nodes_l)
+        assert set(map(tuple, e2.T.tolist())) == set(
+            map(tuple, rg.induced_edges(nodes_l).T.tolist()))
+
+
+def test_gcn_trains_on_remote_sampled_blocks():
+    """End-to-end: GCN minibatch training where every block comes from the
+    graph server (the examples/gnn PS-mode training shape)."""
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+    from hetu_tpu.models.gnn import normalize_adjacency
+    from hetu_tpu.optim import AdamOptimizer
+
+    rng = np.random.default_rng(0)
+    n, n_feat, n_cls = 48, 8, 3
+    # community graph: intra-community edges + community-correlated features
+    comm = rng.integers(0, n_cls, n)
+    src, dst = [], []
+    for _ in range(300):
+        c = rng.integers(0, n_cls)
+        members = np.where(comm == c)[0]
+        if len(members) >= 2:
+            a, b = rng.choice(members, 2, replace=False)
+            src.append(a); dst.append(b)
+    edge_index = np.stack([np.array(src), np.array(dst)])
+    x_all = rng.normal(size=(n, n_feat)).astype(np.float32)
+    x_all[:, :n_cls] += 2.0 * np.eye(n_cls, dtype=np.float32)[comm]
+
+    with EmbeddingServer() as srv:
+        rg = RemoteGraph(f"127.0.0.1:{srv.port}", 12, edge_index,
+                         num_nodes=n)
+        model = GCN(n_feat, 16, n_cls)
+        opt = AdamOptimizer(0.01)
+        state = opt.init(model)
+
+        @jax.jit
+        def step(model, state, x, ei, ew, y, pos):
+            def loss_fn(m):
+                logits = m(x, ei, ew)
+                from hetu_tpu.ops import softmax_cross_entropy_sparse
+                return softmax_cross_entropy_sparse(
+                    logits[pos], y).mean()
+            loss, g = jax.value_and_grad(loss_fn)(model)
+            model, state = opt.update(g, state, model)
+            return model, state, loss
+
+        losses = []
+        for it in range(30):
+            seeds = rng.choice(n, 12, replace=False)
+            nodes, sub_edges, pos = rg.sample_subgraph(seeds, num_hops=2,
+                                                       fanout=8)
+            ei, ew = normalize_adjacency(jnp.asarray(sub_edges),
+                                         len(nodes))
+            model, state, loss = step(
+                model, state, jnp.asarray(x_all[nodes]), ei, ew,
+                jnp.asarray(comm[seeds]), jnp.asarray(pos))
+            losses.append(float(loss))
+        assert np.mean(losses[-5:]) < 0.6 * np.mean(losses[:5]), losses

@@ -3,6 +3,7 @@ a real 2-process jax.distributed world on local CPU (the reference's
 mpirun-on-localhost test pattern, tests/test_comm.py:23)."""
 
 import os
+import sys
 import textwrap
 
 import pytest
@@ -147,3 +148,41 @@ def test_server_roles_in_cluster_yaml(tmp_path):
         assert L.embed_server_addresses() == cfg.server_addresses
     finally:
         del os.environ[L.ENV_EMBED_SERVERS]
+
+
+class TestRemoteBranchExecution:
+    def test_remote_worker_executes_via_fake_ssh(self, tmp_path, monkeypatch):
+        """EXECUTE the remote-host branch end-to-end (not just compose it):
+        a fake `ssh` on PATH runs the composed remote command through
+        `sh -c`, so the cd + env-export + shell-quoting pipeline is proven
+        to produce a working command line (reference runner.py:57-70
+        paramiko path)."""
+        import stat
+        import time
+
+        fake = tmp_path / "ssh"
+        # argv: ssh -o StrictHostKeyChecking=no <host> <remote-cmd>
+        fake.write_text("#!/bin/sh\nshift 3\nexec /bin/sh -c \"$1\"\n")
+        fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+
+        out = tmp_path / "marker with space.txt"  # quoting must survive
+        cfg = DistConfig(hosts=[HostSpec("definitely-not-local", workers=2,
+                                         chief=True)], port=7321)
+        script = ("import os; open(os.environ['OUTFILE'] + "
+                  "os.environ['HETU_TPU_PROC_ID'], 'w')"
+                  ".write(os.environ['HETU_TPU_COORD'] + '|' + "
+                  "os.environ['HETU_TPU_NPROC'])")
+        monkeypatch.setenv("OUTFILE", str(out))
+        procs = launch(cfg, [sys.executable, "-c", script],
+                       extra_env={"OUTFILE": str(out)})
+        try:
+            for _pid, p in procs:
+                assert p.wait(timeout=60) == 0
+        finally:
+            for _pid, p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for pid in (0, 1):
+            got = (tmp_path / f"marker with space.txt{pid}").read_text()
+            assert got == "definitely-not-local:7321|2"

@@ -9,6 +9,8 @@ import numpy as np
 import pytest
 
 from hetu_tpu.core import set_random_seed
+
+pytestmark = pytest.mark.slow  # Galvatron model zoo (ViT/Swin/T5) — jit-heavy
 from hetu_tpu.models import (
     Swin,
     SwinConfig,

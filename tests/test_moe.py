@@ -206,6 +206,7 @@ def test_moe_in_train_step(ep_mesh):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_moe_hierarchical_ep_matches_flat():
     """MoE with a factored (ep, tp) expert axis — the reference's
     hierarchical AllToAll — must equal the flat 4-way ep run on the same
@@ -233,6 +234,7 @@ def test_moe_hierarchical_ep_matches_flat():
     np.testing.assert_allclose(float(aux_h), float(aux_flat), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_moe_pretraining_trains():
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.exec import Trainer

@@ -68,6 +68,7 @@ def test_leaf_chunking_roundtrip():
 
 
 @pytest.mark.parametrize("mode,staleness", [("bsp", 0), ("ssp", 2)])
+@pytest.mark.slow
 def test_two_worker_processes(mode, staleness, tmp_path):
     """Two OS-process workers train against one PS server; both converge and
     end on the SAME server-held parameters."""
@@ -119,6 +120,7 @@ def test_two_worker_processes(mode, staleness, tmp_path):
                                        rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_bsp_lockstep_under_straggler(tmp_path):
     """BSP means both workers compute every round on the SAME parameters.
 
@@ -205,6 +207,7 @@ def test_large_leaf_segmented_transfer():
         psdp._MAX_FLOATS_PER_REQ = old
 
 
+@pytest.mark.slow
 def test_hybrid_mode_across_processes():
     """The reference's Hybrid comm mode across real processes
     (tests/hybrid_wdl_adult.sh): dense parameters data-parallel via a
