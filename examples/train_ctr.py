@@ -28,7 +28,8 @@ MODELS = {"wdl": WideDeep, "deepfm": DeepFM, "dcn": DCN, "dc": DeepCrossing}
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=sorted(MODELS), default="wdl")
-    ap.add_argument("--embedding", choices=["device", "host", "remote"],
+    ap.add_argument("--embedding",
+                    choices=["device", "host", "hbm", "remote"],
                     default="device")
     ap.add_argument("--servers", default=None,
                     help="comma-separated PS addresses for --embedding "

@@ -18,7 +18,8 @@ from hetu_tpu.embed.engine import (
     SSPBarrier,
 )
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
-from hetu_tpu.embed.layer import HostEmbedding, StagedHostEmbedding
+from hetu_tpu.embed.layer import (HBMCachedEmbedding, HostEmbedding,
+                                  StagedHostEmbedding)
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
 from hetu_tpu.embed.net import (EmbeddingServer, RemoteCacheTable,
                                 RemoteEmbeddingTable, RemoteHostEmbedding)
@@ -29,7 +30,8 @@ __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "PReduceGroup", "Prefetcher",
     "make_host_lookup",
-    "HostEmbedding", "StagedHostEmbedding", "ShardedHostEmbedding",
+    "HostEmbedding", "StagedHostEmbedding", "HBMCachedEmbedding",
+    "ShardedHostEmbedding",
     "EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
     "RemoteGraph",
     "RemoteHostEmbedding", "PSDataParallel",

@@ -28,7 +28,7 @@ from hetu_tpu.core.module import Module
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup, sync_fn
 from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
 
-__all__ = ["HostEmbedding", "StagedHostEmbedding"]
+__all__ = ["HostEmbedding", "StagedHostEmbedding", "HBMCachedEmbedding"]
 
 
 class _HostEmbeddingBase(Module):
@@ -183,3 +183,219 @@ class StagedHostEmbedding(_HostEmbeddingBase):
         self._handle.ids = None
         self.store.push(ids.ravel(),
                         np.asarray(grad_rows, np.float32).reshape(-1, self.dim))
+
+
+class _HBMHandle:
+    """Mutable host-side cache directory (identity-stable across pytree
+    unflattens, read/written exclusively OUTSIDE jit).  All-numpy: per-step
+    bookkeeping over ~10k unique ids must be vectorized, not dict loops —
+    measured 25 ms/step of pure Python otherwise.  The id-indexed arrays
+    cost 12 bytes/row of the FULL table (the reference's HET keeps per-row
+    version metadata at the same order)."""
+
+    __slots__ = ("slot_of", "id_of", "staleness", "last_used", "tick",
+                 "ids", "touched_ids", "prefetcher", "pushed_since_prefetch")
+
+    def __init__(self, capacity: int, num_embeddings: int):
+        self.slot_of = np.full(num_embeddings, -1, np.int64)  # id -> slot
+        self.id_of = np.full(capacity, -1, np.int64)          # slot -> id
+        self.staleness = np.zeros(num_embeddings, np.int32)
+        self.last_used = np.zeros(capacity, np.int64)
+        self.tick = 0
+        self.ids = None
+        self.touched_ids = None
+        self.prefetcher = None
+        self.pushed_since_prefetch = None  # ids pushed after prefetch issue
+
+
+class HBMCachedEmbedding(_HostEmbeddingBase):
+    """Host-store embedding whose HOT ROWS are staged into device HBM —
+    the north-star layout for huge tables (BASELINE.json: "the hetu_cache
+    sparse-embedding module keeps host-side caching but stages hot rows to
+    HBM").
+
+    The full table lives in the host engine (server-side optimizer, like
+    the reference's PS); a fixed-capacity ``cache`` array lives in HBM and
+    is managed as an LRU cache with HET-style bounded staleness:
+
+    - ``stage(ids)`` refreshes only MISSING or TOO-STALE rows (one small
+      host→device scatter, padded to power-of-two buckets so it compiles
+      once per bucket), and installs the batch's slot indices — warm steps
+      upload O(refreshed) bytes instead of O(batch) like
+      StagedHostEmbedding.
+    - ``__call__`` gathers from the HBM cache inside jit.  Values flow
+      from the cache under ``stop_gradient``; the gradient rides a zeros
+      ``rows`` leaf added to the gather, so the cotangent arrives
+      batch-shaped ((..., dim) like StagedHostEmbedding) instead of as a
+      dense (capacity, dim) scatter buffer.
+    - ``push_grads`` (Trainer calls it) ships the batch row-gradients to
+      the host engine (duplicate ids accumulate there) and advances each
+      pushed id's staleness — rows are re-pulled once they exceed
+      ``hbm_pull_bound`` server updates (0 = strict freshness).
+
+    Wins over StagedHostEmbedding when the id distribution is skewed and
+    a staleness bound amortizes refreshes (HET's regime, VLDB'22) or when
+    per-row bytes are large; at small dim / uniform ids the plain staged
+    transfer is already cheap — measure both (examples/train_ctr.py
+    --embedding host|hbm).
+    """
+
+    is_staged_host_embedding = True
+    _state_fields = ("cache", "rows", "slots")  # no optimizer updates
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 hbm_capacity: int = 4096, hbm_pull_bound: int = 0, **kw):
+        super().__init__(num_embeddings, dim, **kw)
+        if hbm_capacity <= 0:
+            raise ValueError("hbm_capacity must be > 0")
+        if hbm_capacity >= (1 << 24):
+            raise ValueError("hbm_capacity must stay below 2**24: slot "
+                             "indices ride a float32 leaf (see below) and "
+                             "larger values are not exactly representable")
+        self.capacity = int(hbm_capacity)
+        self.pull_bound = int(hbm_pull_bound)
+        self._handle = _HBMHandle(self.capacity, num_embeddings)
+        self.cache = jnp.zeros((self.capacity, dim), jnp.float32)
+        # zero-valued gradient channel: cotangent of the lookup lands here
+        # batch-shaped; the buffer itself never changes between same-shape
+        # batches (no per-step upload)
+        self.rows = jnp.zeros((1, dim), jnp.float32)
+        # slot indices ride a float32 leaf: the Trainer differentiates the
+        # whole module pytree and jax.grad rejects integer leaves; float32
+        # is exact for slot ids < 2^24 and gets a zero cotangent
+        self.slots = jnp.zeros((1,), jnp.float32)  # placeholder leaf
+
+    def prefetch(self, ids):
+        """Async host pull of the next batch's unique rows (overlap with
+        the current step); stage() serves the refresh subset from it."""
+        if not hasattr(self.store, "sync"):
+            return
+        if self._handle.prefetcher is None:
+            self._handle.prefetcher = Prefetcher(self.store)
+        self._handle.prefetcher.prefetch(np.unique(np.asarray(ids, np.int64)))
+        # rows pushed AFTER this point are newer than the buffered pull;
+        # stage() must not install them from the buffer as "fresh"
+        self._handle.pushed_since_prefetch = []
+
+    def stage(self, ids):
+        h = self._handle
+        ids = np.asarray(ids, np.int64)
+        uniq = np.unique(ids.ravel())
+        if uniq.size > self.capacity:
+            raise ValueError(
+                f"batch touches {uniq.size} unique rows > hbm_capacity "
+                f"{self.capacity}")
+        h.tick += 1
+        cur_slots = h.slot_of[uniq]
+        cached = cur_slots >= 0
+        need_mask = (~cached) | (h.staleness[uniq] > self.pull_bound)
+        need = uniq[need_mask]
+        if need.size:
+            need_slots = cur_slots[need_mask]  # -1 where not resident
+            miss = need_slots < 0
+            n_miss = int(miss.sum())
+            if n_miss:
+                free = np.flatnonzero(h.id_of < 0)
+                if free.size < n_miss:
+                    # LRU victims among OCCUPIED slots not used by this
+                    # batch (free slots must not be re-picked as victims:
+                    # that would hand one slot to two ids, and id_of[-1]
+                    # bookkeeping would corrupt the directory)
+                    in_batch = np.zeros(self.capacity + 1, bool)
+                    in_batch[cur_slots[cached]] = True
+                    order = np.argsort(h.last_used, kind="stable")
+                    occupied = h.id_of[order] >= 0
+                    victims = order[occupied & ~in_batch[order]]
+                    extra = n_miss - free.size
+                    # always satisfiable: free + occupied-not-in-batch =
+                    # capacity - cached >= uniq - cached >= n_miss (the
+                    # uniq > capacity case raised above)
+                    assert victims.size >= extra, "slot accounting broken"
+                    evict = victims[:extra]
+                    h.slot_of[h.id_of[evict]] = -1
+                    free = np.concatenate([free, evict])
+                alloc = free[:n_miss]
+                need_slots[miss] = alloc
+            h.slot_of[need] = need_slots
+            h.id_of[need_slots] = need
+            h.staleness[need] = 0
+            fresh = None
+            if h.prefetcher is not None:
+                rows_all = np.asarray(h.prefetcher.get(uniq))
+                fresh = rows_all[need_mask]
+                # the buffered pull predates any push issued after
+                # prefetch(): re-pull those rows synchronously so a stale
+                # snapshot is never installed with staleness 0
+                pushed = h.pushed_since_prefetch or []
+                if pushed:
+                    dirty = np.isin(need, np.concatenate(pushed))
+                    if dirty.any():
+                        fresh[dirty] = np.asarray(
+                            sync_fn(self.store)(need[dirty])).reshape(
+                                -1, self.dim)
+            else:
+                fresh = np.asarray(sync_fn(self.store)(need))
+            fresh = fresh.reshape(need.size, self.dim).astype(np.float32)
+            # pad the refresh to a power-of-two bucket so the device
+            # scatter compiles once per bucket instead of once per distinct
+            # refresh size (a per-step recompile would dwarf the transfer
+            # saving the cache exists for); padded slots index out of
+            # range and mode="drop" discards them
+            bucket = max(8, 1 << (need.size - 1).bit_length())
+            pad = bucket - need.size
+            if pad:
+                need_slots = np.concatenate(
+                    [need_slots, np.full(pad, self.capacity, np.int64)])
+                fresh = np.concatenate(
+                    [fresh, np.zeros((pad, self.dim), np.float32)])
+            self.cache = self.cache.at[jnp.asarray(need_slots)].set(
+                jnp.asarray(fresh), mode="drop")
+        elif h.prefetcher is not None:
+            h.prefetcher.get(uniq)  # retire the pending pull
+        slot_lut = h.slot_of[uniq]
+        h.last_used[slot_lut] = h.tick
+        batch_slots = slot_lut[np.searchsorted(uniq, ids.ravel())]
+        self.slots = jnp.asarray(batch_slots.reshape(ids.shape), jnp.float32)
+        if tuple(self.rows.shape) != tuple(ids.shape) + (self.dim,):
+            self.rows = jnp.zeros(tuple(ids.shape) + (self.dim,),
+                                  jnp.float32)
+        h.ids = ids
+        h.touched_ids = uniq
+
+    def __call__(self, ids):
+        if tuple(ids.shape) != tuple(self.slots.shape):
+            raise ValueError(
+                f"staged slots {tuple(self.slots.shape)} do not match ids "
+                f"batch {tuple(ids.shape)}: call stage(ids) with this "
+                f"batch's ids before the jitted step")
+        import jax
+
+        gathered = jax.lax.stop_gradient(
+            self.cache[self.slots.astype(jnp.int32)])
+        return (gathered + self.rows).astype(self.dtype)
+
+    def is_fresh(self) -> bool:
+        return self._handle.ids is not None
+
+    def push_grads(self, grad_rows):
+        """``grad_rows`` is the batch-shaped cotangent of the lookup; ship
+        it to the host engine (duplicate ids accumulate there) and bump
+        the pushed ids' staleness."""
+        h = self._handle
+        if h.ids is None:
+            raise RuntimeError(
+                "push_grads without a fresh stage(): call stage(ids) before "
+                "every training step")
+        self.store.push(h.ids.ravel(),
+                        np.asarray(grad_rows, np.float32).reshape(
+                            -1, self.dim))
+        h.staleness[h.touched_ids] += 1
+        if h.pushed_since_prefetch is not None:
+            h.pushed_since_prefetch.append(h.touched_ids)
+        h.ids = None
+        h.touched_ids = None
+
+    def hit_stats(self) -> dict:
+        """Occupancy snapshot for debugging."""
+        return {"resident": int((self._handle.id_of >= 0).sum()),
+                "capacity": self.capacity}

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module
 from hetu_tpu.core.rng import next_key
-from hetu_tpu.embed import HostEmbedding, StagedHostEmbedding
+from hetu_tpu.embed import (HBMCachedEmbedding, HostEmbedding,
+                            StagedHostEmbedding)
 from hetu_tpu.init import normal
 from hetu_tpu.layers import Embedding, Linear, MLPTower
 from hetu_tpu.ops import binary_cross_entropy_with_logits, relu, sigmoid
@@ -71,6 +72,18 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
             optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed,
             cache_capacity=cfg.cache_capacity, policy=cfg.cache_policy,
             pull_bound=cfg.pull_bound, push_bound=cfg.push_bound)
+    if cfg.embedding == "hbm":
+        # host store + hot rows staged into device HBM (the north-star
+        # layout; warm steps transfer only refreshed rows).  The device
+        # cache is LRU; cache_policy/push_bound apply to the host paths
+        # only.
+        if cfg.cache_capacity <= 0:
+            raise ValueError('embedding="hbm" needs cache_capacity > 0 '
+                             "(the HBM-resident row budget)")
+        return HBMCachedEmbedding(
+            cfg.vocab, dim, optimizer=cfg.host_optimizer, lr=cfg.host_lr,
+            seed=seed, hbm_capacity=cfg.cache_capacity,
+            hbm_pull_bound=cfg.pull_bound)
     if cfg.embedding == "host":
         bridge = cfg.host_bridge
         if bridge == "auto":

@@ -1,0 +1,204 @@
+"""HBMCachedEmbedding: hot rows staged in device HBM over the host store.
+
+Oracle: with pull_bound=0 (strict freshness) the HBM-cached layer must
+train BIT-COMPATIBLY with StagedHostEmbedding on the same data — the cache
+is a transport optimization, not a semantics change.  Plus cache-behavior
+invariants: warm steps refresh nothing, pushes staleness-invalidate,
+eviction under pressure, thrash detection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.core.module import Module
+from hetu_tpu.embed import HBMCachedEmbedding, StagedHostEmbedding
+from hetu_tpu.exec import Trainer
+from hetu_tpu.layers import Linear
+from hetu_tpu.ops import binary_cross_entropy_with_logits
+from hetu_tpu.optim import AdamOptimizer
+
+
+class Tiny(Module):
+    def __init__(self, emb):
+        self.emb = emb
+        self.head = Linear(4 * 3, 1)
+
+    def loss(self, sp, y):
+        e = self.emb(sp).reshape(sp.shape[0], -1)
+        return binary_cross_entropy_with_logits(self.head(e)[:, 0], y).mean()
+
+
+def _data(n=64, vocab=50, fields=3, seed=0):
+    rng = np.random.default_rng(seed)
+    # zipf-ish skew so the cache has hot rows
+    sp = np.minimum(rng.zipf(1.5, (n, fields)) - 1, vocab - 1).astype(np.int32)
+    y = (sp.sum(1) % 2).astype(np.float32)
+    return sp, y
+
+
+def _train(emb, steps=12, batch=16):
+    set_random_seed(0)
+    model = Tiny(emb)
+    tr = Trainer(model, AdamOptimizer(1e-2),
+                 lambda m, b, k: (m.loss(b["sp"], b["y"]), {}))
+    sp, y = _data()
+    losses = []
+    for s in range(steps):
+        lo = (s * batch) % (len(y) - batch)
+        b = {"sp": jnp.asarray(sp[lo:lo + batch]),
+             "y": jnp.asarray(y[lo:lo + batch])}
+        for m in tr.staged_modules():
+            m.stage(b["sp"])
+        losses.append(float(tr.step(b)["loss"]))
+    return losses, tr
+
+
+def test_matches_staged_oracle():
+    """Strict-freshness HBM cache == plain staged path, step by step."""
+    set_random_seed(0)
+    l_ref, tr_ref = _train(StagedHostEmbedding(50, 4, optimizer="adagrad",
+                                               lr=0.05, seed=7))
+    set_random_seed(0)
+    l_hbm, tr_hbm = _train(HBMCachedEmbedding(50, 4, optimizer="adagrad",
+                                              lr=0.05, seed=7,
+                                              hbm_capacity=64,
+                                              hbm_pull_bound=0))
+    np.testing.assert_allclose(l_hbm, l_ref, rtol=1e-5)
+    # and the host tables ended identical
+    ids = np.arange(50)
+    np.testing.assert_allclose(
+        tr_hbm.state.model.emb.table.pull(ids),
+        tr_ref.state.model.emb.table.pull(ids), rtol=1e-5)
+    assert l_hbm[-1] < l_hbm[0]
+
+
+def test_warm_steps_refresh_nothing():
+    """Same batch twice without a push between: the second stage must not
+    touch the host store (the transport saving the HBM cache exists for)."""
+    emb = HBMCachedEmbedding(50, 4, hbm_capacity=32, hbm_pull_bound=0)
+    ids = jnp.asarray([[1, 2, 3], [4, 1, 2]])
+    emb.stage(ids)
+    first = np.asarray(emb(ids))
+    pulls_before = emb.table.pull  # wrap to count
+    calls = []
+    emb.table.pull = lambda k: (calls.append(len(np.asarray(k))),
+                                pulls_before(k))[1]
+    emb._handle.ids = None  # simulate eval-style reuse (no push)
+    emb.stage(ids)
+    assert calls == []  # fully warm: zero host pulls
+    np.testing.assert_array_equal(np.asarray(emb(ids)), first)
+    emb.table.pull = pulls_before
+
+
+def test_push_invalidates_with_bound_zero():
+    """After a gradient push, pull_bound=0 forces a refresh of exactly the
+    pushed rows on the next stage."""
+    emb = HBMCachedEmbedding(50, 4, optimizer="sgd", lr=1.0,
+                             hbm_capacity=32, hbm_pull_bound=0)
+    ids = jnp.asarray([[5, 6]])
+    emb.stage(ids)
+    before = np.asarray(emb(ids)).copy()
+    g = np.ones(tuple(ids.shape) + (4,), np.float32)  # grad 1 on both rows
+    emb.push_grads(jnp.asarray(g))
+    emb.stage(ids)  # must re-pull rows 5,6 (server applied -1.0 * lr)
+    after = np.asarray(emb(ids))
+    np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+
+
+def test_stale_reuse_with_loose_bound():
+    """pull_bound=k keeps serving the device copy for up to k pushes —
+    HET's bounded staleness."""
+    emb = HBMCachedEmbedding(50, 4, optimizer="sgd", lr=1.0,
+                             hbm_capacity=32, hbm_pull_bound=2)
+    ids = jnp.asarray([[9]])
+    emb.stage(ids)
+    v0 = np.asarray(emb(ids)).copy()
+    for _ in range(2):  # two pushes: staleness 1, 2 <= bound
+        emb.stage(ids)
+        g = np.ones(tuple(ids.shape) + (4,), np.float32)
+        emb.push_grads(jnp.asarray(g))
+    emb.stage(ids)
+    np.testing.assert_array_equal(np.asarray(emb(ids)), v0)  # still cached
+    # third push exceeds the bound -> refresh picks up all three updates
+    emb.stage(ids)
+    g = np.ones(tuple(ids.shape) + (4,), np.float32)
+    emb.push_grads(jnp.asarray(g))
+    emb.stage(ids)
+    np.testing.assert_allclose(np.asarray(emb(ids)), v0 - 3.0, rtol=1e-6)
+
+
+def test_eviction_and_thrash():
+    emb = HBMCachedEmbedding(100, 4, hbm_capacity=4)
+    emb.stage(jnp.asarray([[0, 1, 2, 3]]))
+    emb._handle.ids = None
+    emb.stage(jnp.asarray([[4, 5]]))  # evicts two LRU rows
+    assert emb.hit_stats()["resident"] == 4
+    assert emb._handle.slot_of[4] >= 0 and emb._handle.slot_of[5] >= 0
+    with pytest.raises(ValueError, match="unique rows > hbm_capacity"):
+        emb.stage(jnp.asarray([[1, 2, 3, 4, 5]]))
+
+
+def test_ctr_config_hbm_path():
+    from hetu_tpu.models import CTRConfig, WideDeep
+
+    set_random_seed(0)
+    cfg = CTRConfig(vocab=200, embed_dim=4, embedding="hbm",
+                    cache_capacity=1024, host_optimizer="adagrad",
+                    host_lr=0.05)
+    model = WideDeep(cfg)
+    tr = Trainer(model, AdamOptimizer(1e-3),
+                 lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))
+    rng = np.random.default_rng(0)
+    b = {"dense": jnp.asarray(rng.normal(size=(16, 13)), jnp.float32),
+         "sparse": jnp.asarray(rng.integers(0, 200, (16, 26)), jnp.int32),
+         "label": jnp.asarray(rng.integers(0, 2, (16,)), jnp.float32)}
+    for m in tr.staged_modules():
+        m.stage(b["sparse"])
+    l0 = float(tr.step(b)["loss"])
+    for _ in range(10):
+        for m in tr.staged_modules():
+            m.stage(b["sparse"])
+        m2 = tr.step(b)
+    assert float(m2["loss"]) < l0
+
+
+def test_partial_free_eviction_keeps_slots_distinct():
+    """Regression: with SOME free slots but fewer than the misses, victim
+    selection must not re-pick a free slot — two ids would share one cache
+    row and one would silently serve the other's embedding."""
+    emb = HBMCachedEmbedding(100, 4, hbm_capacity=4, init_scale=1.0)
+    emb.stage(jnp.asarray([[0, 1, 2]]))  # slot 3 stays free
+    emb._handle.ids = None
+    ids2 = jnp.asarray([[4, 5, 6]])  # 3 misses, only 1 free slot
+    emb.stage(ids2)
+    slots = emb._handle.slot_of[[4, 5, 6]]
+    assert len(set(slots.tolist())) == 3, f"slot collision: {slots}"
+    np.testing.assert_allclose(np.asarray(emb(ids2))[0],
+                               emb.table.pull(np.array([4, 5, 6])),
+                               rtol=1e-6)
+    # directory stayed consistent: resident ids' slots roundtrip
+    h = emb._handle
+    for s in range(4):
+        if h.id_of[s] >= 0:
+            assert h.slot_of[h.id_of[s]] == s
+
+
+def test_prefetch_never_installs_pre_push_snapshot():
+    """Regression: a prefetch issued BEFORE a gradient push must not be
+    installed as a fresh copy of the pushed rows (it predates the server
+    update) — strict freshness (pull_bound=0) has to re-pull them."""
+    emb = HBMCachedEmbedding(50, 4, optimizer="sgd", lr=1.0,
+                             cache_capacity=64,  # host cache => prefetcher
+                             hbm_capacity=32, hbm_pull_bound=0)
+    a = jnp.asarray([[1, 2]])
+    emb.stage(a)
+    before = np.asarray(emb(a)).copy()
+    emb.prefetch(jnp.asarray([[1, 3]]))   # buffer snapshot: pre-push
+    emb.push_grads(jnp.ones(tuple(a.shape) + (4,), jnp.float32))
+    b = jnp.asarray([[1, 3]])
+    emb.stage(b)                           # id 1 stale -> must re-pull
+    got = np.asarray(emb(b))
+    np.testing.assert_allclose(got[0, 0], before[0, 0] - 1.0, rtol=1e-6)
