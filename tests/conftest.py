@@ -17,14 +17,17 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
 
-_force_virtual_cpu_mesh(8)
-
 # Tests are correctness checks, not perf runs: backend optimization level 0
 # cuts XLA:CPU compile time ~40% on this box (the suite is compile-bound).
+# Must be set BEFORE _force_virtual_cpu_mesh — that helper may initialize
+# the backend (it counts devices when jax is already imported), and XLA
+# reads XLA_FLAGS exactly once at backend initialization.
 # Set HETU_TPU_FULL_XLA_OPT=1 to restore full optimization.
 if os.environ.get("HETU_TPU_FULL_XLA_OPT") != "1":
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_backend_optimization_level=0")
+
+_force_virtual_cpu_mesh(8)
 
 import jax  # noqa: E402
 
