@@ -200,9 +200,12 @@ def bench_moe(on_tpu, kind, peak):
     set_random_seed(0)
     if on_tpu:
         batch, seq, chunk = 32, 256, 5
+        # capacity 1.25 (explicit; the standard top-1 Switch setting —
+        # cap 2.0 measured 346 vs 428 samples/s on one v5e)
         cfg = MoELMConfig(vocab_size=32000, hidden_size=1024, num_layers=4,
                           num_heads=16, num_experts=8, top_k=1,
-                          max_seq_len=seq, dtype=jnp.bfloat16)
+                          capacity_factor=1.25, max_seq_len=seq,
+                          dtype=jnp.bfloat16)
     else:
         batch, seq, chunk = 4, 64, 2
         cfg = MoELMConfig(vocab_size=500, hidden_size=64, num_layers=2,
