@@ -54,13 +54,17 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     ring = [(i, (i + 1) % S) for i in range(S)]
 
-    q32 = q.astype(jnp.float32) * scale
+    # matmuls stay in the INPUT dtype with fp32 accumulation: bf16 feeds
+    # the MXU directly (pre-casting q/k/v to fp32 halves matmul throughput
+    # and doubles the HBM traffic of the ring's hot loop); softmax
+    # statistics and the combine stay fp32 regardless.
     q_pos = r * sq + jnp.arange(sq)
 
-    def block(q32, kb, vb, src):
+    def block(qb, kb, vb, src):
         """One K/V block folded into the online softmax: returns the block's
         (logits-exp, rowmax, V-weighted partial) in fp32."""
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qb, kb,
+                            preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = src * sq + jnp.arange(sq)
             cm = q_pos[:, None] >= k_pos[None, :]
@@ -70,7 +74,8 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
         # fully-masked rows: zero them instead of exp(-1e30-(-1e30))=1
         p = jnp.where((m == _NEG)[..., None], 0.0, p)
         l = jnp.sum(p, axis=-1)                            # [b,h,q]
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vb.dtype), vb,
+                       preferred_element_type=jnp.float32)
         return m, l, o
 
     if remat:
@@ -79,7 +84,7 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     def step(carry, t):
         kb, vb, m, l, o = carry
         src = (r - t) % S  # whose block we hold at step t
-        bm, bl, bo = block(q32, kb, vb, src)
+        bm, bl, bo = block(q, kb, vb, src)
         m_new = jnp.maximum(m, bm)
         c_old = jnp.where(m == _NEG, 0.0, jnp.exp(m - m_new))
         c_new = jnp.where(bm == _NEG, 0.0, jnp.exp(bm - m_new))
