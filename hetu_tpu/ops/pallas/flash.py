@@ -341,24 +341,31 @@ def _round_up(x, m):
     return ((x + m - 1) // m) * m
 
 
-def _auto_blocks(Sq_p: int, Sk_p: int) -> tuple[int, int]:
-    """Block sizes swept on a v5e (B=24/12/6, H=16, D=64, fwd+bwd):
+def _auto_blocks(Sq_p: int, Sk_p: int, D: int) -> tuple[int, int]:
+    """Block sizes swept on a v5e (fwd+bwd, best-of-chunks):
 
-    =====  ===========  ========  =======
-    seq    best blocks  flash ms  xla ms
-    =====  ===========  ========  =======
-    512    256 x 512       10.3     15.6
-    1024   512 x 512       16.2     22.4
-    2048   512 x 1024      18.3     27.4
-    =====  ===========  ========  =======
+    D=64 (H=16, B=24/12/6):          D=128 (H=8, B=12/6/3):
+    =====  ===========  =====  ====  ===========  =====  ====
+    seq    best blocks  flash  xla   best blocks  flash  xla
+    =====  ===========  =====  ====  ===========  =====  ====
+    512    256 x 512    10.3   15.6  128 x 512     9.8   13.3
+    1024   512 x 512    16.2   22.4  512 x 512     9.0   12.7
+    2048   512 x 1024   18.3   27.4  512 x 512    13.0   15.5
+    =====  ===========  =====  ====  ===========  =====  ====
 
     128x128 blocks (the old default) LOSE to XLA at every length — the
-    per-block mask/exp/control overhead swamps the 128x64 matmuls.  Large
-    kv blocks amortize it; q blocks cap at 512 to bound VMEM accumulators.
+    per-block mask/exp/control overhead swamps the small matmuls.  Large
+    kv blocks amortize it, but the kv block x head_dim footprint is the
+    VMEM budget: the piecewise length rule is additionally capped at
+    ~64K elements / D, rounded down to the 128-lane tile (512 at D=128,
+    256 at D=256).  q blocks cap at 512 to bound the fp32 accumulators;
+    at D>=128 short sequences measured best with bq=128 (table above).
     """
-    bq = min(512, max(128, Sq_p // 2))
-    bk = Sk_p if Sk_p <= 512 else (512 if Sk_p <= 1024 else 1024)
-    return bq, bk
+    bq = (128 if D >= 128 and Sq_p <= 512
+          else min(512, max(128, Sq_p // 2)))
+    by_len = Sk_p if Sk_p <= 512 else (512 if Sk_p <= 1024 else 1024)
+    vmem_cap = max(128, (65536 // max(D, 1)) // 128 * 128)
+    return bq, min(by_len, vmem_cap)
 
 
 def flash_attention(q, k, v, mask=None, *, causal: bool = False,
@@ -381,7 +388,7 @@ def flash_attention(q, k, v, mask=None, *, causal: bool = False,
     Sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
-    auto_q, auto_k = _auto_blocks(_round_up(Sq, 128), _round_up(Sk, 128))
+    auto_q, auto_k = _auto_blocks(_round_up(Sq, 128), _round_up(Sk, 128), D)
     block_q = min(block_q or auto_q, _round_up(Sq, 128))
     block_k = min(block_k or auto_k, _round_up(Sk, 128))
     Sq_p, Sk_p = _round_up(Sq, block_q), _round_up(Sk, block_k)

@@ -83,3 +83,22 @@ def test_flash_bf16():
     out = flash_attention(q, k, v, causal=True, interpret=True)
     np.testing.assert_allclose(out.astype(np.float32), ref.astype(np.float32),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_auto_blocks_match_sweep_table():
+    """The heuristic must reproduce every hardware-swept best point in its
+    own docstring table, stay 128-aligned, and respect the VMEM cap."""
+    from hetu_tpu.ops.pallas.flash import _auto_blocks
+
+    assert _auto_blocks(512, 512, 64) == (256, 512)
+    assert _auto_blocks(1024, 1024, 64) == (512, 512)
+    assert _auto_blocks(2048, 2048, 64) == (512, 1024)
+    assert _auto_blocks(512, 512, 128) == (128, 512)
+    assert _auto_blocks(1024, 1024, 128) == (512, 512)
+    assert _auto_blocks(2048, 2048, 128) == (512, 512)
+    for D in (32, 64, 96, 128, 256):
+        for S in (128, 256, 512, 1024, 2048, 4096):
+            bq, bk = _auto_blocks(S, S, D)
+            assert bq % 128 == 0 and bk % 128 == 0, (S, D, bq, bk)
+            assert bk * D <= 65536 or bk == 128, (S, D, bk)
+            assert bq <= S and bk <= S
