@@ -115,6 +115,17 @@ class RemoteGraph:
         if st != 0:
             raise RuntimeError(f"graph commit rejected (status {st})")
 
+    def drop(self):
+        """Free the graph on the server (kGraphLoad kind=3) — long-lived
+        shared servers must not accumulate dead graphs.  In-flight
+        requests from other workers finish safely on their own
+        reference."""
+        one = np.zeros(1, np.int64)
+        st = self._lib.het_ps_graph_load(self._c, self.graph_id, 3, 1, 0,
+                                         _i64p(one), 0)
+        if st != 0:
+            raise RuntimeError(f"graph drop failed (status {st})")
+
     def sample(self, seeds, fanout: int) -> np.ndarray:
         """Uniform in-neighbor sample: (n_seeds, fanout) int64, -1 padded
         where degree < fanout (kGraphSample, server-side Fisher-Yates)."""

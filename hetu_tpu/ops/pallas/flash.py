@@ -362,7 +362,7 @@ def _auto_blocks(Sq_p: int, Sk_p: int, D: int) -> tuple[int, int]:
     at D>=128 short sequences measured best with bq=128 (table above).
     """
     bq = (128 if D >= 128 and Sq_p <= 512
-          else min(512, max(128, Sq_p // 2)))
+          else min(512, max(128, (Sq_p // 2) // 128 * 128)))
     by_len = Sk_p if Sk_p <= 512 else (512 if Sk_p <= 1024 else 1024)
     vmem_cap = max(128, (65536 // max(D, 1)) // 128 * 128)
     return bq, min(by_len, vmem_cap)

@@ -217,7 +217,9 @@ struct Server {
   std::map<uint32_t, Barrier> barriers;
   std::map<uint32_t, SspGroup> ssp_groups;
   std::map<uint32_t, void*> preduce_groups;  // het_preduce handles
-  std::map<uint32_t, GraphStore> graphs;      // graph-server role
+  // shared_ptr: a drop must not free a store while another
+  // connection's in-flight sample/edges request still uses it
+  std::map<uint32_t, std::shared_ptr<GraphStore>> graphs;
   std::atomic<bool> record{false};            // per-row touch recording
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
@@ -511,11 +513,13 @@ struct Server {
         }
         case kGraphLoad: {
           // Upload the CSR in chunks: keys = [kind(0=indptr,1=indices,
-          // 2=commit), total_len, offset, payload...].  kind 0 offset 0
-          // (re)allocates; kind 2 validates the assembled CSR and marks
-          // the graph ready — sampling is refused before that, so a
-          // half-uploaded or corrupt graph can never crash the server.
-          if (h.nkeys < 3 || keys[0] < 0 || keys[0] > 2 || keys[1] < 1 ||
+          // 2=commit, 3=drop), total_len, offset, payload...].  kind 0
+          // offset 0 (re)allocates; kind 2 validates the assembled CSR and
+          // marks the graph ready — sampling is refused before that, so a
+          // half-uploaded or corrupt graph can never crash the server;
+          // kind 3 frees the graph (long-lived shared servers must not
+          // accumulate dead graphs).
+          if (h.nkeys < 3 || keys[0] < 0 || keys[0] > 3 || keys[1] < 1 ||
               keys[2] < 0) { resp.status = -3; break; }
           int64_t kind = keys[0], total = keys[1], off = keys[2];
           int64_t m = h.nkeys - 3;
@@ -523,10 +527,26 @@ struct Server {
             resp.status = -3;
             break;
           }
-          GraphStore* gp;
+          if (kind == 3) {
+            std::lock_guard<std::mutex> lk(mu);
+            // in-flight requests on other connections hold their own
+            // shared_ptr; erasing here only drops the map reference
+            resp.status = graphs.erase(h.table_id) ? 0 : -2;
+            break;
+          }
+          std::shared_ptr<GraphStore> gp;
           {
             std::lock_guard<std::mutex> lk(mu);
-            gp = &graphs[h.table_id];
+            auto it = graphs.find(h.table_id);
+            if (it == graphs.end()) {
+              // only a fresh upload may (re)create the store: a commit or
+              // late chunk racing a drop must get -2, not silently leave
+              // a dead entry behind on a long-lived shared server
+              if (kind == 2 || off != 0) { resp.status = -2; break; }
+              it = graphs.emplace(h.table_id,
+                                  std::make_shared<GraphStore>()).first;
+            }
+            gp = it->second;
           }
           std::lock_guard<std::mutex> gl(gp->gmu);
           if (kind == 2) {
@@ -552,12 +572,12 @@ struct Server {
           // fanout in-neighbors without replacement.  Response: for each
           // seed, fanout ids as u64 lo/hi float pairs; missing slots carry
           // ~0 (decoded as -1 client-side).
-          GraphStore* g;
+          std::shared_ptr<GraphStore> g;
           {
             std::lock_guard<std::mutex> lk(mu);
             auto it = graphs.find(h.table_id);
             if (it == graphs.end()) { resp.status = -2; break; }
-            g = &it->second;
+            g = it->second;
           }
           // fanout bounded FIRST: an unbounded keys[0] would overflow the
           // product check and then drive the emit loop to exhaust memory
@@ -598,12 +618,12 @@ struct Server {
         case kGraphEdges: {
           // keys = node set; response = induced in-edges (src, dst) with
           // both endpoints in the set, each id as u64 lo/hi float pairs.
-          GraphStore* g;
+          std::shared_ptr<GraphStore> g;
           {
             std::lock_guard<std::mutex> lk(mu);
             auto it = graphs.find(h.table_id);
             if (it == graphs.end()) { resp.status = -2; break; }
-            g = &it->second;
+            g = it->second;
           }
           std::unordered_set<int64_t> want(keys.begin(), keys.end());
           auto put_u64 = [&](uint64_t v) {

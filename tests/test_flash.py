@@ -97,7 +97,7 @@ def test_auto_blocks_match_sweep_table():
     assert _auto_blocks(1024, 1024, 128) == (512, 512)
     assert _auto_blocks(2048, 2048, 128) == (512, 512)
     for D in (32, 64, 96, 128, 256):
-        for S in (128, 256, 512, 1024, 2048, 4096):
+        for S in (128, 256, 512, 640, 896, 1024, 1152, 2048, 4096):
             bq, bk = _auto_blocks(S, S, D)
             assert bq % 128 == 0 and bk % 128 == 0, (S, D, bq, bk)
             assert bk * D <= 65536 or bk == 128, (S, D, bk)

@@ -251,3 +251,22 @@ def test_gcn_trains_on_remote_sampled_blocks():
                 jnp.asarray(comm[seeds]), jnp.asarray(pos))
             losses.append(float(loss))
         assert np.mean(losses[-5:]) < 0.6 * np.mean(losses[:5]), losses
+
+
+def test_remote_graph_drop_frees_server_side():
+    """kind=3 drop: the server frees the graph; later samples are refused,
+    a re-upload under the same id works, and dropping twice errors."""
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+
+    ei = random_graph(n=16, e=40, seed=1)
+    with EmbeddingServer() as srv:
+        rg = RemoteGraph(f"127.0.0.1:{srv.port}", 21, ei, num_nodes=16)
+        assert rg.sample([0], fanout=2).shape == (1, 2)
+        rg.drop()
+        with pytest.raises(RuntimeError, match="status -2"):
+            rg.sample([0], fanout=2)
+        with pytest.raises(RuntimeError, match="status -2"):
+            rg.drop()
+        rg2 = RemoteGraph(f"127.0.0.1:{srv.port}", 21, ei, num_nodes=16)
+        assert rg2.sample([0], fanout=2).shape == (1, 2)
