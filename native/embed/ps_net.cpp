@@ -23,6 +23,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -141,6 +142,32 @@ bool write_full(int fd, const void* buf, size_t n) {
   }
   return true;
 }
+
+// Header + payload sections go out in ONE sendmsg: separate write()
+// calls cost a syscall each and can emit separate TCP segments even with
+// TCP_NODELAY.  MSG_NOSIGNAL keeps a dead peer an error (-10 at the
+// caller), not a process-killing SIGPIPE.
+inline bool writev_full(int sock, iovec* iov, int n) {
+  while (n > 0) {
+    msghdr msg{};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = static_cast<size_t>(n);
+    ssize_t w = ::sendmsg(sock, &msg, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    size_t left = static_cast<size_t>(w);
+    while (n > 0 && left >= iov->iov_len) {
+      left -= iov->iov_len;
+      ++iov;
+      --n;
+    }
+    if (n > 0 && left) {
+      iov->iov_base = static_cast<char*>(iov->iov_base) + left;
+      iov->iov_len -= left;
+    }
+  }
+  return true;
+}
+
 
 // Server-side load/traffic introspection (the reference's startRecord PS
 // traffic logging + getLoads per-server load stats,
@@ -542,7 +569,7 @@ struct Server {
               // only a fresh upload may (re)create the store: a commit or
               // late chunk racing a drop must get -2, not silently leave
               // a dead entry behind on a long-lived shared server
-              if (kind == 2 || off != 0) { resp.status = -2; break; }
+              if (kind != 0 || off != 0) { resp.status = -2; break; }
               it = graphs.emplace(h.table_id,
                                   std::make_shared<GraphStore>()).first;
             }
@@ -729,9 +756,12 @@ struct Server {
         // connection only
         break;
       }
-      if (!write_full(fd, &resp, sizeof(resp))) break;
-      if (resp.nfloats &&
-          !write_full(fd, out.data(), resp.nfloats * 4)) break;
+      iovec riov[2];
+      int rn = 0;
+      riov[rn++] = {&resp, sizeof(resp)};
+      if (resp.nfloats)
+        riov[rn++] = {out.data(), static_cast<size_t>(resp.nfloats * 4)};
+      if (!writev_full(fd, riov, rn)) break;
     }
     {
       // prune before close: once closed the fd number can be recycled by an
@@ -784,10 +814,18 @@ struct Client {
                      const int64_t* keys, const float* floats,
                      const char* bytes, float* out, int64_t out_floats) {
     std::lock_guard<std::mutex> lk(m);
-    if (!write_full(sock, &h, sizeof(h))) return -10;
-    if (h.nkeys && !write_full(sock, keys, h.nkeys * 8)) return -10;
-    if (h.nfloats && !write_full(sock, floats, h.nfloats * 4)) return -10;
-    if (h.nbytes && !write_full(sock, bytes, h.nbytes)) return -10;
+    iovec iov[4];
+    int n = 0;
+    iov[n++] = {const_cast<ReqHeader*>(&h), sizeof(h)};
+    if (h.nkeys)
+      iov[n++] = {const_cast<int64_t*>(keys),
+                  static_cast<size_t>(h.nkeys * 8)};
+    if (h.nfloats)
+      iov[n++] = {const_cast<float*>(floats),
+                  static_cast<size_t>(h.nfloats * 4)};
+    if (h.nbytes)
+      iov[n++] = {const_cast<char*>(bytes), static_cast<size_t>(h.nbytes)};
+    if (!writev_full(sock, iov, n)) return -10;
     RespHeader r;
     if (!read_full(sock, &r, sizeof(r))) return -11;
     if (r.nfloats) {
@@ -821,9 +859,16 @@ struct Client {
   int64_t request_var(const ReqHeader& h, const int64_t* keys,
                       const float* floats, std::vector<float>& out) {
     std::lock_guard<std::mutex> lk(mu);
-    if (!write_full(fd, &h, sizeof(h))) return -10;
-    if (h.nkeys && !write_full(fd, keys, h.nkeys * 8)) return -10;
-    if (h.nfloats && !write_full(fd, floats, h.nfloats * 4)) return -10;
+    iovec iov[3];
+    int n = 0;
+    iov[n++] = {const_cast<ReqHeader*>(&h), sizeof(h)};
+    if (h.nkeys)
+      iov[n++] = {const_cast<int64_t*>(keys),
+                  static_cast<size_t>(h.nkeys * 8)};
+    if (h.nfloats)
+      iov[n++] = {const_cast<float*>(floats),
+                  static_cast<size_t>(h.nfloats * 4)};
+    if (!writev_full(fd, iov, n)) return -10;
     RespHeader r;
     if (!read_full(fd, &r, sizeof(r))) return -11;
     out.resize(r.nfloats);
