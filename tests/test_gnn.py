@@ -198,6 +198,7 @@ def test_remote_graph_server_sampling():
             map(tuple, rg.induced_edges(nodes_l).T.tolist()))
 
 
+@pytest.mark.slow
 def test_gcn_trains_on_remote_sampled_blocks():
     """End-to-end: GCN minibatch training where every block comes from the
     graph server (the examples/gnn PS-mode training shape)."""
