@@ -15,6 +15,7 @@ from hetu_tpu.init import normal
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.layers import Embedding, LayerNorm, TransformerBlock
 from hetu_tpu.ops import softmax_cross_entropy_sparse
+from hetu_tpu.ops.losses import lm_head_cross_entropy
 
 __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium", "gpt2_large"]
 
@@ -29,6 +30,11 @@ class GPTConfig:
     dropout_rate: float = 0.0
     initializer_range: float = 0.02
     tie_embeddings: bool = True
+    # stream the LM-head CE over vocab chunks of this size instead of
+    # materializing (tokens, vocab) logits — a MEMORY knob for huge vocabs
+    # / very long sequences (ops.lm_head_cross_entropy; where the logits
+    # fit, the default materialized path is faster)
+    streamed_head_chunk: int = 0
     dtype: object = jnp.float32
 
 
@@ -65,8 +71,20 @@ class GPT(Module):
         self.lm_head_axes = ("embed", "vocab")
         self.config = cfg
 
+    def _head(self):
+        """(hidden, vocab) projection — tied to the token embedding unless
+        an untied lm_head exists."""
+        return self.wte.weight.T if self.lm_head is None else self.lm_head
+
     def __call__(self, input_ids, *, key=None, training: bool = False,
                  compute_dtype=None):
+        x = self.hidden_states(input_ids, key=key, training=training,
+                               compute_dtype=compute_dtype)
+        return x @ self._head().astype(x.dtype)
+
+    def hidden_states(self, input_ids, *, key=None, training: bool = False,
+                      compute_dtype=None):
+        """Final-layer-norm hidden states (no LM-head projection)."""
         s = input_ids.shape[-1]
         x = self.wte(input_ids) + self.wpe(jnp.arange(s))
         if compute_dtype is not None:
@@ -77,13 +95,21 @@ class GPT(Module):
         )
         for blk, k in zip(self.blocks, keys):
             x = blk(x, key=k, training=training)
-        x = self.ln_f(x)
-        head = self.wte.weight.T if self.lm_head is None else self.lm_head
-        return x @ head.astype(x.dtype)
+        return self.ln_f(x)
 
     def loss(self, input_ids, *, key=None, training: bool = True,
              compute_dtype=None):
-        """Next-token cross entropy."""
+        """Next-token cross entropy.  With ``streamed_head_chunk`` set, the
+        head never materializes the (tokens, vocab) logits."""
+        chunk = self.config.streamed_head_chunk
+        if chunk > 0:
+            x = self.hidden_states(input_ids, key=key, training=training,
+                                   compute_dtype=compute_dtype)
+            b, sm1 = input_ids.shape[0], input_ids.shape[1] - 1
+            nll = lm_head_cross_entropy(
+                x[:, :-1].reshape(b * sm1, -1), self._head().astype(x.dtype),
+                input_ids[:, 1:].reshape(-1), chunk=chunk)
+            return nll.mean()
         logits = self(input_ids, key=key, training=training,
                       compute_dtype=compute_dtype)
         nll = softmax_cross_entropy_sparse(logits[:, :-1], input_ids[:, 1:])

@@ -120,14 +120,6 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
     Vp = n_chunks * chunk
     labels = labels.reshape(-1)
 
-    def pad_w(w):
-        return (jnp.pad(w, ((0, 0), (0, Vp - V))) if Vp != V else w)
-
-    def pad_b(b):
-        if b is None:
-            return None
-        return jnp.pad(b, (0, Vp - V), constant_values=-1e30) \
-            if Vp != V else b
 
     @jax.custom_vjp
     def _core(hidden, weight, bias_, labels):
@@ -166,9 +158,9 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
             l = l * jnp.exp(m - m_new) + jnp.sum(
                 jnp.exp(lg - m_new[:, None]), axis=-1)
             # label logit if it falls inside this chunk's live columns
+            # label lives in this chunk's non-overlap columns
             rel = labels - start
-            inside = (labels >= jnp.maximum(start, c * chunk)) & (
-                rel < chunk) & (rel >= 0)
+            inside = (labels >= c * chunk) & (rel < chunk)
             got = jnp.take_along_axis(
                 lg, jnp.clip(rel, 0, chunk - 1)[:, None], axis=1)[:, 0]
             lab = jnp.where(inside, got, lab)
@@ -196,9 +188,9 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
             start = jnp.minimum(c * chunk, V - chunk)
             lg = _block_logits(hidden, weight, bias_, c)
             p = jnp.exp(lg - lse[:, None])          # (N, chunk) fp32
+            # label lives in this chunk's non-overlap columns
             rel = labels - start
-            inside = (labels >= jnp.maximum(start, c * chunk)) & (
-                rel < chunk) & (rel >= 0)
+            inside = (labels >= c * chunk) & (rel < chunk)
             onehot_col = jnp.clip(rel, 0, chunk - 1)
             p = p.at[jnp.arange(N), onehot_col].add(
                 jnp.where(inside, -1.0, 0.0))

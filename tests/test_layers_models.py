@@ -183,3 +183,32 @@ def test_transformer_block_custom_plain_mlp():
                     jnp.float32)
     y = blk(x)
     assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+
+
+def test_gpt_streamed_head_matches_materialized():
+    """streamed_head_chunk: loss and gradients (incl. the tied-embedding
+    weight reached through the head transpose) equal the materialized
+    path."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT, GPTConfig
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 300, (4, 24)), jnp.int32)
+    models = []
+    for chunk in (0, 128):
+        set_random_seed(0)
+        models.append(GPT(GPTConfig(
+            vocab_size=300, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=32, streamed_head_chunk=chunk)))
+    m_ref, m_str = models
+    np.testing.assert_allclose(float(m_str.loss(ids, training=False)),
+                               float(m_ref.loss(ids, training=False)),
+                               rtol=1e-5)
+    g_ref = jax.grad(lambda m: m.loss(ids, training=False))(m_ref)
+    g_str = jax.grad(lambda m: m.loss(ids, training=False))(m_str)
+    np.testing.assert_allclose(np.asarray(g_str.wte.weight),
+                               np.asarray(g_ref.wte.weight),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_str.blocks[0].mlp.w_in),
+                               np.asarray(g_ref.blocks[0].mlp.w_in),
+                               rtol=2e-4, atol=1e-6)
