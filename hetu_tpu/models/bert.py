@@ -26,6 +26,7 @@ from hetu_tpu.ops import (
     gelu,
     softmax_cross_entropy_sparse,
 )
+from hetu_tpu.ops.losses import lm_head_cross_entropy
 
 __all__ = [
     "BertConfig", "BertModel", "BertForPreTraining", "BertForMaskedLM",
@@ -46,6 +47,11 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     initializer_range: float = 0.02
+    # stream the MLM-head CE over vocab chunks of this size instead of
+    # materializing (tokens, vocab) logits — a MEMORY knob for huge vocabs
+    # / long sequences (ops.lm_head_cross_entropy; where the logits fit,
+    # the materialized path is faster)
+    streamed_head_chunk: int = 0
     dtype: object = jnp.float32
 
 
@@ -153,7 +159,28 @@ class BertForPreTraining(Module):
     def loss(self, input_ids, token_type_ids, attention_mask, mlm_labels,
              nsp_labels, *, key=None, training: bool = True, compute_dtype=None):
         """Masked-LM + next-sentence loss; label -1 = unmasked position
-        (reference train_hetu_bert_dp.py loss construction)."""
+        (reference train_hetu_bert_dp.py loss construction).  With
+        ``streamed_head_chunk`` set, the MLM decoder never materializes the
+        (tokens, vocab) logits (ops.lm_head_cross_entropy)."""
+        chunk = self.config.streamed_head_chunk
+        if chunk > 0:
+            hidden, pooled = self.bert(
+                input_ids, token_type_ids, attention_mask, key=key,
+                training=training, compute_dtype=compute_dtype)
+            h = self.heads.transform_ln(gelu(self.heads.transform(hidden)))
+            b, sq = input_ids.shape
+            word = self.bert.embeddings.word.weight
+            mlm_nll = lm_head_cross_entropy(
+                h.reshape(b * sq, -1), word.T.astype(h.dtype),
+                mlm_labels.reshape(-1),
+                bias=self.heads.decoder_bias.astype(h.dtype), chunk=chunk)
+            m = (mlm_labels.reshape(-1) >= 0).astype(jnp.float32)
+            mlm_loss = jnp.sum(mlm_nll) / jnp.maximum(jnp.sum(m), 1.0)
+            nsp_logits = self.heads.nsp(pooled)
+            nsp_loss = softmax_cross_entropy_sparse(
+                nsp_logits, nsp_labels).mean()
+            return mlm_loss + nsp_loss, {"mlm_loss": mlm_loss,
+                                         "nsp_loss": nsp_loss}
         mlm_logits, nsp_logits = self(
             input_ids, token_type_ids, attention_mask, key=key,
             training=training, compute_dtype=compute_dtype,

@@ -212,3 +212,41 @@ def test_gpt_streamed_head_matches_materialized():
     np.testing.assert_allclose(np.asarray(g_str.blocks[0].mlp.w_in),
                                np.asarray(g_ref.blocks[0].mlp.w_in),
                                rtol=2e-4, atol=1e-6)
+
+
+def test_bert_streamed_mlm_head_matches_materialized():
+    """BertConfig.streamed_head_chunk: loss and gradients (tied embedding
+    reached through the decoder transpose, plus the decoder bias) equal
+    the materialized MLM head."""
+    from hetu_tpu.core import set_random_seed
+
+    rng = np.random.default_rng(0)
+    B, S, V = 4, 16, 211
+    ids = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    lab = jnp.asarray(np.where(rng.random((B, S)) < 0.3,
+                               rng.integers(0, V, (B, S)), -1), jnp.int32)
+    nsp = jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)
+    models = []
+    for chunk in (0, 64):
+        set_random_seed(0)
+        cfg = bert_base(vocab_size=V, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=S,
+                        streamed_head_chunk=chunk)
+        models.append(BertForPreTraining(cfg))
+    m_ref, m_str = models
+
+    def loss(m):
+        return m.loss(ids, None, None, lab, nsp, training=False)[0]
+
+    np.testing.assert_allclose(float(loss(m_str)), float(loss(m_ref)),
+                               rtol=1e-5)
+    g_ref = jax.grad(loss)(m_ref)
+    g_str = jax.grad(loss)(m_str)
+    for get, name in (
+            (lambda g: g.bert.embeddings.word.weight, "tied embedding"),
+            (lambda g: g.heads.decoder_bias, "decoder bias"),
+            (lambda g: g.heads.transform.w, "transform"),
+            (lambda g: g.heads.nsp.w, "nsp head")):
+        np.testing.assert_allclose(np.asarray(get(g_str)),
+                                   np.asarray(get(g_ref)),
+                                   rtol=3e-4, atol=1e-6, err_msg=name)
