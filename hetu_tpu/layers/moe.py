@@ -47,17 +47,23 @@ def _one_hot(x, n, dtype=jnp.float32):
     return jax.nn.one_hot(x, n, dtype=dtype)
 
 
-def _assign_slots(mask, capacity: int, fill=None):
-    """Capacity bucketing shared by all gates (reference TopGate.py:34-44
-    cumsum locations): first-come-first-served positions per expert, tokens
-    past ``capacity`` dropped.  ``mask``: [T,E] one-hot choices; ``fill``:
-    [1,E] running per-expert occupancy from earlier choice ranks.
-    Returns (dispatch [T,E,C] one-hot, in_cap [T,E], new_fill)."""
+def _slot_positions(mask, capacity: int, fill=None):
+    """Capacity bucketing position math shared by all gates (reference
+    TopGate.py:34-44 cumsum locations): first-come-first-served positions
+    per expert, tokens past ``capacity`` dropped.  ``mask``: [T,E] one-hot
+    choices; ``fill``: [1,E] running per-expert occupancy from earlier
+    choice ranks.  Returns (slot [T] int32, in_cap [T,E], new_fill)."""
     fill = jnp.zeros((1, mask.shape[1]), jnp.float32) if fill is None else fill
     pos = jnp.cumsum(mask, axis=0) - mask + fill
     new_fill = fill + jnp.sum(mask, axis=0, keepdims=True)
     in_cap = (pos < capacity).astype(jnp.float32) * mask
     slot = jnp.sum(pos * in_cap, axis=-1).astype(jnp.int32)
+    return slot, in_cap, new_fill
+
+
+def _assign_slots(mask, capacity: int, fill=None):
+    """One-hot [T,E,C] dispatch over _slot_positions (the einsum path)."""
+    slot, in_cap, new_fill = _slot_positions(mask, capacity, fill)
     slot_oh = _one_hot(slot, capacity) * jnp.sum(in_cap, -1, keepdims=True)
     dispatch = in_cap[:, :, None] * slot_oh[:, None, :]
     return dispatch, in_cap, new_fill
@@ -89,39 +95,52 @@ class TopKGate(Module):
         return max(self.k, self.k * math.ceil(n_tokens / self.num_experts * cf))
 
     def __call__(self, x, *, training: bool = True):
+        """Dense [T,E,C] dispatch/combine built FROM the index plan — one
+        source of routing truth (index_plan); this densification exists for
+        gates/consumers on the einsum path and as the test oracle."""
+        plans, C, aux = self.index_plan(x, training=training)
+        T, E = x.shape[0], self.num_experts
+        dispatch = jnp.zeros((T, E, C), jnp.float32)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        for e_idx, slot, keep, g in plans:
+            oh = (_one_hot(e_idx, E)[:, :, None]
+                  * _one_hot(slot, C)[:, None, :]
+                  * keep.astype(jnp.float32)[:, None, None])
+            dispatch = dispatch + oh
+            combine = combine + g[:, None, None] * oh
+        return dispatch, combine, aux
+
+    def index_plan(self, x, *, training: bool = True):
+        """Index-level routing plan for the scatter/gather dispatch path
+        (MoELayer): per choice rank, (expert_idx [T], slot [T], keep [T],
+        gate [T]).  Same position math (_slot_positions) and balance loss
+        as __call__ — the dense [T,E,C] one-hot tensors are never built;
+        at bench shape their einsums burn T*E*C*d MACs to do a gather's
+        job."""
         T, E = x.shape[0], self.num_experts
         C = self.capacity(T, training)
         logits = (x @ self.w.astype(x.dtype) + self.b.astype(x.dtype))
-        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T,E]
-
-        dispatch = jnp.zeros((T, E, C), jnp.float32)
-        combine = jnp.zeros((T, E, C), jnp.float32)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        plans = []
         aux = 0.0
         remaining = gates
-        # running per-expert fill carries across choice ranks (TopGate.py:39
-        # acc_base): choice i's positions start after choice i-1's tail.
         fill = None
-        masks = []
-        for i in range(self.k):
-            idx = jnp.argmax(remaining, axis=-1)                  # [T]
-            mask = _one_hot(idx, E)                               # [T,E]
-            masks.append(mask)
+        for _ in range(self.k):
+            idx = jnp.argmax(remaining, axis=-1)
+            mask = _one_hot(idx, E)
             remaining = remaining * (1.0 - mask)
-            disp_i, in_cap, fill = _assign_slots(mask, C, fill)
-            gate_i = jnp.sum(gates * mask, axis=-1)               # [T]
-            dispatch = dispatch + disp_i
-            combine = combine + gate_i[:, None, None] * disp_i
-        # balance loss per choice vs the softmax distribution
-        # (TopGate.py:6 balance_loss: E * sum(mean_gates * mean_mask))
-        for mask in masks:
+            slot, in_cap, fill = _slot_positions(mask, C, fill)
+            keep = jnp.sum(in_cap, axis=-1) > 0.0
+            gate_i = jnp.sum(gates * mask, axis=-1)
+            plans.append((idx, slot, keep, gate_i))
             me = jnp.mean(gates, axis=0)
             ce = jnp.mean(mask, axis=0)
             aux = aux + jnp.sum(me * ce) * E
         if self.k > 1:
-            # renormalize combine weights over the selected experts
-            denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-            combine = combine / jnp.maximum(denom, 1e-9)
-        return dispatch, combine, aux
+            denom = sum(g * k.astype(jnp.float32) for _, _, k, g in plans)
+            denom = jnp.maximum(denom, 1e-9)
+            plans = [(i, s_, k, g / denom) for i, s_, k, g in plans]
+        return plans, C, aux
 
 
 class HashGate(Module):
@@ -401,6 +420,37 @@ class MoELayer(Module):
         # XLA lowers the inner axis onto ICI and the outer onto DCN.
         self.axis = (axis,) if isinstance(axis, str) else tuple(axis)
 
+    def _route_in(self, gate, t, training):
+        """(ex_in [E,C,d], plan_ctx, aux).  Index path (scatter) when the
+        gate provides index_plan — one O(T*d) scatter instead of a
+        [T,E,C]x[T,d] einsum burning T*E*C*d MACs; else the one-hot
+        einsum (reference moe_layer.py dispatch)."""
+        E = self.experts.num_experts
+        if hasattr(gate, "index_plan"):
+            plans, C, aux = gate.index_plan(t, training=training)
+            flat = jnp.zeros((E * C, t.shape[1]), t.dtype)
+            for e_idx, slot, keep, _g in plans:
+                tgt = jnp.where(keep, e_idx * C + slot, E * C)
+                flat = flat.at[tgt].add(t, mode="drop")
+            return flat.reshape(E, C, t.shape[1]), ("idx", plans, C), aux
+        dispatch, combine, aux = gate(t, training=training)
+        ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(t.dtype), t)
+        return ex_in, ("oh", combine), aux
+
+    def _route_out(self, ctx, ex_out, t_dtype):
+        """Combine expert outputs back to tokens per the routing context."""
+        if ctx[0] == "idx":
+            _, plans, C = ctx
+            flat = ex_out.reshape(-1, ex_out.shape[-1])
+            y = 0.0
+            for e_idx, slot, keep, g in plans:
+                src = jnp.clip(e_idx * C + slot, 0, flat.shape[0] - 1)
+                w = (g * keep.astype(jnp.float32)).astype(t_dtype)
+                y = y + flat[src] * w[:, None]
+            return y
+        _, combine = ctx
+        return jnp.einsum("tec,ecd->td", combine.astype(t_dtype), ex_out)
+
     def __call__(self, x, *, training: bool = True):
         shape = x.shape
         d = shape[-1]
@@ -415,10 +465,9 @@ class MoELayer(Module):
 
         if ep <= 1:
             t = x.reshape(-1, d)
-            dispatch, combine, aux = self.gate(t, training=training)
-            ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(t.dtype), t)
+            ex_in, ctx, aux = self._route_in(self.gate, t, training)
             ex_out = self.experts(ex_in)
-            y = jnp.einsum("tec,ecd->td", combine.astype(t.dtype), ex_out)
+            y = self._route_out(ctx, ex_out, t.dtype)
             return y.reshape(shape), aux
 
         E_local = E // ep
@@ -449,8 +498,7 @@ class MoELayer(Module):
             experts = _pvary_params(experts)
             # xl: the ep-local token shard [..., d]
             t = xl.reshape(-1, d)
-            dispatch, combine, aux = gate(t, training=training)
-            ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(t.dtype), t)
+            ex_in, ctx, aux = self._route_in(gate, t, training)
             # [E, C, d] -> exchange capacity buckets so each rank holds its
             # E_local experts' buckets from every rank: [E_local, ep*C, d]
             ex_in = lax.all_to_all(ex_in, self.axis, split_axis=0,
@@ -459,7 +507,7 @@ class MoELayer(Module):
             # reverse exchange: [E, C, d] back on every source rank
             ex_out = lax.all_to_all(ex_out, self.axis, split_axis=1,
                                     concat_axis=0, tiled=True)
-            y = jnp.einsum("tec,ecd->td", combine.astype(t.dtype), ex_out)
+            y = self._route_out(ctx, ex_out, t.dtype)
             aux = lax.pmean(aux, self.axis)
             return y.reshape(xl.shape), aux
 

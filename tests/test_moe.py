@@ -291,3 +291,39 @@ def test_bert_moe_expert_parallel_mesh():
         strategy=strategy)
     m = tr.step(b)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_index_dispatch_matches_einsum_dispatch():
+    """The scatter/gather routing path must produce the same outputs as
+    the one-hot einsum path (same _slot_positions math) for top-1 and
+    top-2 incl. capacity drops."""
+    from hetu_tpu.layers.moe import ExpertMLP, MoELayer, TopKGate
+
+    class NoPlanGate:
+        """Hide index_plan so MoELayer takes the einsum path."""
+
+        def __init__(self, gate):
+            self._g = gate
+            self.num_experts = gate.num_experts
+
+        def __call__(self, t, *, training=True):
+            return self._g(t, training=training)
+
+    rng = np.random.default_rng(0)
+    for k in (1, 2):
+        set_random_seed(0)
+        gate = TopKGate(16, 4, k=k, capacity_factor=0.6)  # forces drops
+        experts = ExpertMLP(4, 16, 32)
+        moe_idx = MoELayer(gate, experts)
+        moe_oh = MoELayer(NoPlanGate(gate), experts)
+        x = jnp.asarray(rng.normal(size=(2, 24, 16)), jnp.float32)
+        y1, aux1 = moe_idx(x, training=True)
+        y2, aux2 = moe_oh(x, training=True)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(aux1), float(aux2), rtol=1e-6)
+        # gradients agree too (wrt the inputs)
+        g1 = jax.grad(lambda v: moe_idx(v, training=True)[0].sum())(x)
+        g2 = jax.grad(lambda v: moe_oh(v, training=True)[0].sum())(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-6)
