@@ -51,6 +51,30 @@ def test_flash_grad(B, S, H, D, causal):
         np.testing.assert_allclose(b, a, atol=6e-2, rtol=1e-2)
 
 
+@pytest.mark.parametrize("S,blocks,causal", [
+    (512, (128, 128), True),    # fused multi-kv-block: nk=4 <= _MAX_DQ_PARTIALS
+    (512, (128, 128), False),   # ... incl. the dq-partial sum over j
+    (1280, (128, 128), True),   # nk=10 > _MAX_DQ_PARTIALS: two-kernel fallback
+])
+def test_flash_grad_multi_kv_block(S, blocks, causal):
+    """The fused bwd's dq-partial reduction, causal dead-slot zeroing, and
+    the long-sequence two-kernel fallback (nk > _MAX_DQ_PARTIALS) must all
+    match the dense oracle — explicit small blocks force nk > 1."""
+    from hetu_tpu.ops.pallas.flash import _MAX_DQ_PARTIALS
+    bq, bk = blocks
+    assert (S // bk > _MAX_DQ_PARTIALS) == (S == 1280)
+    q, k, v = _qkv(1, S, 2, 64)
+    gref = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, causal=causal) ** 2
+                         ).sum(), argnums=(0, 1, 2))(q, k, v)
+    gfl = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal=causal, block_q=bq,
+                                         block_k=bk, interpret=True) ** 2
+                         ).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gref, gfl):
+        np.testing.assert_allclose(b, a, atol=6e-2, rtol=1e-2)
+
+
 def test_flash_ragged_grad_zero_padding():
     """Padded q rows must not pollute dK/dV (their dO is zero)."""
     q, k, v = _qkv(1, 160, 2, 64)  # pads 160 -> 256
@@ -90,7 +114,7 @@ def test_auto_blocks_match_sweep_table():
     own docstring table, stay 128-aligned, and respect the VMEM cap."""
     from hetu_tpu.ops.pallas.flash import _auto_blocks
 
-    assert _auto_blocks(512, 512, 64) == (256, 512)
+    assert _auto_blocks(512, 512, 64) == (512, 512)
     assert _auto_blocks(1024, 1024, 64) == (512, 512)
     assert _auto_blocks(2048, 2048, 64) == (512, 1024)
     assert _auto_blocks(512, 512, 128) == (128, 512)
