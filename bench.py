@@ -351,9 +351,13 @@ def bench_bert_long(on_tpu, kind, peak):
 
 
 def bench_bert_headline(on_tpu, kind, peak):
-    # batch swept on v5e with chunked timing (r01): 192 -> best MFU;
-    # >256 OOMs; <=160 underfills the MXU
-    return _bert_mfu(on_tpu, kind, peak, seq=128, batch=192, chunk=5,
+    # batch re-swept r03 with dropout ON: {64: 0.568, 96: 0.571, 128: 0.565,
+    # 192: 0.531, 256: 0.495} — HBM pressure above ~128 degrades the whole
+    # step (optimizer/LN fusions fall off roofline), so the r01 choice of
+    # 192 was costing ~7% MFU.  Flash at seq 128 re-measured and still
+    # loses to XLA (0.461 vs 0.571) — kernel overhead swamps 128-wide
+    # blocks; it stays OFF here and ON at seq 512.
+    return _bert_mfu(on_tpu, kind, peak, seq=128, batch=96, chunk=8,
                      use_flash=False, metric="bert_large_pretrain_mfu")
 
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import argparse
 import functools
-import math
 import os
 import sys
 import time
@@ -63,15 +62,9 @@ def chain_timer(fn, args, reps=5, lengths=(50, 250)):
 
 
 def xla_attn(q, k, v, causal):
-    D = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) / math.sqrt(D)
-    if causal:
-        S = q.shape[1]
-        s = jnp.where(
-            jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # the exact materialized path the kernel replaces (and falls back to)
+    from hetu_tpu.layers.attention import dot_product_attention
+    return dot_product_attention(q, k, v, causal=causal)
 
 
 def main():
@@ -96,9 +89,11 @@ def main():
                               block_q=args.block_q, block_k=args.block_k)
 
     def grad_wrap(attn):
+        # all three grads, summed into one live output — argnums=(0,) would
+        # let XLA dead-code-eliminate the dK/dV matmuls from non-fused paths
         g = jax.grad(lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2),
-                     argnums=(0,))
-        return lambda q, k, v: g(q, k, v)[0]
+                     argnums=(0, 1, 2))
+        return lambda q, k, v: sum(g(q, k, v))
 
     fwd = chain_timer(flash, (q, k, v))
     tot = chain_timer(grad_wrap(flash), (q, k, v))

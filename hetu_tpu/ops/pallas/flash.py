@@ -263,10 +263,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
                    dk_ref, dv_ref, dk_acc, dv_acc, *,
                    scale, causal, block_q, block_k, kv_len):
-    # long-seq fallback: dK/dV only (q innermost)
+    # long-seq fallback: dK/dV only (q innermost).  delta arrives
+    # precomputed (one XLA reduction) — recomputing it in-kernel would
+    # re-read the O block once per inner step, and this path is chosen
+    # exactly when the inner trip count nk is large.
     j, i = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -291,7 +294,7 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - _delta(do_ref, o_ref)) * scale
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -302,7 +305,7 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
                    kv_len):
     # long-seq fallback: dQ only (kv innermost, accumulate in VMEM)
@@ -326,7 +329,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - _delta(do_ref, o_ref)) * scale
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -382,11 +385,15 @@ def _bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
               else jnp.sum(dq_part, axis=0)).astype(q.dtype)
         return dq, dk, dv
 
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    fb_in_specs = [bwd_q_spec, bwd_kv_spec, bwd_kv_spec, bwd_q_spec,
+                   bwd_lse_spec, bwd_lse_spec]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_kv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=kv_len),
         grid=(B, H, nk, nq),
-        in_specs=in_specs,
+        in_specs=fb_in_specs,
         out_specs=[bwd_kv_spec, bwd_kv_spec],
         out_shape=[
             _sds(k.shape, k.dtype, k),
@@ -395,23 +402,23 @@ def _bwd(scale, causal, block_q, block_k, kv_len, interpret, res, g):
         scratch_shapes=kv_scratch,
         compiler_params=_compiler_params(3),
         interpret=interpret,
-    )(q, k, v, do, out, lse)
+    )(q, k, v, do, delta, lse)
 
+    dq_lse_spec = pl.BlockSpec((1, 1, block_q, 1),
+                               lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=kv_len),
         grid=(B, H, nq, nk),
         in_specs=[_q_spec(block_q, D), _kv_spec(block_k, D),
                   _kv_spec(block_k, D), _q_spec(block_q, D),
-                  _q_spec(block_q, D),
-                  pl.BlockSpec((1, 1, block_q, 1),
-                               lambda b, h, i, j: (b, h, i, 0))],
+                  dq_lse_spec, dq_lse_spec],
         out_specs=_q_spec(block_q, D),
         out_shape=_sds(q.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_compiler_params(3),
         interpret=interpret,
-    )(q, k, v, do, out, lse)
+    )(q, k, v, do, delta, lse)
     return dq, dk, dv
 
 
@@ -458,8 +465,7 @@ def _auto_blocks(Sq_p: int, Sk_p: int, D: int) -> tuple[int, int]:
     256 at D=256).  q blocks cap at 512 to bound the fp32 accumulators;
     at D>=128 short sequences measured best with bq=128 (table above).
     """
-    bq = (128 if D >= 128 and Sq_p <= 512
-          else min(512, max(128, Sq_p // 128 * 128)))
+    bq = 128 if D >= 128 and Sq_p <= 512 else min(512, Sq_p)
     by_len = Sk_p if Sk_p <= 512 else (512 if Sk_p <= 1024 else 1024)
     vmem_cap = max(128, (65536 // max(D, 1)) // 128 * 128)
     return bq, min(by_len, vmem_cap)

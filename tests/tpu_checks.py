@@ -22,7 +22,7 @@ import numpy as np
 
 
 def check_flash():
-    """Compiled flash kernel fwd+bwd vs f32 oracle."""
+    """Compiled flash kernel fwd+bwd vs f32 oracle (max-abs ERROR values)."""
     import jax
     import jax.numpy as jnp
     from hetu_tpu.ops.pallas.flash import flash_attention
@@ -51,8 +51,36 @@ def check_flash():
             lambda q, k, v: jnp.sum(ref_fn(q, k, v) ** 2),
             argnums=(0, 1, 2)))(q, k, v)
         eb = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gf, gr))
-        print(f"  flash B{B} S{S} causal={causal}: fwd {ef:.5f} bwd {eb:.5f}")
+        print(f"  flash B{B} S{S} causal={causal}: "
+              f"fwd max-abs-err {ef:.5f} bwd max-abs-err {eb:.5f}")
         assert ef < 0.02 and eb < 0.25, (ef, eb)
+
+
+def check_flash_time():
+    """Kernel wall time at the bench shapes (differenced-scan timing,
+    examples/profile_flash.py) — the bwd/fwd ratio must stay <= 3."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from examples.profile_flash import chain_timer
+    from hetu_tpu.ops.pallas.flash import flash_attention
+
+    rng = np.random.default_rng(0)
+    for (B, S, H, D, causal) in [(24, 512, 16, 64, False),
+                                 (32, 512, 16, 64, True)]:
+        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)) * 0.5,
+                               jnp.bfloat16) for _ in range(3))
+        f = functools.partial(flash_attention, causal=causal)
+        grad = jax.grad(
+            lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))  # all grads live (argnums=(0,) lets XLA DCE dK/dV)
+        fwd = chain_timer(f, (q, k, v))
+        tot = chain_timer(lambda q, k, v: sum(grad(q, k, v)), (q, k, v))
+        ratio = (tot - fwd) / fwd
+        print(f"  flash B{B} S{S} H{H} D{D} causal={causal}: "
+              f"fwd {fwd*1e3:.3f} ms  fwd+bwd {tot*1e3:.3f} ms  "
+              f"bwd/fwd ratio {ratio:.2f}")
+        assert ratio <= 3.0, f"backward too slow: ratio {ratio:.2f}"
 
 
 def check_bridge():
@@ -137,8 +165,8 @@ def check_step_time():
     assert dt < 5.0, "step absurdly slow — backend degraded?"
 
 
-CHECKS = {"flash": check_flash, "bridge": check_bridge, "ctr": check_ctr,
-          "step": check_step_time}
+CHECKS = {"flash": check_flash, "flash_time": check_flash_time,
+          "bridge": check_bridge, "ctr": check_ctr, "step": check_step_time}
 
 
 def main():
