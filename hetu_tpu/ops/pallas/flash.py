@@ -48,7 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attn_fn"]
+__all__ = ["flash_attention", "flash_attn_fn",
+           "flash_block_fwd", "flash_block_bwd"]
 
 _NEG_INF = -1e30  # finite: -inf - -inf = nan would poison alpha/exp paths
 _MAX_DQ_PARTIALS = 8  # fused bwd keeps nk fp32 dQ partials; beyond, two-pass
@@ -212,9 +213,10 @@ def _delta(do_ref, o_ref):
                    axis=1, keepdims=True)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, od_ref, lse_ref,
                       dk_ref, dv_ref, dq_ref, dk_acc, dv_acc, *,
-                      scale, causal, block_q, block_k, kv_len):
+                      scale, causal, block_q, block_k, kv_len,
+                      delta_in=False):
     # grid (B, H, nk, nq) — q innermost.  dK/dV accumulate in scratch for
     # kv block j; the dQ contribution of (j, i) is one matmul, written to
     # its own partial slot and reduced over j outside the kernel.
@@ -243,7 +245,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - _delta(do_ref, o_ref)) * scale
+        d = od_ref[0, 0, :, :] if delta_in else _delta(do_ref, od_ref)
+        ds = p * (dp - d) * scale
         ds_c = ds.astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds_c, q, (((0,), (0,)), ((), ())),
@@ -435,6 +438,130 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_len, interpret):
 
 
 _flash.defvjp(_flash_fwd, _bwd)
+
+
+# --------------------------------------------------------------------------
+# block-level entry points (ring attention)
+# --------------------------------------------------------------------------
+#
+# Ring attention (parallel/ring_attention.py) owns its OWN custom_vjp: with
+# the GLOBAL logsumexp, exp(QK^T*scale - lse) is the true global softmax
+# probability of the block, so the per-block backward is exactly the fused
+# kernel fed an externally-computed (lse, delta) — no lse cotangent exists
+# anywhere.  These raw entry points run the kernels on one (q-chunk,
+# kv-chunk) pair in (B, H, S, D) layout.
+
+def _block_sizes(Sq, Sk, D, block_q, block_k, interpret):
+    bq = block_q or _auto_blocks(Sq, Sk, D)[0]
+    bk = block_k or _auto_blocks(Sq, Sk, D)[1]
+    bq, bk = min(bq, Sq), min(bk, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(
+            f"ring chunk ({Sq}, {Sk}) not divisible by blocks ({bq}, {bk})")
+    if not interpret and (bq % 128 or bk % 128):
+        # the compiled Mosaic path needs lane-aligned blocks; interpreter
+        # tests may use any size
+        raise ValueError(
+            f"ring chunk blocks ({bq}, {bk}) not 128-aligned; pad sequence"
+            " chunks to 128-multiples on TPU")
+    return bq, bk
+
+
+def flash_block_fwd(q, k, v, *, scale, causal=False, block_q=None,
+                    block_k=None, interpret=None):
+    """(out, lse) of one block pair; q, k, v: (B, H, S, D)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret)
+    return _fwd_call(q, k, v, scale, causal, bq, bk, Sk, interpret)
+
+
+def flash_block_bwd(q, k, v, do, lse, delta, *, scale, causal=False,
+                    block_q=None, block_k=None, interpret=None):
+    """(dq, dk, dv) of one block pair given GLOBAL lse/delta for the q
+    chunk; all fp32 outputs (ring steps accumulate across blocks).
+    q, k, v, do: (B, H, S, D); lse, delta: (B, H, Sq, 1) fp32.
+
+    Past ``_MAX_DQ_PARTIALS`` kv blocks the fused kernel's fp32 dQ
+    partials would cost nk x |Q| HBM, so the same two-kernel fallback as
+    the standalone path runs instead."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret)
+    nq, nk = Sq // bq, Sk // bk
+
+    bwd_q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    bwd_kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    bwd_lse_spec = pl.BlockSpec((1, 1, bq, 1),
+                                lambda b, h, j, i: (b, h, i, 0))
+    kv_scratch = [
+        pltpu.VMEM((bk, D), jnp.float32),
+        pltpu.VMEM((bk, D), jnp.float32),
+    ]
+
+    if nk <= _MAX_DQ_PARTIALS:
+        dk, dv, dq_part = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              block_q=bq, block_k=bk, kv_len=Sk,
+                              delta_in=True),
+            grid=(B, H, nk, nq),
+            in_specs=[bwd_q_spec, bwd_kv_spec, bwd_kv_spec, bwd_q_spec,
+                      bwd_lse_spec, bwd_lse_spec],
+            out_specs=[
+                bwd_kv_spec,
+                bwd_kv_spec,
+                pl.BlockSpec((1, 1, 1, bq, D),
+                             lambda b, h, j, i: (j, b, h, i, 0)),
+            ],
+            out_shape=[
+                _sds(k.shape, jnp.float32, k),
+                _sds(v.shape, jnp.float32, v),
+                _sds((nk, B, H, Sq, D), jnp.float32, q),
+            ],
+            scratch_shapes=kv_scratch,
+            compiler_params=_compiler_params(3),
+            interpret=interpret,
+        )(q, k, v, do, delta, lse)
+        dq = dq_part[0] if nk == 1 else jnp.sum(dq_part, axis=0)
+        return dq, dk, dv
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, kv_len=Sk),
+        grid=(B, H, nk, nq),
+        in_specs=[bwd_q_spec, bwd_kv_spec, bwd_kv_spec, bwd_q_spec,
+                  bwd_lse_spec, bwd_lse_spec],
+        out_specs=[bwd_kv_spec, bwd_kv_spec],
+        out_shape=[
+            _sds(k.shape, jnp.float32, k),
+            _sds(v.shape, jnp.float32, v),
+        ],
+        scratch_shapes=kv_scratch,
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(q, k, v, do, delta, lse)
+
+    dq_q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    dq_kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    dq_lse_spec = pl.BlockSpec((1, 1, bq, 1),
+                               lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, kv_len=Sk),
+        grid=(B, H, nq, nk),
+        in_specs=[dq_q_spec, dq_kv_spec, dq_kv_spec, dq_q_spec,
+                  dq_lse_spec, dq_lse_spec],
+        out_specs=dq_q_spec,
+        out_shape=_sds(q.shape, jnp.float32, q),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_compiler_params(3),
+        interpret=interpret,
+    )(q, k, v, do, delta, lse)
+    return dq, dk, dv
 
 
 # --------------------------------------------------------------------------

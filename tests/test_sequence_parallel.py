@@ -22,12 +22,14 @@ def _qkv(b=2, s=16, h=4, d=8, seed=0):
     return mk(), mk(), mk()
 
 
+@pytest.mark.parametrize("impl", ["flash", "blockwise"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_dense(sp_mesh, causal):
+def test_ring_attention_matches_dense(sp_mesh, causal, impl):
     q, k, v = _qkv()
     ref = dot_product_attention(q, k, v, causal=causal)
     out = jax.jit(
-        lambda q, k, v: ring_attn_fn(sp_mesh)(q, k, v, causal=causal)
+        lambda q, k, v: ring_attn_fn(sp_mesh, impl=impl)(q, k, v,
+                                                         causal=causal)
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -44,21 +46,52 @@ def test_ulysses_matches_dense(sp_mesh, causal):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ring_attention_grads_match_dense(sp_mesh):
+@pytest.mark.parametrize("impl", ["flash", "blockwise"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads_match_dense(sp_mesh, causal, impl):
     q, k, v = _qkv(seed=2)
 
     def loss(fn):
         def f(q, k, v):
-            return (fn(q, k, v, causal=True) ** 2).mean()
+            return (fn(q, k, v, causal=causal) ** 2).mean()
         return f
 
     g_ref = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
     g_ring = jax.jit(
-        jax.grad(loss(ring_attn_fn(sp_mesh)), argnums=(0, 1, 2))
+        jax.grad(loss(ring_attn_fn(sp_mesh, impl=impl)), argnums=(0, 1, 2))
     )(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_ring_flash_multi_block_chunks(sp_mesh):
+    """Flash-ring with chunks that split into multiple kernel blocks:
+    explicit 64-wide blocks over s_local=256 chunks force nq=nk=4 inside
+    every block pair (dq-partial reduction + causal dead-slot zeroing)."""
+    q, k, v = _qkv(b=2, s=1024, h=2, d=8, seed=3)  # b divisible by dp=2
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v, causal=True) ** 2).mean()
+
+    attn = ring_attn_fn(sp_mesh, impl="flash", block_q=64, block_k=64)
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g_ref = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss(attn), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+    # block_k=16 -> nk=16 > _MAX_DQ_PARTIALS: the block bwd's two-kernel
+    # long-sequence fallback
+    attn_fb = ring_attn_fn(sp_mesh, impl="flash", block_q=64, block_k=16)
+    g_fb = jax.jit(jax.grad(loss(attn_fb), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_fb, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_mha_with_ring_attention(sp_mesh):

@@ -83,6 +83,44 @@ def check_flash_time():
         assert ratio <= 3.0, f"backward too slow: ratio {ratio:.2f}"
 
 
+def check_ring():
+    """Compiled flash-ring core vs the blockwise-scan core at seq 2048
+    (sp=1 ring on the single chip): correctness vs the dense oracle and
+    the flash core must be at least as fast."""
+    import jax
+    import jax.numpy as jnp
+    from examples.profile_flash import chain_timer
+    from hetu_tpu.layers.attention import dot_product_attention
+    from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+    from hetu_tpu.parallel.ring_attention import ring_attn_fn
+
+    mesh = make_mesh(MeshSpec(sp=1), devices=jax.devices())
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 512, 2, 64)) * 0.5,
+                           jnp.bfloat16) for _ in range(3))
+    attn = ring_attn_fn(mesh, impl="flash")
+    o = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    print(f"  ring-flash vs dense max-abs-err {err:.5f}")
+    assert err < 0.05, err
+
+    B, S, H, D = 4, 2048, 16, 64
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)) * 0.5,
+                           jnp.bfloat16) for _ in range(3))
+    times = {}
+    for impl in ("flash", "blockwise"):
+        a = ring_attn_fn(mesh, impl=impl)
+        f = lambda q, k, v: a(q, k, v, causal=True)  # noqa: E731
+        g = jax.grad(lambda q, k, v: jnp.sum(
+            f(q, k, v).astype(jnp.float32) ** 2), argnums=(0, 1, 2))
+        times[impl] = chain_timer(lambda q, k, v: sum(g(q, k, v)),
+                                  (q, k, v), lengths=(20, 100))
+        print(f"  ring[{impl}] B{B} S{S} fwd+bwd {times[impl]*1e3:.3f} ms")
+    assert times["flash"] <= times["blockwise"], times
+
+
 def check_bridge():
     """Host-callback probe + auto bridge selection on this backend."""
     from hetu_tpu.core import set_random_seed
@@ -166,7 +204,8 @@ def check_step_time():
 
 
 CHECKS = {"flash": check_flash, "flash_time": check_flash_time,
-          "bridge": check_bridge, "ctr": check_ctr, "step": check_step_time}
+          "ring": check_ring, "bridge": check_bridge, "ctr": check_ctr,
+          "step": check_step_time}
 
 
 def main():
