@@ -3,9 +3,13 @@
 The reference's hot CUDA kernels (src/ops/*.cu) mostly map to single XLA HLOs;
 the long-tail that needs hand-tiling on TPU lives here.  Flash attention is
 the MFU-critical one (SURVEY §7: "BERT-large ≥45% MFU requires fused
-attention").
+attention"); the LM-head kernel is the memory-critical one (the (N, vocab)
+logits tensor is the peak of LM pretraining).
 """
 
-from hetu_tpu.ops.pallas.flash import flash_attention, flash_attn_fn
+from hetu_tpu.ops.pallas.flash import (flash_attention, flash_attn_fn,
+                                       flash_block_bwd, flash_block_fwd)
+from hetu_tpu.ops.pallas.lm_head import lm_head_cross_entropy_pallas
 
-__all__ = ["flash_attention", "flash_attn_fn"]
+__all__ = ["flash_attention", "flash_attn_fn", "flash_block_fwd",
+           "flash_block_bwd", "lm_head_cross_entropy_pallas"]

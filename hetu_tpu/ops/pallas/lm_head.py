@@ -1,0 +1,295 @@
+"""Fused LM-head cross entropy (Pallas/Mosaic).
+
+Replaces the reference's materialize-then-CE head
+(src/ops/SoftmaxCrossEntropySparse.cu applied to a full (N, V) logits
+tensor) with a kernel that streams vocab tiles through VMEM: the (N, V)
+logits never touch HBM, and unlike the XLA vocab-chunked scan
+(ops.losses.lm_head_cross_entropy impl="scan") the matmuls stay pipelined
+on the MXU instead of serializing.
+
+Measured fwd+bwd on one v5e (bf16, all three grads live):
+
+  shape                      pallas   xla-scan   materialized
+  N=12288 E=1024 V=30522     21.2 ms   37.7 ms       13.3 ms
+  N=12288 E=1024 V=250112     169 ms    292 ms        130 ms
+
+The materialized path keeps a ~1.3x edge wherever the (N, V) logits fit:
+its backward reuses the forward logits (8*N*E*V total train FLOPs) while
+any non-materializing backward must recompute them (10*N*E*V) — a FLOP
+floor, not an implementation gap (this kernel runs within ~11% of its
+roofline).  Use the kernel when the logits must NOT be materialized:
+250k-vocab models at training batch (6+ GB of logits), long sequences,
+small-HBM parts — it is 1.7x the speed of the scan there with the same
+O(N + E*block_v) memory.
+
+Schedule:
+- forward: grid (n_blocks, v_blocks), vocab innermost.  Each step computes
+  a (block_n, block_v) logits tile ``h @ W + b`` on the MXU and folds it
+  into an online logsumexp (fp32 running max/denominator in VMEM scratch);
+  the label column's raw logit is extracted in the same pass with an
+  iota==label match.  Outputs per-row ``lse`` and ``label_logit``;
+  ``nll = lse - label_logit`` assembles outside.
+- backward (two kernels, both recompute the logits tile from the saved
+  lse — the flash-attention trade of FLOPs for memory):
+  - dH: grid (n_blocks, v_blocks) vocab-inner; ``dh += t @ W^T`` accumulates
+    in a (block_n, E) fp32 scratch where ``t = (softmax - onehot) * dnll``.
+  - dW/db: grid (v_blocks, n_blocks) token-inner; ``dw += h^T @ t`` and
+    ``db += colsum(t)`` accumulate in (E, block_v) fp32 scratch.
+- ignore_index rows: their upstream dnll is zeroed before the kernels, so
+  every contribution vanishes without the kernels knowing about masking.
+- V is padded to a block multiple with bias -1e30 (those columns' softmax
+  is exactly 0) and N to a block multiple with label -1; both pads sit
+  OUTSIDE the custom_vjp, so XLA's pad/slice transpose rules unpad
+  dW/db/dh automatically.
+
+The weight's E axis is not tiled (one h-block row spans all of E), which
+holds to E <= ~4k on 16 MB VMEM — every model in the zoo.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hetu_tpu.ops.pallas.flash import (_compiler_params, _round_up, _sds)
+
+__all__ = ["lm_head_cross_entropy_pallas"]
+
+_NEG = -1e30
+
+
+def _tile(h_ref, w_ref, b_ref):
+    lg = jax.lax.dot_general(
+        h_ref[:, :], w_ref[:, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return lg + b_ref[0, :].astype(jnp.float32)[None, :]
+
+
+def _fwd_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, ylog_ref,
+                m_sc, l_sc, yl_sc, *, block_v):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        yl_sc[:] = jnp.zeros_like(yl_sc)
+
+    lg = _tile(h_ref, w_ref, b_ref)
+    m_prev = m_sc[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(lg, axis=1, keepdims=True))
+    l_sc[:, :1] = (l_sc[:, :1] * jnp.exp(m_prev - m_new)
+                   + jnp.sum(jnp.exp(lg - m_new), axis=1, keepdims=True))
+    m_sc[:, :1] = m_new
+
+    col = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, lg.shape, 1)
+    match = col == y_ref[:, :1]
+    yl_sc[:, :1] += jnp.sum(jnp.where(match, lg, 0.0), axis=1,
+                            keepdims=True)
+
+    @pl.when(j == nv - 1)
+    def _():
+        lse_ref[:, :] = m_sc[:, :1] + jnp.log(l_sc[:, :1])
+        ylog_ref[:, :] = yl_sc[:, :1]
+
+
+def _t_tile(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, j, block_v, dtype):
+    """(softmax - onehot) * dnll for one logits tile, in the matmul dtype."""
+    lg = _tile(h_ref, w_ref, b_ref)
+    p = jnp.exp(lg - lse_ref[:, :1])
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+    match = col == y_ref[:, :1]
+    t = (p - jnp.where(match, 1.0, 0.0)) * g_ref[:, :1]
+    return t.astype(dtype)
+
+
+def _dh_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dh_ref, dh_acc,
+               *, block_v):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        dh_acc[:] = jnp.zeros_like(dh_acc)
+
+    t = _t_tile(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, j, block_v,
+                w_ref.dtype)
+    dh_acc[:] += jax.lax.dot_general(
+        t, w_ref[:, :], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nv - 1)
+    def _():
+        dh_ref[:, :] = dh_acc[:].astype(dh_ref.dtype)
+
+
+def _dw_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dw_ref, db_ref,
+               dw_acc, db_acc, *, block_v):
+    i = pl.program_id(1)
+    nn = pl.num_programs(1)
+    j = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    t = _t_tile(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, j, block_v,
+                h_ref.dtype)
+    dw_acc[:] += jax.lax.dot_general(
+        h_ref[:, :], t, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    db_acc[:1, :] += jnp.sum(t.astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(i == nn - 1)
+    def _():
+        dw_ref[:, :] = dw_acc[:].astype(dw_ref.dtype)
+        db_ref[:, :] = db_acc[:1, :].astype(db_ref.dtype)
+
+
+def _h_spec(bn, E):
+    return pl.BlockSpec((bn, E), lambda i, j: (i, 0))
+
+
+def _col_spec(bn):
+    return pl.BlockSpec((bn, 1), lambda i, j: (i, 0))
+
+
+def _head_fwd(h, w, b2, y2, block_n, block_v, interpret):
+    N, E = h.shape
+    V = w.shape[1]
+    nn, nv = N // block_n, V // block_v
+    lse, ylog = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=block_v),
+        grid=(nn, nv),
+        in_specs=[
+            _h_spec(block_n, E),
+            pl.BlockSpec((E, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+            _col_spec(block_n),
+        ],
+        out_specs=[_col_spec(block_n), _col_spec(block_n)],
+        out_shape=[
+            _sds((N, 1), jnp.float32, h),
+            _sds((N, 1), jnp.float32, h),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_n, 128), jnp.float32)] * 3,
+        compiler_params=_compiler_params(1),
+        interpret=interpret,
+    )(h, w, b2, y2)
+    return lse, ylog
+
+
+def _head_bwd(h, w, b2, y2, lse, gg, block_n, block_v, interpret):
+    N, E = h.shape
+    V = w.shape[1]
+    nn, nv = N // block_n, V // block_v
+    common = [
+        _h_spec(block_n, E),
+        pl.BlockSpec((E, block_v), lambda i, j: (0, j)),
+        pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+        _col_spec(block_n),
+        _col_spec(block_n),
+        _col_spec(block_n),
+    ]
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, block_v=block_v),
+        grid=(nn, nv),
+        in_specs=common,
+        out_specs=_h_spec(block_n, E),
+        out_shape=_sds(h.shape, h.dtype, h),
+        scratch_shapes=[pltpu.VMEM((block_n, E), jnp.float32)],
+        compiler_params=_compiler_params(1),
+        interpret=interpret,
+    )(h, w, b2, y2, lse, gg)
+
+    vb_specs = [
+        pl.BlockSpec((block_n, E), lambda j, i: (i, 0)),
+        pl.BlockSpec((E, block_v), lambda j, i: (0, j)),
+        pl.BlockSpec((1, block_v), lambda j, i: (0, j)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+    ]
+    dw, db = pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=block_v),
+        grid=(nv, nn),
+        in_specs=vb_specs,
+        out_specs=[
+            pl.BlockSpec((E, block_v), lambda j, i: (0, j)),
+            pl.BlockSpec((1, block_v), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            _sds(w.shape, w.dtype, w),
+            _sds((1, V), jnp.float32, w),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((E, block_v), jnp.float32),
+            pltpu.VMEM((8, block_v), jnp.float32),
+        ],
+        compiler_params=_compiler_params(1),
+        interpret=interpret,
+    )(h, w, b2, y2, lse, gg)
+    return dh, dw, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _head(h, w, b2, y2, ignore_index, block_n, block_v, interpret):
+    lse, ylog = _head_fwd(h, w, b2, y2, block_n, block_v, interpret)
+    y = y2[:, 0]
+    return jnp.where(y == ignore_index, 0.0, lse[:, 0] - ylog[:, 0])
+
+
+def _head_vjp_fwd(h, w, b2, y2, ignore_index, block_n, block_v, interpret):
+    lse, ylog = _head_fwd(h, w, b2, y2, block_n, block_v, interpret)
+    y = y2[:, 0]
+    nll = jnp.where(y == ignore_index, 0.0, lse[:, 0] - ylog[:, 0])
+    return nll, (h, w, b2, y2, lse)
+
+
+def _head_vjp_bwd(ignore_index, block_n, block_v, interpret, res, g):
+    h, w, b2, y2, lse = res
+    live = (y2[:, 0] != ignore_index)
+    gg = (g * live).astype(jnp.float32)[:, None]
+    dh, dw, db = _head_bwd(h, w, b2, y2, lse, gg, block_n, block_v,
+                           interpret)
+    return dh, dw, db.astype(b2.dtype), None
+
+
+_head.defvjp(_head_vjp_fwd, _head_vjp_bwd)
+
+
+def lm_head_cross_entropy_pallas(hidden, weight, labels, *, bias=None,
+                                 ignore_index: int = -1,
+                                 block_n: int = 512, block_v: int = 1024,
+                                 interpret: bool | None = None):
+    """Per-row nll of ``softmax(hidden @ weight + bias)`` at ``labels``,
+    never materializing the logits; drop-in for
+    ``ops.lm_head_cross_entropy`` (same masking contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    N, E = hidden.shape
+    V = weight.shape[1]
+    labels = labels.reshape(-1)
+    bn = min(block_n, _round_up(N, 8))
+    bv = min(block_v, _round_up(V, 128))
+    Np, Vp = _round_up(N, bn), _round_up(V, bv)
+
+    h = jnp.pad(hidden, ((0, Np - N), (0, 0))) if Np != N else hidden
+    w = jnp.pad(weight, ((0, 0), (0, Vp - V))) if Vp != V else weight
+    b = (jnp.zeros((V,), jnp.float32) if bias is None
+         else bias.astype(jnp.float32))
+    # padded vocab columns get bias -1e30: their softmax is exactly zero
+    # in every kernel, so no column masking is needed inside
+    b2 = jnp.pad(b, (0, Vp - V), constant_values=_NEG).reshape(1, Vp)
+    y2 = jnp.pad(labels, (0, Np - N),
+                 constant_values=ignore_index).reshape(-1, 1)
+
+    nll = _head(h, w, b2, y2, ignore_index, bn, bv, interpret)
+    return nll[:N]
