@@ -264,8 +264,10 @@ def bench_autogpt(on_tpu, kind, peak):
                     max_seq_len=seq,
                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     strategy = ShardingStrategy(mesh=mesh, **kwargs)
+    from hetu_tpu.ops.pallas import flash_attn_fn
     trainer = Trainer(
-        GPT(cfg), AdamOptimizer(3e-4),
+        GPT(cfg, attn_fn=flash_attn_fn() if on_tpu else None),
+        AdamOptimizer(3e-4),
         lambda m, b, k: (m.loss(b["ids"], key=k, training=True), {}),
         strategy=strategy)
     rng = np.random.default_rng(0)

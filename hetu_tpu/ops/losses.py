@@ -120,7 +120,12 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
     mask outside, as with softmax_cross_entropy_sparse).
     """
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "scan"
+        # the kernel has no SPMD partitioning rule, so under a multi-device
+        # sharded context GSPMD would replicate it (all-gathering hidden
+        # and weight — defeating the memory cap); auto picks it only on a
+        # single-device TPU, the validated case
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and jax.device_count() == 1 else "scan")
     if impl == "pallas":
         from hetu_tpu.ops.pallas.lm_head import lm_head_cross_entropy_pallas
         # chunk keeps its memory-cap meaning: the kernel's vocab tile is

@@ -170,28 +170,79 @@ def test_profile_plan_measured_loop():
             per.append((time.perf_counter() - t0) / 4)
         return min(per)  # min-of-chunks: robust to background load
 
-    # 2) unconstrained search -> measured: must not lose to naive DP
+    # 2) unconstrained search: the planner must FIND naive DP (dp=8 is
+    # optimal here) — a deterministic structural assertion, because
+    # measured timing on the single-core CPU mesh jitters up to ~15%
+    # even between runs of the identical program; the measured bound
+    # below only guards against catastrophic regressions
     plan = dp_search(specs, cluster, global_batch=batch)
     naive = Plan(pp=1, n_microbatches=1,
                  choices=[ParallelChoice(dp=8)] * layers,
                  time=0.0, peak_bytes=0.0, feasible=True)
+    d0 = plan.dominant
+    assert (plan.pp, d0.dp, d0.tp) == (1, 8, 1), plan.describe()
     t_planned = measure(plan)
     t_naive = measure(naive)
-    # generous tolerance: single-core CPU-mesh timing jitters under load;
-    # the real assertion is that the planner never picks something
-    # catastrophically worse than the baseline it could fall back to
-    assert t_planned <= t_naive * 1.75, (
+    assert t_planned <= t_naive * 1.5, (
         f"planned {plan.describe()} measured {t_planned*1e3:.1f}ms vs "
         f"naive DP {t_naive*1e3:.1f}ms")
 
     # 3) constrained search: budget too small for naive DP's per-device
-    # memory -> the planner must shard (tp/zero), and the plan must train
+    # memory -> naive DP is INFEASIBLE, the planner must shard, and the
+    # planned config must not lose to any feasible manual baseline
     mem = MemoryCostModel(cluster)
+
+    def plan_of(choice, pp=1, micro=1):
+        return Plan(pp=pp, n_microbatches=micro,
+                    choices=[choice] * layers, time=0.0, peak_bytes=0.0,
+                    feasible=True)
+
+    def peak_bytes(plan_):
+        per = batch // (plan_.dominant.dp or 1)
+        total = sum(mem.layer_bytes(s, plan_.dominant, per) for s in specs)
+        return total / max(plan_.pp, 1)
+
     dp_bytes = sum(mem.layer_bytes(s, ParallelChoice(dp=8), batch // 8)
                    for s in specs)
     tight = dataclasses.replace(cluster, hbm_bytes=dp_bytes * 0.6)
     plan_tight = dp_search(specs, tight, global_batch=batch)
+    assert plan_tight.feasible
     d = plan_tight.dominant
     assert d.tp > 1 or d.zero or plan_tight.pp > 1, plan_tight.describe()
+
+    # naive DP must NOT fit under this budget (that's the point)
+    assert peak_bytes(naive) > tight.hbm_bytes
+
+    # manual baselines a practitioner would try; keep only the feasible
+    manual = {
+        "tp8": plan_of(ParallelChoice(dp=1, tp=8)),
+        "dp4tp2": plan_of(ParallelChoice(dp=4, tp=2)),
+        "dp2tp4": plan_of(ParallelChoice(dp=2, tp=4)),
+        "zero8": plan_of(ParallelChoice(dp=8, zero=True)),
+    }
+    feasible = {n: p for n, p in manual.items()
+                if peak_bytes(p) <= tight.hbm_bytes}
+    assert feasible, "no manual baseline fits — budget too tight for test"
+
+    # deterministic optimality: by the planner's own calibrated cost
+    # model, its plan must not be beaten by any feasible manual baseline
+    tmodel = TimeCostModel(tight)
+
+    def model_time(plan_):
+        per = batch // (plan_.dominant.dp or 1)
+        return sum(tmodel.layer_time(s, plan_.dominant, per) for s in specs)
+
+    for name, p in feasible.items():
+        assert model_time(plan_tight) <= model_time(p) * 1.001, (
+            f"planner's plan {plan_tight.describe()} modeled slower than "
+            f"manual {name}")
+
+    # measured sanity with a jitter-tolerant bound (~15% run-to-run on
+    # the CPU mesh even for identical programs)
     t_tight = measure(plan_tight)
     assert np.isfinite(t_tight)
+    t_manual = {n: measure(p) for n, p in feasible.items()}
+    best_name = min(t_manual, key=t_manual.get)
+    assert t_tight <= t_manual[best_name] * 1.3, (
+        f"planned {plan_tight.describe()} {t_tight*1e3:.1f}ms loses to "
+        f"manual {best_name} {t_manual[best_name]*1e3:.1f}ms")
