@@ -147,10 +147,14 @@ def bench_ctr(on_tpu, kind, peak):
     vocab = 26000 if on_tpu else 2000
     # cache sized to the working set: a 4096-row cache thrashed on the
     # 26k-vocab batches and cost 3.3x (engine pulls on every miss)
+    # host_async_push = the reference PS default (ASP, bsp=-1): the
+    # gradient push's device->host round trip hides under the next step
+    # instead of serializing every step — 2.9 -> 3.9 steps/s on the
+    # tunneled chip (r03 A/B)
     cfg = CTRConfig(vocab=vocab, embed_dim=16, embedding="host",
                     cache_capacity=65536 if on_tpu else 2048,
                     cache_policy="lfuopt", host_optimizer="adagrad",
-                    host_lr=0.05)
+                    host_lr=0.05, host_async_push=bool(on_tpu))
     model = WideDeep(cfg)
     data = synthetic_ctr(n=batch * 8, vocab_per_field=vocab // 26)
     trainer = Trainer(
@@ -265,8 +269,12 @@ def bench_autogpt(on_tpu, kind, peak):
                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     strategy = ShardingStrategy(mesh=mesh, **kwargs)
     from hetu_tpu.ops.pallas import flash_attn_fn
+    # the raw Pallas kernel has no SPMD partitioning rule: only safe when
+    # the searched plan is single-device (sharded plans would need the
+    # shard_map-wrapped ring/ulysses cores)
+    use_flash = on_tpu and mesh_spec.total() == 1
     trainer = Trainer(
-        GPT(cfg, attn_fn=flash_attn_fn() if on_tpu else None),
+        GPT(cfg, attn_fn=flash_attn_fn() if use_flash else None),
         AdamOptimizer(3e-4),
         lambda m, b, k: (m.loss(b["ids"], key=k, training=True), {}),
         strategy=strategy)

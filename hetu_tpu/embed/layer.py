@@ -60,6 +60,11 @@ class _HostEmbeddingBase(Module):
             self.store.flush()
 
     def save(self, path: str):
+        # staged subclasses may have queued async pushes: drain them before
+        # the (lockless) table snapshot or the checkpoint misses/tears rows
+        flush_pushes = getattr(self, "flush_pushes", None)
+        if flush_pushes is not None:
+            flush_pushes()
         self.flush()
         self.table.save(path)
 
@@ -93,11 +98,13 @@ class _HostHandle:
     of the pytree (compared by identity — the object never changes, only its
     contents, which are read exclusively OUTSIDE jit)."""
 
-    __slots__ = ("ids", "prefetcher")
+    __slots__ = ("ids", "prefetcher", "pusher", "push_err", "__weakref__")
 
     def __init__(self):
         self.ids = None
         self.prefetcher = None
+        self.pusher = None    # ThreadPoolExecutor(1): FIFO async pushes
+        self.push_err = None  # first exception from an async push
 
 
 class StagedHostEmbedding(_HostEmbeddingBase):
@@ -117,10 +124,27 @@ class StagedHostEmbedding(_HostEmbeddingBase):
 
     is_staged_host_embedding = True
     _state_fields = ("rows",)  # excluded from optimizer updates
+    # async_push = the reference's ASP mode (PS default, executor.py:203
+    # bsp=-1): gradient pushes apply on a worker thread, off the step's
+    # critical path; rows pulled by the next stage() may be one push
+    # stale.  Class-level default so subclasses with their own __init__
+    # (RemoteHostEmbedding et al.) inherit BSP-strict behavior.
+    async_push = False
 
-    def __init__(self, num_embeddings: int, dim: int, **kw):
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 async_push: bool = False, **kw):
         super().__init__(num_embeddings, dim, **kw)
         self._handle = _HostHandle()
+        if async_push:
+            # the bare (uncached) table's pull is a lockless read in the C
+            # engine; only the cache path serializes reader and writer, so
+            # async pushes against a bare table would race stage() pulls
+            if not isinstance(self.store, CacheTable):
+                raise ValueError(
+                    "async_push needs cache_capacity > 0: the engine cache "
+                    "serializes the worker thread's pushes against stage() "
+                    "pulls; a bare table read would race them")
+            self.async_push = True
         self.rows = jnp.zeros((1, dim), jnp.float32)  # placeholder leaf
 
     def prefetch(self, ids):
@@ -174,15 +198,60 @@ class StagedHostEmbedding(_HostEmbeddingBase):
         """Host-side push of the staged batch's row gradients; the engine's
         server-side optimizer applies them.  Consumes the staged ids: a
         second push (or a step run without a fresh ``stage``) raises instead
-        of silently corrupting the table with stale ids."""
-        ids = self._handle.ids
+        of silently corrupting the table with stale ids.
+
+        With ``async_push`` the device→host materialization and the engine
+        push run on a single worker thread (FIFO, so pushes apply in step
+        order) instead of blocking the training loop — on a
+        high-dispatch-latency link this is the difference between the push
+        round trip serializing every step or hiding under the next one.
+        Call ``flush_pushes()`` before checkpointing or evaluation."""
+        h = self._handle
+        if h.push_err is not None:
+            # surface a worker-side failure BEFORE consuming this step's
+            # staged ids, so the caller can handle it and retry this push
+            err, h.push_err = h.push_err, None
+            raise err
+        ids = h.ids
         if ids is None:
             raise RuntimeError(
                 "push_grads without a fresh stage(): call stage(ids) before "
                 "every training step")
-        self._handle.ids = None
-        self.store.push(ids.ravel(),
-                        np.asarray(grad_rows, np.float32).reshape(-1, self.dim))
+        h.ids = None
+        if not self.async_push:
+            self.store.push(ids.ravel(), np.asarray(
+                grad_rows, np.float32).reshape(-1, self.dim))
+            return
+        if h.pusher is None:
+            from concurrent.futures import ThreadPoolExecutor
+            import weakref
+            h.pusher = ThreadPoolExecutor(1)
+            # finalize on the identity-stable HANDLE: the module itself is
+            # rebuilt on every pytree unflatten and would tear the pool
+            # down after the first step
+            weakref.finalize(h, h.pusher.shutdown, wait=False)
+        try:  # start the device->host copy without blocking this thread
+            grad_rows.copy_to_host_async()
+        except AttributeError:
+            pass
+
+        def apply(ids=ids, g=grad_rows):
+            try:
+                self.store.push(ids.ravel(), np.asarray(
+                    g, np.float32).reshape(-1, self.dim))
+            except Exception as e:  # surfaced on the next push/flush
+                h.push_err = e
+        h.pusher.submit(apply)
+
+    def flush_pushes(self):
+        """Block until every queued async push has applied (checkpoint /
+        eval barrier); re-raises the first worker-side failure."""
+        h = self._handle
+        if h.pusher is not None:
+            h.pusher.submit(lambda: None).result()
+        if h.push_err is not None:
+            err, h.push_err = h.push_err, None
+            raise err
 
 
 class _HBMHandle:

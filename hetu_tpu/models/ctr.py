@@ -39,7 +39,8 @@ class CTRConfig:
                  host_optimizer: str = "sgd", host_lr: float = 0.01,
                  cache_capacity: int = 0, cache_policy: str = "lru",
                  pull_bound: int = 0, push_bound: int = 0,
-                 host_bridge: str = "auto", servers=None):
+                 host_bridge: str = "auto", host_async_push: bool = False,
+                 servers=None):
         self.dense_dim = dense_dim
         self.sparse_fields = sparse_fields
         self.vocab = vocab
@@ -56,6 +57,9 @@ class CTRConfig:
         # outside jit (works on backends without host callbacks, e.g. the
         # tunneled axon TPU); "auto" picks per backend.
         self.host_bridge = host_bridge
+        # ASP-style pushes off the step's critical path (reference PS
+        # default bsp=-1, executor.py:203); staged bridge only
+        self.host_async_push = host_async_push
         self.servers = list(servers) if servers else []  # embedding="remote"
 
 
@@ -90,11 +94,13 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
             from hetu_tpu.embed.bridge import host_callbacks_supported
             bridge = "callback" if host_callbacks_supported() else "staged"
         cls = StagedHostEmbedding if bridge == "staged" else HostEmbedding
-        return cls(
-            cfg.vocab, dim, optimizer=cfg.host_optimizer, lr=cfg.host_lr,
-            seed=seed, cache_capacity=cfg.cache_capacity,
-            policy=cfg.cache_policy, pull_bound=cfg.pull_bound,
-            push_bound=cfg.push_bound)
+        kw = dict(optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed,
+                  cache_capacity=cfg.cache_capacity,
+                  policy=cfg.cache_policy, pull_bound=cfg.pull_bound,
+                  push_bound=cfg.push_bound)
+        if cls is StagedHostEmbedding:
+            kw["async_push"] = cfg.host_async_push
+        return cls(cfg.vocab, dim, **kw)
     return Embedding(cfg.vocab, dim)
 
 

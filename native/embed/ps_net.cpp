@@ -277,6 +277,9 @@ struct Server {
     std::vector<int64_t> keys;
     std::vector<float> floats;
     std::vector<char> bytes;
+    std::vector<float> out;  // response staging; capacity persists across
+                             // requests (a fresh vector per pull cost a
+                             // malloc + page-fault pass per ~MB response)
     // a stray/corrupt client must never take the server down: bound every
     // header field before resizing (16M elements ≈ 128 MB keys / 64 MB
     // floats per frame — far above any real batch, far below anything that
@@ -298,7 +301,7 @@ struct Server {
       if (h.nbytes && !read_full(fd, bytes.data(), h.nbytes)) break;
 
       RespHeader resp{0, 0};
-      std::vector<float> out;
+      out.clear();
       try {
       switch (h.op) {
         case kCreate: {

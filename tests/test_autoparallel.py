@@ -224,13 +224,16 @@ def test_profile_plan_measured_loop():
                 if peak_bytes(p) <= tight.hbm_bytes}
     assert feasible, "no manual baseline fits — budget too tight for test"
 
-    # deterministic optimality: by the planner's own calibrated cost
-    # model, its plan must not be beaten by any feasible manual baseline
+    # deterministic optimality: under the planner's OWN evaluator
+    # (pipeline bubbles and p2p included), its plan must not be beaten by
+    # any feasible manual baseline
+    from hetu_tpu.parallel.autoparallel.search import _evaluate
     tmodel = TimeCostModel(tight)
 
     def model_time(plan_):
-        per = batch // (plan_.dominant.dp or 1)
-        return sum(tmodel.layer_time(s, plan_.dominant, per) for s in specs)
+        t, _ = _evaluate(specs, plan_.choices, plan_.pp,
+                         plan_.n_microbatches, batch, tight, mem, tmodel)
+        return t
 
     for name, p in feasible.items():
         assert model_time(plan_tight) <= model_time(p) * 1.001, (
