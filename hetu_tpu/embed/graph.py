@@ -64,7 +64,7 @@ class RemoteGraph:
     """
 
     def __init__(self, address: str, graph_id: int, edge_index=None, *,
-                 num_nodes: int | None = None):
+                 num_nodes: int | None = None, seed: int | None = None):
         self._lib = _bind(_lib())
         host, _, port = address.partition(":")
         self._c = self._lib.het_ps_connect(host.encode(), int(port))
@@ -72,7 +72,7 @@ class RemoteGraph:
             raise ConnectionError(f"cannot reach graph server {address}")
         self.graph_id = int(graph_id)
         if edge_index is not None:
-            self._upload(edge_index, num_nodes)
+            self._upload(edge_index, num_nodes, seed)
 
     def close(self):
         if getattr(self, "_c", None):
@@ -85,7 +85,7 @@ class RemoteGraph:
         except Exception:
             pass
 
-    def _upload(self, edge_index, num_nodes):
+    def _upload(self, edge_index, num_nodes, seed=None):
         src, dst = (np.asarray(a, np.int64) for a in edge_index)
         n = int(num_nodes if num_nodes is not None
                 else (max(int(src.max()), int(dst.max())) + 1 if src.size
@@ -108,10 +108,12 @@ class RemoteGraph:
                 if st != 0:
                     raise RuntimeError(f"graph upload failed (status {st})")
         # commit: the server validates the assembled CSR and only then
-        # serves samples — a half-uploaded graph is never sampleable
-        one = np.zeros(1, np.int64)
+        # serves samples — a half-uploaded graph is never sampleable.
+        # A nonzero ``seed`` rides the commit frame for reproducible
+        # sampling (the server otherwise seeds from system entropy).
+        sv = np.asarray([0 if seed is None else int(seed)], np.int64)
         st = self._lib.het_ps_graph_load(self._c, self.graph_id, 2, 1, 0,
-                                         _i64p(one), 0)
+                                         _i64p(sv), 1 if seed else 0)
         if st != 0:
             raise RuntimeError(f"graph commit rejected (status {st})")
 

@@ -310,7 +310,9 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
     """
 
     is_staged_host_embedding = True
-    _state_fields = ("cache", "rows", "slots")  # no optimizer updates
+    is_hbm_cached_embedding = True
+    _state_fields = ("cache", "rows", "slots", "refresh_slots",
+                     "refresh_rows")  # no optimizer updates
 
     def __init__(self, num_embeddings: int, dim: int, *,
                  hbm_capacity: int = 4096, hbm_pull_bound: int = 0, **kw):
@@ -333,6 +335,27 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
         # whole module pytree and jax.grad rejects integer leaves; float32
         # is exact for slot ids < 2^24 and gets a zero cotangent
         self.slots = jnp.zeros((1,), jnp.float32)  # placeholder leaf
+        # pending refresh, applied INSIDE the jitted step: stage() only
+        # sets these leaves (their upload rides the step's own dispatch);
+        # Trainer.apply_refresh folds them into the cache so the scatter
+        # is not a separate device dispatch (which measured slower than
+        # the plain staged path on a high-latency link, ROADMAP #5)
+        self.refresh_slots = jnp.full((1,), self.capacity, jnp.float32)
+        self.refresh_rows = jnp.zeros((1, dim), jnp.float32)
+
+    def _merged_cache(self):
+        # mode="drop": the (1,) no-op placeholder indexes == capacity
+        return self.cache.at[self.refresh_slots.astype(jnp.int32)].set(
+            self.refresh_rows, mode="drop")
+
+    def apply_refresh(self):
+        """Fold the pending refresh into the cache leaf and reset the
+        pending leaves to their no-op shape; called by the Trainer inside
+        the jitted step so the merged cache persists into the next state."""
+        return self.replace(
+            cache=self._merged_cache(),
+            refresh_slots=jnp.full((1,), self.capacity, jnp.float32),
+            refresh_rows=jnp.zeros((1, self.dim), jnp.float32))
 
     def prefetch(self, ids):
         """Async host pull of the next batch's unique rows (overlap with
@@ -348,6 +371,14 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
 
     def stage(self, ids):
         h = self._handle
+        if self.refresh_slots.shape != (1,):
+            # the previous refresh was never folded in (standalone/eval use
+            # without the Trainer's in-step apply): fold it now before the
+            # leaves are overwritten — in the Trainer loop apply_refresh
+            # already reset the leaves and this never dispatches
+            self.cache = self._merged_cache()
+            self.refresh_slots = jnp.full((1,), self.capacity, jnp.float32)
+            self.refresh_rows = jnp.zeros((1, self.dim), jnp.float32)
         ids = np.asarray(ids, np.int64)
         uniq = np.unique(ids.ravel())
         if uniq.size > self.capacity:
@@ -405,8 +436,8 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
             else:
                 fresh = np.asarray(sync_fn(self.store)(need))
             fresh = fresh.reshape(need.size, self.dim).astype(np.float32)
-            # pad the refresh to a power-of-two bucket so the device
-            # scatter compiles once per bucket instead of once per distinct
+            # pad the refresh to a power-of-two bucket so the step
+            # compiles once per bucket instead of once per distinct
             # refresh size (a per-step recompile would dwarf the transfer
             # saving the cache exists for); padded slots index out of
             # range and mode="drop" discards them
@@ -417,10 +448,14 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
                     [need_slots, np.full(pad, self.capacity, np.int64)])
                 fresh = np.concatenate(
                     [fresh, np.zeros((pad, self.dim), np.float32)])
-            self.cache = self.cache.at[jnp.asarray(need_slots)].set(
-                jnp.asarray(fresh), mode="drop")
-        elif h.prefetcher is not None:
-            h.prefetcher.get(uniq)  # retire the pending pull
+            # leaves only — the scatter itself runs inside the jitted step
+            self.refresh_slots = jnp.asarray(need_slots, jnp.float32)
+            self.refresh_rows = jnp.asarray(fresh)
+        else:
+            if h.prefetcher is not None:
+                h.prefetcher.get(uniq)  # retire the pending pull
+            self.refresh_slots = jnp.full((1,), self.capacity, jnp.float32)
+            self.refresh_rows = jnp.zeros((1, self.dim), jnp.float32)
         slot_lut = h.slot_of[uniq]
         h.last_used[slot_lut] = h.tick
         batch_slots = slot_lut[np.searchsorted(uniq, ids.ravel())]
@@ -439,8 +474,11 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
                 f"batch's ids before the jitted step")
         import jax
 
+        # gather from the cache WITH the pending refresh merged in (a
+        # no-op scatter once the Trainer has applied it); values are
+        # stop_gradient'd — the cotangent rides the zeros ``rows`` leaf
         gathered = jax.lax.stop_gradient(
-            self.cache[self.slots.astype(jnp.int32)])
+            self._merged_cache()[self.slots.astype(jnp.int32)])
         return (gathered + self.rows).astype(self.dtype)
 
     def is_fresh(self) -> bool:

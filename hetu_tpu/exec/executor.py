@@ -26,6 +26,17 @@ from hetu_tpu.optim.optimizers import Optimizer
 __all__ = ["TrainState", "Trainer", "Executor"]
 
 
+def _apply_refreshes(model):
+    """Fold HBM-cached embeddings' pending refresh leaves into their cache
+    (embed.HBMCachedEmbedding.apply_refresh) — inside jit, so the scatter
+    rides the step's dispatch and the merged cache persists in the new
+    state."""
+    is_hbm = lambda x: getattr(x, "is_hbm_cached_embedding", False)  # noqa
+    return jax.tree_util.tree_map(
+        lambda m: m.apply_refresh() if is_hbm(m) else m, model,
+        is_leaf=is_hbm)
+
+
 def _find_staged(tree) -> list:
     """Collect StagedHostEmbedding modules (duck-typed via the
     ``is_staged_host_embedding`` class marker, avoiding an import of
@@ -83,10 +94,12 @@ class Trainer:
                 new_model = aux.pop("model", None)
                 return loss, (aux, new_model)
 
+            model0 = (_apply_refreshes(state.model) if self._has_staged
+                      else state.model)
             (loss, (aux, new_model)), grads = jax.value_and_grad(
                 wrapped, has_aux=True
-            )(state.model)
-            base = new_model if new_model is not None else state.model
+            )(model0)
+            base = new_model if new_model is not None else model0
             params, opt_state = optimizer.update(
                 grads, state.opt_state, base, mask=param_mask
             )

@@ -100,6 +100,14 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
                   push_bound=cfg.push_bound)
         if cls is StagedHostEmbedding:
             kw["async_push"] = cfg.host_async_push
+        elif cfg.host_async_push:
+            # the callback bridge pushes inside the jitted step; silently
+            # ignoring the ASP request would change staleness semantics
+            # per backend
+            raise ValueError(
+                "host_async_push requires the staged bridge "
+                '(host_bridge="staged"); the callback bridge resolved here '
+                "pushes inside the step")
         return cls(cfg.vocab, dim, **kw)
     return Embedding(cfg.vocab, dim)
 

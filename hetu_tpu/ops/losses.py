@@ -119,6 +119,10 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
     Returns per-row nll with ``ignore_index`` rows zeroed (mean-reduce and
     mask outside, as with softmax_cross_entropy_sparse).
     """
+    # out-of-range labels (>= V) clamp to the last class — the same
+    # effective semantics as softmax_cross_entropy_sparse's take_along_axis
+    # gather — instead of silently producing lse+1e30-scale garbage
+    labels = jnp.minimum(labels, weight.shape[1] - 1)
     if impl == "auto":
         # the kernel has no SPMD partitioning rule, so under a multi-device
         # sharded context GSPMD would replicate it (all-gathering hidden

@@ -205,7 +205,10 @@ def dropout(x, rate: float, key, *, training: bool = True):
     if not training or rate == 0.0:
         return x
     keep = 1.0 - rate
-    mask = _hash_bits(key, x.shape) < jnp.uint32(keep * 4294967296.0)
+    # clamp: keep*2^32 can round to exactly 2^32 in double for rates below
+    # ~1e-16, and the uint32 cast would wrap to 0 (dropping EVERYTHING)
+    thresh = jnp.uint32(min(keep * 4294967296.0, 4294967295.0))
+    mask = _hash_bits(key, x.shape) < thresh
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 

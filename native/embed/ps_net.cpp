@@ -215,10 +215,18 @@ struct GraphStore {
   int64_t n_nodes = 0, n_edges = 0;
   std::vector<int64_t> indptr;   // n_nodes + 1
   std::vector<int64_t> indices;  // n_edges (in-neighbors)
+
+  // accounted (reserved) bytes per array, maintained under the SERVER
+  // mutex at reservation/drop time — reading vector sizes here would race
+  // other connections' assigns, which run under gmu only
+  int64_t acct_indptr = 0, acct_indices = 0;
   bool ready = false;            // set by the commit op after validation
   std::mutex gmu;                // per-graph: sampling must not block
                                  // barrier/ssp/preduce on the server mutex
-  std::mt19937_64 rng{0x9e3779b97f4a7c15ull};
+  // seeded from the system entropy source so repeated experiment runs get
+  // independent sample streams; the commit frame may carry an explicit
+  // seed for reproducible sampling
+  std::mt19937_64 rng{std::random_device{}()};
 
   // the server must never trust client-supplied CSR: monotone indptr
   // bounded by indices.size() is what keeps sample/edge scans in bounds
@@ -247,6 +255,12 @@ struct Server {
   // shared_ptr: a drop must not free a store while another
   // connection's in-flight sample/edges request still uses it
   std::map<uint32_t, std::shared_ptr<GraphStore>> graphs;
+  int64_t graph_bytes = 0;          // accounted CSR bytes across graphs
+  int64_t graph_budget_bytes = [] {
+    const char* v = std::getenv("HETU_PS_GRAPH_BUDGET_MB");
+    int64_t mb = v ? std::atoll(v) : 4096;
+    return (mb > 0 ? mb : 4096) * (int64_t(1) << 20);
+  }();
   std::atomic<bool> record{false};            // per-row touch recording
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
@@ -561,7 +575,11 @@ struct Server {
             std::lock_guard<std::mutex> lk(mu);
             // in-flight requests on other connections hold their own
             // shared_ptr; erasing here only drops the map reference
-            resp.status = graphs.erase(h.table_id) ? 0 : -2;
+            auto it = graphs.find(h.table_id);
+            if (it == graphs.end()) { resp.status = -2; break; }
+            graph_bytes -= it->second->acct_indptr
+                           + it->second->acct_indices;
+            graphs.erase(it);
             break;
           }
           std::shared_ptr<GraphStore> gp;
@@ -577,9 +595,28 @@ struct Server {
                                   std::make_shared<GraphStore>()).first;
             }
             gp = it->second;
+            // server-wide byte budget (HETU_PS_GRAPH_BUDGET_MB, default
+            // 4096): a client's total_len allocates real memory on the
+            // first chunk, so the budget is RESERVED here — atomically
+            // with the check, under the same mutex every other
+            // connection's reservation takes, and net of this graph's
+            // own resident bytes so re-uploads of a resident graph are
+            // judged by their delta, not double-counted
+            if (kind != 2 && off == 0) {
+              int64_t& acct = kind == 0 ? gp->acct_indptr
+                                        : gp->acct_indices;
+              if (graph_bytes - acct + total * 8 > graph_budget_bytes) {
+                resp.status = -7;  // over budget: drop a graph first
+                break;
+              }
+              graph_bytes += total * 8 - acct;
+              acct = total * 8;
+            }
           }
           std::lock_guard<std::mutex> gl(gp->gmu);
           if (kind == 2) {
+            if (m >= 1 && keys[3] != 0)  // explicit seed: reproducible runs
+              gp->rng.seed(static_cast<uint64_t>(keys[3]));
             gp->ready = gp->validate();
             resp.status = gp->ready ? 0 : -6;
             break;

@@ -271,3 +271,53 @@ def test_remote_graph_drop_frees_server_side():
             rg.drop()
         rg2 = RemoteGraph(f"127.0.0.1:{srv.port}", 21, ei, num_nodes=16)
         assert rg2.sample([0], fanout=2).shape == (1, 2)
+
+
+def test_remote_graph_byte_budget_eviction():
+    """Server-wide graph byte budget (HETU_PS_GRAPH_BUDGET_MB): a load
+    that would exceed it is refused with -7 BEFORE allocating; dropping a
+    resident graph frees budget so the load then succeeds, while another
+    resident graph stays servable throughout."""
+    import os
+
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+
+    small = random_graph(n=64, e=500, seed=2)        # ~4.5 KB
+    big_a = random_graph(n=1000, e=50_000, seed=3)   # ~0.4 MB
+    big_b = random_graph(n=1000, e=100_000, seed=4)  # ~0.8 MB
+    os.environ["HETU_PS_GRAPH_BUDGET_MB"] = "1"
+    try:
+        with EmbeddingServer() as srv:
+            addr = f"127.0.0.1:{srv.port}"
+            keep = RemoteGraph(addr, 1, small, num_nodes=64)
+            ga = RemoteGraph(addr, 2, big_a, num_nodes=1000)
+            with pytest.raises(RuntimeError, match="status -7"):
+                RemoteGraph(addr, 3, big_b, num_nodes=1000)
+            # the survivor keeps serving while the budget is full
+            assert keep.sample([0], fanout=2).shape == (1, 2)
+            ga.drop()  # frees ~0.4 MB of budget
+            gb = RemoteGraph(addr, 3, big_b, num_nodes=1000)
+            assert gb.sample([5], fanout=4).shape == (1, 4)
+            assert keep.sample([1], fanout=2).shape == (1, 2)
+    finally:
+        del os.environ["HETU_PS_GRAPH_BUDGET_MB"]
+
+
+def test_remote_graph_reproducible_seed():
+    """An explicit seed on the commit frame makes sample streams
+    reproducible; without one, two server lifetimes draw independently."""
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+
+    ei = random_graph(n=64, e=2000, seed=5)
+    seeds = list(range(32))
+
+    def draws(seed):
+        with EmbeddingServer() as srv:
+            rg = RemoteGraph(f"127.0.0.1:{srv.port}", 7, ei, num_nodes=64,
+                             seed=seed)
+            return rg.sample(seeds, fanout=8)
+
+    a, b = draws(1234), draws(1234)
+    np.testing.assert_array_equal(a, b)  # same seed -> same stream
