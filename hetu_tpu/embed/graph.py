@@ -113,7 +113,8 @@ class RemoteGraph:
         # sampling (the server otherwise seeds from system entropy).
         sv = np.asarray([0 if seed is None else int(seed)], np.int64)
         st = self._lib.het_ps_graph_load(self._c, self.graph_id, 2, 1, 0,
-                                         _i64p(sv), 1 if seed else 0)
+                                         _i64p(sv),
+                                         0 if seed is None else 1)
         if st != 0:
             raise RuntimeError(f"graph commit rejected (status {st})")
 

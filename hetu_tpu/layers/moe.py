@@ -61,12 +61,19 @@ def _slot_positions(mask, capacity: int, fill=None):
     return slot, in_cap, new_fill
 
 
-def _assign_slots(mask, capacity: int, fill=None):
-    """One-hot [T,E,C] dispatch over _slot_positions (the einsum path)."""
-    slot, in_cap, new_fill = _slot_positions(mask, capacity, fill)
-    slot_oh = _one_hot(slot, capacity) * jnp.sum(in_cap, -1, keepdims=True)
-    dispatch = in_cap[:, :, None] * slot_oh[:, None, :]
-    return dispatch, in_cap, new_fill
+def _densify(plans, T: int, E: int, C: int):
+    """Dense [T,E,C] (dispatch, combine) from an index plan — the einsum
+    path and the test oracle; every gate's __call__ goes through here so
+    index_plan is the single source of routing truth."""
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    for e_idx, slot, keep, g in plans:
+        oh = (_one_hot(e_idx, E)[:, :, None]
+              * _one_hot(slot, C)[:, None, :]
+              * keep.astype(jnp.float32)[:, None, None])
+        dispatch = dispatch + oh
+        combine = combine + g[:, None, None] * oh
+    return dispatch, combine
 
 
 class TopKGate(Module):
@@ -99,15 +106,7 @@ class TopKGate(Module):
         source of routing truth (index_plan); this densification exists for
         gates/consumers on the einsum path and as the test oracle."""
         plans, C, aux = self.index_plan(x, training=training)
-        T, E = x.shape[0], self.num_experts
-        dispatch = jnp.zeros((T, E, C), jnp.float32)
-        combine = jnp.zeros((T, E, C), jnp.float32)
-        for e_idx, slot, keep, g in plans:
-            oh = (_one_hot(e_idx, E)[:, :, None]
-                  * _one_hot(slot, C)[:, None, :]
-                  * keep.astype(jnp.float32)[:, None, None])
-            dispatch = dispatch + oh
-            combine = combine + g[:, None, None] * oh
+        dispatch, combine = _densify(plans, x.shape[0], self.num_experts, C)
         return dispatch, combine, aux
 
     def index_plan(self, x, *, training: bool = True):
@@ -160,13 +159,20 @@ class HashGate(Module):
         return max(1, math.ceil(n_tokens / self.num_experts * self.capacity_factor))
 
     def __call__(self, x, indices=None, *, training: bool = True):
+        plans, C, aux = self.index_plan(x, indices, training=training)
+        dispatch, combine = _densify(plans, x.shape[0], self.num_experts, C)
+        return dispatch, combine, aux
+
+    def index_plan(self, x, indices=None, *, training: bool = True):
         T, E = x.shape[0], self.num_experts
         C = self.capacity(T, training)
         if indices is None:
             indices = jnp.arange(T, dtype=jnp.int32) % E
         mask = _one_hot(indices, E)
-        dispatch, _, _ = _assign_slots(mask, C)
-        return dispatch, dispatch, jnp.float32(0.0)
+        slot, in_cap, _ = _slot_positions(mask, C)
+        keep = jnp.sum(in_cap, axis=-1) > 0.0
+        gate = jnp.ones((T,), jnp.float32)  # hash combine weight is 1
+        return [(indices, slot, keep, gate)], C, jnp.float32(0.0)
 
 
 class KTop1Gate(Module):
@@ -202,6 +208,11 @@ class KTop1Gate(Module):
         return max(1, self.k * math.ceil(n_tokens / self.num_experts * cf))
 
     def __call__(self, x, *, training: bool = True):
+        plans, C, aux = self.index_plan(x, training=training)
+        dispatch, combine = _densify(plans, x.shape[0], self.num_experts, C)
+        return dispatch, combine, aux
+
+    def index_plan(self, x, *, training: bool = True):
         T, E, k = x.shape[0], self.num_experts, self.k
         Ep = E // k                                   # experts per prototype
         C = self.capacity(T, training)
@@ -216,20 +227,19 @@ class KTop1Gate(Module):
         # per-prototype balance loss vs its own softmax (Ep experts)
         me = jnp.mean(pgates, axis=0)                 # [k, Ep]
         ce = jnp.mean(pmask, axis=0)                  # [k, Ep]
-        aux = jnp.sum(me * ce, axis=-1) * Ep          # [k]
-        aux = jnp.sum(aux)
+        aux = jnp.sum(jnp.sum(me * ce, axis=-1) * Ep)
 
         # slot assignment per prototype (expert columns are disjoint, so
-        # fills never interact; _assign_slots expects one choice per row)
-        dispatch = jnp.zeros((T, E, C), jnp.float32)
-        combine = jnp.zeros((T, E, C), jnp.float32)
+        # fills never interact; one choice per row each)
+        plans = []
         for i in range(k):
             mask_i = jnp.zeros((T, k, Ep), jnp.float32).at[:, i].set(
                 pmask[:, i]).reshape(T, E)
-            disp_i, _, _ = _assign_slots(mask_i, C)
-            dispatch = dispatch + disp_i
-            combine = combine + gate_val[:, i, None, None] * disp_i
-        return dispatch, combine, aux
+            slot, in_cap, _ = _slot_positions(mask_i, C)
+            keep = jnp.sum(in_cap, axis=-1) > 0.0
+            e_idx = i * Ep + idx[:, i]
+            plans.append((e_idx, slot, keep, gate_val[:, i]))
+        return plans, C, aux
 
 
 class SAMGate(Module):
@@ -272,6 +282,11 @@ class SAMGate(Module):
         return max(1, self.k * math.ceil(n_tokens / self.num_experts * cf))
 
     def __call__(self, x, *, training: bool = True):
+        plans, C, aux = self.index_plan(x, training=training)
+        dispatch, combine = _densify(plans, x.shape[0], self.num_experts, C)
+        return dispatch, combine, aux
+
+    def index_plan(self, x, *, training: bool = True):
         T, E, G = x.shape[0], self.num_experts, self.num_groups
         Eg = E // G                                    # experts per group
         C = self.capacity(T, training)
@@ -284,8 +299,7 @@ class SAMGate(Module):
         in_group = in_group.reshape(T, E)              # [T,E] group member
         masked_gates = jnp.where(in_group > 0, gates, -jnp.inf)
 
-        dispatch = jnp.zeros((T, E, C), jnp.float32)
-        combine = jnp.zeros((T, E, C), jnp.float32)
+        plans = []
         aux = 0.0
         remaining = masked_gates
         fill = None                                    # shared acc_base fill
@@ -294,11 +308,11 @@ class SAMGate(Module):
             idx = jnp.argmax(remaining, axis=-1)
             mask = _one_hot(idx, E)
             remaining = jnp.where(mask > 0, -jnp.inf, remaining)
-            disp_i, in_cap, fill = _assign_slots(mask, C, fill)
+            slot, in_cap, fill = _slot_positions(mask, C, fill)
+            keep = jnp.sum(in_cap, axis=-1) > 0.0
             gate_i = jnp.sum(gates * mask, axis=-1)
             last_gate = gate_i
-            dispatch = dispatch + disp_i
-            combine = combine + gate_i[:, None, None] * disp_i
+            plans.append((idx, slot, keep, gate_i))
             me = jnp.mean(gates, axis=0)
             ce = jnp.mean(mask, axis=0)
             aux = aux + jnp.sum(me * ce) * E
@@ -308,7 +322,7 @@ class SAMGate(Module):
         # across batch/sequence sizes
         overflow = jnp.maximum(gates - last_gate[:, None], 0.0)
         alignment = jnp.sum(overflow * (1.0 - in_group)) / T
-        return dispatch, combine, aux + self.alignment_weight * alignment
+        return plans, C, aux + self.alignment_weight * alignment
 
 
 class BalanceGate(Module):
@@ -349,6 +363,11 @@ class BalanceGate(Module):
         return max(1, math.ceil(n_tokens / self.num_experts))
 
     def __call__(self, x, *, training: bool = True):
+        plans, C, aux = self.index_plan(x, training=training)
+        dispatch, combine = _densify(plans, x.shape[0], self.num_experts, C)
+        return dispatch, combine, aux
+
+    def index_plan(self, x, *, training: bool = True):
         T, E = x.shape[0], self.num_experts
         C = self.capacity(T, training)
         scores = (x @ self.centroids.astype(x.dtype).T).astype(jnp.float32)
@@ -364,10 +383,10 @@ class BalanceGate(Module):
         plan = logp + f + g                            # balanced log-plan
         idx = jnp.argmax(plan, axis=-1)                # [T]
         mask = _one_hot(idx, E)
-        dispatch, in_cap, _ = _assign_slots(mask, C)
+        slot, in_cap, _ = _slot_positions(mask, C)
+        keep = jnp.sum(in_cap, axis=-1) > 0.0
         weight = jax.nn.sigmoid(jnp.sum(scores * mask, axis=-1))  # BASE
-        combine = weight[:, None, None] * dispatch
-        return dispatch, combine, jnp.float32(0.0)
+        return [(idx, slot, keep, weight)], C, jnp.float32(0.0)
 
 
 class ExpertMLP(Module):

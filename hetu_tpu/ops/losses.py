@@ -121,8 +121,11 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
     """
     # out-of-range labels (>= V) clamp to the last class — the same
     # effective semantics as softmax_cross_entropy_sparse's take_along_axis
-    # gather — instead of silently producing lse+1e30-scale garbage
-    labels = jnp.minimum(labels, weight.shape[1] - 1)
+    # gather — instead of silently producing lse+1e30-scale garbage.
+    # ignore_index rows are exempt: a sentinel >= V (pad id == vocab_size)
+    # must still be recognized by the ignore mask downstream
+    labels = jnp.where(labels == ignore_index, labels,
+                       jnp.minimum(labels, weight.shape[1] - 1))
     if impl == "auto":
         # the kernel has no SPMD partitioning rule, so under a multi-device
         # sharded context GSPMD would replicate it (all-gathering hidden

@@ -585,6 +585,7 @@ struct Server {
           std::shared_ptr<GraphStore> gp;
           {
             std::lock_guard<std::mutex> lk(mu);
+            bool created = false;
             auto it = graphs.find(h.table_id);
             if (it == graphs.end()) {
               // only a fresh upload may (re)create the store: a commit or
@@ -593,6 +594,7 @@ struct Server {
               if (kind != 0 || off != 0) { resp.status = -2; break; }
               it = graphs.emplace(h.table_id,
                                   std::make_shared<GraphStore>()).first;
+              created = true;
             }
             gp = it->second;
             // server-wide byte budget (HETU_PS_GRAPH_BUDGET_MB, default
@@ -607,6 +609,8 @@ struct Server {
                                         : gp->acct_indices;
               if (graph_bytes - acct + total * 8 > graph_budget_bytes) {
                 resp.status = -7;  // over budget: drop a graph first
+                if (created) graphs.erase(it);  // no dead empty entry: the
+                // rejected client never got a handle to drop it with
                 break;
               }
               graph_bytes += total * 8 - acct;
@@ -615,7 +619,7 @@ struct Server {
           }
           std::lock_guard<std::mutex> gl(gp->gmu);
           if (kind == 2) {
-            if (m >= 1 && keys[3] != 0)  // explicit seed: reproducible runs
+            if (m >= 1)  // explicit seed (any value incl. 0): reproducible
               gp->rng.seed(static_cast<uint64_t>(keys[3]));
             gp->ready = gp->validate();
             resp.status = gp->ready ? 0 : -6;

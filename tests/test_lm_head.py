@@ -77,3 +77,17 @@ def test_lm_head_all_masked_rows():
     g = jax.grad(lambda w: jnp.sum(lm_head_cross_entropy_pallas(
         h, w, y, bias=b, interpret=True, block_n=32, block_v=128)))(w)
     np.testing.assert_allclose(g, jnp.zeros_like(g), atol=1e-7)
+
+
+def test_lm_head_ignore_index_at_or_beyond_vocab():
+    """A sentinel ignore_index >= V (e.g. pad id == vocab_size) must still
+    zero its rows — the out-of-range clamp exempts ignore rows."""
+    h, w, b, _ = _case(16, 8, 16, mask_frac=0.0)
+    y = jnp.asarray([1, 2, 16, 3] * 4, jnp.int32)  # 16 == V: the sentinel
+    out = lm_head_cross_entropy(h, w, y, bias=b, ignore_index=16,
+                                impl="scan")
+    assert float(out[2]) == 0.0 and float(out[6]) == 0.0
+    outp = lm_head_cross_entropy_pallas(h, w, y, bias=b, ignore_index=16,
+                                        interpret=True, block_n=16,
+                                        block_v=128)
+    np.testing.assert_allclose(outp, out, rtol=2e-5, atol=2e-5)

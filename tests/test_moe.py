@@ -310,7 +310,9 @@ def test_index_dispatch_matches_einsum_dispatch():
             return self._g(t, training=training)
 
     rng = np.random.default_rng(0)
-    for k in (1, 2):
+    # k=2 exercises everything k=1 does (multi-rank fill, renorm) — the
+    # k=1 case was a second full compile for no extra coverage
+    for k in (2,):
         set_random_seed(0)
         gate = TopKGate(16, 4, k=k, capacity_factor=0.6)  # forces drops
         experts = ExpertMLP(4, 16, 32)
@@ -327,3 +329,35 @@ def test_index_dispatch_matches_einsum_dispatch():
         g2 = jax.grad(lambda v: moe_oh(v, training=True)[0].sum())(x)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("make_gate", [
+    lambda d, E: TopKGate(d, E, 2, capacity_factor=2.0),
+    lambda d, E: HashGate(d, E, capacity_factor=2.0),
+    lambda d, E: KTop1Gate(d, E, 2, capacity_factor=4.0),
+    lambda d, E: SAMGate(d, E, 2, num_groups=4, capacity_factor=8.0),
+    lambda d, E: BalanceGate(d, E),
+])
+def test_index_plan_matches_einsum_dispatch(make_gate):
+    """Every gate's index (scatter/gather) routing must equal the one-hot
+    einsum path exactly — same experts, same slots, same combine weights."""
+    from hetu_tpu.layers.moe import ExpertMLP, MoELayer
+
+    set_random_seed(3)
+    T, d, E = 32, 16, 8
+    gate = make_gate(d, E)
+    experts = ExpertMLP(E, d, 32)
+    layer = MoELayer(gate, experts)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(T, d)), jnp.float32)
+
+    y_idx, aux_idx = layer(x, training=True)  # index path (gate has index_plan)
+
+    # einsum oracle from the densified dispatch/combine
+    dispatch, combine, aux_oh = gate(x, training=True)
+    ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    ex_out = experts(ex_in)
+    y_oh = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ex_out)
+
+    np.testing.assert_allclose(np.asarray(y_idx), np.asarray(y_oh),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux_idx), float(aux_oh), rtol=1e-6)

@@ -67,14 +67,14 @@ def test_ring_attention_grads_match_dense(sp_mesh, causal, impl):
 
 def test_ring_flash_multi_block_chunks(sp_mesh):
     """Flash-ring with chunks that split into multiple kernel blocks:
-    explicit 64-wide blocks over s_local=256 chunks force nq=nk=4 inside
+    explicit 32-wide blocks over s_local=128 chunks force nq=nk=4 inside
     every block pair (dq-partial reduction + causal dead-slot zeroing)."""
-    q, k, v = _qkv(b=2, s=1024, h=2, d=8, seed=3)  # b divisible by dp=2
+    q, k, v = _qkv(b=2, s=512, h=2, d=8, seed=3)  # b divisible by dp=2
 
     def loss(fn):
         return lambda q, k, v: (fn(q, k, v, causal=True) ** 2).mean()
 
-    attn = ring_attn_fn(sp_mesh, impl="flash", block_q=64, block_k=64)
+    attn = ring_attn_fn(sp_mesh, impl="flash", block_q=32, block_k=32)
     out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -85,9 +85,9 @@ def test_ring_flash_multi_block_chunks(sp_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
 
-    # block_k=16 -> nk=16 > _MAX_DQ_PARTIALS: the block bwd's two-kernel
+    # block_k=8 -> nk=16 > _MAX_DQ_PARTIALS: the block bwd's two-kernel
     # long-sequence fallback
-    attn_fb = ring_attn_fn(sp_mesh, impl="flash", block_q=64, block_k=16)
+    attn_fb = ring_attn_fn(sp_mesh, impl="flash", block_q=32, block_k=8)
     g_fb = jax.jit(jax.grad(loss(attn_fb), argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_fb, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),

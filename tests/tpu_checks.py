@@ -214,6 +214,19 @@ def check_ctr():
     assert losses[-1] < losses[0]
 
 
+def check_hbm():
+    """HBM hot-row cache vs plain staged embedding in its regime (zipf
+    skew, dim 64): with the refresh folded into the jitted step the HBM
+    path must win (examples/bench_hbm_cache.py has the full sweep)."""
+    import examples.bench_hbm_cache as ab
+
+    t_staged = ab.run("host", 64, "zipf", steps=10)
+    t_hbm = ab.run("hbm", 64, "zipf", steps=10)
+    print(f"  staged {t_staged*1e3:.1f} ms  hbm {t_hbm*1e3:.1f} ms  "
+          f"speedup {t_staged/t_hbm:.2f}x")
+    assert t_hbm <= t_staged * 1.05, (t_hbm, t_staged)
+
+
 def check_step_time():
     """BERT-large step-time sanity (per-step sync; tunnel-safe timing)."""
     import jax
@@ -254,7 +267,7 @@ def check_step_time():
 
 CHECKS = {"flash": check_flash, "flash_time": check_flash_time,
           "ring": check_ring, "lm_head": check_lm_head,
-          "bridge": check_bridge, "ctr": check_ctr,
+          "bridge": check_bridge, "ctr": check_ctr, "hbm": check_hbm,
           "step": check_step_time}
 
 
