@@ -109,8 +109,9 @@ class RemoteGraph:
                     raise RuntimeError(f"graph upload failed (status {st})")
         # commit: the server validates the assembled CSR and only then
         # serves samples — a half-uploaded graph is never sampleable.
-        # A nonzero ``seed`` rides the commit frame for reproducible
-        # sampling (the server otherwise seeds from system entropy).
+        # Any explicit ``seed`` (including 0) rides the commit frame for
+        # reproducible sampling; seed=None keeps the server's
+        # system-entropy seeding.
         sv = np.asarray([0 if seed is None else int(seed)], np.int64)
         st = self._lib.het_ps_graph_load(self._c, self.graph_id, 2, 1, 0,
                                          _i64p(sv),

@@ -33,6 +33,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent compilation cache: the fast tier is compile-bound (hundreds of
+# small jits on one core), and repeat runs — the common case in CI and
+# development — hit the cache instead of re-lowering.  Keyed by HLO, so
+# code changes invalidate exactly the programs they touch.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

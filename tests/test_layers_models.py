@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import hetu_tpu as ht
 from hetu_tpu.core import set_random_seed
@@ -148,7 +149,7 @@ def test_bert_downstream_heads():
                                  BertForSequenceClassification, bert_base)
 
     set_random_seed(0)
-    cfg = bert_base(num_layers=2, hidden_size=32, num_heads=2, vocab_size=100,
+    cfg = bert_base(num_layers=1, hidden_size=32, num_heads=2, vocab_size=100,
                     max_position_embeddings=16)
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, 100, (2, 8)), jnp.int32)
@@ -185,6 +186,7 @@ def test_transformer_block_custom_plain_mlp():
     assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
 
 
+@pytest.mark.slow
 def test_gpt_streamed_head_matches_materialized():
     """streamed_head_chunk: loss and gradients (incl. the tied-embedding
     weight reached through the head transpose) equal the materialized
@@ -214,6 +216,7 @@ def test_gpt_streamed_head_matches_materialized():
                                rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_streamed_mlm_head_matches_materialized():
     """BertConfig.streamed_head_chunk: loss and gradients (tied embedding
     reached through the decoder transpose, plus the decoder bias) equal

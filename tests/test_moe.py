@@ -332,7 +332,8 @@ def test_index_dispatch_matches_einsum_dispatch():
 
 
 @pytest.mark.parametrize("make_gate", [
-    lambda d, E: TopKGate(d, E, 2, capacity_factor=2.0),
+    # TopKGate's parity is covered (with grads and capacity drops) by
+    # test_index_dispatch_matches_einsum_dispatch — no second compile here
     lambda d, E: HashGate(d, E, capacity_factor=2.0),
     lambda d, E: KTop1Gate(d, E, 2, capacity_factor=4.0),
     lambda d, E: SAMGate(d, E, 2, num_groups=4, capacity_factor=8.0),

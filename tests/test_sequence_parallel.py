@@ -85,8 +85,17 @@ def test_ring_flash_multi_block_chunks(sp_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
 
-    # block_k=8 -> nk=16 > _MAX_DQ_PARTIALS: the block bwd's two-kernel
-    # long-sequence fallback
+
+@pytest.mark.slow
+def test_ring_flash_long_seq_fallback(sp_mesh):
+    """block_k=8 -> nk=16 > _MAX_DQ_PARTIALS inside each block pair: the
+    block bwd's two-kernel long-sequence fallback under the ring."""
+    q, k, v = _qkv(b=2, s=512, h=2, d=8, seed=3)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v, causal=True) ** 2).mean()
+
+    g_ref = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
     attn_fb = ring_attn_fn(sp_mesh, impl="flash", block_q=32, block_k=8)
     g_fb = jax.jit(jax.grad(loss(attn_fb), argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_fb, g_ref):

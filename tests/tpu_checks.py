@@ -224,7 +224,9 @@ def check_hbm():
     t_hbm = ab.run("hbm", 64, "zipf", steps=10)
     print(f"  staged {t_staged*1e3:.1f} ms  hbm {t_hbm*1e3:.1f} ms  "
           f"speedup {t_staged/t_hbm:.2f}x")
-    assert t_hbm <= t_staged * 1.05, (t_hbm, t_staged)
+    # measured 1.33-1.70x wins at this config (r03); a ratio below 1.0
+    # means the in-step refresh fold regressed
+    assert t_hbm <= t_staged, (t_hbm, t_staged)
 
 
 def check_step_time():
