@@ -49,6 +49,33 @@ def synthetic_glue(n, seq, vocab, num_labels, seed=0):
     }
 
 
+def load_glue(args, split="train", tok=None):
+    """Real GLUE TSVs when present (data.datasets.glue_tsv) tokenized with
+    the WordPiece tokenizer — the reference's test_glue_bert_base.sh path.
+    Returns (data, tokenizer) or None (-> synthetic fallback).  Pass the
+    TRAIN split's tokenizer when loading dev: ids must come from one
+    vocab or eval is noise."""
+    from hetu_tpu.data.datasets import glue_tsv
+    from hetu_tpu.data.tokenizer import BertTokenizer, build_vocab
+
+    out = glue_tsv(args.data_dir, args.task, split)
+    if out is None:
+        return None
+    sents, labels = out
+    if tok is None:
+        tok = BertTokenizer(build_vocab(sents, max_size=args.vocab),
+                            max_len=args.seq)
+    enc = tok.batch_encode(sents, max_len=args.seq, pad_to=args.seq)
+    n = (len(sents) // args.batch) * args.batch
+    if n == 0:
+        return None
+    print(f"loaded {n} real {args.task}/{split} examples from "
+          f"{args.data_dir}")
+    return {"input_ids": enc["input_ids"][:n].astype(np.int32),
+            "token_type": enc["token_type_ids"][:n].astype(np.int32),
+            "label": labels[:n].astype(np.int32)}, tok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=4)
@@ -63,6 +90,10 @@ def main():
     ap.add_argument("--init-from", default=None,
                     help="checkpoint dir from a pretraining run; encoder "
                          "weights are loaded, the classifier head stays fresh")
+    ap.add_argument("--data-dir", default="datasets/glue",
+                    help="GLUE TSV root (task/train.tsv); synthetic batches "
+                         "when absent (zero-egress image)")
+    ap.add_argument("--task", default="sst2")
     args = ap.parse_args()
 
     ht.set_random_seed(0)
@@ -87,10 +118,14 @@ def main():
                                b["label"], key=k, training=True),
     )
 
-    data = synthetic_glue(args.batch * 16, args.seq, args.vocab, args.labels)
+    loaded = load_glue(args)
+    data, tok = loaded if loaded else (
+        synthetic_glue(args.batch * 16, args.seq, args.vocab, args.labels),
+        None)
+    n_train = len(data["label"])
     t0 = time.time()
     for step in range(args.steps):
-        lo = (step * args.batch) % (args.batch * 16)
+        lo = (step * args.batch) % max(n_train - args.batch + 1, 1)
         b = {k: jnp.asarray(v[lo:lo + args.batch]) for k, v in data.items()}
         m = trainer.step(b)
         if step % 10 == 0 or step == args.steps - 1:
@@ -98,11 +133,14 @@ def main():
                   f"acc {float(m['accuracy']):.3f}")
     dt = time.time() - t0
 
-    # held-out eval
-    ev = synthetic_glue(args.batch * 4, args.seq, args.vocab, args.labels,
-                        seed=1)
+    # held-out eval — with real data the DEV split must reuse the train
+    # tokenizer (ids from one vocab) and the loop runs the real length
+    ev_loaded = load_glue(args, split="dev", tok=tok) if tok else None
+    ev = (ev_loaded[0] if ev_loaded
+          else synthetic_glue(args.batch * 4, args.seq, args.vocab,
+                              args.labels, seed=1))
     accs = []
-    for lo in range(0, args.batch * 4, args.batch):
+    for lo in range(0, len(ev["label"]) - args.batch + 1, args.batch):
         b = {k: jnp.asarray(v[lo:lo + args.batch]) for k, v in ev.items()}
         accs.append(float(trainer.evaluate(b)["accuracy"]))
     print(f"eval accuracy {np.mean(accs):.3f}  ({args.steps} steps, {dt:.1f}s)")

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hetu_tpu.core import set_random_seed
-from hetu_tpu.data.datasets import synthetic_ctr
+from hetu_tpu.data.datasets import criteo
 from hetu_tpu.exec import Trainer
 from hetu_tpu.exec.metrics import auc_roc
 from hetu_tpu.models import DCN, CTRConfig, DeepCrossing, DeepFM, WideDeep
@@ -60,7 +60,13 @@ def main():
                     cache_capacity=args.cache, cache_policy=args.policy,
                     host_optimizer="adagrad", host_lr=0.05, servers=servers)
     model = MODELS[args.model](cfg)
-    data = synthetic_ctr(n=args.batch * 32)
+    # real Criteo TSV when datasets/criteo/train.txt exists; synthetic
+    # otherwise.  Small real files are tiled so the batch-rotation modulo
+    # below stays positive.
+    data = criteo(n_synth=args.batch * 32, max_rows=args.batch * 32)
+    if len(data["label"]) <= args.batch:
+        reps = args.batch * 2 // max(len(data["label"]), 1) + 1
+        data = {k: np.concatenate([v] * reps) for k, v in data.items()}
     trainer = Trainer(
         model, AdamOptimizer(1e-3),
         lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))

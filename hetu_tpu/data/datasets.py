@@ -13,7 +13,7 @@ import pickle
 
 import numpy as np
 
-__all__ = ["mnist", "cifar10", "synthetic_ctr", "synthetic_lm"]
+__all__ = ["mnist", "cifar10", "criteo", "glue_tsv", "synthetic_ctr", "synthetic_lm"]
 
 
 def _synth_images(n, shape, classes, seed):
@@ -87,3 +87,67 @@ def synthetic_lm(n: int = 2048, seq_len: int = 128, vocab: int = 30522,
     ids = rng.integers(4, vocab, size=(n, seq_len)).astype(np.int32)
     ids[:, ::4] = ids[:, 1::4] % vocab  # correlations to learn
     return ids
+
+
+def criteo(root: str = "datasets/criteo", n_synth: int = 100000,
+           vocab_per_field: int = 1000, max_rows: int | None = None):
+    """Criteo click-log TSV (reference examples/ctr load_data.py layout:
+    label \t 13 integer features \t 26 hex categorical features).
+
+    Reads ``train.txt`` when present: integer features are
+    log1p-normalized with missing->0, categoricals are hashed into
+    ``vocab_per_field`` buckets offset per field (the reference's
+    per-field id spaces).  Falls back to :func:`synthetic_ctr` with the
+    same schema when no file exists (zero-egress images).
+    """
+    path = os.path.join(root, "train.txt")
+    if not os.path.exists(path):
+        return synthetic_ctr(n=n_synth, vocab_per_field=vocab_per_field)
+    dense_rows, sparse_rows, labels = [], [], []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if max_rows is not None and i >= max_rows:
+                break
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) != 40:
+                continue  # malformed line: skip, never crash the loader
+            labels.append(float(parts[0]))
+            dense_rows.append([
+                np.log1p(max(float(v), 0.0)) if v else 0.0
+                for v in parts[1:14]])
+            sparse_rows.append([
+                (int(v, 16) if v else 0) % vocab_per_field
+                for v in parts[14:40]])
+    if not labels:  # empty/wholly-malformed file: honest fallback
+        return synthetic_ctr(n=n_synth, vocab_per_field=vocab_per_field)
+    dense = np.asarray(dense_rows, np.float32)
+    sparse = (np.asarray(sparse_rows, np.int32)
+              + np.arange(26, dtype=np.int32) * vocab_per_field)
+    return {"dense": dense, "sparse": sparse,
+            "label": np.asarray(labels, np.float32)}
+
+
+def glue_tsv(root: str, task: str = "sst2", split: str = "train",
+             max_rows: int | None = None):
+    """GLUE-style TSV (sentence \t label, with a header row — the layout
+    of the reference's GLUE runs, examples/nlp/bert/scripts/
+    test_glue_bert_base.sh).  Returns (sentences, labels) or None when the
+    file is absent (callers fall back to synthetic batches)."""
+    path = os.path.join(root, task, f"{split}.tsv")
+    if not os.path.exists(path):
+        return None
+    sents, labels = [], []
+    with open(path) as f:
+        if next(f, None) is None:  # zero-byte file: treat as absent
+            return None
+        for i, line in enumerate(f):
+            if max_rows is not None and i >= max_rows:
+                break
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 2:
+                continue
+            sents.append(parts[0])
+            labels.append(int(parts[-1]))
+    if not sents:
+        return None
+    return sents, np.asarray(labels, np.int32)

@@ -222,10 +222,14 @@ class BertTokenizer:
 
     def batch_encode(self, texts: Sequence[str],
                      pairs: Optional[Sequence[str]] = None,
-                     max_len: int = 128) -> Dict[str, np.ndarray]:
+                     max_len: int = 128,
+                     pad_to: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """``pad_to`` forces a fixed rectangle width (jit feeds need
+        static shapes across batches); default pads to the longest
+        sequence observed."""
         pairs = pairs or [None] * len(texts)
         enc = [self.encode(t, p, max_len) for t, p in zip(texts, pairs)]
-        width = min(max(len(ids) for ids, _ in enc), max_len)
+        width = pad_to or min(max(len(ids) for ids, _ in enc), max_len)
         n = len(enc)
         input_ids = np.full((n, width), self.pad_id, np.int32)
         token_type = np.zeros((n, width), np.int32)
