@@ -61,11 +61,12 @@ def load_glue(args, split="train", tok=None):
     out = glue_tsv(args.data_dir, args.task, split)
     if out is None:
         return None
-    sents, labels = out
+    sents, pairs, labels = out
     if tok is None:
-        tok = BertTokenizer(build_vocab(sents, max_size=args.vocab),
+        corpus = sents if pairs is None else sents + [p for p in pairs if p]
+        tok = BertTokenizer(build_vocab(corpus, max_size=args.vocab),
                             max_len=args.seq)
-    enc = tok.batch_encode(sents, max_len=args.seq, pad_to=args.seq)
+    enc = tok.batch_encode(sents, pairs, max_len=args.seq, pad_to=args.seq)
     n = (len(sents) // args.batch) * args.batch
     if n == 0:
         return None
@@ -136,6 +137,10 @@ def main():
     # held-out eval — with real data the DEV split must reuse the train
     # tokenizer (ids from one vocab) and the loop runs the real length
     ev_loaded = load_glue(args, split="dev", tok=tok) if tok else None
+    if tok and not ev_loaded:
+        print("WARNING: trained on real data but no usable dev.tsv "
+              f"(>= {args.batch} rows needed) — eval below is on SYNTHETIC "
+              "data and says nothing about the real task")
     ev = (ev_loaded[0] if ev_loaded
           else synthetic_glue(args.batch * 4, args.seq, args.vocab,
                               args.labels, seed=1))

@@ -111,13 +111,17 @@ def criteo(root: str = "datasets/criteo", n_synth: int = 100000,
             parts = line.rstrip("\n").split("\t")
             if len(parts) != 40:
                 continue  # malformed line: skip, never crash the loader
-            labels.append(float(parts[0]))
-            dense_rows.append([
-                np.log1p(max(float(v), 0.0)) if v else 0.0
-                for v in parts[1:14]])
-            sparse_rows.append([
-                (int(v, 16) if v else 0) % vocab_per_field
-                for v in parts[14:40]])
+            try:
+                lab = float(parts[0])
+                dense = [np.log1p(max(float(v), 0.0)) if v else 0.0
+                         for v in parts[1:14]]
+                sparse = [(int(v, 16) if v else 0) % vocab_per_field
+                          for v in parts[14:40]]
+            except ValueError:
+                continue  # non-numeric field: same skip contract
+            labels.append(lab)
+            dense_rows.append(dense)
+            sparse_rows.append(sparse)
     if not labels:  # empty/wholly-malformed file: honest fallback
         return synthetic_ctr(n=n_synth, vocab_per_field=vocab_per_field)
     dense = np.asarray(dense_rows, np.float32)
@@ -129,14 +133,18 @@ def criteo(root: str = "datasets/criteo", n_synth: int = 100000,
 
 def glue_tsv(root: str, task: str = "sst2", split: str = "train",
              max_rows: int | None = None):
-    """GLUE-style TSV (sentence \t label, with a header row — the layout
-    of the reference's GLUE runs, examples/nlp/bert/scripts/
-    test_glue_bert_base.sh).  Returns (sentences, labels) or None when the
-    file is absent (callers fall back to synthetic batches)."""
+    """GLUE-style TSV with a header row (the layout of the reference's
+    GLUE runs, examples/nlp/bert/scripts/test_glue_bert_base.sh):
+    ``sentence \t label`` for single-sentence tasks, ``sentence_a \t
+    sentence_b \t label`` for pair tasks (MNLI/QQP/...).  String labels
+    (e.g. "entailment") map to ids by sorted-unique order.
+
+    Returns ``(sentences, pairs_or_None, labels int32)`` or None when the
+    file is absent/empty (callers fall back to synthetic batches)."""
     path = os.path.join(root, task, f"{split}.tsv")
     if not os.path.exists(path):
         return None
-    sents, labels = [], []
+    sents, pairs, raw_labels = [], [], []
     with open(path) as f:
         if next(f, None) is None:  # zero-byte file: treat as absent
             return None
@@ -147,7 +155,15 @@ def glue_tsv(root: str, task: str = "sst2", split: str = "train",
             if len(parts) < 2:
                 continue
             sents.append(parts[0])
-            labels.append(int(parts[-1]))
+            pairs.append(parts[1] if len(parts) >= 3 else None)
+            raw_labels.append(parts[-1])
     if not sents:
         return None
-    return sents, np.asarray(labels, np.int32)
+    try:
+        labels = np.asarray([int(v) for v in raw_labels], np.int32)
+    except ValueError:  # string labels: sorted-unique -> ids
+        vocab = {v: i for i, v in enumerate(sorted(set(raw_labels)))}
+        labels = np.asarray([vocab[v] for v in raw_labels], np.int32)
+    if all(p is None for p in pairs):
+        pairs = None
+    return sents, pairs, labels

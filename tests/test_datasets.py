@@ -88,7 +88,29 @@ def test_glue_tsv_branch(tmp_path):
         "terrible in every way\t0\n")
     out = glue_tsv(str(root), "sst2", "train")
     assert out is not None
-    sents, labels = out
+    sents, pairs, labels = out
     assert sents == ["a fine movie", "terrible in every way"]
+    assert pairs is None
     np.testing.assert_array_equal(labels, [1, 0])
     assert glue_tsv(str(root), "mnli", "train") is None  # absent task
+
+    # pair task with string labels (MNLI layout)
+    (root / "mnli").mkdir()
+    (root / "mnli" / "train.tsv").write_text(
+        "sentence1\tsentence2\tlabel\n"
+        "a man eats\ta person eats\tentailment\n"
+        "a man eats\tnobody eats\tcontradiction\n")
+    sents, pairs, labels = glue_tsv(str(root), "mnli", "train")
+    assert pairs == ["a person eats", "nobody eats"]
+    np.testing.assert_array_equal(labels, [1, 0])  # sorted-unique ids
+
+
+def test_criteo_skips_corrupt_numeric_fields(tmp_path):
+    root = tmp_path / "criteo"
+    root.mkdir()
+    good = "1\t" + "\t".join(str(i) for i in range(13)) + "\t" \
+        + "\t".join(f"{i:x}" for i in range(26))
+    bad = good.replace("\t3\t", "\toops\t", 1)
+    (root / "train.txt").write_text(good + "\n" + bad + "\n")
+    d = criteo(root=str(root), vocab_per_field=50)
+    assert d["label"].shape == (1,)  # corrupt line skipped, not fatal

@@ -186,3 +186,52 @@ class TestRemoteBranchExecution:
         for pid in (0, 1):
             got = (tmp_path / f"marker with space.txt{pid}").read_text()
             assert got == "definitely-not-local:7321|2"
+
+
+@pytest.mark.slow
+def test_two_process_dp_training_smoke():
+    """Full DP training across two REAL processes (the multi-host path
+    minus the ssh hop, which the fake-ssh test covers): each process owns
+    2 of the 4 global devices, feeds its own dp shard, and after each
+    step the psum-synchronized gradients leave both processes with
+    identical losses and parameters."""
+    import textwrap
+
+    script = textwrap.dedent("""
+        import hetu_tpu.launch as L
+        L.initialize()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.exec import Trainer
+        from hetu_tpu.models import GPT, GPTConfig
+        from hetu_tpu.optim import AdamOptimizer
+        from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+        from hetu_tpu.parallel.strategies import DataParallel
+
+        set_random_seed(0)
+        pid = jax.process_index()
+        mesh = make_mesh(MeshSpec(dp=4), devices=jax.devices())
+        cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16)
+        trainer = Trainer(GPT(cfg), AdamOptimizer(1e-3),
+                          lambda m, b, k: (m.loss(b["ids"], training=False),
+                                           {}),
+                          strategy=DataParallel(mesh=mesh))
+        rng = np.random.default_rng(0)  # same data on both: loss must agree
+        ids = rng.integers(0, 256, (8, 16))
+        b = {"ids": jnp.asarray(ids, jnp.int32)}
+        losses = [float(trainer.step(b)["loss"]) for _ in range(3)]
+        print(f"RESULT pid={pid} losses="
+              + ",".join(f"{x:.6f}" for x in losses))
+    """)
+    outs = simulate_workers(2, script, cpu_devices_per_proc=2, timeout=300.0)
+    results = sorted(line for out in outs for line in out.splitlines()
+                     if line.startswith("RESULT"))
+    assert len(results) == 2, results
+    l0 = results[0].split("losses=")[1]
+    l1 = results[1].split("losses=")[1]
+    assert l0 == l1, (l0, l1)  # same global computation on both processes
+    first, last = (float(x) for x in (l0.split(",")[0], l0.split(",")[-1]))
+    assert last < first  # and it actually trains
