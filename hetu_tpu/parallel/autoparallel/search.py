@@ -108,7 +108,7 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
               global_batch: int, *, mem_model: MemoryCostModel | None = None,
               time_model: TimeCostModel | None = None,
               microbatch_options: Sequence[int] = (1, 2, 4, 8),
-              uniform: bool = False) -> Plan:
+              uniform: bool = False, max_pp: int | None = None) -> Plan:
     """Search pp_deg x per-layer choices; returns the fastest feasible plan.
 
     With ``uniform=False`` a dynamic program picks each layer's choice
@@ -122,7 +122,11 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     time_model = time_model or TimeCostModel(cluster)
     best: Optional[Plan] = None
     pp = 1
-    while pp <= cluster.n_devices and pp <= len(layers):
+    # max_pp caps the pipeline search space (e.g. a runtime without a
+    # pipelined model must plan within tp/zero/dp)
+    pp_cap = min(cluster.n_devices, len(layers),
+                 max_pp if max_pp is not None else cluster.n_devices)
+    while pp <= pp_cap:
         per_stage = cluster.n_devices // pp
         if per_stage * pp != cluster.n_devices:
             pp *= 2
