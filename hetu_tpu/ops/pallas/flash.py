@@ -575,14 +575,16 @@ def _round_up(x, m):
 def _auto_blocks(Sq_p: int, Sk_p: int, D: int) -> tuple[int, int]:
     """Block sizes swept on a v5e (fwd+bwd, best-of-chunks):
 
-    D=64 (H=16, B=24/12/6):          D=128 (H=8, B=12/6/3):
-    =====  ===========  =====  ====  ===========  =====  ====
-    seq    best blocks  flash  xla   best blocks  flash  xla
-    =====  ===========  =====  ====  ===========  =====  ====
-    512    512 x 512    10.3   15.6  128 x 512     9.8   13.3
-    1024   512 x 512    16.2   22.4  512 x 512     9.0   12.7
-    2048   512 x 1024   18.3   27.4  512 x 512    13.0   15.5
-    =====  ===========  =====  ====  ===========  =====  ====
+    D=64 (H=16, B=24/12/6):          D=128 (H=8, B=12/6; fused bwd,
+    =====  ===========  =====  ====  causal, fwd+bwd ms, r03):
+    seq    best blocks  flash  xla   ==========================
+    =====  ===========  =====  ====  seq    best blocks   ms
+    512    512 x 512    10.3   15.6  512    256 x 512    0.37
+    1024   512 x 512    16.2   22.4  1024   512 x 512    0.60
+    2048   512 x 1024   18.3   27.4  ==========================
+    =====  ===========  =====  ====
+    (bq=128 at D=128 S<=512 — the r02 best — is 1.8x slower than
+    bq=256 with the fused single-pass backward.)
 
     128x128 blocks (the old default) LOSE to XLA at every length — the
     per-block mask/exp/control overhead swamps the small matmuls.  Large
@@ -590,9 +592,15 @@ def _auto_blocks(Sq_p: int, Sk_p: int, D: int) -> tuple[int, int]:
     VMEM budget: the piecewise length rule is additionally capped at
     ~64K elements / D, rounded down to the 128-lane tile (512 at D=128,
     256 at D=256).  q blocks cap at 512 to bound the fp32 accumulators;
-    at D>=128 short sequences measured best with bq=128 (table above).
+    at D>=128 short sequences measured best with bq=256 with the fused
+    backward (r03 table above; the r02 two-kernel best was 128).
     """
-    bq = 128 if D >= 128 and Sq_p <= 512 else min(512, Sq_p)
+    # align bq to the sequence so an already-128-aligned Sq (e.g. 384)
+    # is not re-padded up to a 256 boundary for nothing
+    cap = 256 if D >= 128 and Sq_p <= 512 else 512
+    bq = min(cap, Sq_p)
+    if Sq_p % bq:
+        bq = 128  # falls back to the universal tile; zero padding
     by_len = Sk_p if Sk_p <= 512 else (512 if Sk_p <= 1024 else 1024)
     vmem_cap = max(128, (65536 // max(D, 1)) // 128 * 128)
     return bq, min(by_len, vmem_cap)

@@ -117,7 +117,7 @@ def test_auto_blocks_match_sweep_table():
     assert _auto_blocks(512, 512, 64) == (512, 512)
     assert _auto_blocks(1024, 1024, 64) == (512, 512)
     assert _auto_blocks(2048, 2048, 64) == (512, 1024)
-    assert _auto_blocks(512, 512, 128) == (128, 512)
+    assert _auto_blocks(512, 512, 128) == (256, 512)
     assert _auto_blocks(1024, 1024, 128) == (512, 512)
     assert _auto_blocks(2048, 2048, 128) == (512, 512)
     for D in (32, 64, 96, 128, 256):
