@@ -58,16 +58,26 @@ def check_flash():
 
 def check_flash_time():
     """Kernel wall time at the bench shapes (differenced-scan timing,
-    examples/profile_flash.py) — the bwd/fwd ratio must stay <= 3."""
+    examples/profile_flash.py).  Gates are ABSOLUTE forward+backward and
+    backward-alone times against the r03 v5e record (+25% tunnel-variance
+    headroom).  A bwd/fwd RATIO gate would be flaky now: the single-block
+    specialization made the forward 2x faster, so the ratio's denominator
+    is small and fluctuates as much as the gate's own headroom.  The
+    record is machine-specific, so the gates only enforce on the chip
+    kind they were measured on (elsewhere: print-only)."""
     import functools
     import jax
     import jax.numpy as jnp
     from examples.profile_flash import chain_timer
     from hetu_tpu.ops.pallas.flash import flash_attention
 
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    gate = kind in ("TPU v5 lite", "TPU v5e")  # where the record was set
     rng = np.random.default_rng(0)
-    for (B, S, H, D, causal) in [(24, 512, 16, 64, False),
-                                 (32, 512, 16, 64, True)]:
+    # (shape..., causal, r03 record: fwd ms, fwd+bwd ms)
+    for (B, S, H, D, causal, rec_fwd, rec_tot) in [
+            (24, 512, 16, 64, False, 0.48, 1.67),
+            (32, 512, 16, 64, True, 0.54, 2.25)]:
         q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)) * 0.5,
                                jnp.bfloat16) for _ in range(3))
         f = functools.partial(flash_attention, causal=causal)
@@ -76,11 +86,15 @@ def check_flash_time():
             argnums=(0, 1, 2))  # all grads live (argnums=(0,) lets XLA DCE dK/dV)
         fwd = chain_timer(f, (q, k, v))
         tot = chain_timer(lambda q, k, v: sum(grad(q, k, v)), (q, k, v))
-        ratio = (tot - fwd) / fwd
         print(f"  flash B{B} S{S} H{H} D{D} causal={causal}: "
               f"fwd {fwd*1e3:.3f} ms  fwd+bwd {tot*1e3:.3f} ms  "
-              f"bwd/fwd ratio {ratio:.2f}")
-        assert ratio <= 3.0, f"backward too slow: ratio {ratio:.2f}"
+              f"bwd {(tot-fwd)*1e3:.3f} ms")
+        if gate:
+            assert tot <= rec_tot * 1.25e-3, (
+                f"fwd+bwd regressed: {tot*1e3:.2f} ms vs record {rec_tot}")
+            assert tot - fwd <= (rec_tot - rec_fwd) * 1.25e-3, (
+                f"backward regressed: {(tot-fwd)*1e3:.2f} ms vs record "
+                f"{rec_tot - rec_fwd:.2f}")
 
 
 def check_ring():
@@ -224,8 +238,8 @@ def check_hbm():
     t_hbm = ab.run("hbm", 64, "zipf", steps=10)
     print(f"  staged {t_staged*1e3:.1f} ms  hbm {t_hbm*1e3:.1f} ms  "
           f"speedup {t_staged/t_hbm:.2f}x")
-    # measured 1.33-1.70x wins at this config (r03); a ratio below 1.0
-    # means the in-step refresh fold regressed
+    # measured 1.15-1.70x wins at this config across r03 runs (tunnel
+    # load varies); a ratio below 1.0 means the in-step fold regressed
     assert t_hbm <= t_staged, (t_hbm, t_staged)
 
 
