@@ -49,16 +49,16 @@ def synthetic_glue(n, seq, vocab, num_labels, seed=0):
     }
 
 
-def load_glue(args, split="train", tok=None):
+def load_glue(args, split="train", tok=None, label_map=None):
     """Real GLUE TSVs when present (data.datasets.glue_tsv) tokenized with
     the WordPiece tokenizer — the reference's test_glue_bert_base.sh path.
     Returns (data, tokenizer) or None (-> synthetic fallback).  Pass the
-    TRAIN split's tokenizer when loading dev: ids must come from one
-    vocab or eval is noise."""
+    TRAIN split's tokenizer AND label_map when loading dev: token ids
+    and label ids must both come from the train split or eval is noise."""
     from hetu_tpu.data.datasets import glue_tsv
     from hetu_tpu.data.tokenizer import BertTokenizer, build_vocab
 
-    out = glue_tsv(args.data_dir, args.task, split)
+    out = glue_tsv(args.data_dir, args.task, split, label_map=label_map)
     if out is None:
         return None
     sents, pairs, labels = out
@@ -119,7 +119,8 @@ def main():
                                b["label"], key=k, training=True),
     )
 
-    loaded = load_glue(args)
+    label_map = {}  # shared train->dev label-id pinning (string labels)
+    loaded = load_glue(args, label_map=label_map)
     data, tok = loaded if loaded else (
         synthetic_glue(args.batch * 16, args.seq, args.vocab, args.labels),
         None)
@@ -136,7 +137,8 @@ def main():
 
     # held-out eval — with real data the DEV split must reuse the train
     # tokenizer (ids from one vocab) and the loop runs the real length
-    ev_loaded = load_glue(args, split="dev", tok=tok) if tok else None
+    ev_loaded = (load_glue(args, split="dev", tok=tok, label_map=label_map)
+                 if tok else None)
     if tok and not ev_loaded:
         print("WARNING: trained on real data but no usable dev.tsv "
               f"(>= {args.batch} rows needed) — eval below is on SYNTHETIC "

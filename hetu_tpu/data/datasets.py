@@ -132,12 +132,14 @@ def criteo(root: str = "datasets/criteo", n_synth: int = 100000,
 
 
 def glue_tsv(root: str, task: str = "sst2", split: str = "train",
-             max_rows: int | None = None):
+             max_rows: int | None = None,
+             label_map: dict | None = None):
     """GLUE-style TSV with a header row (the layout of the reference's
     GLUE runs, examples/nlp/bert/scripts/test_glue_bert_base.sh):
     ``sentence \t label`` for single-sentence tasks, ``sentence_a \t
     sentence_b \t label`` for pair tasks (MNLI/QQP/...).  String labels
-    (e.g. "entailment") map to ids by sorted-unique order.
+    (e.g. "entailment") map to ids by sorted-unique order; pass one
+    shared ``label_map`` dict across splits to pin train ids for dev.
 
     Returns ``(sentences, pairs_or_None, labels int32)`` or None when the
     file is absent/empty (callers fall back to synthetic batches)."""
@@ -159,11 +161,29 @@ def glue_tsv(root: str, task: str = "sst2", split: str = "train",
             raw_labels.append(parts[-1])
     if not sents:
         return None
+    # ``label_map`` (a shared mutable dict) pins ids across splits: the
+    # train split fills it, dev reuses it, so a dev split missing a train
+    # class (or carrying an extra one) cannot shift ids relative to the
+    # trained classifier head.  Unseen labels append AFTER the existing
+    # ids, never renumbering them.  The all-integer fast path ALSO feeds
+    # the map (identity, '1' -> 1): otherwise a numeric train split would
+    # leave the map empty and one corrupt label in dev would renumber the
+    # whole dev split by sorted-unique — the exact bug the map prevents.
     try:
-        labels = np.asarray([int(v) for v in raw_labels], np.int32)
-    except ValueError:  # string labels: sorted-unique -> ids
-        vocab = {v: i for i, v in enumerate(sorted(set(raw_labels)))}
-        labels = np.asarray([vocab[v] for v in raw_labels], np.int32)
+        int_labels = [int(v) for v in raw_labels]
+    except ValueError:
+        int_labels = None
+    if int_labels is not None and not label_map:
+        labels = np.asarray(int_labels, np.int32)
+        if label_map is not None:
+            for v, i in zip(raw_labels, int_labels):
+                label_map.setdefault(v, i)
+    else:  # string labels, or a prior split already pinned ids
+        if label_map is None:
+            label_map = {}
+        for v in sorted(set(raw_labels)):
+            label_map.setdefault(v, len(label_map))
+        labels = np.asarray([label_map[v] for v in raw_labels], np.int32)
     if all(p is None for p in pairs):
         pairs = None
     return sents, pairs, labels

@@ -442,6 +442,13 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
             # saving the cache exists for); padded slots index out of
             # range and mode="drop" discards them
             bucket = max(8, 1 << (need.size - 1).bit_length())
+            # COUPLING: stage() detects a pending refresh by
+            # refresh_slots.shape != (1,), which is only unambiguous
+            # because the bucket floor keeps every real refresh >= 8
+            # rows.  A floor of 1 would make a one-row refresh
+            # indistinguishable from the no-op placeholder and silently
+            # dropped.
+            assert bucket > 1, "bucket floor must exceed the (1,) no-op"
             pad = bucket - need.size
             if pad:
                 need_slots = np.concatenate(

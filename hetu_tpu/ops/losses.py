@@ -119,13 +119,15 @@ def lm_head_cross_entropy(hidden, weight, labels, *, bias=None,
     Returns per-row nll with ``ignore_index`` rows zeroed (mean-reduce and
     mask outside, as with softmax_cross_entropy_sparse).
     """
-    # out-of-range labels (>= V) clamp to the last class — the same
-    # effective semantics as softmax_cross_entropy_sparse's take_along_axis
-    # gather — instead of silently producing lse+1e30-scale garbage.
-    # ignore_index rows are exempt: a sentinel >= V (pad id == vocab_size)
-    # must still be recognized by the ignore mask downstream
+    # out-of-range labels clamp into [0, V-1] — the same effective
+    # semantics as softmax_cross_entropy_sparse's take_along_axis gather
+    # (>= V -> last class, negative -> class 0) — instead of silently
+    # producing lse+1e30-scale garbage (high side) or lse-with-no-column
+    # (a negative label matches no iota column in the kernel).
+    # ignore_index rows are exempt: the sentinel (pad id == vocab_size,
+    # or -1) must still be recognized by the ignore mask downstream
     labels = jnp.where(labels == ignore_index, labels,
-                       jnp.minimum(labels, weight.shape[1] - 1))
+                       jnp.clip(labels, 0, weight.shape[1] - 1))
     if impl == "auto":
         # the kernel has no SPMD partitioning rule, so under a multi-device
         # sharded context GSPMD would replicate it (all-gathering hidden

@@ -276,11 +276,14 @@ def lm_head_cross_entropy_pallas(hidden, weight, labels, *, bias=None,
         interpret = jax.default_backend() != "tpu"
     N, E = hidden.shape
     V = weight.shape[1]
-    # clamp out-of-range labels like softmax_cross_entropy_sparse's gather;
-    # ignore_index rows keep their sentinel so the ignore mask still fires
+    # clamp out-of-range labels into [0, V-1] like
+    # softmax_cross_entropy_sparse's gather (negatives too: a negative
+    # non-ignore label would match no iota column and nll would silently
+    # become lse); ignore_index rows keep their sentinel so the ignore
+    # mask still fires
     labels = labels.reshape(-1)
     labels = jnp.where(labels == ignore_index, labels,
-                       jnp.minimum(labels, V - 1))
+                       jnp.clip(labels, 0, V - 1))
     bn = min(block_n, _round_up(N, 8))
     bv = min(block_v, _round_up(V, 128))
     Np, Vp = _round_up(N, bn), _round_up(V, bv)

@@ -298,8 +298,12 @@ def _sp_sharded(fn_inner, mesh: Mesh, axis: str, check_vma: bool = True):
     # partition the region automatically, which Mosaic kernels refuse
     # ("Mosaic kernels cannot be automatically partitioned") even for
     # size-1 axes.  Batch rides the dp axis when the mesh has one; heads
-    # stay unsharded here (SP x TP head sharding is not composed yet —
-    # a mismatch fails loudly in shard_map's spec check).
+    # stay unsharded here (SP x TP head sharding is not composed yet).
+    # NOTE: shard_map does NOT error on a spec mismatch — it RESHARDS
+    # inputs to match in_specs, so tp-head-sharded activations fed here
+    # would be silently all-gathered across tp (a quiet perf cliff).
+    # Composing SP x TP therefore needs explicit head entries in `spec`,
+    # not reliance on a check.
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch_axis, axis)
 

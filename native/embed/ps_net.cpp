@@ -627,7 +627,15 @@ struct Server {
           }
           gp->ready = false;
           std::vector<int64_t>& dst = kind == 0 ? gp->indptr : gp->indices;
-          if (off == 0) dst.assign(total, 0);
+          if (off == 0) {
+            dst.assign(total, 0);
+            // a shrinking re-upload must release the old capacity too:
+            // acct was just reset to the smaller total, so keeping the
+            // larger allocation would make graph_bytes under-count real
+            // residency
+            if (dst.capacity() > static_cast<size_t>(total))
+              dst.shrink_to_fit();
+          }
           if (static_cast<int64_t>(dst.size()) != total) {
             resp.status = -3;  // chunks disagree on total_len
             break;

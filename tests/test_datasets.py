@@ -114,3 +114,46 @@ def test_criteo_skips_corrupt_numeric_fields(tmp_path):
     (root / "train.txt").write_text(good + "\n" + bad + "\n")
     d = criteo(root=str(root), vocab_per_field=50)
     assert d["label"].shape == (1,)  # corrupt line skipped, not fatal
+
+
+def test_glue_tsv_label_map_pins_train_ids(tmp_path):
+    """A shared label_map keeps dev label ids aligned with train even when
+    dev is missing a train class and carries an extra one (ADVICE r3)."""
+    root = tmp_path / "glue"
+    (root / "mnli").mkdir(parents=True)
+    (root / "mnli" / "train.tsv").write_text(
+        "sentence1\tsentence2\tlabel\n"
+        "a\tb\tentailment\n"
+        "c\td\tneutral\n"
+        "e\tf\tcontradiction\n")
+    (root / "mnli" / "dev.tsv").write_text(
+        "sentence1\tsentence2\tlabel\n"
+        "g\th\tneutral\n"          # no 'contradiction'/'entailment' in dev
+        "i\tj\tsurprise\n")        # class absent from train
+    lmap = {}
+    _, _, tr = glue_tsv(str(root), "mnli", "train", label_map=lmap)
+    np.testing.assert_array_equal(tr, [1, 2, 0])  # sorted-unique ids
+    _, _, dv = glue_tsv(str(root), "mnli", "dev", label_map=lmap)
+    # 'neutral' keeps its TRAIN id (2); the unseen class appends (3)
+    np.testing.assert_array_equal(dv, [2, 3])
+    # without the shared map, dev would renumber: neutral->0, surprise->1
+    _, _, dv_alone = glue_tsv(str(root), "mnli", "dev")
+    np.testing.assert_array_equal(dv_alone, [0, 1])
+
+
+def test_glue_tsv_numeric_train_corrupt_dev_label(tmp_path):
+    """Numeric train labels must still feed the shared map, so a dev split
+    with one non-numeric label keeps train's int ids instead of
+    renumbering by sorted-unique (review finding, round 4)."""
+    root = tmp_path / "glue"
+    (root / "sst2").mkdir(parents=True)
+    (root / "sst2" / "train.tsv").write_text(
+        "sentence\tlabel\na\t0\nb\t1\n")
+    (root / "sst2" / "dev.tsv").write_text(
+        "sentence\tlabel\nc\t1\nd\tunknown\n")
+    lmap = {}
+    _, _, tr = glue_tsv(str(root), "sst2", "train", label_map=lmap)
+    np.testing.assert_array_equal(tr, [0, 1])
+    _, _, dv = glue_tsv(str(root), "sst2", "dev", label_map=lmap)
+    # '1' keeps its train id 1; the corrupt label appends (2)
+    np.testing.assert_array_equal(dv, [1, 2])

@@ -91,3 +91,18 @@ def test_lm_head_ignore_index_at_or_beyond_vocab():
                                         interpret=True, block_n=16,
                                         block_v=128)
     np.testing.assert_allclose(outp, out, rtol=2e-5, atol=2e-5)
+
+
+def test_lm_head_negative_label_clamps_to_class_zero():
+    """A negative label that is NOT ignore_index clamps to class 0 — the
+    take_along_axis-gather semantics of the dense oracle — in both the
+    scan and the Pallas kernel (where an unclamped negative would match
+    no iota column and nll would silently collapse to lse)."""
+    h, w, b, _ = _case(16, 8, 16, mask_frac=0.0)
+    y = jnp.asarray([-3, 2, -1, 5] * 4, jnp.int32)  # -1 IS ignore here
+    ref = _oracle(h, w, b, jnp.where(y == -1, y, jnp.clip(y, 0, 15)))
+    out = lm_head_cross_entropy(h, w, y, bias=b, impl="scan")
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    outp = lm_head_cross_entropy_pallas(h, w, y, bias=b, interpret=True,
+                                        block_n=16, block_v=128)
+    np.testing.assert_allclose(outp, ref, rtol=2e-5, atol=2e-5)
