@@ -171,6 +171,43 @@ class Trainer:
         rows leaf, not the batch ids."""
         return self._eval_step(self._state, batch)
 
+    def scan_steps(self, n_steps: int):
+        """Compile ``n_steps`` train steps into ONE program (a ``lax.scan``
+        over the step body) and return ``run(state, batch, key) ->
+        (new_state, last_loss)``.
+
+        Two uses: (1) amortizing per-dispatch host cost when batches repeat
+        or are generated on-device — the reference's SubExecutor batches
+        kernel launches per run() for the same reason (executor.py:430);
+        (2) device-time benchmarking: timing run(k) and run(2k) and
+        differencing cancels the fixed dispatch overhead exactly, leaving
+        pure device time per step.
+
+        The batch is FIXED across the n steps; the RNG key is split once
+        per step inside the scan, so dropout stays honest.  Feed the
+        returned state back in (the state argument is donated).  Not
+        supported with staged host embeddings: their per-step host
+        push/stage cannot live inside a compiled loop."""
+        if self._has_staged:
+            raise ValueError(
+                "scan_steps cannot run staged host embeddings: stage()/"
+                "push_grads() are per-step host work (use the io_callback "
+                "HostEmbedding or the plain step loop)")
+        train_step = self._train_step  # inlined when traced under jit
+
+        def run(state: TrainState, batch, key):
+            def body(carry, _):
+                st, k = carry
+                k, sub = jax.random.split(k)
+                st, metrics = train_step(st, batch, sub)
+                return (st, k), metrics["loss"]
+
+            (state, _), losses = jax.lax.scan(
+                body, (state, key), None, length=n_steps)
+            return state, losses[-1]
+
+        return jax.jit(run, donate_argnums=(0,))
+
     def profile(self, batch, key=None, iters: int = 10) -> dict:
         """Wall-time + cost profile of one train step on the given batch
         (reference executor.profile, executor.py:501)."""
