@@ -66,6 +66,8 @@ class MultiHeadAttention(Module):
         self.attn_fn = attn_fn  # static; None -> dot_product_attention
 
     def __call__(self, x, mask=None, *, key=None, training: bool = False):
+        if getattr(self.attn_fn, "bhsd", False):
+            return self._call_bhsd(x, mask, key=key, training=training)
         b, s, d = x.shape
         qkv = x @ self.wqkv.astype(x.dtype)
         if self.bqkv is not None:
@@ -80,6 +82,47 @@ class MultiHeadAttention(Module):
         if training and self.dropout_rate > 0.0 and key is not None:
             out = dropout_op(out, self.dropout_rate, key, training=True)
         y = out @ self.wo.astype(x.dtype)
+        if self.bo is not None:
+            y = y + self.bo.astype(x.dtype)
+        return y
+
+    def _call_bhsd(self, x, mask=None, *, key=None, training: bool = False):
+        """Native-kernel-layout path: q/k/v are PROJECTED into (B, H, S, D)
+        — ``einsum('bsd,dkhe->kbhse')`` — and the output projection
+        contracts (h, e) straight out of (B, H, S, D), so no transpose op
+        (forward or vjp) ever sits between the projection matmuls and a
+        ``bhsd``-marked attention core (the Pallas flash kernel's tiling).
+        The (B, S, H, D) path materializes an XLA relayout copy around
+        every kernel operand and gradient instead — ~9% of the BERT-large
+        seq-512 step (ROADMAP r03 4b).  Same math, same weights, same
+        parameter layout; only the activation layout differs."""
+        h, e = self.num_heads, self.head_dim
+        d = x.shape[-1]
+        # THREE separate projection einsums, not one fused "bsd,dkhe->
+        # kbhse": measured on one v5e at BERT-large seq 512 (examples/
+        # profile_qkv_variants.py) the per-operand dots let XLA absorb the
+        # (b,s,h,e)->(b,h,s,e) permutation into each dot's output layout,
+        # while the fused 5-d variant pays ~9 ms/step of slice_bitcast
+        # fusions for qkv[k] and the matmul+transpose variant pays ~22 ms
+        # of relayout copies.  A=241.3 / B=237.0 / C(this)=225.1 /
+        # D=247.7 ms per step.
+        w4 = self.wqkv.astype(x.dtype).reshape(d, 3, h, e)
+        b4 = (None if self.bqkv is None
+              else self.bqkv.astype(x.dtype).reshape(3, 1, h, 1, e))
+        parts = []
+        for i in range(3):
+            p = jnp.einsum("bsd,dhe->bhse", x, w4[:, i])
+            if b4 is not None:
+                p = p + b4[i]
+            parts.append(p)
+        q, k, v = parts
+        out = self.attn_fn(q, k, v, mask, causal=self.causal)  # (b,h,s,e)
+        if training and self.dropout_rate > 0.0 and key is not None:
+            # elementwise iid mask: applying it in (b,h,s,e) is the same
+            # distribution as the (b,s,d) path (different RNG alignment)
+            out = dropout_op(out, self.dropout_rate, key, training=True)
+        y = jnp.einsum("bhse,hed->bsd",
+                       out, self.wo.astype(x.dtype).reshape(h, e, d))
         if self.bo is not None:
             y = y + self.bo.astype(x.dtype)
         return y

@@ -281,10 +281,62 @@ def check_step_time():
     assert dt < 5.0, "step absurdly slow — backend degraded?"
 
 
+def check_attn_layout():
+    """The native (B,H,S,D) attention path must keep the per-layer relayout
+    copies GONE: r03's (B,S,H,D) path paid ~23 ms/step of copy.* device
+    ops around the flash kernel at BERT-large seq 512 (ROADMAP 4b); the
+    einsum projection path measured 1.6 ms.  Gate at < 5 ms/step, plus
+    the native path must actually be faster than the copy path."""
+    import glob
+    import gzip
+    import json
+    import shutil
+    import tempfile
+    from collections import defaultdict
+
+    import jax
+    from examples.profile_attn_layout import build_trainer
+
+    def copies_ms_per_step(native):
+        trainer, b, _ = build_trainer(native, seq=512, batch=24)
+        key = jax.random.key(0)
+        m = trainer.step(b, key=key)
+        float(m["loss"])
+        outdir = tempfile.mkdtemp(prefix="attn_layout_")
+        with jax.profiler.trace(outdir):
+            for _ in range(3):
+                m = trainer.step(b, key=key)
+            float(m["loss"])
+        path = sorted(glob.glob(
+            outdir + "/**/*.trace.json.gz", recursive=True))[-1]
+        with gzip.open(path, "rt") as f:
+            trace = json.load(f)
+        total = defaultdict(float)
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            name = (ev.get("args", {}).get("deduplicated_name")
+                    or ev.get("name", ""))
+            # copy.* / copy_fusion.* are the relayout ops; copy-done/
+            # copy-start are async DMA bookkeeping, and transpose_jvp___
+            # is a jax SCOPE name (the vjp region), not a data transpose
+            if name.startswith("copy.") or name.startswith("copy_fusion"):
+                total[name] += ev["dur"]
+        shutil.rmtree(outdir, ignore_errors=True)
+        return sum(total.values()) / 3e3
+
+    native = copies_ms_per_step(True)
+    plain = copies_ms_per_step(False)
+    print(f"  relayout copies at seq 512: native {native:.2f} ms/step "
+          f"vs (B,S,H,D) path {plain:.2f} ms/step")
+    assert native < 5.0, f"native-layout copies crept back: {native:.2f} ms"
+    assert native < plain, "native path no longer beats the copy path"
+
+
 CHECKS = {"flash": check_flash, "flash_time": check_flash_time,
           "ring": check_ring, "lm_head": check_lm_head,
           "bridge": check_bridge, "ctr": check_ctr, "hbm": check_hbm,
-          "step": check_step_time}
+          "step": check_step_time, "attn_layout": check_attn_layout}
 
 
 def main():
