@@ -52,7 +52,8 @@ def _lib():
                          ctypes.c_int64, ctypes.c_int64, f32p],
                         ctypes.c_int64),
         "het_ps_push": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
-                         ctypes.c_int64, ctypes.c_int64, f32p],
+                         ctypes.c_int64, ctypes.c_int64, f32p,
+                         ctypes.c_uint64, ctypes.c_uint64],
                         ctypes.c_int64),
         "het_ps_set_rows": ([ctypes.c_void_p, ctypes.c_uint32, i64p,
                              ctypes.c_int64, ctypes.c_int64, f32p],
@@ -183,27 +184,126 @@ class RemoteEmbeddingTable:
     # overlapped across shards on a thread pool
     parallel_pull = True
 
+    # socket-level failures from the C client (writev/read on a dead
+    # connection); everything else is a server-reported application error
+    _NET_ERRS = (-10, -11)
+
     def __init__(self, address: str, table_id: int, rows: int, dim: int, *,
                  optimizer: str = "sgd", lr: float = 0.01,
                  momentum: float = 0.9, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
                  weight_decay: float = 0.0, seed: int = 0,
-                 init_scale: float = 0.01):
-        host, _, port = address.partition(":")
+                 init_scale: float = 0.01, reconnect_attempts: int = 0,
+                 reconnect_backoff: float = 0.1,
+                 restore_path: str | None = None):
+        """``reconnect_attempts > 0`` turns on fault tolerance: an RPC that
+        hits a dead socket redials the server with bounded exponential
+        backoff (``reconnect_backoff`` doubling, capped at 2 s), re-creates
+        the table, reloads ``restore_path`` (server-side checkpoint from
+        ``save``) when set, and retries.  The reference survives transient
+        drops via ps-lite message retry (ps-lite/src/resender.h); here the
+        same kill-restart-resume contract is met from checkpoints, since
+        the v2 save format carries optimizer slots."""
         self._lib = _lib()
-        self._c = self._lib.het_ps_connect(host.encode(), int(port))
-        if not self._c:
-            raise ConnectionError(f"cannot reach embedding server {address}")
+        self.address = address
         self.table_id = int(table_id)
         self.rows = rows
         self.dim = dim
-        st = self._lib.het_ps_create_table(
-            self._c, self.table_id, rows, dim, OPTIMIZERS[optimizer], lr,
-            momentum, beta1, beta2, eps, weight_decay, seed, init_scale)
+        self.reconnect_attempts = int(reconnect_attempts)
+        self.reconnect_backoff = float(reconnect_backoff)
+        self.restore_path = restore_path
+        self._create_args = (rows, dim, OPTIMIZERS[optimizer], lr, momentum,
+                            beta1, beta2, eps, weight_decay, seed,
+                            init_scale)
+        import secrets
+        import threading
+        self._reconnect_lock = threading.Lock()
+        self._gen = 0
+        # survives reconnects (unlike any connection-scoped id): the
+        # server's push dedup is keyed on it
+        self._client_id = secrets.randbits(63) | 1
+        self._push_seq = 0
+        # dead Client objects are parked, not freed, until close(): another
+        # thread may still be blocked inside a C call on the old sockets
+        # (its request fails with -10/-11 and enters its own retry); fd
+        # cost is bounded by reconnect count
+        self._dead = []
+        self._c = None
+        self._connect()
+
+    def _connect(self) -> int:
+        """Dial + create/attach.  Returns the kCreate status: 0 = table
+        freshly created (server has no state), 1 = already existed (a
+        reconnect to a server that never died, or another worker made
+        it)."""
+        host, _, port = self.address.partition(":")
+        c = self._lib.het_ps_connect(host.encode(), int(port))
+        if not c:
+            raise ConnectionError(
+                f"cannot reach embedding server {self.address}")
+        st = self._lib.het_ps_create_table(c, self.table_id,
+                                           *self._create_args)
         if st < 0:
+            self._lib.het_ps_disconnect(c)
             raise RuntimeError(
-                f"table {table_id} exists on {address} with a different "
-                f"shape (status {st})")
+                f"table {self.table_id} exists on {self.address} with a "
+                f"different shape (status {st})")
+        if self._c:
+            self._dead.append(self._c)
+        self._c = c
+        return int(st)
+
+    def _reconnect(self, gen: int) -> bool:
+        """Redial after a dead-socket RPC.  Serialized: the first thread to
+        notice does the work; later threads see the bumped generation and
+        just retry on the fresh connection."""
+        import time as _time
+        with self._reconnect_lock:
+            if self._gen != gen:
+                return True  # another thread already reconnected
+            for attempt in range(self.reconnect_attempts):
+                if attempt:  # dial immediately first; back off only
+                    _time.sleep(min(self.reconnect_backoff *
+                                    (2 ** (attempt - 1)), 2.0))
+                try:
+                    created = self._connect() == 0
+                except (ConnectionError, RuntimeError):
+                    continue
+                # reload ONLY when the table came back empty (the server
+                # really restarted).  kCreate status 1 = it already
+                # existed: a transient socket drop on a LIVE server — its
+                # rows carry every push since the last save, and loading
+                # the stale checkpoint would silently roll them back
+                # (under other workers' feet, if any are attached).
+                if created and self.restore_path is not None:
+                    st = self._lib.het_ps_load(
+                        self._c, self.table_id,
+                        str(self.restore_path).encode())
+                    # -1 = no checkpoint file yet (failure before the
+                    # first save): the fresh table IS the restore point
+                    if st not in (0, -1):
+                        raise RuntimeError(
+                            f"restore from {self.restore_path} failed "
+                            f"after reconnect (status {st})")
+                self._gen += 1
+                return True
+            return False
+
+    def _rpc(self, what: str, call):
+        """Run ``call(conn) -> status``; on a dead socket, reconnect (if
+        enabled) and retry once per successful redial.  The generation is
+        snapshotted BEFORE each call: a thread whose RPC died on a
+        connection another thread has already replaced sees the bumped
+        gen inside _reconnect and retries immediately instead of
+        redialing a second time."""
+        while True:
+            gen = self._gen
+            st = call(self._c)
+            if st not in self._NET_ERRS or self.reconnect_attempts <= 0:
+                break
+            if not self._reconnect(gen):
+                break
+        self._check(st, what)
 
     def _check(self, st, what):
         if st != 0:
@@ -212,44 +312,50 @@ class RemoteEmbeddingTable:
     def pull(self, keys) -> np.ndarray:
         keys = _i64(np.asarray(keys).ravel())
         out = np.empty((keys.size, self.dim), np.float32)
-        st = self._lib.het_ps_pull(
-            self._c, self.table_id,
+        self._rpc("pull", lambda c: self._lib.het_ps_pull(
+            c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            self.dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        self._check(st, "pull")
+            self.dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
         return out
 
     def push(self, keys, grads):
         keys = _i64(np.asarray(keys).ravel())
         grads = _f32(np.asarray(grads).reshape(keys.size, self.dim))
-        st = self._lib.het_ps_push(
-            self._c, self.table_id,
+        # each push carries a fresh (client_id, seq); a RETRY after
+        # reconnect replays the SAME seq, so a push whose response was
+        # lost on a live server is applied at most once (the server
+        # dedups; see kPush).  Pushes for one store come from one thread
+        # (the trainer, or the async-push worker), so a plain counter is
+        # enough.
+        self._push_seq += 1
+        seq = self._push_seq
+        self._rpc("push", lambda c: self._lib.het_ps_push(
+            c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            self.dim, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        self._check(st, "push")
+            self.dim, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._client_id, seq))
 
     def set_rows(self, keys, values):
         keys = _i64(np.asarray(keys).ravel())
         values = _f32(np.asarray(values).reshape(keys.size, self.dim))
-        st = self._lib.het_ps_set_rows(
-            self._c, self.table_id,
+        self._rpc("set_rows", lambda c: self._lib.het_ps_set_rows(
+            c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            self.dim, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        self._check(st, "set_rows")
+            self.dim, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
 
     def set_lr(self, lr: float):
-        self._check(self._lib.het_ps_set_lr(self._c, self.table_id, lr),
-                    "set_lr")
+        self._rpc("set_lr",
+                  lambda c: self._lib.het_ps_set_lr(c, self.table_id, lr))
 
     def save(self, path: str):
         """Server-side save — the file is written where the SERVER runs
         (reference SaveParam, PSFHandle.h:389)."""
-        self._check(self._lib.het_ps_save(self._c, self.table_id,
-                                          str(path).encode()), "save")
+        self._rpc("save", lambda c: self._lib.het_ps_save(
+            c, self.table_id, str(path).encode()))
 
     def load(self, path: str):
-        self._check(self._lib.het_ps_load(self._c, self.table_id,
-                                          str(path).encode()), "load")
+        self._rpc("load", lambda c: self._lib.het_ps_load(
+            c, self.table_id, str(path).encode()))
 
     def barrier(self, barrier_id: int, world: int):
         """Block until ``world`` clients reach this barrier id on the same
@@ -301,6 +407,9 @@ class RemoteEmbeddingTable:
         if getattr(self, "_c", None):
             self._lib.het_ps_disconnect(self._c)
             self._c = None
+        for c in getattr(self, "_dead", []):
+            self._lib.het_ps_disconnect(c)
+        self._dead = []
 
     def __del__(self):
         try:
@@ -429,12 +538,27 @@ class RemoteHostEmbedding(ShardedHostEmbedding):
                  lr: float = 0.01, weight_decay: float = 0.0, seed: int = 0,
                  init_scale: float = 0.01, cache_capacity: int = 0,
                  policy: str = "lru", pull_bound: int = 0,
-                 push_bound: int = 0, dtype=None):
+                 push_bound: int = 0, dtype=None,
+                 reconnect_attempts: int = 0,
+                 reconnect_backoff: float = 0.1,
+                 restore_path: str | None = None):
+        """``reconnect_attempts``/``restore_path`` enable PS fault
+        tolerance on the UNCACHED path (see RemoteEmbeddingTable; each
+        shard restores ``{restore_path}.shard{s}``, the layout ``save``
+        writes).  The client-side cached path (``cache_capacity > 0``)
+        does not reconnect: the C cache object pins the original
+        connection, and its versioned rows would be stale across a server
+        restart anyway — combine caching with fault tolerance by
+        checkpoint/restart of the whole worker instead."""
         import jax.numpy as jnp
 
         servers = list(servers)
         if not servers:
             raise ValueError("need at least one server address")
+        if cache_capacity > 0 and reconnect_attempts > 0:
+            raise ValueError(
+                "reconnect_attempts requires cache_capacity=0 (the remote "
+                "cache pins its connection; see docstring)")
         if table_id is None:
             table_id = next(_next_table_id)
         # deliberately NOT calling super().__init__ (same pattern as
@@ -449,7 +573,12 @@ class RemoteHostEmbedding(ShardedHostEmbedding):
             RemoteEmbeddingTable(addr, table_id, rows_per, dim,
                                  optimizer=optimizer, lr=lr,
                                  weight_decay=weight_decay, seed=seed + s,
-                                 init_scale=init_scale)
+                                 init_scale=init_scale,
+                                 reconnect_attempts=reconnect_attempts,
+                                 reconnect_backoff=reconnect_backoff,
+                                 restore_path=(None if restore_path is None
+                                               else f"{restore_path}"
+                                                    f".shard{s}"))
             for s, addr in enumerate(servers)
         ]
         if cache_capacity > 0:

@@ -23,6 +23,7 @@
 // Exposed as a flat extern "C" ctypes surface (no pybind11 in this image).
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -546,10 +547,46 @@ int het_table_save(void* h, const char* path) {
   auto* t = static_cast<Table*>(h);
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
+  // quiesce: hold EVERY shard lock for the whole save so the checkpoint
+  // is one consistent cut — weights, step, and optimizer moments all
+  // from the same instant.  Lock-free snapshots (the pre-v2 behavior)
+  // can pair a pre-push weight with a post-push moment when a push
+  // lands mid-save (async_push / second worker), and a restore of that
+  // file resumes a trajectory that never existed.  apply_row takes one
+  // shard lock at a time, so ascending-order acquisition cannot
+  // deadlock; pushes simply wait out the save.
+  std::array<std::unique_lock<std::mutex>, kShards> locks;
+  for (int i = 0; i < kShards; ++i)
+    locks[i] = std::unique_lock<std::mutex>(t->shards[i].mu);
   std::fwrite(&t->rows, sizeof(int64_t), 1, f);
   std::fwrite(&t->dim, sizeof(int64_t), 1, f);
   std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
   std::fwrite(t->version.data(), sizeof(uint64_t), t->version.size(), f);
+  // v2 trailer (older files simply end before it; load treats EOF as
+  // "no slots"): optimizer slot matrices + step counter, so a server
+  // restart + load resumes the exact optimizer trajectory (momentum/
+  // adagrad accumulators, adam moments + bias-correction step), not
+  // just the weights — the PS fault-recovery path needs this to make
+  // kill -> restart -> resume converge like the unkilled run.
+  bool m1 = t->opt.kind != OPT_SGD;
+  bool m2 = t->opt.kind == OPT_ADAM || t->opt.kind == OPT_ADAMW;
+  int64_t nslots = (m1 ? 1 : 0) + (m2 ? 1 : 0);
+  uint64_t step = t->step.load();
+  std::fwrite(&nslots, sizeof(int64_t), 1, f);
+  std::fwrite(&step, sizeof(uint64_t), 1, f);
+  std::vector<float> rowbuf(t->dim);
+  for (int64_t pass = 0; pass < nslots; ++pass) {
+    for (int64_t r = 0; r < t->rows; ++r) {
+      Shard& s = t->shards[t->shard_of(r)];
+      const std::vector<float>& src = pass == 0 ? s.m1 : s.m2;
+      if (src.empty())  // lazily-allocated slot never touched yet
+        std::fill(rowbuf.begin(), rowbuf.end(), 0.f);
+      else
+        std::copy(&src[r * t->dim], &src[r * t->dim] + t->dim,
+                  rowbuf.begin());
+      std::fwrite(rowbuf.data(), sizeof(float), t->dim, f);
+    }
+  }
   std::fclose(f);
   return 0;
 }
@@ -568,8 +605,46 @@ int het_table_load(void* h, const char* path) {
   size_t nd = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
   size_t nv = std::fread(t->version.data(), sizeof(uint64_t),
                          t->version.size(), f);
+  if (nd != t->data.size() || nv != t->version.size()) {
+    std::fclose(f);
+    return -3;
+  }
+  // optional v2 trailer: optimizer slots + step (see het_table_save)
+  int64_t nslots = 0;
+  if (std::fread(&nslots, sizeof(int64_t), 1, f) == 1) {
+    uint64_t step = 0;
+    if (std::fread(&step, sizeof(uint64_t), 1, f) != 1 || nslots < 0 ||
+        nslots > 2) {
+      std::fclose(f);
+      return -3;
+    }
+    t->step.store(step);
+    bool m1 = t->opt.kind != OPT_SGD;
+    bool m2 = t->opt.kind == OPT_ADAM || t->opt.kind == OPT_ADAMW;
+    std::vector<float> rowbuf(t->dim);
+    for (int64_t pass = 0; pass < nslots; ++pass) {
+      // a slot the current optimizer does not use is read and discarded
+      // (optimizer-kind changes across save/load stay legal)
+      bool want = pass == 0 ? m1 : m2;
+      for (int64_t r = 0; r < t->rows; ++r) {
+        if (std::fread(rowbuf.data(), sizeof(float), t->dim, f) !=
+            static_cast<size_t>(t->dim)) {
+          std::fclose(f);
+          return -3;
+        }
+        if (!want) continue;
+        Shard& s = t->shards[t->shard_of(r)];
+        {
+          std::lock_guard<std::mutex> lk(s.mu);
+          t->ensure_slots(s);
+          std::vector<float>& dst = pass == 0 ? s.m1 : s.m2;
+          std::copy(rowbuf.begin(), rowbuf.end(), &dst[r * t->dim]);
+        }
+      }
+    }
+  }
   std::fclose(f);
-  return (nd == t->data.size() && nv == t->version.size()) ? 0 : -3;
+  return 0;
 }
 
 // ---- cache ----

@@ -191,11 +191,21 @@ struct TableStats {
   }
 };
 
+// at-most-once gradient application across client reconnects (the role
+// ps-lite's resender sequence numbers play, resender.h): a push carries a
+// (client_id, seq) trailer; a RETRY of a push whose response was lost on a
+// live server replays the same seq and is skipped instead of applied twice
+struct PushDedup {
+  std::mutex mu;
+  std::unordered_map<uint64_t, uint64_t> last_seq;  // per client_id
+};
+
 struct TableEntry {
   void* handle = nullptr;
   int64_t rows = 0;
   int64_t dim = 0;
   std::shared_ptr<TableStats> stats;  // shared: lookup() returns copies
+  std::shared_ptr<PushDedup> dedup;
 };
 
 struct Barrier {
@@ -338,6 +348,7 @@ struct Server {
           e.rows = keys[0];
           e.dim = keys[1];
           e.stats = std::make_shared<TableStats>();
+          e.dedup = std::make_shared<PushDedup>();
           if (record.load()) {
             e.stats->touches.assign(e.rows, 0);
             e.stats->recording.store(true);
@@ -367,6 +378,22 @@ struct Server {
           if (!e.handle) { resp.status = -2; break; }
           if (!keys_in_range(keys, e.rows) ||
               h.nfloats != h.nkeys * e.dim) { resp.status = -4; break; }
+          // optional 16-byte (client_id, seq) trailer: a reconnecting
+          // client replays the seq of a push whose RESPONSE was lost; if
+          // the request itself had landed (live-server socket drop), the
+          // seq is already recorded and the duplicate must not be
+          // applied again (at-most-once; ps-lite resender.h role).
+          // Legacy frames (nbytes == 0: cache eviction pushes, old
+          // clients) skip dedup — those paths never retry.
+          if (h.nbytes == 16 && e.dedup) {
+            uint64_t cid, seq;
+            std::memcpy(&cid, bytes.data(), 8);
+            std::memcpy(&seq, bytes.data() + 8, 8);
+            std::lock_guard<std::mutex> lk(e.dedup->mu);
+            uint64_t& last = e.dedup->last_seq[cid];
+            if (seq <= last) break;  // duplicate retry: status 0, no apply
+            last = seq;
+          }
           het_table_push(e.handle, keys.data(), h.nkeys, floats.data());
           e.stats->push_reqs++;
           e.stats->push_rows += h.nkeys;
@@ -1296,9 +1323,21 @@ int64_t het_ps_pull(void* h, uint32_t table_id, const int64_t* keys,
 }
 
 int64_t het_ps_push(void* h, uint32_t table_id, const int64_t* keys,
-                    int64_t n, int64_t dim, const float* grads) {
-  ReqHeader hh{kPush, table_id, n, n * dim, 0};
-  return static_cast<Client*>(h)->request_prio(hh, keys, grads, nullptr,
+                    int64_t n, int64_t dim, const float* grads,
+                    uint64_t client_id, uint64_t seq) {
+  // seq 0 = legacy fire-once push (no dedup trailer); a retrying caller
+  // passes a stable (client_id, seq) so a replay after reconnect is
+  // applied at most once server-side
+  if (seq == 0) {
+    ReqHeader hh{kPush, table_id, n, n * dim, 0};
+    return static_cast<Client*>(h)->request_prio(hh, keys, grads, nullptr,
+                                                 nullptr, 0);
+  }
+  char trailer[16];
+  std::memcpy(trailer, &client_id, 8);
+  std::memcpy(trailer + 8, &seq, 8);
+  ReqHeader hh{kPush, table_id, n, n * dim, 16};
+  return static_cast<Client*>(h)->request_prio(hh, keys, grads, trailer,
                                                nullptr, 0);
 }
 

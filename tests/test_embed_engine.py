@@ -284,3 +284,43 @@ def test_host_embedding_layer_trains():
     layer.flush()
     l1 = float(step(layer))
     assert l1 < l0  # rows shrink toward zero under the host optimizer
+
+
+@pytest.mark.parametrize("opt", ["momentum", "adagrad", "adam"])
+def test_save_load_restores_optimizer_slots(tmp_path, opt):
+    """The v2 checkpoint trailer must carry optimizer slots + step: after
+    load, further pushes continue the EXACT optimizer trajectory.  Without
+    the trailer a stateful optimizer diverges immediately (fresh zero
+    accumulators), which is what made server-restart recovery lossy."""
+    rng = np.random.default_rng(3)
+    keys = np.arange(ROWS)
+    g1 = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    g2 = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+
+    t = HostEmbeddingTable(ROWS, DIM, optimizer=opt, seed=7)
+    t.push(keys, g1)
+    path = str(tmp_path / "t.bin")
+    t.save(path)
+    t.push(keys, g2)  # trajectory continued WITHOUT interruption
+
+    t2 = HostEmbeddingTable(ROWS, DIM, optimizer=opt, seed=99)  # other init
+    t2.load(path)
+    t2.push(keys, g2)  # trajectory continued FROM the checkpoint
+    np.testing.assert_allclose(t2.pull(keys), t.pull(keys), rtol=1e-6,
+                               atol=1e-7)
+
+
+def test_load_accepts_legacy_checkpoint_without_trailer(tmp_path):
+    """Pre-v2 files end after the version array; load must still succeed
+    (slots stay zero)."""
+    t = HostEmbeddingTable(ROWS, DIM, optimizer="adagrad", seed=7)
+    t.push(np.arange(8), np.ones((8, DIM), np.float32))
+    path = str(tmp_path / "t.bin")
+    t.save(path)
+    legacy_size = 16 + ROWS * DIM * 4 + ROWS * 8  # header+data+version
+    with open(path, "r+b") as f:
+        f.truncate(legacy_size)
+    t2 = HostEmbeddingTable(ROWS, DIM, optimizer="adagrad", seed=99)
+    t2.load(path)
+    np.testing.assert_allclose(t2.pull(np.arange(ROWS)),
+                               t.pull(np.arange(ROWS)))
