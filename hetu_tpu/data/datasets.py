@@ -182,7 +182,11 @@ def glue_tsv(root: str, task: str = "sst2", split: str = "train",
         if label_map is None:
             label_map = {}
         for v in sorted(set(raw_labels)):
-            label_map.setdefault(v, len(label_map))
+            if v not in label_map:
+                # max+1, NOT len(): identity-pinned numeric ids need not
+                # be dense from 0 ('1','2' pins {1,2}; len() would hand a
+                # new label the id 2, colliding with class '2')
+                label_map[v] = max(label_map.values(), default=-1) + 1
         labels = np.asarray([label_map[v] for v in raw_labels], np.int32)
     if all(p is None for p in pairs):
         pairs = None

@@ -157,3 +157,21 @@ def test_glue_tsv_numeric_train_corrupt_dev_label(tmp_path):
     _, _, dv = glue_tsv(str(root), "sst2", "dev", label_map=lmap)
     # '1' keeps its train id 1; the corrupt label appends (2)
     np.testing.assert_array_equal(dv, [1, 2])
+
+
+def test_glue_tsv_sparse_numeric_ids_no_collision(tmp_path):
+    """Identity-pinned numeric ids need not be dense from 0: an unseen
+    string label must append AFTER max(id), not at len(map) (review
+    finding, round 4: '1','2' pins {1,2}; len() would alias 'unknown'
+    onto class 2)."""
+    root = tmp_path / "glue"
+    (root / "sst2").mkdir(parents=True)
+    (root / "sst2" / "train.tsv").write_text(
+        "sentence\tlabel\na\t1\nb\t2\n")
+    (root / "sst2" / "dev.tsv").write_text(
+        "sentence\tlabel\nc\t2\nd\tunknown\n")
+    lmap = {}
+    _, _, tr = glue_tsv(str(root), "sst2", "train", label_map=lmap)
+    np.testing.assert_array_equal(tr, [1, 2])
+    _, _, dv = glue_tsv(str(root), "sst2", "dev", label_map=lmap)
+    np.testing.assert_array_equal(dv, [2, 3])  # NOT [2, 2]
