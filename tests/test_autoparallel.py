@@ -146,7 +146,7 @@ def test_profile_plan_measured_loop():
                                   n_devices=8)
     assert cluster.peak_flops > 0 and cluster.ici_bandwidth > 0
 
-    def measure(plan) -> float:
+    def build(plan):
         mesh_spec, kwargs = plan_to_strategy(plan)
         set_random_seed(0)
         cfg = GPTConfig(vocab_size=512, hidden_size=hidden,
@@ -158,32 +158,49 @@ def test_profile_plan_measured_loop():
         rng = np.random.default_rng(0)
         b = {"ids": jnp.asarray(rng.integers(0, 512, (batch, seq)),
                                 jnp.int32)}
-        m = trainer.step(b)  # compile
-        loss = float(m["loss"])
+        loss = float(trainer.step(b)["loss"])  # compile + sanity
         assert np.isfinite(loss)
-        per = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(4):
-                m = trainer.step(b)
-            float(m["loss"])
-            per.append((time.perf_counter() - t0) / 4)
-        return min(per)  # min-of-chunks: robust to background load
+        return trainer, b
+
+    def chunk_time(trainer, b) -> float:
+        t0 = time.perf_counter()
+        for _ in range(4):
+            m = trainer.step(b)
+        float(m["loss"])
+        return (time.perf_counter() - t0) / 4
+
+    def measure_pair(plan_a, plan_b):
+        """Min-of-8 INTERLEAVED chunks per plan: a background-load burst
+        hits both plans' windows, so the ratio is load-paired — what lets
+        the gate sit at 1.1x on a CPU mesh with ~15% ambient jitter."""
+        ta, ba = build(plan_a)
+        tb, bb = build(plan_b)
+        pa, pb = [], []
+        for _ in range(8):
+            pa.append(chunk_time(ta, ba))
+            pb.append(chunk_time(tb, bb))
+        return min(pa), min(pb)
+
+    def measure(plan) -> float:
+        trainer, b = build(plan)
+        return min(chunk_time(trainer, b) for _ in range(8))
 
     # 2) unconstrained search: the planner must FIND naive DP (dp=8 is
-    # optimal here) — a deterministic structural assertion, because
-    # measured timing on the single-core CPU mesh jitters up to ~15%
-    # even between runs of the identical program; the measured bound
-    # below only guards against catastrophic regressions
+    # optimal here) — a deterministic structural assertion — AND the
+    # materialized plan's measured step must stay within 1.1x of the
+    # manual naive-DP strategy (min over 8 chunks of 4: min-of-N is the
+    # noise estimator on the CPU mesh, where there is no fixed dispatch
+    # to difference away; the two programs here are structurally
+    # identical, so the gate bounds strategy-materialization overhead +
+    # measurement noise, and 1.1 held over repeated local runs)
     plan = dp_search(specs, cluster, global_batch=batch)
     naive = Plan(pp=1, n_microbatches=1,
                  choices=[ParallelChoice(dp=8)] * layers,
                  time=0.0, peak_bytes=0.0, feasible=True)
     d0 = plan.dominant
     assert (plan.pp, d0.dp, d0.tp) == (1, 8, 1), plan.describe()
-    t_planned = measure(plan)
-    t_naive = measure(naive)
-    assert t_planned <= t_naive * 1.5, (
+    t_planned, t_naive = measure_pair(plan, naive)
+    assert t_planned <= t_naive * 1.1, (
         f"planned {plan.describe()} measured {t_planned*1e3:.1f}ms vs "
         f"naive DP {t_naive*1e3:.1f}ms")
 

@@ -189,3 +189,53 @@ def test_hierarchical_all_to_all_matches_flat(mesh8):
     out = shard_map(hier, mesh=mesh24, in_specs=P(("dp", "tp")),
                     out_specs=P(("dp", "tp")))(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_hierarchical_all_to_all_16_devices_2x8():
+    """Axis-factorization generality beyond the suite's 8-device mesh: the
+    hierarchical a2a must equal the flat a2a on a 16-device 2x8 factoring
+    too.  The backend's device count is fixed at init, so this runs in a
+    subprocess with its own 16-device virtual CPU platform (fast: one
+    tiny program)."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import jax, jax.numpy as jnp, numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+from hetu_tpu.parallel import collectives as col
+from hetu_tpu.parallel.mesh import MeshSpec, make_mesh
+
+assert len(jax.devices()) == 16, jax.devices()
+x = jnp.arange(16.0 * 16).reshape(16, 16)
+mesh16 = make_mesh(MeshSpec(dp=16))
+ref = shard_map(lambda x: col.all_to_all(x, "dp", split_dim=1, concat_dim=0),
+                mesh=mesh16, in_specs=P("dp"), out_specs=P("dp"))(x)
+mesh28 = make_mesh(MeshSpec(dp=2, tp=8), devices=jax.devices())
+out = shard_map(lambda x: col.hierarchical_all_to_all(
+                    x, "dp", "tp", split_dim=1, concat_dim=0),
+                mesh=mesh28, in_specs=P(("dp", "tp")),
+                out_specs=P(("dp", "tp")))(x)
+np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+mesh82 = make_mesh(MeshSpec(dp=8, tp=2), devices=jax.devices())
+out2 = shard_map(lambda x: col.hierarchical_all_to_all(
+                     x, "dp", "tp", split_dim=1, concat_dim=0),
+                 mesh=mesh82, in_specs=P(("dp", "tp")),
+                 out_specs=P(("dp", "tp")))(x)
+np.testing.assert_allclose(np.asarray(out2), np.asarray(ref))
+print("OK16")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=16")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if ".axon_site" not in p)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0 and "OK16" in out.stdout, (
+        out.stdout, out.stderr[-2000:])
