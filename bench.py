@@ -111,12 +111,13 @@ def timed_scan_diff(trainer, batch, *, k: int, reps: int = 4,
     run_2k = trainer.scan_steps(2 * k)
     key = jax.random.key(1) if key is None else key
     state = trainer.state
+    last = {}
 
     def call(run):
-        nonlocal state
+        nonlocal state, last
         t0 = time.perf_counter()
-        state, loss = run(state, batch, key)
-        float(loss)
+        state, last = run(state, batch, key)
+        float(last["loss"])
         return time.perf_counter() - t0
 
     call(run_k)
@@ -137,6 +138,8 @@ def timed_scan_diff(trainer, batch, *, k: int, reps: int = 4,
     return {"median_s": med, "min_s": mn,
             "spread": round(med / mn, 4) if mn > 0 else None,
             "dispatch_ms": round(float(np.median(fixed)) * 1e3, 1),
+            "last_metrics": last,  # final step's full metrics, no extra
+            # dispatch or compile (scan_steps returns them)
             "timing": "scan-diff-device"}
 
 
@@ -282,10 +285,13 @@ def bench_moe(on_tpu, kind, peak):
         batch, seq, k = 32, 256, 8
         # capacity 1.25 (explicit; the standard top-1 Switch setting —
         # cap 2.0 measured 346 vs 428 samples/s on one v5e)
+        # routing observability ON (the reference logs gate accounting
+        # too): overflow_frac / load_entropy ride the metric line so a
+        # silently-collapsing router is visible in the bench artifact
         cfg = MoELMConfig(vocab_size=32000, hidden_size=1024, num_layers=4,
                           num_heads=16, num_experts=8, top_k=1,
                           capacity_factor=1.25, max_seq_len=seq,
-                          dtype=jnp.bfloat16)
+                          log_routing_stats=True, dtype=jnp.bfloat16)
     else:
         batch, seq, k = 4, 64, 2
         cfg = MoELMConfig(vocab_size=500, hidden_size=64, num_layers=2,
@@ -298,13 +304,18 @@ def bench_moe(on_tpu, kind, peak):
     b = {"ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                             jnp.int32)}
     t = timed_step(trainer, b, k=k, on_tpu=on_tpu)
+    # routing stats ride the timed scan's final metrics — no extra
+    # compile/dispatch (off-TPU, log_routing_stats is off and this is {})
+    m = t.get("last_metrics", {})
+    stats = {k2: round(float(m[k2]), 4)
+             for k2 in ("overflow_frac", "load_entropy") if k2 in m}
     return _line(
         "moe_samples_per_sec", batch / t["median_s"], "samples/s", 1.0,
         best_samples_per_sec=round(batch / t["min_s"], 1),
         baseline_note="reference run_top1.sh ships no table; this round's "
                       "value sets the baseline",
         device=kind, batch=batch, seq=seq, experts=cfg.num_experts,
-        top_k=cfg.top_k, **_tinfo(t))
+        top_k=cfg.top_k, **stats, **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -389,9 +400,13 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, use_flash,
         cfg = bert_base(num_layers=2, hidden_size=128, num_heads=2,
                         vocab_size=8192, dtype=jnp.float32)
         batch, seq, k = 8, 64, 2
+    # the native (B,H,S,D) einsum projection path pays off for BOTH cores:
+    # flash at seq 512, and the XLA materialized core at seq 128 (0.634 ->
+    # 0.658 MFU: the qkv split/relayout copies vanish)
+    from hetu_tpu.layers.attention import dot_product_attention_bhsd
     model = BertForPreTraining(
-        cfg, attn_fn=(flash_attn_fn(native_layout=True)
-                      if use_flash and on_tpu else None))
+        cfg, attn_fn=(flash_attn_fn(native_layout=True) if use_flash
+                      else dot_product_attention_bhsd) if on_tpu else None)
 
     def loss_fn(model, b, key):
         # honest training step: dropout ON, RNG key threaded

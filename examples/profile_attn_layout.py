@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def build_trainer(native: bool, *, seq=512, batch=24):
+def build_trainer(native: bool, *, seq=512, batch=24, use_flash=True):
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.exec import Trainer
     from hetu_tpu.models import BertForPreTraining, bert_large
@@ -34,7 +34,8 @@ def build_trainer(native: bool, *, seq=512, batch=24):
     cfg = bert_large(max_position_embeddings=max(512, seq),
                      dtype=jnp.bfloat16)
     model = BertForPreTraining(
-        cfg, attn_fn=flash_attn_fn(native_layout=native))
+        cfg, attn_fn=flash_attn_fn(native_layout=native) if use_flash
+        else None)
 
     def loss_fn(model, b, key):
         loss, aux = model.loss(

@@ -174,7 +174,9 @@ class Trainer:
     def scan_steps(self, n_steps: int):
         """Compile ``n_steps`` train steps into ONE program (a ``lax.scan``
         over the step body) and return ``run(state, batch, key) ->
-        (new_state, last_loss)``.
+        (new_state, last_metrics)`` — the final step's full metrics dict
+        (loss plus whatever the loss_fn's aux carries, e.g. MoE routing
+        stats), so a compiled loop costs no extra per-metric dispatch.
 
         Two uses: (1) amortizing per-dispatch host cost when batches repeat
         or are generated on-device — the reference's SubExecutor batches
@@ -200,11 +202,11 @@ class Trainer:
                 st, k = carry
                 k, sub = jax.random.split(k)
                 st, metrics = train_step(st, batch, sub)
-                return (st, k), metrics["loss"]
+                return (st, k), metrics
 
-            (state, _), losses = jax.lax.scan(
+            (state, _), stacked = jax.lax.scan(
                 body, (state, key), None, length=n_steps)
-            return state, losses[-1]
+            return state, jax.tree_util.tree_map(lambda x: x[-1], stacked)
 
         return jax.jit(run, donate_argnums=(0,))
 

@@ -39,7 +39,7 @@ from hetu_tpu.ops import gelu
 
 __all__ = [
     "TopKGate", "HashGate", "KTop1Gate", "SAMGate", "BalanceGate",
-    "ExpertMLP", "MoELayer", "moe_transformer_mlp",
+    "ExpertMLP", "MoELayer", "moe_transformer_mlp", "routing_stats",
 ]
 
 
@@ -74,6 +74,38 @@ def _densify(plans, T: int, E: int, C: int):
         dispatch = dispatch + oh
         combine = combine + g[:, None, None] * oh
     return dispatch, combine
+
+
+def routing_stats(plans, E: int):
+    """Routing observability from an index plan (any gate's
+    ``index_plan`` output): the two numbers that tell you whether a MoE
+    run is silently degrading (reference gate accounting,
+    moe_layer.py:45).
+
+    - ``overflow_frac``: fraction of (token, choice) assignments dropped
+      by capacity buckets.  High values mean tokens are falling out of
+      the model — raise capacity_factor or fix the balance loss.
+    - ``load_entropy``: entropy of the post-capacity per-expert load,
+      normalized to [0, 1] (1 = perfectly balanced, 0 = every kept token
+      on one expert — router collapse).
+    """
+    import math
+
+    total = 0.0
+    kept = 0.0
+    load = jnp.zeros((E,), jnp.float32)
+    for e_idx, _slot, keep, _g in plans:
+        kf = keep.astype(jnp.float32)
+        kept = kept + jnp.sum(kf)
+        total = total + e_idx.shape[0]
+        load = load + jnp.sum(_one_hot(e_idx, E) * kf[:, None], axis=0)
+    p = load / jnp.maximum(jnp.sum(load), 1e-9)
+    ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)),
+                             0.0))
+    return {
+        "overflow_frac": 1.0 - kept / total,
+        "load_entropy": ent / math.log(E) if E > 1 else jnp.float32(1.0),
+    }
 
 
 class TopKGate(Module):
@@ -470,7 +502,21 @@ class MoELayer(Module):
         _, combine = ctx
         return jnp.einsum("tec,ecd->td", combine.astype(t_dtype), ex_out)
 
-    def __call__(self, x, *, training: bool = True):
+    def _stats_of(self, ctx, E):
+        """routing_stats from the routing context (index path only: the
+        one-hot einsum path has no plan to account; all shipped gates
+        provide index_plan)."""
+        if ctx[0] != "idx":
+            raise ValueError(
+                "with_stats needs a gate with index_plan (scatter path)")
+        return routing_stats(ctx[1], E)
+
+    def __call__(self, x, *, training: bool = True,
+                 with_stats: bool = False):
+        """``with_stats=True`` returns ``(y, (aux, stats))`` where stats is
+        ``routing_stats`` of this call's plan (overflow_frac,
+        load_entropy) — pmean'd over ep so every rank logs the global
+        picture."""
         shape = x.shape
         d = shape[-1]
         mesh = self.mesh
@@ -487,6 +533,8 @@ class MoELayer(Module):
             ex_in, ctx, aux = self._route_in(self.gate, t, training)
             ex_out = self.experts(ex_in)
             y = self._route_out(ctx, ex_out, t.dtype)
+            if with_stats:
+                return y.reshape(shape), (aux, self._stats_of(ctx, E))
             return y.reshape(shape), aux
 
         E_local = E // ep
@@ -528,13 +576,19 @@ class MoELayer(Module):
                                     concat_axis=0, tiled=True)
             y = self._route_out(ctx, ex_out, t.dtype)
             aux = lax.pmean(aux, self.axis)
+            if with_stats:
+                stats = {k: lax.pmean(v, self.axis)
+                         for k, v in self._stats_of(ctx, E).items()}
+                return y.reshape(xl.shape), (aux, stats)
             return y.reshape(xl.shape), aux
 
+        out_aux_spec = (P(), {"overflow_frac": P(), "load_entropy": P()}) \
+            if with_stats else P()
         return jax.shard_map(
             inner,
             mesh=mesh,
             in_specs=(P(), P(self.axis), P(self.axis)),
-            out_specs=(P(self.axis), P()),
+            out_specs=(P(self.axis), out_aux_spec),
             axis_names=frozenset(self.axis),
         )(self.gate, self.experts, x)
 

@@ -39,6 +39,11 @@ class MoELMConfig:
     max_seq_len: int = 1024
     aux_weight: float = 0.01
     initializer_range: float = 0.02
+    # thread per-step routing observability (capacity-overflow fraction +
+    # expert-load entropy, layer-averaged) into the loss metrics, where
+    # the Trainer/Logger pick them up — the numbers that catch silent
+    # router collapse or capacity starvation (layers.moe.routing_stats)
+    log_routing_stats: bool = False
     dtype: object = jnp.float32
 
 
@@ -58,11 +63,14 @@ class MoEBlock(Module):
             capacity_factor=cfg.capacity_factor, mesh=mesh, dtype=cfg.dtype,
         ) if use_moe else None
 
-    def __call__(self, x, *, training: bool = False):
+    def __call__(self, x, *, training: bool = False,
+                 with_stats: bool = False):
         x = x + self.attn(self.ln1(x))
         if self.moe is None:
-            return x, jnp.float32(0.0)
-        y, aux = self.moe(self.ln2(x), training=training)
+            zero = jnp.float32(0.0)
+            return x, ((zero, None) if with_stats else zero)
+        y, aux = self.moe(self.ln2(x), training=training,
+                          with_stats=with_stats)
         return x + y, aux
 
 
@@ -83,17 +91,38 @@ class MoELM(Module):
         self.ln_f = LayerNorm(cfg.hidden_size)
         self.config = cfg
 
-    def __call__(self, input_ids, *, training: bool = False):
+    def __call__(self, input_ids, *, training: bool = False,
+                 with_stats: bool = False):
         s = input_ids.shape[-1]
         x = self.wte(input_ids) + self.wpe(jnp.arange(s))
         aux_total = 0.0
+        stats_acc, n_moe = None, 0
         for blk in self.blocks:
-            x, aux = blk(x, training=training)
+            x, aux = blk(x, training=training, with_stats=with_stats)
+            if with_stats:
+                aux, stats = aux
+                if stats is not None:
+                    n_moe += 1
+                    stats_acc = stats if stats_acc is None else {
+                        k: stats_acc[k] + v for k, v in stats.items()}
             aux_total = aux_total + aux
         x = self.ln_f(x)
-        return x @ self.wte.weight.T.astype(x.dtype), aux_total
+        logits = x @ self.wte.weight.T.astype(x.dtype)
+        if with_stats:
+            stats = ({k: v / n_moe for k, v in stats_acc.items()}
+                     if stats_acc else {})
+            return logits, (aux_total, stats)
+        return logits, aux_total
 
     def loss(self, input_ids, *, training: bool = True):
-        logits, aux = self(input_ids, training=training)
+        with_stats = self.config.log_routing_stats
+        out = self(input_ids, training=training, with_stats=with_stats)
+        metrics = {}
+        if with_stats:
+            logits, (aux, stats) = out
+            metrics.update(stats)  # overflow_frac, load_entropy
+        else:
+            logits, aux = out
         nll = softmax_cross_entropy_sparse(logits[:, :-1], input_ids[:, 1:])
-        return nll.mean() + self.config.aux_weight * aux, {"aux": aux}
+        metrics["aux"] = aux
+        return nll.mean() + self.config.aux_weight * aux, metrics

@@ -263,7 +263,8 @@ def test_scan_steps_matches_step_loop():
     b = batch()
     key = jax.random.key(7)
     run = tr_scan.scan_steps(4)
-    new_state, last_loss = run(tr_scan.state, b, key)
+    new_state, last_metrics = run(tr_scan.state, b, key)
+    last_loss = last_metrics["loss"]
     tr_scan.state = new_state
 
     k = key
@@ -280,8 +281,8 @@ def test_scan_steps_matches_step_loop():
     assert int(tr_scan.state.step) == 4
 
     # feeding the returned state back continues training (donation-safe)
-    st2, loss2 = run(tr_scan.state, b, key)
-    assert float(loss2) < float(last_loss) + 1e-6
+    st2, m2 = run(tr_scan.state, b, key)
+    assert float(m2["loss"]) < float(last_loss) + 1e-6
 
 
 def test_scan_steps_rejects_staged_embeddings():

@@ -333,10 +333,57 @@ def check_attn_layout():
     assert native < plain, "native path no longer beats the copy path"
 
 
+def check_moe64():
+    """Large-E dispatch on the chip (the r03 ROADMAP #3 measurement,
+    promoted to a tracked artifact): E=64 experts, T=4096 tokens,
+    d=1024, ffn 2048, fwd+bwd per step via the differenced scan; top-2
+    and SAM k=2 must stay in the same regime as r03 (18.2 / 12.2
+    ms/step) — no per-choice-scatter pathology at large E — and the
+    routing stats must show a live, bounded router."""
+    import jax
+    import jax.numpy as jnp
+    from bench import timed_scan_diff
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.layers.moe import (ExpertMLP, MoELayer, SAMGate, TopKGate,
+                                     routing_stats)
+    from hetu_tpu.optim import AdamOptimizer
+
+    T, d, ffn, E = 4096, 1024, 2048, 64
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((T, d)), jnp.bfloat16)
+
+    def loss_fn(m, b, k):
+        y, aux = m(b["x"])
+        return jnp.sum(y.astype(jnp.float32) ** 2) * 1e-3 + 1e-2 * aux, {}
+
+    for name, make_gate in (
+            ("top2", lambda: TopKGate(d, E, 2, capacity_factor=1.25,
+                                      dtype=jnp.bfloat16)),
+            ("sam_k2", lambda: SAMGate(d, E, 2, num_groups=8,
+                                       capacity_factor=1.25,
+                                       dtype=jnp.bfloat16))):
+        set_random_seed(0)
+        gate = make_gate()
+        moe = MoELayer(gate, ExpertMLP(E, d, ffn, dtype=jnp.bfloat16))
+        trainer = Trainer(moe, AdamOptimizer(1e-4), loss_fn)
+        t = timed_scan_diff(trainer, {"x": x}, k=5)
+        # the original module's buffers were donated into the scan; the
+        # live gate is the trainer's current state
+        plans, C, _ = trainer.state.model.gate.index_plan(x)
+        s = {k2: float(v) for k2, v in routing_stats(plans, E).items()}
+        print(f"  moe64 {name}: {t['median_s']*1e3:.1f} ms/step "
+              f"(spread {t['spread']}) overflow={s['overflow_frac']:.3f} "
+              f"entropy={s['load_entropy']:.3f}")
+        assert t["median_s"] < 0.040, f"{name}: large-E regression"
+        assert s["overflow_frac"] < 0.6 and s["load_entropy"] > 0.5, s
+
+
 CHECKS = {"flash": check_flash, "flash_time": check_flash_time,
           "ring": check_ring, "lm_head": check_lm_head,
           "bridge": check_bridge, "ctr": check_ctr, "hbm": check_hbm,
-          "step": check_step_time, "attn_layout": check_attn_layout}
+          "step": check_step_time, "attn_layout": check_attn_layout,
+          "moe64": check_moe64}
 
 
 def main():
