@@ -56,7 +56,7 @@ class TransformerBlock(Module):
     def __init__(self, dim: int, num_heads: int, mlp_ratio: int = 4, *,
                  causal: bool = False, post_ln: bool = False,
                  dropout_rate: float = 0.0, attn_fn=None, mlp=None,
-                 dtype=jnp.float32):
+                 fused_ln: bool = False, dtype=jnp.float32):
         self.ln1 = LayerNorm(dim)
         self.attn = MultiHeadAttention(
             dim, num_heads, causal=causal, dropout_rate=dropout_rate,
@@ -77,6 +77,16 @@ class TransformerBlock(Module):
             self._mlp_takes_training = False
         self.post_ln = post_ln
         self.dropout_rate = dropout_rate
+        # Pallas fused residual+dropout+LayerNorm for the post-LN sites
+        # (ops/pallas/fused_ln.py: one HBM pass per direction instead of
+        # XLA's separate stat/normalize/backward-reduction passes).
+        if fused_ln and not post_ln:
+            raise ValueError(
+                "fused_ln fuses the POST-LN residual+dropout+ln(x+y) "
+                "sites; a pre-LN block normalizes the sublayer input "
+                "(plain LN) and has nothing to fuse — drop the flag or "
+                "set post_ln=True")
+        self.fused_ln = fused_ln
 
     def _ffn(self, x, training):
         out = (self.mlp(x, training=training) if self._mlp_takes_training
@@ -88,6 +98,19 @@ class TransformerBlock(Module):
         if key is not None:
             ka, k1, k2 = jax.random.split(key, 3)
         if self.post_ln:
+            if self.fused_ln:
+                from hetu_tpu.ops.pallas.fused_ln import (
+                    fused_residual_dropout_ln)
+                rate = self.dropout_rate if training else 0.0
+                a = self.attn(x, mask, key=ka, training=training)
+                x = fused_residual_dropout_ln(
+                    x, a, self.ln1.scale, self.ln1.bias, rate=rate,
+                    key=k1, eps=self.ln1.eps)
+                y, aux = self._ffn(x, training)
+                x = fused_residual_dropout_ln(
+                    x, y, self.ln2.scale, self.ln2.bias, rate=rate,
+                    key=k2, eps=self.ln2.eps)
+                return x if aux is None else (x, aux)
             x = self.ln1(x + self._drop(self.attn(x, mask, key=ka, training=training), k1, training))
             y, aux = self._ffn(x, training)
             x = self.ln2(x + self._drop(y, k2, training))

@@ -52,6 +52,10 @@ class BertConfig:
     # / long sequences (ops.lm_head_cross_entropy; where the logits fit,
     # the materialized path is faster)
     streamed_head_chunk: int = 0
+    # Pallas fused residual+dropout+LayerNorm at the post-LN sites (one
+    # HBM pass per direction; see ops/pallas/fused_ln.py).  Off by
+    # default: measured per-config on TPU before enabling in a bench
+    fused_ln: bool = False
     dtype: object = jnp.float32
 
 
@@ -92,7 +96,7 @@ class BertModel(Module):
             TransformerBlock(
                 cfg.hidden_size, cfg.num_heads, cfg.intermediate_ratio,
                 post_ln=True, dropout_rate=cfg.dropout_rate, attn_fn=attn_fn,
-                dtype=cfg.dtype,
+                fused_ln=cfg.fused_ln, dtype=cfg.dtype,
             )
             for _ in range(cfg.num_layers)
         ]

@@ -199,15 +199,25 @@ def _hash_bits(key, shape):
     return x.reshape(shape)
 
 
+def dropout_keep_thresh(rate: float) -> int:
+    """The uint32 keep threshold ``_hash_bits(key, shape) < thresh`` that
+    defines this framework's dropout bits — ONE source of truth shared by
+    ``dropout`` and the fused Pallas residual+dropout+LN kernel
+    (ops/pallas/fused_ln.py regenerates the identical mask in-kernel).
+    Clamped: keep*2^32 can round to exactly 2^32 in double for rates
+    below ~1e-16, and the uint32 cast would wrap to 0 (dropping
+    EVERYTHING)."""
+    keep = 1.0 - rate
+    return int(min(keep * 4294967296.0, 4294967295.0))
+
+
 def dropout(x, rate: float, key, *, training: bool = True):
     """Inverted dropout (src/ops/Dropout.cu) with a counter-hash mask
     (see _hash_bits for why not threefry)."""
     if not training or rate == 0.0:
         return x
     keep = 1.0 - rate
-    # clamp: keep*2^32 can round to exactly 2^32 in double for rates below
-    # ~1e-16, and the uint32 cast would wrap to 0 (dropping EVERYTHING)
-    thresh = jnp.uint32(min(keep * 4294967296.0, 4294967295.0))
+    thresh = jnp.uint32(dropout_keep_thresh(rate))
     mask = _hash_bits(key, x.shape) < thresh
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 

@@ -1,0 +1,151 @@
+"""Fused residual+dropout+LayerNorm kernel vs the composed oracle.
+
+Oracle-comparison style (reference tests compare CUDA kernels vs numpy);
+kernels run under the Pallas interpreter on CPU.  The fused kernel's
+dropout regenerates ops.dropout's exact bits in-register, so the oracle
+is literally ``layer_norm(x + ops.dropout(y, rate, key))``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.ops.nn import dropout, layer_norm
+from hetu_tpu.ops.pallas.fused_ln import fused_residual_dropout_ln
+
+
+def _case(shape, D, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((*shape, D)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((*shape, D)), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(D), jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(D), jnp.float32)
+    return x, y, scale, bias
+
+
+def _oracle(x, y, scale, bias, rate, key):
+    v = x + (dropout(y, rate, key) if rate > 0.0 and key is not None else y)
+    return layer_norm(v, scale, bias, eps=1e-5)
+
+
+@pytest.mark.parametrize("shape,D", [((4, 32), 256), ((16,), 512),
+                                     ((2, 3, 8), 128)])
+@pytest.mark.parametrize("rate", [0.0, 0.1])
+def test_fused_ln_forward_bit_parity(shape, D, rate):
+    """Same bits as ops.dropout + ops.layer_norm — the in-kernel hash
+    regen must reproduce the mask exactly."""
+    x, y, scale, bias = _case(shape, D)
+    key = jax.random.key(11)
+    out = fused_residual_dropout_ln(x, y, scale, bias, rate=rate, key=key,
+                                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_oracle(x, y, scale, bias, rate, key)),
+        rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("rate", [0.0, 0.1])
+def test_fused_ln_grads(rate):
+    x, y, scale, bias = _case((4, 16), 256, seed=3)
+    key = jax.random.key(4)
+
+    def loss_fused(x, y, scale, bias):
+        o = fused_residual_dropout_ln(x, y, scale, bias, rate=rate,
+                                      key=key, interpret=True)
+        return jnp.sum(o * jnp.cos(o))  # nontrivial cotangent
+
+    def loss_ref(x, y, scale, bias):
+        o = _oracle(x, y, scale, bias, rate, key)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, y, scale, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, y, scale, bias)
+    for a, b, name in zip(gf, gr, ("dx", "dy", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_fused_ln_bf16_and_ragged_rows():
+    """bf16 activations with fp32 stats; a row count that does not divide
+    the preferred block (exercises _pick_block's gcd fallback).  bf16 is
+    allclose, not bitwise: the fused path keeps the residual sum in fp32
+    (the unfused path rounds it to bf16 before the LN)."""
+    x, y, scale, bias = _case((7, 13), 128, seed=5)
+    x, y = x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    key = jax.random.key(6)
+    out = fused_residual_dropout_ln(x, y, scale, bias, rate=0.2, key=key,
+                                    interpret=True)
+    ref = _oracle(x, y, scale, bias, 0.2, key)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_transformer_block_fused_ln_matches_unfused():
+    """A post-LN TransformerBlock with fused_ln=True computes the same
+    function as the unfused path — eval mode exactly, train mode with
+    dropout ON too (the fused kernel regenerates ops.dropout's bits)."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.layers import TransformerBlock
+
+    set_random_seed(0)
+    blk = TransformerBlock(128, 4, post_ln=True, dropout_rate=0.1)
+    set_random_seed(0)
+    blk_f = TransformerBlock(128, 4, post_ln=True, dropout_rate=0.1,
+                             fused_ln=True)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((2, 16, 128)), jnp.float32)
+
+    # eval: deterministic, must agree
+    np.testing.assert_allclose(np.asarray(blk_f(x)), np.asarray(blk(x)),
+                               rtol=2e-5, atol=2e-5)
+
+    # train with a fixed key: same dropout bits -> same output and grads
+    key = jax.random.key(3)
+    ref = blk(x, key=key, training=True)
+    out = blk_f(x, key=key, training=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    gref = jax.grad(lambda m: (m(x, key=key, training=True) ** 2).sum())(blk)
+    gout = jax.grad(lambda m: (m(x, key=key, training=True) ** 2).sum())(blk_f)
+    for a, b in zip(jax.tree_util.tree_leaves(gout),
+                    jax.tree_util.tree_leaves(gref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_fused_ln_rejects_pre_ln_block():
+    from hetu_tpu.layers import TransformerBlock
+
+    with pytest.raises(ValueError, match="post_ln"):
+        TransformerBlock(64, 2, fused_ln=True)  # default pre-LN
+
+
+def test_bert_fused_ln_trains():
+    """BertForPreTraining(fused_ln=True) trains: loss drops through the
+    fused kernel's custom vjp."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import BertForPreTraining, bert_base
+    from hetu_tpu.optim import AdamWOptimizer
+
+    set_random_seed(0)
+    cfg = bert_base(num_layers=2, hidden_size=128, num_heads=2,
+                    vocab_size=256, fused_ln=True)
+    tr = Trainer(BertForPreTraining(cfg),
+                 AdamWOptimizer(1e-3, weight_decay=0.01),
+                 lambda m, b, k: (m.loss(b["ids"], b["tt"], None, b["mlm"],
+                                         b["nsp"], key=k,
+                                         training=True)[0], {}))
+    rng = np.random.default_rng(0)
+    B, S = 4, 32
+    b = {"ids": jnp.asarray(rng.integers(0, 256, (B, S)), jnp.int32),
+         "tt": jnp.zeros((B, S), jnp.int32),
+         "mlm": jnp.asarray(np.where(rng.random((B, S)) < 0.3,
+                                     rng.integers(0, 256, (B, S)), -1),
+                            jnp.int32),
+         "nsp": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)}
+    losses = [float(tr.step(b)["loss"]) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9, losses
