@@ -204,14 +204,21 @@ def fused_residual_dropout_ln(x, y, scale, bias, *, rate: float = 0.0,
     D = x.shape[-1]
     if not interpret and D % 128:
         raise ValueError(f"fused LN needs D % 128 == 0 on TPU, got {D}")
+    if not 0.0 <= rate < 1.0:
+        # rate=1.0 would make the keep threshold 0, which the kernels'
+        # thresh sentinel reads as "no dropout" — the opposite semantics;
+        # ops.dropout at rate 1 drops everything.  Nobody trains at
+        # rate>=1, so reject instead of special-casing the sentinel.
+        raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
     if key is None:
         rate = 0.0
     if rate > 0.0:
         kd = jax.random.key_data(key) if jax.dtypes.issubdtype(
             key.dtype, jax.dtypes.prng_key) else key
-        kw = kd.astype(jnp.uint32).reshape(-1)[:2].reshape(1, 2)
-        if kw.size < 2:
-            kw = jnp.concatenate([kw, kw], axis=1)[:, :2]
+        kw = kd.astype(jnp.uint32).reshape(-1)
+        if kw.size < 2:  # 1-word raw key: ops.dropout folds words[1 % 1]
+            kw = jnp.concatenate([kw, kw])
+        kw = kw[:2].reshape(1, 2)
     else:
         kw = jnp.zeros((1, 2), jnp.uint32)
     lead = x.shape[:-1]

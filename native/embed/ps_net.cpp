@@ -238,6 +238,8 @@ struct GraphStore {
   // seed for reproducible sampling
   std::mt19937_64 rng{std::random_device{}()};
 
+  std::atomic<uint64_t> last_used{0};  // server LRU clock at last touch
+
   // the server must never trust client-supplied CSR: monotone indptr
   // bounded by indices.size() is what keeps sample/edge scans in bounds
   bool validate() const {
@@ -271,6 +273,16 @@ struct Server {
     int64_t mb = v ? std::atoll(v) : 4096;
     return (mb > 0 ? mb : 4096) * (int64_t(1) << 20);
   }();
+  // HETU_PS_GRAPH_EVICT=1: an over-budget upload evicts least-recently-
+  // SAMPLED ready graphs instead of failing with -7.  Opt-in: auto
+  // eviction invalidates other clients' graph ids (their next sample
+  // gets -2 and they must re-upload), which only a long-lived shared
+  // server with re-uploadable graphs wants
+  bool graph_auto_evict = [] {
+    const char* v = std::getenv("HETU_PS_GRAPH_EVICT");
+    return v && v[0] == '1';
+  }();
+  std::atomic<uint64_t> graph_tick{0};  // LRU clock (sample/edges/commit)
   std::atomic<bool> record{false};            // per-row touch recording
   std::condition_variable barrier_cv;
   std::vector<int> conn_fds;
@@ -634,6 +646,34 @@ struct Server {
             if (kind != 2 && off == 0) {
               int64_t& acct = kind == 0 ? gp->acct_indptr
                                         : gp->acct_indices;
+              // eviction can only help if the upload fits with EVERY
+              // other graph gone (this graph keeps its other array's
+              // reservation); otherwise evicting would destroy other
+              // clients' graphs and still fail -7
+              int64_t own_other = kind == 0 ? gp->acct_indices
+                                            : gp->acct_indptr;
+              bool can_ever_fit = total * 8 + own_other
+                                  <= graph_budget_bytes;
+              while (graph_bytes - acct + total * 8 > graph_budget_bytes
+                     && graph_auto_evict && can_ever_fit) {
+                // evict the least-recently-sampled READY graph (never the
+                // one being uploaded); evicted ids answer -2 afterwards.
+                // `it` stays valid: std::map erase only invalidates the
+                // erased iterator, and the victim is never h.table_id
+                auto victim = graphs.end();
+                for (auto jt = graphs.begin(); jt != graphs.end(); ++jt) {
+                  if (jt->first == h.table_id || !jt->second->ready)
+                    continue;
+                  if (victim == graphs.end() ||
+                      jt->second->last_used.load() <
+                          victim->second->last_used.load())
+                    victim = jt;
+                }
+                if (victim == graphs.end()) break;  // nothing evictable
+                graph_bytes -= victim->second->acct_indptr +
+                               victim->second->acct_indices;
+                graphs.erase(victim);
+              }
               if (graph_bytes - acct + total * 8 > graph_budget_bytes) {
                 resp.status = -7;  // over budget: drop a graph first
                 if (created) graphs.erase(it);  // no dead empty entry: the
@@ -649,6 +689,8 @@ struct Server {
             if (m >= 1)  // explicit seed (any value incl. 0): reproducible
               gp->rng.seed(static_cast<uint64_t>(keys[3]));
             gp->ready = gp->validate();
+            // a freshly-committed graph is MRU, not instantly evictable
+            gp->last_used.store(graph_tick.fetch_add(1) + 1);
             resp.status = gp->ready ? 0 : -6;
             break;
           }
@@ -684,6 +726,7 @@ struct Server {
             auto it = graphs.find(h.table_id);
             if (it == graphs.end()) { resp.status = -2; break; }
             g = it->second;
+            g->last_used.store(graph_tick.fetch_add(1) + 1);  // LRU touch
           }
           // fanout bounded FIRST: an unbounded keys[0] would overflow the
           // product check and then drive the emit loop to exhaust memory
@@ -730,6 +773,7 @@ struct Server {
             auto it = graphs.find(h.table_id);
             if (it == graphs.end()) { resp.status = -2; break; }
             g = it->second;
+            g->last_used.store(graph_tick.fetch_add(1) + 1);  // LRU touch
           }
           std::unordered_set<int64_t> want(keys.begin(), keys.end());
           auto put_u64 = [&](uint64_t v) {

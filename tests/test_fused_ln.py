@@ -149,3 +149,17 @@ def test_bert_fused_ln_trains():
          "nsp": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)}
     losses = [float(tr.step(b)["loss"]) for _ in range(20)]
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_fused_ln_rejects_rate_one_and_single_word_key():
+    x, y, scale, bias = _case((2, 4), 128, seed=8)
+    with pytest.raises(ValueError, match="rate"):
+        fused_residual_dropout_ln(x, y, scale, bias, rate=1.0,
+                                  key=jax.random.key(0), interpret=True)
+    # raw single-word key: folded like ops.dropout's words[1 % 1]
+    kw1 = jnp.asarray([7], jnp.uint32)
+    out = fused_residual_dropout_ln(x, y, scale, bias, rate=0.2, key=kw1,
+                                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_oracle(x, y, scale, bias, 0.2, kw1)),
+        rtol=2e-5, atol=2e-5)

@@ -321,3 +321,59 @@ def test_remote_graph_reproducible_seed():
 
     a, b = draws(1234), draws(1234)
     np.testing.assert_array_equal(a, b)  # same seed -> same stream
+
+
+def test_remote_graph_auto_eviction_lru():
+    """HETU_PS_GRAPH_EVICT=1: an over-budget upload evicts the least-
+    recently-SAMPLED ready graph instead of failing; the recently-used
+    graph survives, the evicted id answers -2 (client re-uploads)."""
+    import os
+
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+
+    a = random_graph(n=500, e=25_000, seed=6)    # ~0.2 MB
+    bgr = random_graph(n=500, e=25_000, seed=7)  # ~0.2 MB
+    c = random_graph(n=1000, e=80_000, seed=8)   # ~0.65 MB
+    os.environ["HETU_PS_GRAPH_BUDGET_MB"] = "1"
+    os.environ["HETU_PS_GRAPH_EVICT"] = "1"
+    try:
+        with EmbeddingServer() as srv:
+            addr = f"127.0.0.1:{srv.port}"
+            ga = RemoteGraph(addr, 11, a, num_nodes=500)
+            gb = RemoteGraph(addr, 12, bgr, num_nodes=500)
+            ga.sample([0], fanout=2)  # ga is now MORE recent than gb
+            gc = RemoteGraph(addr, 13, c, num_nodes=1000)  # evicts gb (LRU)
+            assert gc.sample([3], fanout=4).shape == (1, 4)
+            assert ga.sample([1], fanout=2).shape == (1, 2)  # survivor
+            with pytest.raises(RuntimeError, match="-2"):
+                gb.sample([0], fanout=2)  # evicted: client must re-upload
+    finally:
+        del os.environ["HETU_PS_GRAPH_BUDGET_MB"]
+        del os.environ["HETU_PS_GRAPH_EVICT"]
+
+
+def test_remote_graph_no_win_eviction_refused():
+    """An upload that can NEVER fit must not evict anything: other
+    clients' graphs survive and the upload fails -7 (review finding,
+    round 4)."""
+    import os
+
+    from hetu_tpu.embed.graph import RemoteGraph
+    from hetu_tpu.embed.net import EmbeddingServer
+
+    a = random_graph(n=500, e=25_000, seed=9)
+    huge = random_graph(n=1000, e=200_000, seed=10)  # ~1.6 MB > budget
+    os.environ["HETU_PS_GRAPH_BUDGET_MB"] = "1"
+    os.environ["HETU_PS_GRAPH_EVICT"] = "1"
+    try:
+        with EmbeddingServer() as srv:
+            addr = f"127.0.0.1:{srv.port}"
+            ga = RemoteGraph(addr, 21, a, num_nodes=500)
+            with pytest.raises(RuntimeError, match="-7"):
+                RemoteGraph(addr, 22, huge, num_nodes=1000)
+            # the resident graph was NOT sacrificed for a doomed upload
+            assert ga.sample([0], fanout=2).shape == (1, 2)
+    finally:
+        del os.environ["HETU_PS_GRAPH_BUDGET_MB"]
+        del os.environ["HETU_PS_GRAPH_EVICT"]
