@@ -39,6 +39,10 @@ def main():
                     help="host cache capacity (rows); 0 = uncached")
     ap.add_argument("--policy", choices=["lru", "lfu", "lfuopt"],
                     default="lfuopt")
+    ap.add_argument("--reconnect", type=int, default=0,
+                    help="PS fault tolerance for --embedding remote (uncached):\nretry dead sockets this many times with backoff")
+    ap.add_argument("--restore-path", default=None,
+                    help="server-side checkpoint reloaded after a PS restart;\nwrite it periodically with model.embed.save(path)")
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--batch", type=int, default=512)
     args = ap.parse_args()
@@ -58,7 +62,9 @@ def main():
             print(f"spawned local embedding servers: {servers}")
     cfg = CTRConfig(vocab=26000, embed_dim=16, embedding=args.embedding,
                     cache_capacity=args.cache, cache_policy=args.policy,
-                    host_optimizer="adagrad", host_lr=0.05, servers=servers)
+                    host_optimizer="adagrad", host_lr=0.05, servers=servers,
+                    reconnect_attempts=args.reconnect,
+                    restore_path=args.restore_path)
     model = MODELS[args.model](cfg)
     # real Criteo TSV when datasets/criteo/train.txt exists; synthetic
     # otherwise.  Small real files are tiled so the batch-rotation modulo

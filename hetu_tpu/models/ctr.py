@@ -40,7 +40,8 @@ class CTRConfig:
                  cache_capacity: int = 0, cache_policy: str = "lru",
                  pull_bound: int = 0, push_bound: int = 0,
                  host_bridge: str = "auto", host_async_push: bool = False,
-                 servers=None):
+                 servers=None, reconnect_attempts: int = 0,
+                 restore_path: str | None = None):
         self.dense_dim = dense_dim
         self.sparse_fields = sparse_fields
         self.vocab = vocab
@@ -61,6 +62,20 @@ class CTRConfig:
         # default bsp=-1, executor.py:203); staged bridge only
         self.host_async_push = host_async_push
         self.servers = list(servers) if servers else []  # embedding="remote"
+        # PS fault tolerance (embedding="remote", uncached): reconnect
+        # with bounded backoff + checkpoint restore on server restart
+        # (embed.net.RemoteEmbeddingTable)
+        if restore_path is not None and reconnect_attempts <= 0:
+            raise ValueError(
+                "restore_path only takes effect during a reconnect — set "
+                "reconnect_attempts > 0 or the checkpoint would silently "
+                "never be restored after a PS restart")
+        if reconnect_attempts > 0 and embedding != "remote":
+            raise ValueError(
+                'reconnect_attempts is the network-PS fault-tolerance '
+                'knob: it needs embedding="remote"')
+        self.reconnect_attempts = reconnect_attempts
+        self.restore_path = restore_path
 
 
 def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
@@ -75,7 +90,9 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
             cfg.vocab, dim, servers=cfg.servers,
             optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed,
             cache_capacity=cfg.cache_capacity, policy=cfg.cache_policy,
-            pull_bound=cfg.pull_bound, push_bound=cfg.push_bound)
+            pull_bound=cfg.pull_bound, push_bound=cfg.push_bound,
+            reconnect_attempts=cfg.reconnect_attempts,
+            restore_path=cfg.restore_path)
     if cfg.embedding == "hbm":
         # host store + hot rows staged into device HBM (the north-star
         # layout; warm steps transfer only refreshed rows).  The device

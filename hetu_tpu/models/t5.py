@@ -70,8 +70,13 @@ def relative_position_bucket(relative_position, *, bidirectional: bool,
         n = jnp.maximum(n, 0)
     max_exact = num_buckets // 2
     is_small = n < max_exact
+    # max(n, 1) keeps the log defined where the large branch is DISCARDED
+    # (n < max_exact selects is_small); for selected positions n >=
+    # max_exact >= 1, so the values match the reference epsilon-free
+    # formula exactly (an additive epsilon can flip a bucket at a
+    # boundary)
     val_if_large = max_exact + (
-        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
         / jnp.log(max_distance / max_exact)
         * (num_buckets - max_exact)
     ).astype(jnp.int32)

@@ -1,11 +1,11 @@
-"""GPT and ViT parity vs independent PyTorch oracles.
+"""GPT, ViT, and T5 parity vs independent PyTorch oracles.
 
 Extends the BERT torch-oracle harness (test_torch_oracle.py) to the other
-two flagship families, matching the reference's hetu-vs-pytorch model
+flagship families, matching the reference's hetu-vs-pytorch model
 checks (examples/nlp/bert/scripts/test_glue_bert_base.sh pattern applied
 per model family).  Each torch twin is written from the architecture
-description (pre-LN transformer / ViT paper), NOT translated from
-hetu_tpu; our weights are ported in and we assert
+description (pre-LN transformer / ViT paper / T5 paper+HF semantics),
+NOT translated from hetu_tpu; our weights are ported in and we assert
 
   1. forward logits match (fp32, tight tolerance),
   2. gradients of the training loss match at step 0 (autograd oracle).
@@ -217,3 +217,176 @@ def test_vit_forward_and_gradient_parity():
     _grad_close(g.blocks[0].attn.wqkv, tm.blocks[0].qkv.weight.grad.T,
                 "block0.wqkv")
     _grad_close(g.head.w, tm.head.weight.grad.T, "head.w")
+
+
+class TorchT5Block(torch.nn.Module):
+    """One T5 block (self-attn [+ cross-attn] + relu MLP, RMS pre-norm,
+    bias-free, unscaled QK^T) written from the T5 paper / HF semantics."""
+
+    def __init__(self, d_model, heads, d_kv, d_ff, decoder):
+        super().__init__()
+        n = torch.nn
+        inner = heads * d_kv
+        self.ln1_w = n.Parameter(torch.ones(d_model))
+        self.wq = n.Linear(d_model, inner, bias=False)
+        self.wk = n.Linear(d_model, inner, bias=False)
+        self.wv = n.Linear(d_model, inner, bias=False)
+        self.wo = n.Linear(inner, d_model, bias=False)
+        self.decoder = decoder
+        if decoder:
+            self.cln_w = n.Parameter(torch.ones(d_model))
+            self.cq = n.Linear(d_model, inner, bias=False)
+            self.ck = n.Linear(d_model, inner, bias=False)
+            self.cv = n.Linear(d_model, inner, bias=False)
+            self.co = n.Linear(inner, d_model, bias=False)
+        self.ln2_w = n.Parameter(torch.ones(d_model))
+        self.mlp_in = n.Linear(d_model, d_ff, bias=False)
+        self.mlp_out = n.Linear(d_ff, d_model, bias=False)
+        self.heads, self.d_kv = heads, d_kv
+
+    @staticmethod
+    def rms(x, w, eps=1e-6):
+        return x * torch.rsqrt(x.pow(2).mean(-1, keepdim=True) + eps) * w
+
+    def attend(self, q, k, v, wo, bias=None, causal=False):
+        b, qs, _ = q.shape
+        ks = k.shape[1]
+        H, D = self.heads, self.d_kv
+        q = q.view(b, qs, H, D).transpose(1, 2)
+        k = k.view(b, ks, H, D).transpose(1, 2)
+        v = v.view(b, ks, H, D).transpose(1, 2)
+        lg = q @ k.transpose(-1, -2)  # UNSCALED (T5 folds into init)
+        if bias is not None:
+            lg = lg + bias
+        if causal:
+            m = torch.tril(torch.ones(qs, ks, dtype=torch.bool))
+            lg = lg.masked_fill(~m, -1e30)
+        p = torch.softmax(lg, dim=-1)
+        return wo((p @ v).transpose(1, 2).reshape(b, qs, H * D))
+
+    def forward(self, x, enc=None, bias=None):
+        h = self.rms(x, self.ln1_w)
+        x = x + self.attend(self.wq(h), self.wk(h), self.wv(h), self.wo,
+                            bias=bias, causal=self.decoder)
+        if self.decoder and enc is not None:
+            h = self.rms(x, self.cln_w)
+            x = x + self.attend(self.cq(h), self.ck(enc), self.cv(enc),
+                                self.co)
+        h = self.rms(x, self.ln2_w)
+        return x + self.mlp_out(torch.relu(self.mlp_in(h)))
+
+
+class TorchT5(torch.nn.Module):
+    def __init__(self, V, d_model, heads, d_kv, d_ff, layers, buckets,
+                 maxdist):
+        super().__init__()
+        n = torch.nn
+        self.shared = n.Embedding(V, d_model)
+        self.enc_bias = n.Parameter(torch.zeros(buckets, heads))
+        self.dec_bias = n.Parameter(torch.zeros(buckets, heads))
+        self.enc = n.ModuleList([TorchT5Block(d_model, heads, d_kv, d_ff,
+                                              False) for _ in range(layers)])
+        self.dec = n.ModuleList([TorchT5Block(d_model, heads, d_kv, d_ff,
+                                              True) for _ in range(layers)])
+        self.enc_ln = n.Parameter(torch.ones(d_model))
+        self.dec_ln = n.Parameter(torch.ones(d_model))
+        self.buckets, self.maxdist, self.d_model = buckets, maxdist, d_model
+
+    def _bucket(self, rel, bidirectional):
+        nb = self.buckets
+        ret = torch.zeros_like(rel)
+        n = -rel
+        if bidirectional:
+            nb //= 2
+            ret = ret + (n < 0).long() * nb
+            n = n.abs()
+        else:
+            n = n.clamp(min=0)
+        me = nb // 2
+        small = n < me
+        # HF's epsilon-FREE formula (clamp(min=1) only keeps log defined
+        # where the branch is discarded) — an oracle sharing an epsilon
+        # quirk could not detect a boundary-bucket divergence
+        large = me + (torch.log(n.clamp(min=1).float() / me)
+                      / np.log(self.maxdist / me)
+                      * (nb - me)).long()
+        large = large.clamp(max=nb - 1)
+        return ret + torch.where(small, n, large)
+
+    def _bias(self, table, s, bidirectional):
+        pos = torch.arange(s)
+        bucket = self._bucket(pos[None, :] - pos[:, None], bidirectional)
+        return table[bucket].permute(2, 0, 1)[None]
+
+    def forward(self, ids, dec_ids):
+        eb = self._bias(self.enc_bias, ids.shape[1], True)
+        db = self._bias(self.dec_bias, dec_ids.shape[1], False)
+        x = self.shared(ids)
+        for blk in self.enc:
+            x = blk(x, bias=eb)
+        enc = TorchT5Block.rms(x, self.enc_ln)
+        y = self.shared(dec_ids)
+        for blk in self.dec:
+            y = blk(y, enc=enc, bias=db)
+        y = TorchT5Block.rms(y, self.dec_ln) * self.d_model ** -0.5
+        return y @ self.shared.weight.T  # tied, rescaled head
+
+
+def test_t5_forward_and_gradient_parity():
+    from hetu_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    V, DM, H, DKV, DFF, L, SB, SD, B = 96, 64, 4, 16, 128, 2, 12, 10, 4
+    set_random_seed(0)
+    cfg = T5Config(vocab_size=V, d_model=DM, d_kv=DKV, d_ff=DFF,
+                   num_layers=L, num_heads=H, dropout_rate=0.0)
+    ours = T5ForConditionalGeneration(cfg)
+    tm = TorchT5(V, DM, H, DKV, DFF, L, cfg.relative_buckets,
+                 cfg.relative_max_distance)
+    with torch.no_grad():
+        tm.shared.weight.copy_(_t(ours.t5.shared.weight))
+        tm.enc_bias.copy_(_t(ours.t5.encoder.rel_bias.table))
+        tm.dec_bias.copy_(_t(ours.t5.decoder.rel_bias.table))
+        tm.enc_ln.copy_(_t(ours.t5.encoder.final_ln.scale))
+        tm.dec_ln.copy_(_t(ours.t5.decoder.final_ln.scale))
+        for src, dst in ((ours.t5.encoder.blocks, tm.enc),
+                         (ours.t5.decoder.blocks, tm.dec)):
+            for blk, tb in zip(src, dst):
+                tb.ln1_w.copy_(_t(blk.ln1.scale))
+                tb.wq.weight.copy_(_t(blk.attn.wq).T)
+                tb.wk.weight.copy_(_t(blk.attn.wk).T)
+                tb.wv.weight.copy_(_t(blk.attn.wv).T)
+                tb.wo.weight.copy_(_t(blk.attn.wo).T)
+                if tb.decoder:
+                    tb.cln_w.copy_(_t(blk.cross_ln.scale))
+                    tb.cq.weight.copy_(_t(blk.cross.wq).T)
+                    tb.ck.weight.copy_(_t(blk.cross.wk).T)
+                    tb.cv.weight.copy_(_t(blk.cross.wv).T)
+                    tb.co.weight.copy_(_t(blk.cross.wo).T)
+                tb.ln2_w.copy_(_t(blk.ln2.scale))
+                tb.mlp_in.weight.copy_(_t(blk.mlp.w_in).T)
+                tb.mlp_out.weight.copy_(_t(blk.mlp.w_out).T)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, V, (B, SB))
+    dec = rng.integers(0, V, (B, SD))
+    lbl = rng.integers(0, V, (B, SD))
+
+    logits_j = np.asarray(ours(jnp.asarray(ids, jnp.int32),
+                               jnp.asarray(dec, jnp.int32)))
+    logits_t = tm(torch.from_numpy(ids), torch.from_numpy(dec))
+    np.testing.assert_allclose(logits_j, logits_t.detach().numpy(),
+                               rtol=3e-4, atol=3e-4)
+
+    g = jax.grad(lambda m: m.loss(jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(dec, jnp.int32),
+                                  jnp.asarray(lbl, jnp.int32),
+                                  training=False)[0])(ours)
+    lt = torch.nn.functional.cross_entropy(
+        logits_t.reshape(-1, V), torch.from_numpy(lbl.reshape(-1)))
+    lt.backward()
+    _grad_close(g.t5.encoder.rel_bias.table, tm.enc_bias.grad, "enc_bias")
+    _grad_close(g.t5.decoder.blocks[0].cross.wk,
+                tm.dec[0].ck.weight.grad.T, "dec0.cross.wk")
+    _grad_close(g.t5.encoder.blocks[1].mlp.w_in,
+                tm.enc[1].mlp_in.weight.grad.T, "enc1.mlp_in")
+    _grad_close(g.t5.shared.weight, tm.shared.weight.grad, "shared(tied)")
