@@ -2,12 +2,7 @@
 op category, specifically hunting the attention relayout copies (ROADMAP
 4b).  Usage: python examples/profile_attn_trace.py [native01] [seq]."""
 
-import glob
-import gzip
-import json
-import os
 import sys
-from collections import defaultdict
 
 sys.path.insert(0, ".")
 
@@ -27,43 +22,17 @@ def trace_step(native: bool, seq: int, outdir: str):
 
 
 def summarize(outdir: str, top: int = 28):
-    paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
-                      recursive=True)
-    assert paths, f"no trace under {outdir}"
-    with gzip.open(sorted(paths)[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
-    # device events live on pids whose process_name mentions the TPU/
-    # TensorCore; everything else is host python / runtime
-    dev_pids = set()
-    for ev in events:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            pname = ev.get("args", {}).get("name", "")
-            if any(s in pname for s in ("TPU", "Tensor", "Device", "/device")):
-                dev_pids.add(ev.get("pid"))
-    by_name = defaultdict(float)
-    for ev in events:
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        if dev_pids and ev.get("pid") not in dev_pids:
-            continue
-        args = ev.get("args", {})
-        name = args.get("deduplicated_name") or ev.get("name", "")
-        if (not name or name.isdigit() or name.startswith(("$", "jit_"))
-                or "(" in name):
-            continue  # program envelopes / host frames
-        by_name[name] += ev["dur"]
-    total = sum(by_name.values())
-    print(f"device pids: {sorted(dev_pids)}; "
-          f"accounted {total/3e3:.2f} ms/step")
-    for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:top]:
-        print(f"  {dur/3e3:9.3f} ms/step  {name[:110]}")
-    copies = {n: d for n, d in by_name.items()
-              if "copy" in n.lower() or "transpose" in n.lower()}
-    print(f"copy/transpose-named total: "
-          f"{sum(copies.values())/3e3:.2f} ms/step over {len(copies)} ops")
+    from hetu_tpu.exec.profiler import device_op_breakdown
+
+    per, totals = device_op_breakdown(outdir, steps=3)
+    print(f"accounted {totals['device_s']*1e3:.2f} ms/step "
+          f"(copies {totals['copy_s']*1e3:.2f} ms)")
+    for name, dur in list(per.items())[:top]:
+        print(f"  {dur*1e3:9.3f} ms/step  {name[:110]}")
+    copies = {n: d for n, d in per.items()
+              if n.startswith(("copy.", "copy_fusion"))}
     for n, d in sorted(copies.items(), key=lambda kv: -kv[1])[:10]:
-        print(f"    {d/3e3:8.3f} ms/step  {n[:100]}")
+        print(f"    {d*1e3:8.3f} ms/step  {n[:100]}")
 
 
 if __name__ == "__main__":

@@ -1,28 +1,24 @@
 """Non-MXU breakdown of the headline BERT-large seq-128 train step.
 
-At 0.633 MFU, ~37% of the 201 ms step is not matmul; this script traces 3
-steps and buckets device time by op category (fusion names + HLO-ish
-prefixes) so the residue (dropout RNG, LM-head CE, embedding, layernorm,
-optimizer) is ranked, published in ROADMAP, and attackable.
+Traces 3 steps and ranks device time per deduplicated op via
+``exec.profiler.device_op_breakdown`` so the residue (dropout RNG,
+LM-head CE, embedding, layernorm, optimizer) is attackable; the ROADMAP
+4c table came from this.
 
 Usage: python examples/profile_bert128_breakdown.py [batch] [seq]
 """
 
-import glob
-import gzip
-import json
 import sys
 import tempfile
-from collections import defaultdict
 
 sys.path.insert(0, ".")
 
 import jax
-import numpy as np
 
 
 def main():
     from examples.profile_attn_layout import build_trainer
+    from hetu_tpu.exec.profiler import device_op_breakdown
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 96
     seq = int(sys.argv[2]) if len(sys.argv) > 2 else 128
@@ -37,31 +33,11 @@ def main():
             m = trainer.step(b, key=key)
         float(m["loss"])
 
-    path = sorted(glob.glob(outdir + "/**/*.trace.json.gz",
-                            recursive=True))[-1]
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
-    dev_pids = {ev.get("pid") for ev in events
-                if ev.get("ph") == "M" and ev.get("name") == "process_name"
-                and any(s in ev.get("args", {}).get("name", "")
-                        for s in ("TPU", "Tensor", "Device"))}
-    by_name = defaultdict(float)
-    for ev in events:
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        if dev_pids and ev.get("pid") not in dev_pids:
-            continue
-        name = (ev.get("args", {}).get("deduplicated_name")
-                or ev.get("name", ""))
-        if (not name or name.isdigit() or name.startswith(("$", "jit_"))
-                or "(" in name):
-            continue
-        by_name[name] += ev["dur"]
-    total = sum(by_name.values()) / 3e3
-    print(f"accounted {total:.1f} ms/step over {len(by_name)} op names")
-    for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:40]:
-        print(f"  {dur/3e3:8.3f} ms  {name[:100]}")
+    per, totals = device_op_breakdown(outdir, steps=3, top=40)
+    print(f"accounted {totals['device_s']*1e3:.1f} ms/step "
+          f"(copies {totals['copy_s']*1e3:.2f} ms)")
+    for name, dur in per.items():
+        print(f"  {dur*1e3:8.3f} ms  {name[:100]}")
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ Reference surfaces being covered (SURVEY §5.1):
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from collections import defaultdict
 from typing import Any, Callable, Optional
@@ -22,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["HetuTimer", "profile_fn", "compiled_cost", "primitive_counts",
+__all__ = ["HetuTimer", "device_op_breakdown", "profile_fn", "compiled_cost", "primitive_counts",
            "trace"]
 
 
@@ -182,3 +183,57 @@ def trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def device_op_breakdown(logdir: str, *, steps: int = 1, top: int = 0):
+    """Parse the newest ``*.trace.json.gz`` under ``logdir`` (written by
+    ``trace()``) into per-op device time — the analysis loop behind the
+    round-4 attention-layout and non-MXU-residue findings (ROADMAP 4b/4c),
+    promoted from a script to API.
+
+    Groups device-timeline events by XLA's ``deduplicated_name`` (repeats
+    of the same fusion across layers aggregate), filters host frames and
+    program envelopes, and divides by ``steps`` (trace ``steps``
+    iterations for stable numbers).  Returns ``(per_op, totals)``:
+    ``per_op`` maps op name -> seconds/step (all ops, or the ``top``
+    largest), ``totals`` has ``device_s`` and ``copy_s`` (relayout
+    ``copy.*``/``copy_fusion*`` ops — the layout-health number;
+    ``transpose_jvp*``-style SCOPE names are not data transposes and are
+    not counted).
+    """
+    import glob
+    import gzip
+    import json
+    from collections import defaultdict
+
+    paths = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no trace under {logdir}")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    dev_pids = {ev.get("pid") for ev in events
+                if ev.get("ph") == "M" and ev.get("name") == "process_name"
+                and any(s in ev.get("args", {}).get("name", "")
+                        for s in ("TPU", "Tensor", "Device", "/device"))}
+    per = defaultdict(float)
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if dev_pids and ev.get("pid") not in dev_pids:
+            continue
+        name = (ev.get("args", {}).get("deduplicated_name")
+                or ev.get("name", ""))
+        if (not name or name.isdigit() or name.startswith(("$", "jit_"))
+                or "(" in name):
+            continue  # host python frames / program envelopes
+        per[name] += ev["dur"] / 1e6 / steps
+    totals = {
+        "device_s": sum(per.values()),
+        "copy_s": sum(v for k, v in per.items()
+                      if k.startswith(("copy.", "copy_fusion"))),
+    }
+    ranked = dict(sorted(per.items(), key=lambda kv: -kv[1]))
+    if top:
+        ranked = dict(list(ranked.items())[:top])
+    return ranked, totals

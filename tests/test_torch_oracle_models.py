@@ -1,10 +1,11 @@
-"""GPT, ViT, and T5 parity vs independent PyTorch oracles.
+"""GPT, ViT, T5, and Swin parity vs independent PyTorch oracles.
 
 Extends the BERT torch-oracle harness (test_torch_oracle.py) to the other
 flagship families, matching the reference's hetu-vs-pytorch model
 checks (examples/nlp/bert/scripts/test_glue_bert_base.sh pattern applied
 per model family).  Each torch twin is written from the architecture
-description (pre-LN transformer / ViT paper / T5 paper+HF semantics),
+description (pre-LN transformer / ViT paper / T5 paper+HF semantics / Swin
+paper),
 NOT translated from hetu_tpu; our weights are ported in and we assert
 
   1. forward logits match (fp32, tight tolerance),
@@ -390,3 +391,190 @@ def test_t5_forward_and_gradient_parity():
     _grad_close(g.t5.encoder.blocks[1].mlp.w_in,
                 tm.enc[1].mlp_in.weight.grad.T, "enc1.mlp_in")
     _grad_close(g.t5.shared.weight, tm.shared.weight.grad, "shared(tied)")
+
+
+class TorchSwinBlock(torch.nn.Module):
+    """One Swin block (windowed MHA + relative bias + optional cyclic
+    shift + gelu MLP), written from the Swin paper semantics."""
+
+    def __init__(self, dim, heads, ws, shift, mlp_ratio=4):
+        super().__init__()
+        n = torch.nn
+        self.ln1 = n.LayerNorm(dim, eps=1e-5)
+        self.qkv = n.Linear(dim, 3 * dim)
+        self.attn_out = n.Linear(dim, dim)
+        self.bias_table = n.Parameter(
+            torch.zeros((2 * ws - 1) ** 2, heads))
+        self.ln2 = n.LayerNorm(dim, eps=1e-5)
+        self.mlp_in = n.Linear(dim, mlp_ratio * dim)
+        self.mlp_out = n.Linear(mlp_ratio * dim, dim)
+        self.heads, self.ws, self.shift = heads, ws, shift
+        # static relative index: pairwise (dy, dx) shifted to >= 0,
+        # flattened row-major over the (2ws-1)^2 table
+        ys, xs = torch.meshgrid(torch.arange(ws), torch.arange(ws),
+                                indexing="ij")
+        co = torch.stack([ys.reshape(-1), xs.reshape(-1)])
+        rel = co[:, :, None] - co[:, None, :] + (ws - 1)
+        self.register_buffer(
+            "rel_idx", rel[0] * (2 * ws - 1) + rel[1], persistent=False)
+
+    def _shift_mask(self, h, w):
+        ws, sh = self.ws, self.shift
+        img = torch.zeros(h, w)
+        cnt = 0
+        for hs in (slice(0, -ws), slice(-ws, -sh), slice(-sh, None)):
+            for vs in (slice(0, -ws), slice(-ws, -sh), slice(-sh, None)):
+                img[hs, vs] = cnt
+                cnt += 1
+        wins = img.reshape(h // ws, ws, w // ws, ws).permute(0, 2, 1, 3)
+        wins = wins.reshape(-1, ws * ws)
+        diff = wins[:, None, :] - wins[:, :, None]
+        return torch.where(diff != 0, torch.tensor(-1e9), torch.tensor(0.0))
+
+    def forward(self, x):  # x: [B, H, W, C]
+        b, h, w, c = x.shape
+        ws, sh, H = self.ws, self.shift, self.heads
+        d = c // H
+        shortcut = x
+        x = self.ln1(x)
+        if sh:
+            x = torch.roll(x, (-sh, -sh), dims=(1, 2))
+        wins = x.reshape(b, h // ws, ws, w // ws, ws, c)
+        wins = wins.permute(0, 1, 3, 2, 4, 5).reshape(-1, ws * ws, c)
+        nb, wsq, _ = wins.shape
+        q, k, v = self.qkv(wins).split(c, dim=-1)
+        q = q.view(nb, wsq, H, d).transpose(1, 2)
+        k = k.view(nb, wsq, H, d).transpose(1, 2)
+        v = v.view(nb, wsq, H, d).transpose(1, 2)
+        lg = (q @ k.transpose(-1, -2)) * d ** -0.5
+        lg = lg + self.bias_table[self.rel_idx].permute(2, 0, 1)[None]
+        if sh:
+            m = self._shift_mask(h, w)
+            nw = m.shape[0]
+            lg = lg.reshape(nb // nw, nw, H, wsq, wsq) + m[None, :, None]
+            lg = lg.reshape(nb, H, wsq, wsq)
+        p = torch.softmax(lg, dim=-1)
+        o = self.attn_out((p @ v).transpose(1, 2).reshape(nb, wsq, c))
+        x = o.reshape(b, h // ws, w // ws, ws, ws, c)
+        x = x.permute(0, 1, 3, 2, 4, 5).reshape(b, h, w, c)
+        if sh:
+            x = torch.roll(x, (sh, sh), dims=(1, 2))
+        x = shortcut + x
+        m2 = self.mlp_out(torch.nn.functional.gelu(
+            self.mlp_in(self.ln2(x)), approximate="tanh"))
+        return x + m2
+
+
+class TorchSwin(torch.nn.Module):
+    def __init__(self, img, patch, chans, dim, depths, heads, ws, classes):
+        super().__init__()
+        n = torch.nn
+        self.patch = patch
+        self.proj = n.Linear(patch * patch * chans, dim)
+        self.patch_ln = n.LayerNorm(dim, eps=1e-5)
+        res = img // patch
+        self.stages = n.ModuleList()
+        self.merge_ln = n.ModuleList()
+        self.merge_proj = n.ModuleList()
+        for si, (depth, hd) in enumerate(zip(depths, heads)):
+            w_eff = res if res <= ws else ws
+            blocks = n.ModuleList([
+                TorchSwinBlock(dim, hd, w_eff,
+                               0 if (i % 2 == 0 or res <= ws)
+                               else w_eff // 2)
+                for i in range(depth)])
+            self.stages.append(blocks)
+            if si < len(depths) - 1:
+                self.merge_ln.append(n.LayerNorm(4 * dim, eps=1e-5))
+                self.merge_proj.append(n.Linear(4 * dim, 2 * dim,
+                                                bias=False))
+                dim *= 2
+                res //= 2
+        self.final_ln = n.LayerNorm(dim, eps=1e-5)
+        self.head = n.Linear(dim, classes)
+
+    def forward(self, images):  # (B, H, W, C)
+        b, h, w, c = images.shape
+        p = self.patch
+        x = images.reshape(b, h // p, p, w // p, p, c)
+        x = x.permute(0, 1, 3, 2, 4, 5).reshape(b, h // p, w // p,
+                                                p * p * c)
+        x = self.patch_ln(self.proj(x))
+        for si, blocks in enumerate(self.stages):
+            for blk in blocks:
+                x = blk(x)
+            if si < len(self.stages) - 1:
+                bb, hh, ww, cc = x.shape
+                x = x.reshape(bb, hh // 2, 2, ww // 2, 2, cc)
+                x = x.permute(0, 1, 3, 2, 4, 5).reshape(
+                    bb, hh // 2, ww // 2, 4 * cc)
+                x = self.merge_proj[si](self.merge_ln[si](x))
+        x = self.final_ln(x)
+        return self.head(x.mean(dim=(1, 2)))
+
+
+def test_swin_forward_and_gradient_parity():
+    from hetu_tpu.models.swin import Swin, SwinConfig
+
+    IMG, PATCH, C, DIM, WS, CLASSES, B = 16, 2, 3, 32, 4, 10, 4
+    depths, heads = (2, 2), (2, 4)
+    set_random_seed(0)
+    ours = Swin(SwinConfig(image_size=IMG, patch_size=PATCH,
+                           num_channels=C, embed_dim=DIM, depths=depths,
+                           num_heads=heads, window_size=WS,
+                           num_classes=CLASSES))
+    tm = TorchSwin(IMG, PATCH, C, DIM, depths, heads, WS, CLASSES)
+    with torch.no_grad():
+        tm.proj.weight.copy_(_t(ours.patch_embed.proj.w).T)
+        tm.proj.bias.copy_(_t(ours.patch_embed.proj.b))
+        tm.patch_ln.weight.copy_(_t(ours.patch_ln.scale))
+        tm.patch_ln.bias.copy_(_t(ours.patch_ln.bias))
+        for sblocks, tblocks in zip(ours.stages, tm.stages):
+            for blk, tb in zip(sblocks, tblocks):
+                tb.ln1.weight.copy_(_t(blk.ln1.scale))
+                tb.ln1.bias.copy_(_t(blk.ln1.bias))
+                tb.qkv.weight.copy_(_t(blk.attn.wqkv).T)
+                tb.qkv.bias.copy_(_t(blk.attn.bqkv))
+                tb.attn_out.weight.copy_(_t(blk.attn.wo).T)
+                tb.attn_out.bias.copy_(_t(blk.attn.bo))
+                tb.bias_table.copy_(_t(blk.attn.bias_table))
+                tb.ln2.weight.copy_(_t(blk.ln2.scale))
+                tb.ln2.bias.copy_(_t(blk.ln2.bias))
+                tb.mlp_in.weight.copy_(_t(blk.mlp.w_in).T)
+                tb.mlp_in.bias.copy_(_t(blk.mlp.b_in))
+                tb.mlp_out.weight.copy_(_t(blk.mlp.w_out).T)
+                tb.mlp_out.bias.copy_(_t(blk.mlp.b_out))
+        for mrg, ln, pj in zip(ours.merges, tm.merge_ln, tm.merge_proj):
+            ln.weight.copy_(_t(mrg.ln.scale))
+            ln.bias.copy_(_t(mrg.ln.bias))
+            pj.weight.copy_(_t(mrg.proj.w).T)
+        tm.final_ln.weight.copy_(_t(ours.final_ln.scale))
+        tm.final_ln.bias.copy_(_t(ours.final_ln.bias))
+        tm.head.weight.copy_(_t(ours.head.w).T)
+        tm.head.bias.copy_(_t(ours.head.b))
+
+    rng = np.random.default_rng(4)
+    imgs = rng.standard_normal((B, IMG, IMG, C)).astype(np.float32)
+    y = rng.integers(0, CLASSES, (B,))
+
+    logits_j = np.asarray(ours(jnp.asarray(imgs)))
+    logits_t = tm(torch.from_numpy(imgs))
+    np.testing.assert_allclose(logits_j, logits_t.detach().numpy(),
+                               rtol=3e-4, atol=3e-4)
+
+    def loss_j(m):
+        lg = m(jnp.asarray(imgs))
+        return softmax_cross_entropy_sparse(lg, jnp.asarray(y)).mean()
+
+    g = jax.grad(loss_j)(ours)
+    lt = torch.nn.functional.cross_entropy(
+        tm(torch.from_numpy(imgs)), torch.from_numpy(y.astype(np.int64)))
+    lt.backward()
+    # shifted-window block (stage0 block1) bias table + qkv, merge proj
+    _grad_close(g.stages[0][1].attn.bias_table,
+                tm.stages[0][1].bias_table.grad, "s0b1.bias_table")
+    _grad_close(g.stages[0][1].attn.wqkv,
+                tm.stages[0][1].qkv.weight.grad.T, "s0b1.wqkv")
+    _grad_close(g.merges[0].proj.w, tm.merge_proj[0].weight.grad.T,
+                "merge0.proj")
+    _grad_close(g.head.w, tm.head.weight.grad.T, "head.w")

@@ -287,15 +287,12 @@ def check_attn_layout():
     ops around the flash kernel at BERT-large seq 512 (ROADMAP 4b); the
     einsum projection path measured 1.6 ms.  Gate at < 5 ms/step, plus
     the native path must actually be faster than the copy path."""
-    import glob
-    import gzip
-    import json
     import shutil
     import tempfile
-    from collections import defaultdict
 
     import jax
     from examples.profile_attn_layout import build_trainer
+    from hetu_tpu.exec.profiler import device_op_breakdown
 
     def copies_ms_per_step(native):
         trainer, b, _ = build_trainer(native, seq=512, batch=24)
@@ -307,23 +304,9 @@ def check_attn_layout():
             for _ in range(3):
                 m = trainer.step(b, key=key)
             float(m["loss"])
-        path = sorted(glob.glob(
-            outdir + "/**/*.trace.json.gz", recursive=True))[-1]
-        with gzip.open(path, "rt") as f:
-            trace = json.load(f)
-        total = defaultdict(float)
-        for ev in trace.get("traceEvents", []):
-            if ev.get("ph") != "X" or "dur" not in ev:
-                continue
-            name = (ev.get("args", {}).get("deduplicated_name")
-                    or ev.get("name", ""))
-            # copy.* / copy_fusion.* are the relayout ops; copy-done/
-            # copy-start are async DMA bookkeeping, and transpose_jvp___
-            # is a jax SCOPE name (the vjp region), not a data transpose
-            if name.startswith("copy.") or name.startswith("copy_fusion"):
-                total[name] += ev["dur"]
+        _, totals = device_op_breakdown(outdir, steps=3)
         shutil.rmtree(outdir, ignore_errors=True)
-        return sum(total.values()) / 3e3
+        return totals["copy_s"] * 1e3
 
     native = copies_ms_per_step(True)
     plain = copies_ms_per_step(False)
