@@ -98,13 +98,16 @@ class _HostHandle:
     of the pytree (compared by identity — the object never changes, only its
     contents, which are read exclusively OUTSIDE jit)."""
 
-    __slots__ = ("ids", "prefetcher", "pusher", "push_err", "__weakref__")
+    __slots__ = ("ids", "prefetcher", "pusher", "push_err", "autosave",
+                 "autosave_n", "__weakref__")
 
     def __init__(self):
         self.ids = None
         self.prefetcher = None
         self.pusher = None    # ThreadPoolExecutor(1): FIFO async pushes
         self.push_err = None  # first exception from an async push
+        self.autosave = None  # (path, every) from ShardedHostEmbedding
+        self.autosave_n = 0
 
 
 class StagedHostEmbedding(_HostEmbeddingBase):

@@ -183,6 +183,31 @@ class ShardedHostEmbedding(StagedHostEmbedding):
             if hasattr(st, "flush"):
                 st.flush()
 
+    def autosave(self, path: str, every: int):
+        """Checkpoint the shard tables every ``every`` ``stage()`` calls
+        (i.e. every ``every`` training steps).  Pair with the remote
+        tables' ``restore_path`` pointing at the SAME path for hands-off
+        PS fault recovery: kill -> restart -> the reconnect reloads the
+        last autosave, losing at most ``every`` steps of embedding
+        updates (writes are tmp+rename atomic per shard, so a kill
+        mid-save never corrupts the restore file).  Counted on
+        ``push_grads`` — actual applied training steps — so eval-loop
+        ``stage()`` calls neither drift the cadence nor trigger saves.
+        Counter state lives on the host handle so the jitted step never
+        retraces."""
+        if every <= 0:
+            raise ValueError(f"autosave every must be positive, got {every}")
+        self._handle.autosave = (str(path), int(every))
+        self._handle.autosave_n = 0
+
+    def push_grads(self, grad_rows):
+        super().push_grads(grad_rows)
+        auto = getattr(self._handle, "autosave", None)
+        if auto:
+            self._handle.autosave_n += 1
+            if self._handle.autosave_n % auto[1] == 0:
+                self.save(auto[0])
+
     def save(self, path: str):
         self.flush()
         for s, t in enumerate(self.tables):

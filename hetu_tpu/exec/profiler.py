@@ -204,7 +204,6 @@ def device_op_breakdown(logdir: str, *, steps: int = 1, top: int = 0):
     import glob
     import gzip
     import json
-    from collections import defaultdict
 
     paths = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
                       recursive=True)

@@ -545,7 +545,12 @@ uint64_t het_table_version(void* h, int64_t row) {
 
 int het_table_save(void* h, const char* path) {
   auto* t = static_cast<Table*>(h);
-  FILE* f = std::fopen(path, "wb");
+  // write-to-temp + rename: a crash (the fault-recovery feature's whole
+  // premise is SIGKILL mid-anything) during the write must never corrupt
+  // the checkpoint a restore_path reload depends on.  rename(2) is atomic
+  // on POSIX, so the file at `path` is always a complete snapshot.
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = std::fopen(tmp.c_str(), "wb");
   if (!f) return -1;
   // quiesce: hold EVERY shard lock for the whole save so the checkpoint
   // is one consistent cut — weights, step, and optimizer moments all
@@ -587,7 +592,8 @@ int het_table_save(void* h, const char* path) {
       std::fwrite(rowbuf.data(), sizeof(float), t->dim, f);
     }
   }
-  std::fclose(f);
+  if (std::fclose(f) != 0) return -1;
+  if (std::rename(tmp.c_str(), path) != 0) return -1;
   return 0;
 }
 

@@ -606,3 +606,66 @@ def test_push_replay_same_seq_applied_once(server):
     np.testing.assert_array_equal(t.pull([0]), np.full((1, 2), 1.0))
     t.push([0], np.full((1, 2), -1.0, np.float32))  # fresh seq applies
     np.testing.assert_array_equal(t.pull([0]), np.full((1, 2), 2.0))
+
+
+@pytest.mark.slow
+def test_autosave_plus_restart_recovers_hands_off(tmp_path):
+    """autosave(path, every) + restore_path on the same path = hands-off
+    fault recovery: no manual save anywhere, SIGKILL the server, restart,
+    training resumes from the last autosave (at most `every` steps of
+    embedding updates lost) and keeps converging."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.core.module import Module
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.layers import Linear
+    from hetu_tpu.ops import binary_cross_entropy_with_logits
+    from hetu_tpu.optim import AdamOptimizer
+
+    rng = np.random.default_rng(1)
+    sp = rng.integers(0, 80, (32, 4))
+    y = (sp.sum(1) % 2).astype(np.float32)
+    b = {"sp": jnp.asarray(sp), "y": jnp.asarray(y)}
+    ckpt = str(tmp_path / "auto.ckpt")
+    port = _free_port()
+    proc = _spawn_server(port)
+    proc2 = None
+    try:
+        set_random_seed(0)
+
+        class Model(Module):
+            def __init__(self):
+                self.embed = RemoteHostEmbedding(
+                    80, 8, servers=[f"127.0.0.1:{port}"], table_id=970,
+                    optimizer="adagrad", lr=0.05, seed=3,
+                    reconnect_attempts=40, reconnect_backoff=0.05,
+                    restore_path=ckpt)
+                self.head = Linear(8 * 4, 1)
+
+            def loss(self, sparse, label):
+                e = self.embed(sparse).reshape(sparse.shape[0], -1)
+                return binary_cross_entropy_with_logits(
+                    self.head(e)[:, 0], label).mean()
+
+        m = Model()
+        m.embed.autosave(ckpt, every=3)
+        tr = Trainer(m, AdamOptimizer(1e-2),
+                     lambda mm, bb, k: (mm.loss(bb["sp"], bb["y"]), {}))
+
+        def step():
+            for mod in tr.staged_modules():
+                mod.stage(sp)
+            return float(tr.step(b)["loss"])
+
+        pre = [step() for _ in range(7)]  # autosaves after steps 3 and 6
+        assert os.path.exists(ckpt + ".shard0")
+        proc.kill()
+        proc.wait(10)
+        proc2 = _spawn_server(port)
+        post = [step() for _ in range(13)]
+        assert post[-1] < pre[0] * 0.7, (pre, post)
+        assert post[-1] < post[0], (pre, post)
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(10)
