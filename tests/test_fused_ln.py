@@ -82,6 +82,7 @@ def test_fused_ln_bf16_and_ragged_rows():
     assert out.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_transformer_block_fused_ln_matches_unfused():
     """A post-LN TransformerBlock with fused_ln=True computes the same
     function as the unfused path — eval mode exactly, train mode with
@@ -123,6 +124,7 @@ def test_fused_ln_rejects_pre_ln_block():
         TransformerBlock(64, 2, fused_ln=True)  # default pre-LN
 
 
+@pytest.mark.slow
 def test_bert_fused_ln_trains():
     """BertForPreTraining(fused_ln=True) trains: loss drops through the
     fused kernel's custom vjp."""
