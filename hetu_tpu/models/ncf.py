@@ -113,5 +113,5 @@ class NeuMF(_RatingModel):
         gmf = e[:, :, :self.factor]
         mlp = e[:, :, self.factor:]
         out_gmf = gmf[:, 0] * gmf[:, 1]                     # [b, f]
-        h = self.tower(mlp.reshape(mlp.shape[0], -1))       # [b, 2*(d-f)]
+        h = self.tower(mlp.reshape(mlp.shape[0], -1))       # [b, f]
         return self.predict(jnp.concatenate([out_gmf, h], axis=-1))[:, 0]
