@@ -472,12 +472,48 @@ CONFIGS = [
 _TRANSIENT = ("rpc", "deadline", "unavailable", "connection", "stream")
 
 
+def _require_backend_alive(timeout_s: float = 240.0):
+    """Fail FAST with an honest artifact line if the device backend is
+    unreachable, instead of hanging forever on the first dispatch.  The
+    tunneled chip's relay can die (r04: gone for 8+ hours; a hung
+    make_c_api_client blocks in C and cannot be interrupted), so the
+    probe runs on a daemon thread and a watchdog hard-exits."""
+    import os
+    import threading
+
+    settled = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            x = jnp.ones((8, 8))
+            float((x @ x).sum())
+        except Exception as e:  # deterministic failure: report IT, now
+            err.append(f"{type(e).__name__}: {e}")
+        settled.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not settled.wait(timeout_s):
+        _line("backend_unreachable", 0.0, "none", 0.0,
+              note=f"device backend did not answer a trivial program "
+                   f"within {timeout_s:.0f}s (dead tunnel relay?); "
+                   f"no perf numbers can be produced this run")
+        sys.stdout.flush()
+        os._exit(3)
+    if err:
+        _line("backend_unreachable", 0.0, "none", 0.0,
+              note=f"device backend failed a trivial program: {err[0][:400]}")
+        sys.stdout.flush()
+        os._exit(3)
+
+
 def main():
     names = {name for name, _ in CONFIGS}
     unknown = set(sys.argv[1:]) - names
-    if unknown:
+    if unknown:  # usage errors need no backend: fail instantly
         sys.exit(f"bench: unknown config(s) {sorted(unknown)}; "
                  f"choose from {sorted(names)}")
+    _require_backend_alive()
     only = set(sys.argv[1:]) or names
     on_tpu, kind, peak = _env()
     done = set()
