@@ -481,28 +481,36 @@ def _require_backend_alive(timeout_s: float = 240.0):
     import os
     import threading
 
-    settled = threading.Event()
-    err = []
+    for attempt in (0, 1):
+        settled = threading.Event()
+        err = []
 
-    def probe():
-        try:
-            x = jnp.ones((8, 8))
-            float((x @ x).sum())
-        except Exception as e:  # deterministic failure: report IT, now
-            err.append(f"{type(e).__name__}: {e}")
-        settled.set()
+        def probe():
+            try:
+                x = jnp.ones((8, 8))
+                float((x @ x).sum())
+            except Exception as e:  # deterministic failure: report IT
+                err.append(f"{type(e).__name__}: {e}")
+            settled.set()
 
-    threading.Thread(target=probe, daemon=True).start()
-    if not settled.wait(timeout_s):
+        threading.Thread(target=probe, daemon=True).start()
+        if not settled.wait(timeout_s):
+            _line("backend_unreachable", 0.0, "none", 0.0,
+                  note=f"device backend did not answer a trivial program "
+                       f"within {timeout_s:.0f}s (dead tunnel relay?); "
+                       f"no perf numbers can be produced this run")
+            sys.stdout.flush()
+            os._exit(3)
+        if not err:
+            return
+        # transient tunnel/RPC blips get ONE retry, matching the
+        # per-config retry policy in main(); anything else is terminal
+        if attempt == 0 and any(s in err[0].lower() for s in _TRANSIENT):
+            time.sleep(5)
+            continue
         _line("backend_unreachable", 0.0, "none", 0.0,
-              note=f"device backend did not answer a trivial program "
-                   f"within {timeout_s:.0f}s (dead tunnel relay?); "
-                   f"no perf numbers can be produced this run")
-        sys.stdout.flush()
-        os._exit(3)
-    if err:
-        _line("backend_unreachable", 0.0, "none", 0.0,
-              note=f"device backend failed a trivial program: {err[0][:400]}")
+              note=f"device backend failed a trivial program: "
+                   f"{err[0][:400]}")
         sys.stdout.flush()
         os._exit(3)
 
