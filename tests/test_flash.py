@@ -142,6 +142,7 @@ def test_flash_attention_bhsd_matches_bshd():
                                    np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_mha_native_layout_matches_plain():
     """MultiHeadAttention's bhsd einsum path (projections straight into the
     kernel layout, no transposes) computes the same function — values AND
@@ -193,6 +194,7 @@ def test_mha_native_layout_mask_fallback():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_mha_bhsd_xla_core_matches_plain():
     """The bhsd-marked XLA materialized core (no Pallas) through MHA's
     einsum path equals the plain (B,S,H,D) path — values and grads —
