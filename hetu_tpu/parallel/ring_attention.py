@@ -286,26 +286,35 @@ def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     return unswap(out)
 
 
-def _sp_sharded(fn_inner, mesh: Mesh, axis: str, check_vma: bool = True):
+def _sp_sharded(fn_inner, mesh: Mesh, axis: str, check_vma: bool = True,
+                head_axis: Optional[str] = None):
     """Wrap an inside-shard_map attention core into a drop-in ``attn_fn`` for
     MultiHeadAttention: qkv arrive seq-sharded over ``axis`` (GSPMD side),
     manual only over ``axis``.  ``check_vma=False`` is needed when the core
     runs Pallas kernels in interpreter mode (CPU tests): the interpreter's
     internal grid slicing mixes varying and unvarying values, which the
-    vma checker rejects."""
+    vma checker rejects.
+
+    ``head_axis`` composes SP × TP: with Megatron column-parallel qkv
+    (``qkv_three_heads`` → tp) the activations reaching attention are
+    already head-sharded over tp, and every attention core here is
+    per-head independent — so the composition is an in_specs entry, not a
+    new algorithm: each tp rank rings (or all-to-alls) only its own head
+    slice over ``axis``.  Without the entry, shard_map does NOT error on
+    the mismatch — it RESHARDS, silently all-gathering the tp-sharded
+    heads on entry and re-scattering on exit every layer (a quiet perf
+    cliff, which is why the default stays None only for meshes with no tp
+    axis in play)."""
 
     # Manualize EVERY mesh axis: leaving axes "auto" makes XLA try to
     # partition the region automatically, which Mosaic kernels refuse
     # ("Mosaic kernels cannot be automatically partitioned") even for
-    # size-1 axes.  Batch rides the dp axis when the mesh has one; heads
-    # stay unsharded here (SP x TP head sharding is not composed yet).
-    # NOTE: shard_map does NOT error on a spec mismatch — it RESHARDS
-    # inputs to match in_specs, so tp-head-sharded activations fed here
-    # would be silently all-gathered across tp (a quiet perf cliff).
-    # Composing SP x TP therefore needs explicit head entries in `spec`,
-    # not reliance on a check.
+    # size-1 axes.  Batch rides the dp axis when the mesh has one.
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        raise ValueError(f"head_axis {head_axis!r} not in mesh axes "
+                         f"{mesh.axis_names}")
     batch_axis = "dp" if "dp" in mesh.axis_names else None
-    spec = P(batch_axis, axis)
+    spec = P(batch_axis, axis, head_axis)
 
     def attn_fn(q, k, v, mask=None, *, causal: bool = False):
         if mask is not None:
@@ -325,41 +334,49 @@ def _sp_sharded(fn_inner, mesh: Mesh, axis: str, check_vma: bool = True):
             check_vma=check_vma,
         )(q, k, v)
 
+    attn_fn.spec = spec  # introspectable by tests / dryrun assertions
     return attn_fn
 
 
 def ring_attn_fn(mesh: Mesh, axis: str = "sp", *, remat: bool = True,
                  impl: str = "flash", interpret: Optional[bool] = None,
                  block_q: Optional[int] = None,
-                 block_k: Optional[int] = None):
+                 block_k: Optional[int] = None,
+                 head_axis: Optional[str] = None):
     """attn_fn running ring attention over ``axis``; plug into
     ``MultiHeadAttention(attn_fn=...)``.
 
     ``impl="flash"`` (default) runs the Pallas flash kernel per block with
     the ring-level custom vjp; ``impl="blockwise"`` keeps the XLA
     blockwise-scan core (any chunk size/dtype, no 128-alignment needs).
+    ``head_axis="tp"`` composes with Megatron tensor parallelism: heads
+    stay tp-sharded through the ring (see ``_sp_sharded``).
     """
     if impl == "flash":
         interp = (interpret if interpret is not None
                   else jax.default_backend() != "tpu")
         core = lambda q, k, v, causal: ring_flash_attention(  # noqa: E731
             q, k, v, axis, causal, None, interp, block_q, block_k)
-        return _sp_sharded(core, mesh, axis, check_vma=not interp)
+        return _sp_sharded(core, mesh, axis, check_vma=not interp,
+                           head_axis=head_axis)
     if impl == "blockwise":
         core = lambda q, k, v, causal: ring_attention(  # noqa: E731
             q, k, v, axis=axis, causal=causal, remat=remat)
-        return _sp_sharded(core, mesh, axis)
+        return _sp_sharded(core, mesh, axis, head_axis=head_axis)
     raise ValueError(f"unknown ring impl {impl!r}")
 
 
 def ulysses_attn_fn(mesh: Mesh, axis: str = "sp", *,
-                    inner_fn: Optional[Callable] = None):
+                    inner_fn: Optional[Callable] = None,
+                    head_axis: Optional[str] = None):
     """attn_fn running Ulysses head/seq all-to-all attention over ``axis``.
 
     The local core defaults to the Pallas flash kernel (each rank holds the
     full sequence for its head slice after the all-to-all, exactly the
     kernel's sweet spot); pass ``inner_fn=dot_product_attention`` for the
-    dense fp32-softmax core.
+    dense fp32-softmax core.  With ``head_axis="tp"`` the all-to-all
+    redistributes only the rank's tp-local head slice, so local heads
+    (num_heads / tp) must be divisible by the ``axis`` size.
     """
     if inner_fn is None:
         from hetu_tpu.ops.pallas import flash_attn_fn
@@ -371,5 +388,5 @@ def ulysses_attn_fn(mesh: Mesh, axis: str = "sp", *,
         lambda q, k, v, causal: ulysses_attention(
             q, k, v, axis=axis, causal=causal, inner_fn=inner_fn
         ),
-        mesh, axis, check_vma=not interp,
+        mesh, axis, check_vma=not interp, head_axis=head_axis,
     )
