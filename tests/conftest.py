@@ -55,3 +55,40 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def onnx_shim(monkeypatch):
+    """Minimal ``onnx`` module over our own wire codec, satisfying torch's
+    torchscript exporter — it insists on ``import onnx`` for one purpose:
+    scanning the exported graph for custom onnxscript function ops (none
+    exist in plain nn modules).  The scan succeeding is itself a
+    cross-check: our decoder must parse torch's bytes.  Shared by
+    test_onnx_torch_producer.py and test_onnx_external_consumer.py."""
+    import sys as _sys
+    import types
+
+    from hetu_tpu.interop import onnx_pb as pb
+
+    class _AttrView:
+        def __init__(self, a):
+            self.g = None  # subgraphs only appear under control-flow ops
+
+    class _NodeView:
+        def __init__(self, n):
+            self.domain = n.domain or ""
+            self.op_type = n.op_type
+            self.attribute = [_AttrView(a) for a in n.attributes]
+
+    class _GraphView:
+        def __init__(self, g):
+            self.node = [_NodeView(n) for n in g.nodes]
+
+    class _ModelView:
+        def __init__(self, m):
+            self.graph = _GraphView(m.graph)
+            self.functions = []
+
+    mod = types.ModuleType("onnx")
+    mod.load_model_from_string = lambda b: _ModelView(pb.ModelProto.decode(b))
+    monkeypatch.setitem(_sys.modules, "onnx", mod)

@@ -19,8 +19,6 @@ bytes for the export call to succeed at all.
 from __future__ import annotations
 
 import io
-import sys
-import types
 
 import jax.numpy as jnp
 import numpy as np
@@ -28,41 +26,12 @@ import pytest
 
 torch = pytest.importorskip("torch")
 
-from hetu_tpu.interop import onnx_pb as pb  # noqa: E402
 from hetu_tpu.interop.onnx_import import import_model  # noqa: E402
 
 pytestmark = pytest.mark.slow
 
-
-class _AttrView:
-    def __init__(self, a):
-        self.g = None  # subgraphs only appear under control-flow ops
-
-
-class _NodeView:
-    def __init__(self, n):
-        self.domain = n.domain or ""
-        self.op_type = n.op_type
-        self.attribute = [_AttrView(a) for a in n.attributes]
-
-
-class _GraphView:
-    def __init__(self, g):
-        self.node = [_NodeView(n) for n in g.nodes]
-
-
-class _ModelView:
-    def __init__(self, m):
-        self.graph = _GraphView(m.graph)
-        self.functions = []
-
-
-@pytest.fixture
-def onnx_shim(monkeypatch):
-    """Minimal ``onnx`` module over our own codec (see module docstring)."""
-    mod = types.ModuleType("onnx")
-    mod.load_model_from_string = lambda b: _ModelView(pb.ModelProto.decode(b))
-    monkeypatch.setitem(sys.modules, "onnx", mod)
+# the `onnx_shim` fixture (tests/conftest.py) satisfies torch's
+# `import onnx` scan via our own wire codec — see its docstring
 
 
 def _export(model, args):
