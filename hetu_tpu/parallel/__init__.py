@@ -22,8 +22,11 @@ from hetu_tpu.parallel.pipeline import (
     stage_partition,
 )
 from hetu_tpu.parallel.pipedream import (
+    interleave_stages,
     pipedream_grads,
+    pipedream_schedule_stats,
     pipedream_train_step,
+    uninterleave_stages,
 )
 from hetu_tpu.parallel.hetero import (
     HeteroPipeline,

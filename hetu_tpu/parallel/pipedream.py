@@ -37,6 +37,28 @@ versions become explicit scan carries:
 frozen, gradients accumulated): gradients identical to the GPipe pipeline
 (parallel/pipeline.py) but with 1F1B's O(S) — not O(M) — peak in-flight
 activation footprint, the reason Megatron-LM-style trainers default to it.
+
+Round-5 schedule redesign (bubble):
+
+- **Three-phase scans.** A single scan whose tick body always contains
+  both a forward and a backward charges masked (invalid) work at full
+  price — warmup ticks where no backward exists anywhere still pay the
+  vjp, so the wall-clock bubble was ~2(S-1)(f+b).  The phase boundaries
+  are static functions of (S, V, M), so the schedule now runs THREE
+  scans — warmup (forward-only body, no vjp traced), steady (1F1B), and
+  drain (backward-only body) — restoring the classic 1F1B bubble
+  (S-1)·(f+b) with zero numeric change.
+- **Interleaved virtual stages** (``virtual_stages=V > 1``, sync mode):
+  each device owns V depth-interleaved chunks (device d holds virtual
+  stages {v·S+d}), microbatches travel in groups of S with the group
+  timetable  t_fwd(g,v,r,d) = g·SV + v·S + r + d  (and its mirror for
+  backwards).  The decomposition of t−d is unique, so each device still
+  runs ≤1 chunk-forward and ≤1 chunk-backward per tick and the existing
+  single ppermute ring routes everything — chunk hand-offs (v, S−1) →
+  (v+1, 0) ride the ring's wrap-around.  Bubble shrinks to
+  (S−1)·(f+b)/V, the Megatron-LM interleaved-schedule bound, at the
+  cost of V× the stashed-activation footprint.  See
+  ``interleave_stages`` for the device-major parameter layout.
 """
 
 from __future__ import annotations
@@ -49,7 +71,8 @@ import jax.tree_util as jtu
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipedream_grads", "pipedream_train_step"]
+__all__ = ["pipedream_grads", "pipedream_train_step", "interleave_stages",
+           "uninterleave_stages", "pipedream_schedule_stats"]
 
 
 def _tree_index(tree, i):
@@ -79,13 +102,59 @@ def _microbatch(x, M, name):
     return x.reshape(M, x.shape[0] // M, *x.shape[1:])
 
 
+def _phase_bounds(S: int, V: int, M: int):
+    """The three-phase schedule's static tick boundaries: [0, T1) is
+    forward-only warmup (no backward can exist before the depth-S*V
+    pipeline fills), [T1, T2) steady 1F1B (T2 = last forward + 1), and
+    [T2, T3) backward-only drain.  Single source of truth for _run_1f1b
+    and pipedream_schedule_stats."""
+    SV = S * V
+    g_last, r_last = divmod(M - 1, S)
+    t_last = g_last * SV + (V - 1) * S + r_last + (S - 1)
+    return SV - 1, t_last + 1, SV - 1 + t_last + 1
+
+
 def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
               *, mesh: Mesh, axis: str, n_microbatches: int,
-              dp_axis: Optional[str], mode: str):
+              dp_axis: Optional[str], mode: str, virtual_stages: int = 1):
     S = mesh.shape[axis]
+    V = virtual_stages
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if V > 1 and mode == "async":
+        raise NotImplementedError(
+            "interleaved virtual stages are a synchronous-schedule feature "
+            "(pipedream_grads); asynchronous per-microbatch updates with "
+            "chunked weight versions are not defined by the reference "
+            "semantics")
     M = n_microbatches
-    K = max(2 * S - 1, 1)  # max in-flight microbatches at stage 0
+    SV = S * V
+    # max in-flight microbatch slots per chunk (forwards of one chunk land
+    # S-per-SV-tick-group; the fwd->bwd span is < 2*SV ticks)
+    K = max(2 * S - 1, 1) if V == 1 else 2 * S
+    D = SV - 1  # first tick any backward can run (depth-SV pipeline fill)
     manual = (axis,) if dp_axis is None else (axis, dp_axis)
+    T1, T2, T3 = _phase_bounds(S, V, M)
+
+    def _decode_fwd(t, stage):
+        """tick -> (valid, microbatch, chunk) for this device's forward.
+        Timetable: t = g*SV + v*S + r + d with m = g*S + r — the unique
+        decomposition of t - d, so <= 1 chunk-forward per device per tick
+        and messages travel exactly one ring hop per tick (V == 1 reduces
+        to the plain wavefront m = t - d)."""
+        a = t - stage
+        a_s = jnp.maximum(a, 0)
+        rem = a_s % SV
+        m = (a_s // SV) * S + rem % S
+        return (a >= 0) & (m < M), jnp.minimum(m, M - 1), rem // S
+
+    def _decode_bwd(t, stage):
+        """Mirror timetable: t = D + g*SV + (V-1-v)*S + r + (S-1-d)."""
+        ab = t - D - (S - 1 - stage)
+        ab_s = jnp.maximum(ab, 0)
+        rem = ab_s % SV
+        m = (ab_s // SV) * S + rem % S
+        return (ab >= 0) & (m < M), jnp.minimum(m, M - 1), V - 1 - rem // S
 
     xs = _microbatch(x, M, "x")
     ys = _microbatch(y, M, "y")
@@ -122,25 +191,28 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
                 opt_state)
 
     def inner(params, opt_state, xs, ys, exs):
-        W0 = jtu.tree_map(lambda p: p[0], params)  # [1, ...] -> [...]
+        # local param leaves are [V, ...] (the device's chunks, device-major
+        # global layout — see interleave_stages); async mode is V == 1 so
+        # its chunk IS the whole local stage
+        Wl = params
+        W0 = jtu.tree_map(lambda p: p[0], params)
         if mode == "async":
             ost0 = jtu.tree_map(
                 lambda l, sp: l[0] if sp == P(axis) else
                 lax.pcast(l, (axis,), to="varying"),
                 opt_state, ost_specs)
         stage = lax.axis_index(axis)
-        is_last = stage == S - 1
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
         bwd_ring = [(i, (i - 1) % S) for i in range(S)]
 
-        def V(t):
+        def Vr(t):
             return lax.pcast(t, manual, to="varying")
 
         h_shape, h_dtype = xs.shape[1:], xs.dtype
-        stash_h0 = V(jnp.zeros((K,) + h_shape, h_dtype))
-        fmsg0 = V(jnp.zeros(h_shape, h_dtype))
-        bmsg0 = V(jnp.zeros(h_shape, h_dtype))
-        loss0 = V(jnp.zeros((), jnp.float32))
+        stash_h0 = Vr(jnp.zeros((V * K,) + h_shape, h_dtype))
+        fmsg0 = Vr(jnp.zeros(h_shape, h_dtype))
+        bmsg0 = Vr(jnp.zeros(h_shape, h_dtype))
+        loss0 = Vr(jnp.zeros((), jnp.float32))
         # weight-shaped carries are dp-INVARIANT (the vjp psum-reduces dW
         # over dp), so they vary over the pp axis only
         def Vpp(t):
@@ -152,75 +224,99 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
             carry0 = (W0, ost0, stash_W0, stash_h0, fmsg0, bmsg0, loss0)
         else:
             gsum0 = jtu.tree_map(
-                lambda p: Vpp(jnp.zeros(p.shape, jnp.float32)), W0)
+                lambda p: Vpp(jnp.zeros(p.shape, jnp.float32)), Wl)
             carry0 = (stash_h0, fmsg0, bmsg0, loss0, gsum0)
 
-        def tick(carry, t):
-            if mode == "async":
-                W, ost, stash_W, stash_h, fmsg, bmsg, loss_acc = carry
-            else:
-                stash_h, fmsg, bmsg, loss_acc, gsum = carry
-                W = W0
+        def make_tick(do_fwd: bool, do_bwd: bool):
+            def tick(carry, t):
+                if mode == "async":
+                    W, ost, stash_W, stash_h, fmsg, bmsg, loss_acc = carry
+                else:
+                    stash_h, fmsg, bmsg, loss_acc, gsum = carry
 
-            # ---- forward: microbatch m_f = t - stage (GPipe wavefront) ----
-            m_f = t - stage
-            vf = (m_f >= 0) & (m_f < M)
-            mf = jnp.clip(m_f, 0, M - 1)
-            slot_f = mf % K
-            x0 = lax.dynamic_index_in_dim(xs, mf, 0, keepdims=False)
-            h_in = jnp.where(stage == 0, x0, fmsg)
-            stash_h = _tree_stash(stash_h, h_in, slot_f, vf)
-            if mode == "async":
-                stash_W = _tree_stash(stash_W, W, slot_f, vf)
-            ex_f = _tree_index(exs, mf) if has_ex else None
-            y_out = stage_fn(W, h_in, ex_f)
+                if do_fwd:
+                    vf, mf, vc_f = _decode_fwd(t, stage)
+                    slot_f = vc_f * K + mf % K
+                    x0 = lax.dynamic_index_in_dim(xs, mf, 0, keepdims=False)
+                    h_in = jnp.where((stage == 0) & (vc_f == 0), x0, fmsg)
+                    stash_h = _tree_stash(stash_h, h_in, slot_f, vf)
+                    if mode == "async":
+                        stash_W = _tree_stash(stash_W, W, mf % K, vf)
+                        W_f = W
+                    else:
+                        W_f = _tree_index(Wl, vc_f)
+                    ex_f = _tree_index(exs, mf) if has_ex else None
+                    y_out = stage_fn(W_f, h_in, ex_f)
+                    # message for tick t+1 (wrap-around entries carry chunk
+                    # hand-offs (v, S-1) -> (v+1, 0); the final stage's
+                    # wrapped output is never consumed by the decode)
+                    fmsg = lax.ppermute(y_out, axis, fwd_ring)
 
-            # ---- backward: microbatch m_b = t - (2S - 2 - stage) ----
-            m_b = t - (2 * S - 2 - stage)
-            vb = (m_b >= 0) & (m_b < M)
-            mb = jnp.clip(m_b, 0, M - 1)
-            slot_b = mb % K
-            W_b = _tree_index(stash_W, slot_b) if mode == "async" else W
-            h_b = lax.dynamic_index_in_dim(stash_h, slot_b, 0, keepdims=False)
-            y_tgt = lax.dynamic_index_in_dim(ys, mb, 0, keepdims=False)
-            ex_b = _tree_index(exs, mb) if has_ex else None
+                if do_bwd:
+                    vb, mb, vc_b = _decode_bwd(t, stage)
+                    is_last = (stage == S - 1) & (vc_b == V - 1)
+                    slot_b = vc_b * K + mb % K
+                    if mode == "async":
+                        W_b = _tree_index(stash_W, mb % K)
+                    else:
+                        W_b = _tree_index(Wl, vc_b)
+                    h_b = lax.dynamic_index_in_dim(stash_h, slot_b, 0,
+                                                   keepdims=False)
+                    y_tgt = lax.dynamic_index_in_dim(ys, mb, 0,
+                                                     keepdims=False)
+                    ex_b = _tree_index(exs, mb) if has_ex else None
 
-            # one vjp serves every stage: the loss output is seeded 1 only at
-            # the last stage, the activation output is seeded with the ring
-            # message only at non-last stages.
-            def f(Wm, hm):
-                out = stage_fn(Wm, hm, ex_b)
-                return out, loss_fn(out, y_tgt).astype(jnp.float32)
+                    # one vjp serves every stage: the loss output is seeded
+                    # 1 only at the last virtual stage, the activation
+                    # output is seeded with the ring message elsewhere.
+                    def f(Wm, hm):
+                        out = stage_fn(Wm, hm, ex_b)
+                        return out, loss_fn(out, y_tgt).astype(jnp.float32)
 
-            (out, loss), vjp_fn = jax.vjp(f, W_b, h_b)
-            # derive cotangents arithmetically from the outputs so they carry
-            # the outputs' exact varying-axes (vma) signature
-            g_out = jnp.where(is_last, out * 0, bmsg.astype(out.dtype))
-            g_loss = jnp.where(is_last, loss * 0 + 1, loss * 0)
-            dW, dh = vjp_fn((g_out, g_loss))
-            dW = jtu.tree_map(lambda g: g * vb.astype(g.dtype), dW)
-            dh = dh * vb.astype(dh.dtype)
-            loss_acc = loss_acc + jnp.where(is_last & vb, loss, 0.0)
+                    (out, loss), vjp_fn = jax.vjp(f, W_b, h_b)
+                    # derive cotangents arithmetically from the outputs so
+                    # they carry the outputs' exact varying-axes signature
+                    g_out = jnp.where(is_last, out * 0, bmsg.astype(out.dtype))
+                    g_loss = jnp.where(is_last, loss * 0 + 1, loss * 0)
+                    dW, dh = vjp_fn((g_out, g_loss))
+                    dW = jtu.tree_map(lambda g: g * vb.astype(g.dtype), dW)
+                    dh = dh * vb.astype(dh.dtype)
+                    loss_acc = loss_acc + jnp.where(is_last & vb, loss, 0.0)
+                    bmsg = lax.ppermute(dh.astype(h_dtype), axis, bwd_ring)
 
-            # messages for tick t+1 (wrap-around entries are masked above)
-            fmsg = lax.ppermute(y_out, axis, fwd_ring)
-            bmsg = lax.ppermute(dh.astype(h_dtype), axis, bwd_ring)
+                    if mode == "async":
+                        if dp_axis is not None:
+                            # W is dp-invariant, so the vjp has already
+                            # psum-reduced dW over dp; rescale the sum to
+                            # the HetPipe mean.
+                            dW = jtu.tree_map(
+                                lambda g: g / mesh.shape[dp_axis], dW)
+                        newW, newost = opt.update(dW, ost, W)
+                        W = _tree_where(vb, newW, W)
+                        ost = _tree_where(vb, newost, ost)
+                    else:
+                        # accumulate into the chunk's gradient slot
+                        gsum = jtu.tree_map(
+                            lambda G, g: lax.dynamic_update_index_in_dim(
+                                G,
+                                lax.dynamic_index_in_dim(
+                                    G, vc_b, 0, keepdims=False) + g,
+                                vc_b, 0),
+                            gsum, dW)
 
-            if mode == "async":
-                if dp_axis is not None:
-                    # W is dp-invariant, so the vjp has already psum-reduced
-                    # dW over dp; rescale the sum to the HetPipe mean.
-                    dW = jtu.tree_map(
-                        lambda g: g / mesh.shape[dp_axis], dW)
-                newW, newost = opt.update(dW, ost, W)
-                W = _tree_where(vb, newW, W)
-                ost = _tree_where(vb, newost, ost)
-                return (W, ost, stash_W, stash_h, fmsg, bmsg, loss_acc), None
-            gsum = jtu.tree_map(lambda a, g: a + g, gsum, dW)
-            return (stash_h, fmsg, bmsg, loss_acc, gsum), None
+                if mode == "async":
+                    return (W, ost, stash_W, stash_h, fmsg, bmsg,
+                            loss_acc), None
+                return (stash_h, fmsg, bmsg, loss_acc, gsum), None
 
-        T = M + 2 * S - 2 if S > 1 else M
-        carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+            return tick
+
+        carry = carry0
+        for lo, hi, df, db in ((0, T1, True, False), (T1, T2, True, True),
+                               (T2, T3, False, True)):
+            if hi > lo:
+                carry, _ = lax.scan(make_tick(df, db), carry,
+                                    jnp.arange(lo, hi))
 
         if mode == "async":
             W, ost, loss_acc = carry[0], carry[1], carry[-1]
@@ -241,7 +337,7 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
             # the vjp already psum-reduced dW over dp (W is dp-invariant);
             # rescale the sum to the mean over replicas.
             gsum = jtu.tree_map(lambda g: g / mesh.shape[dp_axis], gsum)
-        grads = jtu.tree_map(lambda g: g[None] / M, gsum)
+        grads = jtu.tree_map(lambda g: g / M, gsum)
         return loss_out, grads
 
     if mode == "sync":
@@ -264,9 +360,41 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
     )(stage_params, opt_state, xs, ys, exs)
 
 
+def interleave_stages(stacked, S: int, V: int):
+    """Depth-order stacked stage params ([S*V, ...] leaves, virtual stage
+    ``u`` at index ``u``) -> the device-major layout ``_run_1f1b`` shards
+    (position ``d*V + v`` holds virtual stage ``u = v*S + d``, so the
+    ``P(axis)`` split hands device ``d`` exactly its V chunks)."""
+    perm = jnp.asarray([(p % V) * S + p // V for p in range(S * V)])
+    return jtu.tree_map(lambda l: l[perm], stacked)
+
+
+def uninterleave_stages(stacked, S: int, V: int):
+    """Inverse of :func:`interleave_stages` (device-major -> depth order);
+    apply to the grads returned by ``pipedream_grads(virtual_stages=V)``."""
+    perm = jnp.asarray([(u % S) * V + u // S for u in range(S * V)])
+    return jtu.tree_map(lambda l: l[perm], stacked)
+
+
+def pipedream_schedule_stats(S: int, V: int, M: int,
+                             f_cost: float = 1.0, b_cost: float = 2.0):
+    """Analytic tick counts and bubble fraction of the three-phase
+    schedule (f_cost/b_cost: relative per-tick cost of the forward-only
+    and backward-only bodies; the backward recomputes the forward under
+    vjp, hence the 2x default).  V == 1 gives the classic 1F1B bubble
+    (S-1)/(M+S-1); V > 1 the Megatron interleaved bound with the
+    denominator scaled by V."""
+    t1, t2, t3 = _phase_bounds(S, V, M)
+    total = t1 * f_cost + (t2 - t1) * (f_cost + b_cost) + (t3 - t2) * b_cost
+    ideal = M * V * (f_cost + b_cost)
+    return {"warmup_ticks": t1, "steady_ticks": t2 - t1,
+            "drain_ticks": t3 - t2, "total_ticks": t3,
+            "bubble_fraction": 1.0 - ideal / total}
+
+
 def pipedream_grads(stage_fn, loss_fn, stage_params, x, y, extras=None, *,
                     mesh: Mesh, axis: str = "pp", n_microbatches: int,
-                    dp_axis: Optional[str] = None):
+                    dp_axis: Optional[str] = None, virtual_stages: int = 1):
     """Synchronous 1F1B: gradients of the mean-over-microbatches loss.
 
     ``stage_fn(stage_params_local, h, extras_mb) -> h'`` is the per-stage
@@ -277,11 +405,27 @@ def pipedream_grads(stage_fn, loss_fn, stage_params, x, y, extras=None, *,
     ``grads`` shaped/sharded like ``stage_params``.  Numerically equal to
     differentiating the GPipe pipeline; peak activation memory is O(S)
     in-flight microbatches instead of O(M).
+
+    ``virtual_stages=V > 1`` interleaves V model chunks per device
+    (Megatron-LM interleaved 1F1B): ``stage_params`` leaves become
+    ``[S*V, ...]`` in DEVICE-MAJOR order — build them from depth order
+    with :func:`interleave_stages`, and map the returned grads back with
+    :func:`uninterleave_stages`.  Microbatches should be a multiple of S
+    (the schedule's group size; other M still compute correctly but
+    waste bubble ticks).  Bubble drops from (S-1)/(M+S-1) to
+    ~(S-1)/(M·V) — see :func:`pipedream_schedule_stats`.
     """
+    leading = {l.shape[0] for l in jtu.tree_leaves(stage_params)}
+    want = mesh.shape[axis] * virtual_stages
+    if leading != {want}:
+        raise ValueError(
+            f"stage_params leading dims {sorted(leading)} != S*V = {want} "
+            f"(S={mesh.shape[axis]}, virtual_stages={virtual_stages}); "
+            "for V > 1 build device-major params with interleave_stages()")
     return _run_1f1b(stage_fn, loss_fn, stage_params, None, None, x, y,
                      extras, mesh=mesh, axis=axis,
                      n_microbatches=n_microbatches, dp_axis=dp_axis,
-                     mode="sync")
+                     mode="sync", virtual_stages=virtual_stages)
 
 
 def pipedream_train_step(stage_fn, loss_fn, opt, stage_params, opt_state, x,
