@@ -384,10 +384,13 @@ def bench_autogpt(on_tpu, kind, peak):
 # configs 5+6: BERT-large pretraining (long-seq flash + headline)
 # ---------------------------------------------------------------------------
 
-def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, use_flash,
-              metric):
+def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln):
+    """Build a fresh BERT trainer with the given (attention core, fused_ln)
+    variant and return the timing dict (+ config/flops context).
+    attn: "flash" = Pallas kernel, "xla" = materialized bhsd core."""
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.exec import Trainer
+    from hetu_tpu.layers.attention import dot_product_attention_bhsd
     from hetu_tpu.models import BertForPreTraining, bert_base, bert_large
     from hetu_tpu.ops.pallas import flash_attn_fn
     from hetu_tpu.optim import AdamWOptimizer
@@ -395,17 +398,17 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, use_flash,
     set_random_seed(0)
     if on_tpu:
         cfg = bert_large(max_position_embeddings=max(512, seq),
-                         dtype=jnp.bfloat16)
+                         fused_ln=fused_ln, dtype=jnp.bfloat16)
     else:
         cfg = bert_base(num_layers=2, hidden_size=128, num_heads=2,
-                        vocab_size=8192, dtype=jnp.float32)
+                        vocab_size=8192, fused_ln=fused_ln,
+                        dtype=jnp.float32)
         batch, seq, k = 8, 64, 2
     # the native (B,H,S,D) einsum projection path pays off for BOTH cores:
     # flash at seq 512, and the XLA materialized core at seq 128 (0.634 ->
     # 0.658 MFU: the qkv split/relayout copies vanish)
-    from hetu_tpu.layers.attention import dot_product_attention_bhsd
     model = BertForPreTraining(
-        cfg, attn_fn=(flash_attn_fn(native_layout=True) if use_flash
+        cfg, attn_fn=(flash_attn_fn(native_layout=True) if attn == "flash"
                       else dot_product_attention_bhsd) if on_tpu else None)
 
     def loss_fn(model, b, key):
@@ -429,35 +432,85 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, use_flash,
         "nsp_labels": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
     }
     t = timed_step(trainer, b, k=k, on_tpu=on_tpu)
-    flops = transformer_train_flops(
+    t["flops"] = transformer_train_flops(
         cfg.num_layers, cfg.hidden_size, cfg.vocab_size, batch, seq,
         cfg.intermediate_ratio)
-    mfu = flops / t["median_s"] / peak
+    t["batch"], t["seq"] = batch, seq
+    return t
+
+
+def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric):
+    """Measure each (attn, fused_ln) variant with a short probe, emit the
+    full-length winner.  This is how perf decisions stay MEASURED per
+    round instead of frozen: r04's fused-LN kernel was
+    interpreter-validated but the tunnel died before any on-chip A/B
+    (TPU_CHECKS_r04), so the flag choice lives HERE, decided on the chip
+    the driver actually runs — and the losing variants' numbers ride the
+    artifact line (reference composes LayerNorm.cu + Dropout.cu as
+    discrete kernels either way)."""
+    ab, probes = {}, {}
+    if on_tpu and len(variants) > 1:
+        for attn, fl in variants:
+            tag = f"{attn}{'+fln' if fl else ''}"
+            try:
+                p = _bert_time(on_tpu, kind, peak, seq=seq, batch=batch,
+                               k=3, attn=attn, fused_ln=fl)
+                probes[(attn, fl)] = p
+                ab[tag] = round(p["median_s"] * 1e3, 2)
+            except Exception as e:
+                # a variant that deterministically cannot compile/run is
+                # disqualified with its error in the artifact; transient
+                # tunnel blips must NOT silently disqualify — re-raise into
+                # main()'s per-config transient retry
+                if any(s in str(e).lower() for s in _TRANSIENT):
+                    raise
+                traceback.print_exc()
+                ab[tag] = f"failed: {str(e)[:120]}"
+        if not probes:
+            raise RuntimeError(f"all bert variants failed: {ab}")
+        attn, fused_ln = min(probes, key=lambda v: probes[v]["median_s"])
+    else:
+        (attn, fused_ln), = variants[:1]
+    if (attn, fused_ln) in probes and k == 3:
+        t = probes[(attn, fused_ln)]  # the probe IS the full measurement
+    else:
+        t = _bert_time(on_tpu, kind, peak, seq=seq, batch=batch, k=k,
+                       attn=attn, fused_ln=fused_ln)
+    mfu = t["flops"] / t["median_s"] / peak
     return _line(
         metric if on_tpu else "bert_smoke_mfu", mfu, "MFU", mfu / 0.45,
-        samples_per_sec_per_chip=round(batch / t["median_s"], 2),
+        samples_per_sec_per_chip=round(t["batch"] / t["median_s"], 2),
         step_ms=round(t["median_s"] * 1e3, 2),
-        best_mfu=round(flops / t["min_s"] / peak, 4),
-        dropout=True, flash_attention=bool(use_flash and on_tpu),
-        device=kind, batch=batch, seq=seq, **_tinfo(t))
+        best_mfu=round(t["flops"] / t["min_s"] / peak, 4),
+        dropout=True, flash_attention=(attn == "flash" and on_tpu),
+        fused_ln=bool(fused_ln and on_tpu),
+        **({"ab_probe_ms": ab} if ab else {}),
+        device=kind, batch=t["batch"], seq=t["seq"], **_tinfo(t))
 
 
 def bench_bert_long(on_tpu, kind, peak):
     # batch 24: 48 (token parity with the seq-128 headline) OOMs on 16 GB —
-    # seq-512 MLP activation temps are 4x larger per token batch
+    # seq-512 MLP activation temps are 4x larger per token batch.
+    # Variants probed on-chip each run: the flash kernel vs the relayout-
+    # free XLA bhsd core (TPU_CHECKS_r04 measured the latter at 225 ms vs
+    # r03 flash's 274 — driver-unverified, hence measured HERE), each with
+    # and without the fused-LN kernel.
     return _bert_mfu(on_tpu, kind, peak, seq=512, batch=24, k=3,
-                     use_flash=True, metric="bert_large_seq512_mfu")
+                     variants=[("flash", False), ("xla", False),
+                               ("flash", True), ("xla", True)],
+                     metric="bert_large_seq512_mfu")
 
 
 def bench_bert_headline(on_tpu, kind, peak):
     # batch re-swept r03 with dropout ON: {64: 0.568, 96: 0.571, 128: 0.565,
     # 192: 0.531, 256: 0.495} — HBM pressure above ~128 degrades the whole
     # step (optimizer/LN fusions fall off roofline), so the r01 choice of
-    # 192 was costing ~7% MFU.  Flash at seq 128 re-measured and still
-    # loses to XLA (0.461 vs 0.571) — kernel overhead swamps 128-wide
-    # blocks; it stays OFF here and ON at seq 512.
+    # 192 was costing ~7% MFU.  Flash at seq 128 re-measured r03 and still
+    # lost to XLA (0.461 vs 0.571) — kernel overhead swamps 128-wide
+    # blocks; only the fused-LN choice is probed here (ROADMAP 4d).
     return _bert_mfu(on_tpu, kind, peak, seq=128, batch=96, k=5,
-                     use_flash=False, metric="bert_large_pretrain_mfu")
+                     variants=[("xla", False), ("xla", True)],
+                     metric="bert_large_pretrain_mfu")
 
 
 CONFIGS = [
