@@ -7,12 +7,16 @@ attention"); the LM-head kernel is the memory-critical one (the (N, vocab)
 logits tensor is the peak of LM pretraining).
 """
 
+from hetu_tpu.ops.pallas.autotune import (autotune_flash_blocks,
+                                          tuned_blocks)
 from hetu_tpu.ops.pallas.flash import (flash_attention,
                                        flash_attention_bhsd, flash_attn_fn,
                                        flash_block_bwd, flash_block_fwd)
 from hetu_tpu.ops.pallas.fused_ln import fused_residual_dropout_ln
 from hetu_tpu.ops.pallas.lm_head import lm_head_cross_entropy_pallas
 
-__all__ = ["flash_attention", "flash_attention_bhsd", "flash_attn_fn",
+__all__ = ["autotune_flash_blocks", "flash_attention",
+           "flash_attention_bhsd", "flash_attn_fn",
            "flash_block_fwd", "flash_block_bwd",
-           "fused_residual_dropout_ln", "lm_head_cross_entropy_pallas"]
+           "fused_residual_dropout_ln", "lm_head_cross_entropy_pallas",
+           "tuned_blocks"]

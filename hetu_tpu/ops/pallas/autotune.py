@@ -1,0 +1,214 @@
+"""Persistent block-size autotuner for the Pallas flash kernel.
+
+``_auto_blocks`` (flash.py) is a HEURISTIC table swept by hand on a v5e at
+head_dim 64 (plus two d=128 points) — every other (seq, head_dim, device)
+combination runs on extrapolation.  This module makes the sweep a
+framework feature instead of a round-artifact: ``autotune_flash_blocks``
+measures the candidate grid fwd+bwd on the live device with a
+differenced-scan timer (the tunnel's fixed ~110 ms dispatch cost cancels
+in the difference) and persists the winner to a JSON cache keyed by
+(device kind, Sq, Sk, head_dim, causal).  ``_block_sizes`` consults the
+cache at trace time, so every later jit of the same shape on the same
+device kind picks up the measured blocks with no code change.
+
+Reference parity note: the reference has no flash kernel and no tuner;
+the closest machinery is HetuSimulator's persistent op-time cache
+(reference python/hetu/profiler.py:609-877), whose cache-keyed-by-device
+design this follows (as does parallel/autoparallel/profiler.py).
+
+Usage (explicit, outside jit — measurement never happens implicitly at
+trace time):
+
+    from hetu_tpu.ops.pallas import autotune_flash_blocks
+    autotune_flash_blocks(512, 512, 128, causal=True)   # once per shape
+    # ... flash_attention / flash_attn_fn now use the measured blocks
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["autotune_flash_blocks", "tuned_blocks", "clear_tune_cache"]
+
+_CACHE_ENV = "HETU_TPU_FLASH_TUNE_CACHE"
+_DEFAULT_CACHE = pathlib.Path.home() / ".cache" / "hetu_tpu_flash_blocks.json"
+_mem_cache: dict | None = None
+
+
+def _cache_path() -> pathlib.Path:
+    return pathlib.Path(os.environ.get(_CACHE_ENV, _DEFAULT_CACHE))
+
+
+def _device_kind() -> str:
+    return str(getattr(jax.devices()[0], "device_kind", "cpu"))
+
+
+def _key(Sq: int, Sk: int, D: int, causal: bool, kind: str | None) -> str:
+    return f"{kind or _device_kind()}|{Sq}x{Sk}|d{D}|c{int(bool(causal))}"
+
+
+def _load() -> dict:
+    global _mem_cache
+    if _mem_cache is None:
+        try:
+            _mem_cache = json.loads(_cache_path().read_text())
+        except (OSError, ValueError):
+            _mem_cache = {}
+    return _mem_cache
+
+
+def clear_tune_cache() -> None:
+    """Drop the in-memory cache (tests; a changed cache file re-loads)."""
+    global _mem_cache
+    _mem_cache = None
+
+
+def tuned_blocks(Sq: int, Sk: int, D: int,
+                 causal: bool = False) -> tuple[int, int] | None:
+    """The measured (block_q, block_k) for this shape on this device kind,
+    or None if never autotuned.  Consulted by flash._block_sizes at trace
+    time (shapes are static under jit, so this is a plain dict lookup).
+    Falls back to the causal-complement entry: the block-size optimum
+    tracks the (seq, head_dim) footprint, not the mask."""
+    cache = _load()
+    for c in (causal, not causal):
+        hit = cache.get(_key(Sq, Sk, D, c, None))
+        if hit:
+            return int(hit["block_q"]), int(hit["block_k"])
+    return None
+
+
+def _candidate_grid(Sq: int, Sk: int, D: int, interpret: bool):
+    """128-aligned divisors of the (padded) sequence, VMEM-capped — the
+    same constraints _block_sizes enforces.  Interpreter mode (CPU tests)
+    lifts the 128-alignment rule like the kernel itself does."""
+    def divisors(S, cands):
+        return [c for c in cands if c <= S and S % c == 0]
+
+    if interpret:
+        qs = divisors(Sq, [max(1, Sq // 2), Sq]) or [Sq]
+        ks = divisors(Sk, [max(1, Sk // 2), Sk]) or [Sk]
+    else:
+        vmem_cap = max(128, (65536 // max(D, 1)) // 128 * 128)
+        qs = divisors(Sq, [128, 256, 512])
+        ks = [b for b in divisors(Sk, [128, 256, 512, 1024])
+              if b <= vmem_cap]
+    return [(bq, bk) for bq in qs for bk in ks]
+
+
+def _time_fwd_bwd(bq: int, bk: int, q, k, v, causal: bool, interpret: bool,
+                  n1: int, n2: int) -> float:
+    """Per-iteration seconds of flash fwd+bwd at (bq, bk), via a
+    differenced scan: time a scan of n1 and n2 chained iterations and
+    divide the delta — the fixed dispatch cost cancels.  ALL of dq/dk/dv
+    stay live (folded into the carry) so XLA cannot dead-code-eliminate
+    any backward matmul."""
+    from hetu_tpu.ops.pallas.flash import flash_attention_bhsd
+
+    def loss(q, k, v):
+        return flash_attention_bhsd(
+            q, k, v, causal=causal, block_q=bq, block_k=bk,
+            interpret=interpret).astype(jnp.float32).sum()
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    def chain(n):
+        def body(c, _):
+            q, k, v = c
+            dq, dk, dv = grad(q, k, v)
+            eps = jnp.asarray(1e-6, q.dtype)
+            return (q + eps * dq.astype(q.dtype),
+                    k + eps * dk.astype(k.dtype),
+                    v + eps * dv.astype(v.dtype)), ()
+
+        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=n)[0])
+
+    run1, run2 = chain(n1), chain(n2)
+
+    def t(run):
+        t0 = time.perf_counter()
+        out = run((q, k, v))
+        float(out[0].sum())  # sync (block_until_ready is a tunnel no-op)
+        return time.perf_counter() - t0
+
+    t(run1), t(run2)  # compile both
+    t(run1), t(run2)  # throwaway pair (first post-compile run skews)
+    d = [(t(run2) - t(run1)) / (n2 - n1) for _ in range(3)]
+    med = float(np.median(d))
+    if med <= 0:
+        # a latency spike on the short-chain side can make the difference
+        # negative; persisting that would let a garbage candidate win the
+        # grid and poison every later trace of this shape
+        raise RuntimeError(f"nonpositive differenced timing {d} (noise)")
+    return med
+
+
+def autotune_flash_blocks(Sq: int, Sk: int, D: int, *, causal: bool = False,
+                          batch: int = 4, heads: int = 8,
+                          dtype=jnp.bfloat16, interpret: bool | None = None,
+                          n1: int = 4, n2: int = 12, save: bool = True,
+                          budget_s: float | None = None,
+                          verbose: bool = False) -> dict:
+    """Measure the candidate (block_q, block_k) grid for this shape on the
+    live device and persist the winner.  Returns
+    {"block_q", "block_k", "table": {"bqxbk": seconds, ...}}.
+
+    Run OUTSIDE jit; costs one compile per candidate (a handful — the
+    grid is the 128-aligned divisors under the VMEM cap).  ``budget_s``
+    stops measuring further candidates once exceeded (keeps the
+    best-so-far; un-measured candidates are marked "skipped: budget").
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((batch, heads, Sq, D)) * 0.1, dtype)
+    q = mk()
+    k, v = (jnp.asarray(rng.standard_normal((batch, heads, Sk, D)) * 0.1,
+                        dtype) for _ in range(2))
+
+    table = {}
+    t_start = time.perf_counter()
+    for bq, bk in _candidate_grid(Sq, Sk, D, interpret):
+        if (budget_s is not None and table
+                and time.perf_counter() - t_start > budget_s):
+            table[f"{bq}x{bk}"] = "skipped: budget"
+            continue
+        try:
+            table[f"{bq}x{bk}"] = _time_fwd_bwd(
+                bq, bk, q, k, v, causal, interpret, n1, n2)
+        except Exception as e:  # candidate rejected by Mosaic/VMEM
+            table[f"{bq}x{bk}"] = f"failed: {str(e)[:120]}"
+        if verbose:
+            print(f"autotune {Sq}x{Sk} d{D}: {bq}x{bk} -> "
+                  f"{table[f'{bq}x{bk}']}")
+    timed = {kk: vv for kk, vv in table.items() if isinstance(vv, float)}
+    if not timed:
+        raise RuntimeError(f"no flash block candidate ran: {table}")
+    best = min(timed, key=timed.get)
+    bq, bk = (int(x) for x in best.split("x"))
+    entry = {"block_q": bq, "block_k": bk, "table": table,
+             "measured_at": {"batch": batch, "heads": heads,
+                             "dtype": str(jnp.dtype(dtype))}}
+    if save:
+        path = _cache_path()
+        try:  # merge against DISK, not the memoized snapshot — another
+            # process (or an earlier tune in this one) may have written
+            # entries since _load() memoized
+            cache = json.loads(path.read_text())
+        except (OSError, ValueError):
+            cache = {}
+        cache[_key(Sq, Sk, D, causal, None)] = entry
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(cache, indent=1))
+        tmp.replace(path)  # atomic: a concurrent reader never sees a torn file
+        clear_tune_cache()
+    return entry

@@ -572,7 +572,20 @@ _flash.defvjp(_flash_fwd, _bwd)
 # anywhere.  These raw entry points run the kernels on one (q-chunk,
 # kv-chunk) pair in (B, H, S, D) layout.
 
-def _block_sizes(Sq, Sk, D, block_q, block_k, interpret):
+def _apply_tuned(block_q, block_k, Sq, Sk, D, causal):
+    """Fill unset block sizes from the measured autotune cache (explicit
+    args always win; ops/pallas/autotune.py).  Shapes are static under
+    jit, so this is a dict lookup at trace time."""
+    if block_q is None or block_k is None:
+        from hetu_tpu.ops.pallas.autotune import tuned_blocks
+        tuned = tuned_blocks(Sq, Sk, D, causal)
+        if tuned is not None:
+            block_q, block_k = block_q or tuned[0], block_k or tuned[1]
+    return block_q, block_k
+
+
+def _block_sizes(Sq, Sk, D, block_q, block_k, interpret, causal=False):
+    block_q, block_k = _apply_tuned(block_q, block_k, Sq, Sk, D, causal)
     bq = block_q or _auto_blocks(Sq, Sk, D)[0]
     bk = block_k or _auto_blocks(Sq, Sk, D)[1]
     bq, bk = min(bq, Sq), min(bk, Sk)
@@ -595,7 +608,7 @@ def flash_block_fwd(q, k, v, *, scale, causal=False, block_q=None,
         interpret = jax.default_backend() != "tpu"
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret)
+    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret, causal)
     return _fwd_call(q, k, v, scale, causal, bq, bk, Sk, interpret)
 
 
@@ -612,7 +625,7 @@ def flash_block_bwd(q, k, v, do, lse, delta, *, scale, causal=False,
         interpret = jax.default_backend() != "tpu"
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret)
+    bq, bk = _block_sizes(Sq, Sk, D, block_q, block_k, interpret, causal)
     nq, nk = Sq // bq, Sk // bk
 
     if nq == 1 and nk == 1:
@@ -777,6 +790,7 @@ def flash_attention_bhsd(q, k, v, *, causal: bool = False,
     Sk = k.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
+    block_q, block_k = _apply_tuned(block_q, block_k, Sq, Sk, D, causal)
     auto_q, auto_k = _auto_blocks(_round_up(Sq, 128), _round_up(Sk, 128), D)
     block_q = min(block_q or auto_q, _round_up(Sq, 128))
     block_k = min(block_k or auto_k, _round_up(Sk, 128))

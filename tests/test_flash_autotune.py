@@ -1,0 +1,82 @@
+"""Flash block autotuner: measured cache entries outrank the heuristic.
+
+The hand-swept `_auto_blocks` table only covers the shapes past rounds
+measured (head_dim 64 + two d=128 points); ``autotune_flash_blocks``
+makes any (seq, head_dim, device-kind) combination measurable on the spot
+and persists the winner.  These tests run the REAL tuner in interpreter
+mode on a tiny shape (end-to-end: measurement, persistence, atomic write)
+and pin the trace-time lookup priority: explicit args > tuned cache >
+heuristic.
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from hetu_tpu.ops.pallas import autotune as at
+from hetu_tpu.ops.pallas.flash import _auto_blocks, _block_sizes
+
+
+@pytest.fixture
+def tune_cache(tmp_path, monkeypatch):
+    path = tmp_path / "flash_blocks.json"
+    monkeypatch.setenv(at._CACHE_ENV, str(path))
+    at.clear_tune_cache()
+    yield path
+    at.clear_tune_cache()
+
+
+def test_autotune_runs_and_persists(tune_cache):
+    entry = at.autotune_flash_blocks(
+        8, 8, 4, causal=True, batch=1, heads=1, dtype=jnp.float32,
+        interpret=True, n1=1, n2=2)
+    assert entry["block_q"] in (4, 8) and entry["block_k"] in (4, 8)
+    assert any(isinstance(v, float) for v in entry["table"].values())
+    # persisted, and the file is valid json with the device-kind key
+    disk = json.loads(tune_cache.read_text())
+    (key,) = disk.keys()
+    assert "|8x8|d4|c1" in key
+    # the lookup sees it (and the causal-complement fallback works)
+    assert at.tuned_blocks(8, 8, 4, causal=True) == (
+        entry["block_q"], entry["block_k"])
+    assert at.tuned_blocks(8, 8, 4, causal=False) == (
+        entry["block_q"], entry["block_k"])
+    assert at.tuned_blocks(16, 16, 4, causal=True) is None
+
+
+def test_block_sizes_priority(tune_cache):
+    # seed a fake measured entry
+    tune_cache.write_text(json.dumps({
+        at._key(256, 256, 64, False, None): {"block_q": 256, "block_k": 128},
+    }))
+    at.clear_tune_cache()
+    heur = _auto_blocks(256, 256, 64)
+    assert (256, 128) != heur  # the test must distinguish cache from table
+    # tuned cache outranks the heuristic...
+    assert _block_sizes(256, 256, 64, None, None, True) == (256, 128)
+    # ...explicit args outrank the cache (per-axis)
+    assert _block_sizes(256, 256, 64, 64, None, True) == (64, 128)
+    # uncached shapes fall through to the heuristic
+    s = 512
+    assert _block_sizes(s, s, 64, None, None, True) == \
+        tuple(min(b, s) for b in _auto_blocks(s, s, 64))
+
+
+def test_tuner_feeds_flash_attention_bhsd(tune_cache):
+    """End to end: a tuned entry changes the blocks the kernel entry uses
+    (observable because mis-dividing blocks would raise; here we check via
+    the interpret path running fine with the tuned 4x4 on an 8-seq)."""
+    import numpy as np
+
+    from hetu_tpu.ops.pallas.flash import flash_attention_bhsd
+
+    tune_cache.write_text(json.dumps({
+        at._key(8, 8, 4, True, None): {"block_q": 4, "block_k": 4},
+    }))
+    at.clear_tune_cache()
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 1, 8, 4)), jnp.float32)
+               for _ in range(3))
+    out = flash_attention_bhsd(q, k, v, causal=True, interpret=True)
+    assert out.shape == (1, 1, 8, 4)
