@@ -915,25 +915,82 @@ struct Server {
   }
 };
 
+// ---------------------------------------------------------------------------
+// transport seam (client side)
+//
+// ps-lite swaps its whole Van subclass by scheme — zmq_van.h, p3_van.h,
+// ibverbs_van.h:484 — and the RDMA van is ~1500 lines because it re-owns
+// framing, memory registration, and connection state.  Here the protocol
+// (ReqHeader framing, op enums, response handling) is transport-neutral
+// already, so the seam is ONE interface: a Channel is a reliable ordered
+// byte stream with scatter-gather send.  TcpChannel is the only backend
+// buildable in this image (no verbs hardware/headers); an RDMA backend is
+// a drop-in: implement Channel over RC queue pairs (send -> post iovecs
+// from registered regions, recv -> completion-queue poll into the caller
+// buffer) and add its scheme to make_channel.  Selection:
+// HETU_PS_TRANSPORT env ("tcp" default; "rdma" reports unavailability
+// loudly rather than silently falling back).  The server's accept loop
+// (Server::start) is the matching listener seam — an RdmaListener would
+// slot there, handing established channels to the same per-connection
+// handler.
+// ---------------------------------------------------------------------------
+
+struct Channel {
+  virtual ~Channel() = default;
+  virtual bool send(iovec* iov, int n) = 0;       // gather-send, all-or-fail
+  virtual bool recv(void* buf, size_t len) = 0;   // exact-length read
+};
+
+struct TcpChannel : Channel {
+  int fd;
+  explicit TcpChannel(int fd_) : fd(fd_) {}
+  ~TcpChannel() override {
+    if (fd >= 0) ::close(fd);
+  }
+  bool send(iovec* iov, int n) override { return writev_full(fd, iov, n); }
+  bool recv(void* buf, size_t len) override {
+    return read_full(fd, buf, len);
+  }
+};
+
+Channel* make_channel(const char* scheme, const addrinfo* res) {
+  if (!scheme || !*scheme || std::strcmp(scheme, "tcp") == 0) {
+    int sock = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (sock >= 0 && ::connect(sock, res->ai_addr, res->ai_addrlen) != 0) {
+      ::close(sock);
+      sock = -1;
+    }
+    if (sock < 0) return nullptr;
+    int one = 1;
+    ::setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return new TcpChannel(sock);
+  }
+  if (std::strcmp(scheme, "rdma") == 0) {
+    std::fprintf(stderr,
+                 "hetu_ps: HETU_PS_TRANSPORT=rdma requested but no verbs "
+                 "backend is built (no RDMA hardware/headers in this "
+                 "image); implement Channel over ibverbs and register it "
+                 "here (see ps_net.cpp transport seam)\n");
+    return nullptr;
+  }
+  std::fprintf(stderr, "hetu_ps: unknown HETU_PS_TRANSPORT '%s'\n", scheme);
+  return nullptr;
+}
+
 struct Client {
   // Two independently-locked channels to the same server (the portable
   // core of ps-lite's priority-scheduled P3 van, p3_van.h:12): bulk
-  // traffic (pulls, prefetch delta syncs — large responses) rides ``fd``;
-  // gradient pushes and blocking control ops ride ``fd_prio`` so they are
-  // never queued behind an in-flight bulk response on one socket.  The
+  // traffic (pulls, prefetch delta syncs — large responses) rides ``ch``;
+  // gradient pushes and blocking control ops ride ``ch_prio`` so they are
+  // never queued behind an in-flight bulk response on one channel.  The
   // server handles each connection on its own thread, so a push completes
   // while a large prefetch pull is still streaming.
-  int fd = -1;       // bulk channel
-  int fd_prio = -1;  // priority channel (-1: single-channel mode)
+  std::unique_ptr<Channel> ch;       // bulk channel
+  std::unique_ptr<Channel> ch_prio;  // priority (null: single-channel mode)
   std::mutex mu;       // one in-flight request per channel
   std::mutex mu_prio;
 
-  ~Client() {
-    if (fd >= 0) ::close(fd);
-    if (fd_prio >= 0) ::close(fd_prio);
-  }
-
-  int64_t request_on(int sock, std::mutex& m, const ReqHeader& h,
+  int64_t request_on(Channel& c, std::mutex& m, const ReqHeader& h,
                      const int64_t* keys, const float* floats,
                      const char* bytes, float* out, int64_t out_floats) {
     std::lock_guard<std::mutex> lk(m);
@@ -948,17 +1005,17 @@ struct Client {
                   static_cast<size_t>(h.nfloats * 4)};
     if (h.nbytes)
       iov[n++] = {const_cast<char*>(bytes), static_cast<size_t>(h.nbytes)};
-    if (!writev_full(sock, iov, n)) return -10;
+    if (!c.send(iov, n)) return -10;
     RespHeader r;
-    if (!read_full(sock, &r, sizeof(r))) return -11;
+    if (!c.recv(&r, sizeof(r))) return -11;
     if (r.nfloats) {
       if (r.nfloats != out_floats || !out) {
         // drain to keep the stream consistent, then report
         std::vector<float> sink(r.nfloats);
-        read_full(sock, sink.data(), r.nfloats * 4);
+        c.recv(sink.data(), r.nfloats * 4);
         return -12;
       }
-      if (!read_full(sock, out, r.nfloats * 4)) return -11;
+      if (!c.recv(out, r.nfloats * 4)) return -11;
     }
     return r.status;
   }
@@ -966,15 +1023,15 @@ struct Client {
   int64_t request(const ReqHeader& h, const int64_t* keys,
                   const float* floats, const char* bytes, float* out,
                   int64_t out_floats) {
-    return request_on(fd, mu, h, keys, floats, bytes, out, out_floats);
+    return request_on(*ch, mu, h, keys, floats, bytes, out, out_floats);
   }
 
   int64_t request_prio(const ReqHeader& h, const int64_t* keys,
                        const float* floats, const char* bytes, float* out,
                        int64_t out_floats) {
-    if (fd_prio < 0)  // HETU_PS_SINGLE_CHANNEL=1 (A/B benchmarking)
-      return request_on(fd, mu, h, keys, floats, bytes, out, out_floats);
-    return request_on(fd_prio, mu_prio, h, keys, floats, bytes, out,
+    if (!ch_prio)  // HETU_PS_SINGLE_CHANNEL=1 (A/B benchmarking)
+      return request_on(*ch, mu, h, keys, floats, bytes, out, out_floats);
+    return request_on(*ch_prio, mu_prio, h, keys, floats, bytes, out,
                       out_floats);
   }
 
@@ -991,11 +1048,11 @@ struct Client {
     if (h.nfloats)
       iov[n++] = {const_cast<float*>(floats),
                   static_cast<size_t>(h.nfloats * 4)};
-    if (!writev_full(fd, iov, n)) return -10;
+    if (!ch->send(iov, n)) return -10;
     RespHeader r;
-    if (!read_full(fd, &r, sizeof(r))) return -11;
+    if (!ch->recv(&r, sizeof(r))) return -11;
     out.resize(r.nfloats);
-    if (r.nfloats && !read_full(fd, out.data(), r.nfloats * 4)) return -11;
+    if (r.nfloats && !ch->recv(out.data(), r.nfloats * 4)) return -11;
     return r.status;
   }
 };
@@ -1318,26 +1375,15 @@ void* het_ps_connect(const char* host, int port) {
   std::string port_s = std::to_string(port);
   if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
     return nullptr;
-  auto dial = [&]() {
-    int sock = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-    if (sock >= 0 && ::connect(sock, res->ai_addr, res->ai_addrlen) != 0) {
-      ::close(sock);
-      sock = -1;
-    }
-    if (sock >= 0) {
-      int one = 1;
-      ::setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    }
-    return sock;
-  };
+  const char* scheme = ::getenv("HETU_PS_TRANSPORT");  // see transport seam
   auto* c = new Client();
-  c->fd = dial();
+  c->ch.reset(make_channel(scheme, res));
   const char* single = ::getenv("HETU_PS_SINGLE_CHANNEL");
   bool split = !(single && single[0] == '1');
   if (split)  // see Client: separate channel for pushes/control
-    c->fd_prio = dial();
+    c->ch_prio.reset(make_channel(scheme, res));
   ::freeaddrinfo(res);
-  if (c->fd < 0 || (split && c->fd_prio < 0)) {
+  if (!c->ch || (split && !c->ch_prio)) {
     delete c;
     return nullptr;
   }

@@ -669,3 +669,28 @@ def test_autosave_plus_restart_recovers_hands_off(tmp_path):
             if p is not None and p.poll() is None:
                 p.terminate()
                 p.wait(10)
+
+
+def test_transport_scheme_selection(monkeypatch):
+    """The client van's transport seam: tcp (default and explicit) connects;
+    rdma — the documented drop-in slot with no verbs backend in this image
+    — must fail LOUDLY at connect (null client), never silently fall back;
+    unknown schemes likewise."""
+    from hetu_tpu.embed.net import EmbeddingServer, _lib
+
+    lib = _lib()
+    with EmbeddingServer() as srv:
+        def connect(scheme):
+            if scheme is None:
+                monkeypatch.delenv("HETU_PS_TRANSPORT", raising=False)
+            else:
+                monkeypatch.setenv("HETU_PS_TRANSPORT", scheme)
+            c = lib.het_ps_connect(b"127.0.0.1", srv.port)
+            if c:
+                lib.het_ps_disconnect(c)
+            return bool(c)
+
+        assert connect(None)
+        assert connect("tcp")
+        assert not connect("rdma")
+        assert not connect("quic")
