@@ -214,6 +214,8 @@ class TestDimFamily:
         assert full.proj is None
         check_forward_and_grad(full)
 
+    # slow tier (r5 re-tier): the dim-family unit tests stay fast; this is the supernet integration
+    @pytest.mark.slow
     def test_autodim_supernet_and_materialize(self):
         layer = AutoDimEmbedding(VOCAB, dim_candidates=[2, 4, 8],
                                  num_slot=2)

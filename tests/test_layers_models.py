@@ -74,6 +74,8 @@ def test_batchnorm_state_threading():
     np.testing.assert_array_equal(bn3.running_mean, bn2.running_mean)
 
 
+# slow tier (r5 re-tier): resnet is bench config 1 + alexnet forward stays fast
+@pytest.mark.slow
 def test_resnet18_forward_and_state():
     m = resnet18(num_classes=10)
     x = jnp.ones((2, 32, 32, 3))
@@ -89,6 +91,8 @@ def test_lenet_mlp():
     assert MLP((16, 8, 4))(jnp.ones((3, 16))).shape == (3, 4)
 
 
+# slow tier (r5 re-tier): BERT torch-parity oracle gates this in the slow tier; mlm-mask semantics stay fast
+@pytest.mark.slow
 def test_bert_tiny_forward_and_loss():
     cfg = bert_base(vocab_size=100, hidden_size=32, num_layers=2, num_heads=2,
                     max_position_embeddings=16)

@@ -262,6 +262,8 @@ def test_bert_moe_pretraining_trains():
     assert np.isfinite(float(m["moe_aux"]))
 
 
+# slow tier (r5 re-tier): dryrun config B exercises MoE+EP on the mesh every driver round
+@pytest.mark.slow
 def test_bert_moe_expert_parallel_mesh():
     """MoE BERT over an ep mesh axis — the hetu_bert_moe distributed config."""
     from hetu_tpu.core import set_random_seed
@@ -293,6 +295,8 @@ def test_bert_moe_expert_parallel_mesh():
     assert np.isfinite(float(m["loss"]))
 
 
+# slow tier (r5 re-tier): per-gate index_plan equivalence stays fast; this is the full-layer integration
+@pytest.mark.slow
 def test_index_dispatch_matches_einsum_dispatch():
     """The scatter/gather routing path must produce the same outputs as
     the one-hot einsum path (same _slot_positions math) for top-1 and
@@ -388,6 +392,8 @@ def test_routing_stats_oracle():
     np.testing.assert_allclose(float(s2["load_entropy"]), 1.0, rtol=1e-6)
 
 
+# slow tier (r5 re-tier): torch routing oracle incl. forced overflow gates this in the slow tier
+@pytest.mark.slow
 def test_moe_ep_stats_and_overflow_threshold(ep_mesh):
     """The EP path reports routing stats (pmean'd across ranks) and a
     sanely-configured layer keeps overflow bounded — the observability

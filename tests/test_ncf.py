@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hetu_tpu.core import set_random_seed
 from hetu_tpu.models import GMF, MF, MLPRec, NeuMF
@@ -27,6 +28,8 @@ def test_neumf_split_shapes():
     assert m.logits(ids).shape == (1,)
 
 
+# slow tier (r5 re-tier): NeuMF torch oracle (slow tier) covers training parity; shape tests stay fast
+@pytest.mark.slow
 def test_all_heads_train():
     rng = np.random.default_rng(0)
     n_users, n_items = 30, 40

@@ -65,6 +65,8 @@ def test_ring_attention_grads_match_dense(sp_mesh, causal, impl):
                                    rtol=1e-4, atol=1e-5)
 
 
+# slow tier (r5 re-tier): kernel-level block parity stays fast (test_flash); the fallback twin is already slow
+@pytest.mark.slow
 def test_ring_flash_multi_block_chunks(sp_mesh):
     """Flash-ring with chunks that split into multiple kernel blocks:
     explicit 32-wide blocks over s_local=128 chunks force nq=nk=4 inside

@@ -110,6 +110,8 @@ def test_mha_megatron_sharded_with_sp_tp_ring(mesh3):
                                rtol=2e-5, atol=2e-5)
 
 
+# slow tier (r5 re-tier): dryrun config G runs the same sp-x-tp step vs unsharded trace every driver round
+@pytest.mark.slow
 def test_gpt_training_step_sp_tp_dp_matches_unsharded(mesh3):
     """Full training step on MeshSpec(dp=2, tp=2, sp=2): params tp-sharded
     by MEGATRON_RULES, attention ringing over sp with heads over tp.  The
