@@ -27,6 +27,8 @@ def tune_cache(tmp_path, monkeypatch):
     at.clear_tune_cache()
 
 
+# slow tier (r5 re-tier pass 2): the cache-priority + kernel-feed tests stay fast; this runs the real tuner in the interpreter
+@pytest.mark.slow
 def test_autotune_runs_and_persists(tune_cache):
     entry = at.autotune_flash_blocks(
         8, 8, 4, causal=True, batch=1, heads=1, dtype=jnp.float32,

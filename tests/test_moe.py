@@ -131,6 +131,8 @@ def test_new_gates_drive_moe_layer(make_gate):
     assert np.isfinite(np.asarray(y)).all()
 
 
+# slow tier (r5 re-tier pass 2): dryrun config B runs MoE+EP on the mesh every driver round
+@pytest.mark.slow
 def test_moe_ep_matches_single_group(ep_mesh):
     set_random_seed(2)
     T, d, E = 32, 8, 8

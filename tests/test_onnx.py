@@ -221,6 +221,9 @@ class TestModels:
 
 
 class TestScanExport:
+    # slow tier (r5 re-tier pass 2): reverse-scan roundtrip keeps the
+    # scan-export path fast; LSTM is the heavier twin
+    @pytest.mark.slow
     def test_lstm_roundtrip(self):
         from hetu_tpu.core import set_random_seed
         from hetu_tpu.models import LSTMCell, RNN
@@ -289,6 +292,8 @@ class TestScanExport:
             export_fn(f, jnp.zeros((0, 3), jnp.float32))
 
 
+# slow tier (r5 re-tier pass 2): MLP/CNN roundtrips stay fast; the external-consumer BERT test is slow-tier too
+@pytest.mark.slow
 def test_bert_roundtrip():
     """Full BERT-for-pretraining forward exports and re-imports with
     matching numerics — transformer coverage beyond the reference's

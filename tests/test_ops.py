@@ -309,6 +309,8 @@ def test_dropout_mask_statistics():
     np.testing.assert_array_equal(np.asarray(g) != 0, kept)
 
 
+# slow tier (r5 re-tier pass 2): the other lm_head equivalence/grad tests stay fast
+@pytest.mark.slow
 def test_lm_head_cross_entropy_streams_exactly(rng):
     """Vocab-chunked LM-head CE == materialized logits oracle: forward,
     all three gradients, ignore_index, non-dividing chunk, no-bias."""

@@ -4,6 +4,7 @@ runner vs per-step loop, and end-to-end classifier training."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hetu_tpu.core import set_random_seed
 from hetu_tpu.models import GRUCell, LSTMCell, RNN, RNNCell, RNNClassifier
@@ -102,6 +103,8 @@ def test_rnn_classifier_trains():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+# slow tier (r5 re-tier pass 2): lenet/mlp/vgg-pattern forwards stay fast
+@pytest.mark.slow
 def test_alexnet_forward():
     from hetu_tpu.models import alexnet
     set_random_seed(4)

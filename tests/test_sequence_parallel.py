@@ -47,7 +47,11 @@ def test_ulysses_matches_dense(sp_mesh, causal):
 
 
 @pytest.mark.parametrize("impl", ["flash", "blockwise"])
-@pytest.mark.parametrize("causal", [False, True])
+# slow tier (r5 re-tier pass 2): causal grads stay fast for both impls; the
+# non-causal grad variants add compile time without a distinct code path
+# (forward-value tests cover non-causal fast)
+@pytest.mark.parametrize("causal", [
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_ring_attention_grads_match_dense(sp_mesh, causal, impl):
     q, k, v = _qkv(seed=2)
 
