@@ -7,4 +7,5 @@ from hetu_tpu.exec.checkpoint import (
     state_dict,
 )
 from hetu_tpu.exec.logger import Logger, WandbLogger
+from hetu_tpu.exec.profiler import audit_donation
 from hetu_tpu.exec import metrics
