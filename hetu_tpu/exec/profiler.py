@@ -23,8 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["HetuTimer", "device_op_breakdown", "profile_fn", "compiled_cost", "primitive_counts",
-           "trace"]
+__all__ = ["HetuTimer", "audit_donation", "device_op_breakdown",
+           "profile_fn", "compiled_cost", "primitive_counts", "trace"]
 
 
 class HetuTimer:
@@ -131,16 +131,25 @@ def compiled_cost(fn: Callable, *example_args, static_argnums=()) -> dict:
         out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
     except Exception:  # backend without cost analysis
         pass
+    out.update(_memory_stats(compiled))
+    return out
+
+
+def _memory_stats(compiled) -> dict:
+    """argument/output/alias/temp byte sizes of a compiled executable
+    (empty dict on backends without memory analysis)."""
     try:
         mem = compiled.memory_analysis()
-        if mem is not None:
-            out["temp_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0))
-            out["argument_bytes"] = float(
-                getattr(mem, "argument_size_in_bytes", 0))
-            out["output_bytes"] = float(getattr(mem, "output_size_in_bytes", 0))
     except Exception:
-        pass
-    return out
+        return {}
+    if mem is None:
+        return {}
+    return {
+        "argument_bytes": float(getattr(mem, "argument_size_in_bytes", 0)),
+        "output_bytes": float(getattr(mem, "output_size_in_bytes", 0)),
+        "aliased_bytes": float(getattr(mem, "alias_size_in_bytes", 0)),
+        "temp_bytes": float(getattr(mem, "temp_size_in_bytes", 0)),
+    }
 
 
 def audit_donation(trainer, batch, key=None) -> dict:
@@ -157,41 +166,28 @@ def audit_donation(trainer, batch, key=None) -> dict:
     Returns {"argument_bytes", "output_bytes", "aliased_bytes",
     "temp_bytes", "donated_fraction", "unusable": [messages]} where
     ``unusable`` captures XLA's "donated buffers were not usable"
-    warnings (expected: ALL of them on the CPU backend, which does not
-    implement donation — the audit is meaningful on TPU).
+    warnings.  Numeric keys are 0.0 when the step cannot be lowered or
+    the backend reports no memory analysis — the report degrades, it
+    never KeyErrors.
     """
-    import io
     import warnings
-    from contextlib import redirect_stderr
 
-    import jax as _jax
-
-    key = _jax.random.key(0) if key is None else key
-    out: dict = {"unusable": []}
+    key = jax.random.key(0) if key is None else key
+    out: dict = {"argument_bytes": 0.0, "output_bytes": 0.0,
+                 "aliased_bytes": 0.0, "temp_bytes": 0.0,
+                 "donated_fraction": 0.0, "unusable": []}
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
-        buf = io.StringIO()
-        with redirect_stderr(buf):
-            lowered = trainer._train_step.lower(trainer.state, batch, key) \
-                if hasattr(trainer._train_step, "lower") else None
-            compiled = lowered.compile() if lowered is not None else None
-    for w in caught:
-        msg = str(w.message)
-        if "donated" in msg.lower():
-            out["unusable"].append(msg)
+        lowered = trainer._train_step.lower(trainer.state, batch, key) \
+            if hasattr(trainer._train_step, "lower") else None
+        compiled = lowered.compile() if lowered is not None else None
+    out["unusable"] = [str(w.message) for w in caught
+                       if "donated" in str(w.message).lower()]
     if compiled is None:
         return out
-    try:
-        mem = compiled.memory_analysis()
-    except Exception:
-        mem = None
-    if mem is not None:
-        arg = float(getattr(mem, "argument_size_in_bytes", 0))
-        out["argument_bytes"] = arg
-        out["output_bytes"] = float(getattr(mem, "output_size_in_bytes", 0))
-        out["aliased_bytes"] = float(getattr(mem, "alias_size_in_bytes", 0))
-        out["temp_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0))
-        out["donated_fraction"] = (out["aliased_bytes"] / arg if arg else 0.0)
+    out.update(_memory_stats(compiled))
+    if out["argument_bytes"]:
+        out["donated_fraction"] = out["aliased_bytes"] / out["argument_bytes"]
     return out
 
 

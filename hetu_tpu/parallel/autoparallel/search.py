@@ -38,6 +38,9 @@ class Plan:
     time: float            # modeled step time (s)
     peak_bytes: float      # modeled per-device memory
     feasible: bool
+    # interleaved virtual stages per device (pipedream schedule; the
+    # runtime knob is pipedream_grads(virtual_stages=V))
+    virtual_stages: int = 1
 
     @property
     def dominant(self) -> ParallelChoice:
@@ -47,7 +50,8 @@ class Plan:
 
     def describe(self) -> str:
         d = self.dominant
-        return (f"pp={self.pp} micro={self.n_microbatches} {d} "
+        v = f" V={self.virtual_stages}" if self.virtual_stages > 1 else ""
+        return (f"pp={self.pp} micro={self.n_microbatches}{v} {d} "
                 f"time={self.time * 1e3:.2f}ms "
                 f"mem={self.peak_bytes / 1e9:.2f}GB")
 
@@ -249,17 +253,27 @@ def partition_stages(costs: Sequence[float], pp: int) -> list[int]:
 
 def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                      global_batch: int, *, schedule: str,
-                     microbatch_options: Sequence[int]) -> tuple[Plan, list[int]]:
+                     microbatch_options: Sequence[int],
+                     virtual_stage_options: Sequence[int] = (1,)
+                     ) -> tuple[Plan, list[int]]:
     """Shared machinery for GPipe/PipeDream/PipeOpt searching: pick pp, a
     cost-balanced stage partition, a uniform per-stage choice, and the
     microbatch count.  Both schedules share the (n_micro + pp - 1) x slot
     critical-path time bound; 1F1B ('pipedream') additionally charges
     weight-stash memory for in-flight microbatches, which changes which
-    plans are feasible."""
+    plans are feasible — and may interleave V virtual stages per device
+    (pipedream_grads' three-phase schedule), shrinking the bubble term to
+    (pp - 1) x slot / V at ~V x the in-flight activation stash (the
+    time model matches pipedream_schedule_stats' phase algebra)."""
     mem_model = MemoryCostModel(cluster)
     time_model = TimeCostModel(cluster)
     best: Optional[Plan] = None
     best_bounds: list[int] = [len(layers)]
+    v_options = (virtual_stage_options if schedule == "pipedream" else (1,))
+    if any(v < 1 for v in v_options):
+        # the runtime rejects V < 1 too (pipedream._run_1f1b); V=0 would
+        # divide by zero and V<0 would win the search with negative time
+        raise ValueError(f"virtual_stage_options must be >= 1: {v_options}")
     pp = 1
     while pp <= cluster.n_devices and pp <= len(layers):
         per_stage = cluster.n_devices // pp
@@ -274,25 +288,38 @@ def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                 bpr = math.ceil(global_batch / c.dp)
                 costs = [time_model.layer_time(l, c, bpr) for l in layers]
                 bounds = partition_stages(costs, pp)
-                # stage times under this balanced partition
-                idx, stage_times, stage_mems = 0, [], []
+                # per-stage time and base memory are V-invariant: compute
+                # once, apply the V-dependent stash surcharge per V
+                idx, stage_times, base_mems = 0, [], []
                 for cnt in bounds:
-                    t = sum(costs[idx:idx + cnt])
-                    m = sum(mem_model.layer_bytes(layers[li], c, bpr, n_micro)
-                            for li in range(idx, idx + cnt))
-                    if schedule == "pipedream":
-                        # weight stashing keeps up to pp weight versions of
-                        # the stage (pipedream_subexecutor.py:130)
-                        m += m / max(n_micro, 1) * (pp - 1) * 0.1
-                    stage_times.append(t)
-                    stage_mems.append(m)
+                    stage_times.append(sum(costs[idx:idx + cnt]))
+                    base_mems.append(sum(mem_model.layer_bytes(
+                        layers[li], c, bpr, n_micro)
+                        for li in range(idx, idx + cnt)))
                     idx += cnt
                 slot = max(stage_times) / n_micro
-                t_total = (n_micro + pp - 1) * slot
-                plan = Plan(pp, n_micro, [c] * len(layers), t_total,
-                            max(stage_mems), max(stage_mems) <= cluster.hbm_bytes)
-                if plan.feasible and (best is None or plan.time < best.time):
-                    best, best_bounds = plan, bounds
+                for V in v_options:
+                    if pp == 1 and V > 1:
+                        continue  # no bubble to interleave away
+                    if V > 1 and min(bounds) < V:
+                        continue  # every stage must split into V chunks
+                    if schedule == "pipedream":
+                        # weight stashing keeps up to pp weight versions
+                        # of the stage (pipedream_subexecutor.py:130);
+                        # interleaving keeps each chunk's activations
+                        # in flight ~V x longer (pipedream.py K slots)
+                        mems = [m + m / max(n_micro, 1) * (pp - 1) * 0.1 * V
+                                for m in base_mems]
+                    else:
+                        mems = base_mems
+                    # ideal + bubble/V: (M*V + pp - 1) chunk-ticks at slot/V
+                    t_total = n_micro * slot + (pp - 1) * slot / V
+                    plan = Plan(pp, n_micro, [c] * len(layers), t_total,
+                                max(mems), max(mems) <= cluster.hbm_bytes,
+                                virtual_stages=V)
+                    if plan.feasible and (best is None
+                                          or plan.time < best.time):
+                        best, best_bounds = plan, bounds
         pp *= 2
     if best is None:
         plan = dp_search(layers, cluster, global_batch,
@@ -312,23 +339,30 @@ def gpipe_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
 
 def pipedream_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                      global_batch: int,
-                     microbatch_options: Sequence[int] = (1, 2, 4, 8, 16)):
+                     microbatch_options: Sequence[int] = (1, 2, 4, 8, 16),
+                     virtual_stage_options: Sequence[int] = (1, 2, 4)):
     """PipeDream partitioner (reference PipeDreamSearching, pipedream.py:7):
-    1F1B steady-state objective + weight-stash memory."""
+    1F1B steady-state objective + weight-stash memory.  Additionally
+    searches interleaved virtual stages (no reference counterpart —
+    pipedream_grads' Megatron-style schedule): the planner picks V where
+    the bubble saving beats the stash-memory cost."""
     return _pipeline_search(layers, cluster, global_batch,
                             schedule="pipedream",
-                            microbatch_options=microbatch_options)
+                            microbatch_options=microbatch_options,
+                            virtual_stage_options=virtual_stage_options)
 
 
 def pipeopt_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                    global_batch: int,
-                   microbatch_options: Sequence[int] = (1, 2, 4, 8, 16)):
+                   microbatch_options: Sequence[int] = (1, 2, 4, 8, 16),
+                   virtual_stage_options: Sequence[int] = (1, 2, 4)):
     """Joint pipeline + intra-layer search (reference PipeOptSearching,
     pipeopt.py:9): compare the balanced-pipeline plans against dp_search's
     per-layer plans and take the faster feasible one."""
     pipe_plan, bounds = _pipeline_search(
         layers, cluster, global_batch, schedule="pipedream",
-        microbatch_options=microbatch_options)
+        microbatch_options=microbatch_options,
+        virtual_stage_options=virtual_stage_options)
     flat_plan = dp_search(layers, cluster, global_batch,
                           microbatch_options=microbatch_options)
     if flat_plan.feasible and (not pipe_plan.feasible

@@ -54,6 +54,45 @@ class TestPipelineSearch:
         assert plan.feasible
         assert sum(bounds) == 16
 
+    def test_pipedream_search_interleaving_cuts_bubble(self):
+        """The V search (no reference counterpart): with a deep pipeline
+        and generous memory the planner must pick V > 1, its modeled time
+        must beat the V=1 plan by exactly the bubble shrink, and a
+        memory-starved budget must push it back to fewer virtual stages
+        (the stash surcharge scales with V)."""
+        layers = self._big_layers()
+        plan, _ = pipedream_search(layers, CLUSTER, global_batch=16)
+        base, _ = pipedream_search(layers, CLUSTER, global_batch=16,
+                                   virtual_stage_options=(1,))
+        assert plan.virtual_stages > 1
+        assert plan.time < base.time
+        if (plan.pp, plan.n_microbatches, plan.dominant) == (
+                base.pp, base.n_microbatches, base.dominant):
+            # same plan shape -> the delta is exactly the bubble term
+            slot = (base.time / (base.n_microbatches + base.pp - 1))
+            expect = (base.n_microbatches * slot
+                      + (base.pp - 1) * slot / plan.virtual_stages)
+            assert abs(plan.time - expect) < 1e-9
+        # V never exceeds the thinnest stage's layer count
+        assert plan.virtual_stages <= min(
+            partition_stages([1.0] * len(layers), plan.pp))
+        # a memory-starved budget must push V back down: the stash
+        # surcharge scales with V, so under a budget the V>1 plan can't
+        # fit, the planner falls back (fewer virtual stages or a cheaper
+        # shape) rather than returning an infeasible interleaved plan
+        tight = ClusterSpec(n_devices=8, hbm_bytes=plan.peak_bytes * 0.98)
+        starved, _ = pipedream_search(layers, tight, global_batch=16)
+        assert starved.feasible
+        assert starved.peak_bytes <= tight.hbm_bytes
+        assert (starved.virtual_stages, starved.pp,
+                starved.n_microbatches, starved.dominant) != (
+            plan.virtual_stages, plan.pp, plan.n_microbatches, plan.dominant)
+
+    def test_pipedream_search_rejects_bad_virtual_options(self):
+        with pytest.raises(ValueError, match="virtual_stage_options"):
+            pipedream_search(self._big_layers(), CLUSTER, global_batch=16,
+                             virtual_stage_options=(0, 2))
+
     def test_pipeopt_no_slower_than_components(self):
         small = [transformer_layer_spec(512, 128, name=f"l{i}")
                  for i in range(4)]
