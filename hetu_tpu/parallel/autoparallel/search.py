@@ -262,9 +262,12 @@ def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     critical-path time bound; 1F1B ('pipedream') additionally charges
     weight-stash memory for in-flight microbatches, which changes which
     plans are feasible — and may interleave V virtual stages per device
-    (pipedream_grads' three-phase schedule), shrinking the bubble term to
-    (pp - 1) x slot / V at ~V x the in-flight activation stash (the
-    time model matches pipedream_schedule_stats' phase algebra)."""
+    (pipedream_grads' three-phase schedule), shrinking the bubble term
+    toward (pp - 1) x slot / V at ~V x the in-flight activation stash.
+    The interleaved time is computed from the runtime scheduler's OWN
+    phase bounds (pipedream._phase_bounds), so the model is exact for
+    every (n_micro, pp, V) — including microbatch counts the group
+    timetable cannot fill, where interleaving buys nothing."""
     mem_model = MemoryCostModel(cluster)
     time_model = TimeCostModel(cluster)
     best: Optional[Plan] = None
@@ -312,8 +315,16 @@ def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                                 for m in base_mems]
                     else:
                         mems = base_mems
-                    # ideal + bubble/V: (M*V + pp - 1) chunk-ticks at slot/V
-                    t_total = n_micro * slot + (pp - 1) * slot / V
+                    # chunk-tick count straight from the runtime schedule's
+                    # own phase algebra (pipedream._phase_bounds, T2 = last
+                    # forward + 1; drain overlaps in combined-slot units):
+                    # exact for every (M, pp, V), including M not a
+                    # multiple of pp, where the naive M*V + pp - 1 model
+                    # would credit V > 1 with a speedup that does not
+                    # exist (wasted group slots eat it)
+                    from hetu_tpu.parallel.pipedream import _phase_bounds
+                    t2 = _phase_bounds(pp, V, n_micro)[1]
+                    t_total = t2 * slot / V
                     plan = Plan(pp, n_micro, [c] * len(layers), t_total,
                                 max(mems), max(mems) <= cluster.hbm_bytes,
                                 virtual_stages=V)

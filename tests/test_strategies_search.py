@@ -68,11 +68,13 @@ class TestPipelineSearch:
         assert plan.time < base.time
         if (plan.pp, plan.n_microbatches, plan.dominant) == (
                 base.pp, base.n_microbatches, base.dominant):
-            # same plan shape -> the delta is exactly the bubble term
+            # same plan shape -> the delta is exactly the schedule's own
+            # phase algebra (single source of truth with the runtime)
+            from hetu_tpu.parallel.pipedream import _phase_bounds
             slot = (base.time / (base.n_microbatches + base.pp - 1))
-            expect = (base.n_microbatches * slot
-                      + (base.pp - 1) * slot / plan.virtual_stages)
-            assert abs(plan.time - expect) < 1e-9
+            t2 = _phase_bounds(base.pp, plan.virtual_stages,
+                               base.n_microbatches)[1]
+            assert abs(plan.time - t2 * slot / plan.virtual_stages) < 1e-9
         # V never exceeds the thinnest stage's layer count
         assert plan.virtual_stages <= min(
             partition_stages([1.0] * len(layers), plan.pp))
@@ -92,6 +94,16 @@ class TestPipelineSearch:
         with pytest.raises(ValueError, match="virtual_stage_options"):
             pipedream_search(self._big_layers(), CLUSTER, global_batch=16,
                              virtual_stage_options=(0, 2))
+
+    def test_interleaving_not_credited_when_groups_cannot_fill(self):
+        """M=1 at pp=4: the group timetable runs SV chunk-ticks either
+        way, so V>1 must model EXACTLY the V=1 time (the naive
+        M*V + pp - 1 model would fabricate a 1.6x win here) and the
+        planner must not pay V's stash surcharge for nothing."""
+        layers = self._big_layers()
+        plan, _ = pipedream_search(layers, CLUSTER, global_batch=16,
+                                   microbatch_options=(1,))
+        assert plan.virtual_stages == 1, plan.describe()
 
     def test_pipeopt_no_slower_than_components(self):
         small = [transformer_layer_spec(512, 128, name=f"l{i}")
