@@ -388,7 +388,8 @@ _PROBE_K = 3  # scan length of A/B probes; a config whose own k matches
 # reuses its winning probe as the full measurement (no recompile)
 
 
-def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln):
+def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln,
+               remat=False):
     """Build a fresh BERT trainer with the given (attention core, fused_ln)
     variant and return the timing dict (+ config/flops context).
     attn: "flash" = Pallas kernel, "xla" = materialized bhsd core."""
@@ -402,10 +403,10 @@ def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln):
     set_random_seed(0)
     if on_tpu:
         cfg = bert_large(max_position_embeddings=max(512, seq),
-                         fused_ln=fused_ln, dtype=jnp.bfloat16)
+                         fused_ln=fused_ln, remat=remat, dtype=jnp.bfloat16)
     else:
         cfg = bert_base(num_layers=2, hidden_size=128, num_heads=2,
-                        vocab_size=8192, fused_ln=fused_ln,
+                        vocab_size=8192, fused_ln=fused_ln, remat=remat,
                         dtype=jnp.float32)
         batch, seq, k = 8, 64, 2
     # the native (B,H,S,D) einsum projection path pays off for BOTH cores:
@@ -443,7 +444,8 @@ def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln):
     return t
 
 
-def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric):
+def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric,
+              remat_batch=None):
     """Measure each (attn, fused_ln) variant with a short probe, emit the
     full-length winner.  This is how perf decisions stay MEASURED per
     round instead of frozen: r04's fused-LN kernel was
@@ -475,11 +477,33 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric):
         attn, fused_ln = min(probes, key=lambda v: probes[v]["median_s"])
     else:
         (attn, fused_ln), = variants[:1]
-    if (attn, fused_ln) in probes and k == _PROBE_K:
-        t = probes[(attn, fused_ln)]  # the probe IS the full measurement
+    remat = False
+    if ab and remat_batch and remat_batch > batch:
+        # the winner at the memory-capped batch vs the SAME variant at a
+        # larger batch with per-block rematerialization (exact numerics,
+        # ~1/3 more backward FLOPs for O(layers) activation memory):
+        # whichever moves more samples/sec wins.  An OOM at the larger
+        # batch just disqualifies the candidate.
+        try:
+            pr = _bert_time(on_tpu, kind, peak, seq=seq, batch=remat_batch,
+                            k=_PROBE_K, attn=attn, fused_ln=fused_ln,
+                            remat=True)
+            ab[f"b{remat_batch}+remat"] = round(pr["median_s"] * 1e3, 2)
+            base = probes[(attn, fused_ln)]
+            if (remat_batch / pr["median_s"]) > (batch / base["median_s"]):
+                probes[(attn, fused_ln, "remat")] = pr
+                batch, remat = remat_batch, True
+        except Exception as e:
+            if any(s in str(e).lower() for s in _TRANSIENT):
+                raise
+            traceback.print_exc()
+            ab[f"b{remat_batch}+remat"] = f"failed: {str(e)[:120]}"
+    key3 = (attn, fused_ln, "remat") if remat else (attn, fused_ln)
+    if key3 in probes and k == _PROBE_K:
+        t = probes[key3]  # the probe IS the full measurement
     else:
         t = _bert_time(on_tpu, kind, peak, seq=seq, batch=batch, k=k,
-                       attn=attn, fused_ln=fused_ln)
+                       attn=attn, fused_ln=fused_ln, remat=remat)
     mfu = t["flops"] / t["median_s"] / peak
     return _line(
         metric if on_tpu else "bert_smoke_mfu", mfu, "MFU", mfu / 0.45,
@@ -487,7 +511,7 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric):
         step_ms=round(t["median_s"] * 1e3, 2),
         best_mfu=round(t["flops"] / t["min_s"] / peak, 4),
         dropout=True, flash_attention=(attn == "flash" and on_tpu),
-        fused_ln=bool(fused_ln and on_tpu),
+        fused_ln=bool(fused_ln and on_tpu), remat=bool(remat),
         **({"ab_probe_ms": ab} if ab else {}),
         device=kind, batch=t["batch"], seq=t["seq"], **_tinfo(t))
 
@@ -514,10 +538,13 @@ def bench_bert_long(on_tpu, kind, peak):
                   f"{e['block_q']}x{e['block_k']}", file=sys.stderr)
         except Exception:
             traceback.print_exc()  # heuristic table still applies
+    # remat_batch=48: seq-512 is memory-capped at batch 24 (48 OOMs on
+    # 16 GB); per-block remat may buy the doubled batch back at ~1/3 more
+    # backward FLOPs — probed, decided by samples/sec
     return _bert_mfu(on_tpu, kind, peak, seq=512, batch=24, k=3,
                      variants=[("flash", False), ("xla", False),
                                ("flash", True), ("xla", True)],
-                     metric="bert_large_seq512_mfu")
+                     metric="bert_large_seq512_mfu", remat_batch=48)
 
 
 def bench_bert_headline(on_tpu, kind, peak):

@@ -258,3 +258,35 @@ def test_bert_streamed_mlm_head_matches_materialized():
         np.testing.assert_allclose(np.asarray(get(g_str)),
                                    np.asarray(get(g_ref)),
                                    rtol=3e-4, atol=1e-6, err_msg=name)
+
+
+def test_bert_remat_is_exact():
+    """BertConfig(remat=True) must be numerically IDENTICAL (jax.checkpoint
+    recomputes, never approximates) — it only trades backward FLOPs for
+    activation memory (the seq-512 batch-cap knob, bench probes it)."""
+    import jax
+
+    from hetu_tpu.models import BertForPreTraining, bert_base
+
+    def build(remat):
+        set_random_seed(0)
+        return BertForPreTraining(bert_base(
+            num_layers=2, hidden_size=64, num_heads=2, vocab_size=200,
+            max_position_embeddings=32, remat=remat))
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 200, (2, 16)), jnp.int32)
+    tt = jnp.zeros((2, 16), jnp.int32)
+    lab = jnp.asarray(rng.integers(0, 200, (2, 16)), jnp.int32)
+    nsp = jnp.zeros((2,), jnp.int32)
+    key = jax.random.key(0)
+
+    def loss(m):
+        return m.loss(ids, tt, None, lab, nsp, key=key, training=True)[0]
+
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
