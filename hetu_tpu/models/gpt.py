@@ -35,6 +35,10 @@ class GPTConfig:
     # / very long sequences (ops.lm_head_cross_entropy; where the logits
     # fit, the default materialized path is faster)
     streamed_head_chunk: int = 0
+    # rematerialize each block in the backward (jax.checkpoint): exact
+    # numerics, ~1/3 more backward FLOPs for O(layers) activation memory
+    # (the long-context batch-cap knob; same as BertConfig.remat)
+    remat: bool = False
     dtype: object = jnp.float32
 
 
@@ -94,7 +98,12 @@ class GPT(Module):
             else [None] * len(self.blocks)
         )
         for blk, k in zip(self.blocks, keys):
-            x = blk(x, key=k, training=training)
+            if self.config.remat:
+                x = jax.checkpoint(
+                    lambda b, xx, kk: b(xx, key=kk,
+                                        training=training))(blk, x, k)
+            else:
+                x = blk(x, key=k, training=training)
         return self.ln_f(x)
 
     def loss(self, input_ids, *, key=None, training: bool = True,

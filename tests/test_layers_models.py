@@ -290,3 +290,27 @@ def test_bert_remat_is_exact():
     for a, b in zip(jax.tree_util.tree_leaves(g0),
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gpt_remat_is_exact():
+    """GPTConfig(remat=True): same bit-exactness contract as BERT's."""
+    import jax
+
+    from hetu_tpu.models.gpt import GPT, GPTConfig
+
+    def build(remat):
+        set_random_seed(0)
+        return GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                             num_heads=4, max_seq_len=32, dropout_rate=0.1,
+                             remat=remat))
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    key = jax.random.key(1)
+    loss = lambda m: m.loss(ids, key=key, training=True)  # noqa: E731
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
