@@ -260,10 +260,13 @@ def test_bert_streamed_mlm_head_matches_materialized():
                                    rtol=3e-4, atol=1e-6, err_msg=name)
 
 
-def test_bert_remat_is_exact():
+@pytest.mark.parametrize("fused_ln", [False, True])
+def test_bert_remat_is_exact(fused_ln):
     """BertConfig(remat=True) must be numerically IDENTICAL (jax.checkpoint
     recomputes, never approximates) — it only trades backward FLOPs for
-    activation memory (the seq-512 batch-cap knob, bench probes it)."""
+    activation memory (the seq-512 batch-cap knob, bench probes it).
+    Composed with fused_ln too: checkpoint wraps the Pallas custom-vjp
+    block without disturbing it."""
     import jax
 
     from hetu_tpu.models import BertForPreTraining, bert_base
@@ -272,7 +275,7 @@ def test_bert_remat_is_exact():
         set_random_seed(0)
         return BertForPreTraining(bert_base(
             num_layers=2, hidden_size=64, num_heads=2, vocab_size=200,
-            max_position_embeddings=32, remat=remat))
+            max_position_embeddings=32, remat=remat, fused_ln=fused_ln))
 
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, 200, (2, 16)), jnp.int32)
