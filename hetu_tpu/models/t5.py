@@ -40,6 +40,9 @@ class T5Config:
     relative_buckets: int = 32
     relative_max_distance: int = 128
     dropout_rate: float = 0.1
+    # rematerialize each block in the backward (jax.checkpoint): exact
+    # numerics, activation memory O(layers) (same knob as BertConfig.remat)
+    remat: bool = False
     dtype: object = jnp.float32
 
 
@@ -193,6 +196,7 @@ class T5Stack(Module):
                        for _ in range(cfg.num_layers)]
         self.final_ln = RMSNorm(cfg.d_model)
         self.decoder = decoder
+        self.config = cfg
 
     def __call__(self, x, *, enc=None, mask=None, enc_mask=None, key=None,
                  training=False):
@@ -201,8 +205,14 @@ class T5Stack(Module):
         keys = (jax.random.split(key, len(self.blocks)) if key is not None
                 else [None] * len(self.blocks))
         for blk, k in zip(self.blocks, keys):
-            x = blk(x, enc=enc, mask=mask, enc_mask=enc_mask,
-                    pos_bias=pos_bias, key=k, training=training)
+            if self.config.remat:
+                x = jax.checkpoint(
+                    lambda b, xx, kk: b(xx, enc=enc, mask=mask,
+                                        enc_mask=enc_mask, pos_bias=pos_bias,
+                                        key=kk, training=training))(blk, x, k)
+            else:
+                x = blk(x, enc=enc, mask=mask, enc_mask=enc_mask,
+                        pos_bias=pos_bias, key=k, training=training)
         return self.final_ln(x)
 
 

@@ -317,3 +317,37 @@ def test_gpt_remat_is_exact():
     for a, b in zip(jax.tree_util.tree_leaves(g0),
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_t5_remat_is_exact():
+    """T5Config(remat=True): same recompute-only contract.  Not bit-exact
+    like BERT/GPT — the relative-position bias is shared ACROSS blocks, so
+    its gradient accumulates in a different order under checkpoint; equal
+    to tight fp32 tolerance."""
+    import jax
+
+    from hetu_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    def build(remat):
+        set_random_seed(0)
+        return T5ForConditionalGeneration(T5Config(
+            vocab_size=128, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+            num_heads=4, remat=remat))
+
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 128, (2, 12)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 128, (2, 10)), jnp.int32)
+    lab = jnp.asarray(rng.integers(0, 128, (2, 10)), jnp.int32)
+    key = jax.random.key(1)
+
+    def loss(m):
+        out = m.loss(src, tgt, lab, key=key, training=True)
+        return out[0] if isinstance(out, tuple) else out
+
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
