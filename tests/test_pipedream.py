@@ -324,3 +324,35 @@ def test_interleaved_1f1b_on_real_transformer_blocks(pp4_mesh):
     for a, b in zip(jtu.tree_leaves(grads), jtu.tree_leaves(ref_g)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("V,M", [(2, 3), (2, 5), (4, 6), (3, 7)])
+def test_interleaved_schedule_odd_combinations(pp4_mesh, V, M):
+    """Awkward (V, M) combinations — M smaller than the group size, odd
+    M, V not dividing M — must still be EXACT (the decode/validity
+    masking guarantees correctness for any M; only bubble suffers)."""
+    from hetu_tpu.parallel.pipedream import (interleave_stages,
+                                             uninterleave_stages)
+
+    rng = np.random.default_rng(V * 10 + M)
+    S, d = 4, 8
+    B = M * 2
+    params = make_params(rng, S * V, d)
+    x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+    def ref_loss(p):
+        xs = x.reshape(M, B // M, d)
+        ys = y.reshape(M, B // M, d)
+        return jnp.mean(jax.vmap(
+            lambda xm, ym: loss_fn(seq_forward(p, xm), ym))(xs, ys))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    loss, g_dm = jax.jit(lambda p: pipedream_grads(
+        stage_fn, loss_fn, interleave_stages(p, S, V), x, y,
+        mesh=pp4_mesh, n_microbatches=M, virtual_stages=V))(params)
+    grads = uninterleave_stages(g_dm, S, V)
+    np.testing.assert_allclose(loss, ref_l, rtol=1e-6)
+    np.testing.assert_allclose(grads["w"], ref_g["w"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(grads["b"], ref_g["b"], rtol=1e-5, atol=1e-6)
