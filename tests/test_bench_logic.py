@@ -1,0 +1,128 @@
+"""bench.py's on-TPU decision machinery, unit-tested with a stubbed timer.
+
+The variant A/B (fused-LN on/off, flash vs xla-bhsd), the probe-reuse
+rule, and the batch-48+remat trade only execute on a live chip — which
+this round never had (TPU_CHECKS_r05).  The driver's bench run must not
+be the first execution of the selection logic, so it runs here against
+scripted timings: winner selection, artifact fields, probe reuse (no
+re-measure when k matches), deterministic-failure disqualification,
+transient re-raise, and both outcomes of the remat probe.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+class _Stub:
+    """Scripted _bert_time: keyed by (attn, fused_ln, remat, batch)."""
+
+    def __init__(self, table, fail=()):
+        self.table = table
+        self.fail = dict(fail)
+        self.calls = []
+
+    def __call__(self, on_tpu, kind, peak, *, seq, batch, k, attn,
+                 fused_ln, remat=False):
+        key = (attn, fused_ln, remat, batch)
+        self.calls.append(key + (k,))
+        if key in self.fail:
+            raise self.fail[key]
+        return {"median_s": self.table[key], "min_s": self.table[key],
+                "spread": 1.0, "timing": "stub", "flops": 1e12,
+                "batch": batch, "seq": seq}
+
+
+@pytest.fixture
+def capture(monkeypatch):
+    lines = []
+    monkeypatch.setattr(
+        bench, "_line",
+        lambda metric, value, unit, vs, **kw: lines.append(
+            {"metric": metric, "value": value, **kw}) or lines[-1])
+    return lines
+
+
+def _run(monkeypatch, capture, stub, *, variants, remat_batch=None, k=3):
+    monkeypatch.setattr(bench, "_bert_time", stub)
+    bench._bert_mfu(True, "TPU v5 lite", 197e12, seq=512, batch=24, k=k,
+                    variants=variants, metric="m", remat_batch=remat_batch)
+    return capture[-1]
+
+
+V4 = [("flash", False), ("xla", False), ("flash", True), ("xla", True)]
+
+
+def test_winner_selection_and_probe_reuse(monkeypatch, capture):
+    stub = _Stub({("flash", False, False, 24): 0.30,
+                  ("xla", False, False, 24): 0.25,
+                  ("flash", True, False, 24): 0.29,
+                  ("xla", True, False, 24): 0.22})
+    line = _run(monkeypatch, capture, stub, variants=V4)
+    assert line["fused_ln"] is True and line["flash_attention"] is False
+    assert line["ab_probe_ms"]["xla+fln"] == 220.0
+    # k == probe k: the winning probe IS the measurement — 4 calls only
+    assert len(stub.calls) == 4
+
+
+def test_final_remeasured_when_k_differs(monkeypatch, capture):
+    stub = _Stub({("xla", False, False, 24): 0.25,
+                  ("xla", True, False, 24): 0.22})
+    _run(monkeypatch, capture, stub,
+         variants=[("xla", False), ("xla", True)], k=5)
+    assert stub.calls[-1] == ("xla", True, False, 24, 5)
+
+
+def test_deterministic_failure_disqualifies(monkeypatch, capture):
+    stub = _Stub({("flash", False, False, 24): 0.30,
+                  ("xla", False, False, 24): 0.25,
+                  ("xla", True, False, 24): 0.27},
+                 fail={("flash", True, False, 24): RuntimeError("Mosaic")})
+    line = _run(monkeypatch, capture, stub, variants=V4)
+    assert line["fused_ln"] is False and line["flash_attention"] is False
+    assert line["ab_probe_ms"]["flash+fln"].startswith("failed:")
+
+
+def test_transient_failure_reraises(monkeypatch, capture):
+    stub = _Stub({("flash", False, False, 24): 0.30},
+                 fail={("xla", False, False, 24):
+                       RuntimeError("DEADLINE_EXCEEDED: rpc timeout")})
+    with pytest.raises(RuntimeError, match="rpc"):
+        _run(monkeypatch, capture, stub, variants=V4)
+
+
+def test_remat_probe_wins_on_throughput(monkeypatch, capture):
+    # 48/0.40 = 120 samples/s beats 24/0.22 = 109
+    stub = _Stub({("flash", False, False, 24): 0.30,
+                  ("xla", False, False, 24): 0.25,
+                  ("flash", True, False, 24): 0.29,
+                  ("xla", True, False, 24): 0.22,
+                  ("xla", True, True, 48): 0.40})
+    line = _run(monkeypatch, capture, stub, variants=V4, remat_batch=48)
+    assert line["remat"] is True and line["batch"] == 48
+    assert line["ab_probe_ms"]["b48+remat"] == 400.0
+
+
+def test_remat_probe_loses_on_throughput(monkeypatch, capture):
+    # 48/0.50 = 96 samples/s loses to 24/0.22 = 109
+    stub = _Stub({("flash", False, False, 24): 0.30,
+                  ("xla", False, False, 24): 0.25,
+                  ("flash", True, False, 24): 0.29,
+                  ("xla", True, False, 24): 0.22,
+                  ("xla", True, True, 48): 0.50})
+    line = _run(monkeypatch, capture, stub, variants=V4, remat_batch=48)
+    assert line["remat"] is False and line["batch"] == 24
+
+
+def test_remat_oom_disqualifies(monkeypatch, capture):
+    stub = _Stub({("flash", False, False, 24): 0.30,
+                  ("xla", False, False, 24): 0.25,
+                  ("flash", True, False, 24): 0.29,
+                  ("xla", True, False, 24): 0.22},
+                 fail={("xla", True, True, 48):
+                       RuntimeError("RESOURCE_EXHAUSTED: out of memory")})
+    line = _run(monkeypatch, capture, stub, variants=V4, remat_batch=48)
+    assert line["remat"] is False and line["batch"] == 24
+    assert line["ab_probe_ms"]["b48+remat"].startswith("failed:")
