@@ -9,9 +9,12 @@ final line record the north-star metric).  Configs (BASELINE.md):
                                       host HET-cached embedding under load
   3. moe_samples_per_sec            — examples/moe/scripts/run_top1.sh
   4. gpt_autoparallel_samples_per_sec — profile -> plan -> train
-  5. bert_large_seq512_mfu          — long-sequence path, flash kernel ON
+  5. bert_large_seq512_mfu          — long-sequence path; attention core
+                                      ({flash, xla-bhsd} x fused-LN) and
+                                      batch-48+remat probed per run
   6. bert_large_pretrain_mfu        — headline; honest training step
-                                      (dropout ON, key threaded)
+                                      (dropout ON, key threaded);
+                                      fused-LN probed per run
 
 Timing: DEVICE time via a differenced compiled scan (Trainer.scan_steps):
 one dispatch runs a lax.scan of k (then 2k) train steps, and
