@@ -41,11 +41,24 @@ __all__ = [
     "FrozenDict",
     "is_array",
     "logical_axes",
+    "maybe_remat",
     "trainable_mask",
     "tree_replace",
     "named_parameters",
     "param_count",
 ]
+
+
+def maybe_remat(call, remat: bool):
+    """``call(block, x, key) -> x'`` wrapped in ``jax.checkpoint`` when
+    ``remat`` — the one place the per-block rematerialization policy
+    lives (BertConfig/GPTConfig/T5Config ``remat=True``): exact numerics,
+    activations recomputed in the backward instead of saved.  A future
+    checkpoint policy (e.g. ``jax.checkpoint_policies.save_only_these``)
+    changes here, not in every model."""
+    import jax
+
+    return jax.checkpoint(call) if remat else call
 
 
 def is_array(x: Any) -> bool:

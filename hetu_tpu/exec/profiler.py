@@ -167,8 +167,8 @@ def audit_donation(trainer, batch, key=None) -> dict:
     "temp_bytes", "donated_fraction", "unusable": [messages]} where
     ``unusable`` captures XLA's "donated buffers were not usable"
     warnings.  Numeric keys are 0.0 when the step cannot be lowered or
-    the backend reports no memory analysis — the report degrades, it
-    never KeyErrors.
+    compiled (the failure is recorded under "error") or the backend
+    reports no memory analysis — the report degrades, it never raises.
     """
     import warnings
 
@@ -178,9 +178,13 @@ def audit_donation(trainer, batch, key=None) -> dict:
                  "donated_fraction": 0.0, "unusable": []}
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
-        lowered = trainer._train_step.lower(trainer.state, batch, key) \
-            if hasattr(trainer._train_step, "lower") else None
-        compiled = lowered.compile() if lowered is not None else None
+        try:
+            lowered = trainer._train_step.lower(trainer.state, batch, key) \
+                if hasattr(trainer._train_step, "lower") else None
+            compiled = lowered.compile() if lowered is not None else None
+        except Exception as e:  # honor the degrade-don't-raise contract
+            out["error"] = f"{type(e).__name__}: {e}"
+            compiled = None
     out["unusable"] = [str(w.message) for w in caught
                        if "donated" in str(w.message).lower()]
     if compiled is None:

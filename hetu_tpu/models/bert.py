@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import normal, zeros
 from hetu_tpu.layers import Embedding, LayerNorm, Linear, TransformerBlock
@@ -122,15 +122,11 @@ class BertModel(Module):
             jax.random.split(key, len(self.blocks)) if key is not None
             else [None] * len(self.blocks)
         )
+        step = maybe_remat(
+            lambda b, xx, kk: b(xx, mask, key=kk, training=training),
+            self.config.remat)
         for blk, k in zip(self.blocks, keys):
-            if self.config.remat:
-                # exact rematerialization: the block's activations are
-                # recomputed in the backward instead of saved
-                x = jax.checkpoint(
-                    lambda b, xx, kk: b(xx, mask, key=kk,
-                                        training=training))(blk, x, k)
-            else:
-                x = blk(x, mask, key=k, training=training)
+            x = step(blk, x, k)
         pooled = jnp.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

@@ -10,7 +10,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.init import normal
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.layers import Embedding, LayerNorm, TransformerBlock
@@ -97,13 +97,11 @@ class GPT(Module):
             jax.random.split(key, len(self.blocks)) if key is not None
             else [None] * len(self.blocks)
         )
+        step = maybe_remat(
+            lambda b, xx, kk: b(xx, key=kk, training=training),
+            self.config.remat)
         for blk, k in zip(self.blocks, keys):
-            if self.config.remat:
-                x = jax.checkpoint(
-                    lambda b, xx, kk: b(xx, key=kk,
-                                        training=training))(blk, x, k)
-            else:
-                x = blk(x, key=k, training=training)
+            x = step(blk, x, k)
         return self.ln_f(x)
 
     def loss(self, input_ids, *, key=None, training: bool = True,

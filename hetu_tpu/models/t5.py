@@ -18,7 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import normal
 from hetu_tpu.layers import Embedding, RMSNorm
@@ -204,15 +204,13 @@ class T5Stack(Module):
         pos_bias = self.rel_bias(s, s)
         keys = (jax.random.split(key, len(self.blocks)) if key is not None
                 else [None] * len(self.blocks))
+        step = maybe_remat(
+            lambda b, xx, kk: b(xx, enc=enc, mask=mask, enc_mask=enc_mask,
+                                pos_bias=pos_bias, key=kk,
+                                training=training),
+            self.config.remat)
         for blk, k in zip(self.blocks, keys):
-            if self.config.remat:
-                x = jax.checkpoint(
-                    lambda b, xx, kk: b(xx, enc=enc, mask=mask,
-                                        enc_mask=enc_mask, pos_bias=pos_bias,
-                                        key=kk, training=training))(blk, x, k)
-            else:
-                x = blk(x, enc=enc, mask=mask, enc_mask=enc_mask,
-                        pos_bias=pos_bias, key=k, training=training)
+            x = step(blk, x, k)
         return self.final_ln(x)
 
 

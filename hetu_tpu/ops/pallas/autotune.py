@@ -207,8 +207,10 @@ def autotune_flash_blocks(Sq: int, Sk: int, D: int, *, causal: bool = False,
             cache = {}
         cache[_key(Sq, Sk, D, causal, None)] = entry
         path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp")
+        # per-process tmp: a shared tmp name would let two concurrent
+        # tuners truncate each other mid-write and publish torn content
+        tmp = path.with_suffix(f".tmp.{os.getpid()}")
         tmp.write_text(json.dumps(cache, indent=1))
-        tmp.replace(path)  # atomic: a concurrent reader never sees a torn file
+        tmp.replace(path)  # atomic per writer; last writer wins the merge
         clear_tune_cache()
     return entry

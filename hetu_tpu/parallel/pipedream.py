@@ -195,8 +195,8 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
         # global layout — see interleave_stages); async mode is V == 1 so
         # its chunk IS the whole local stage
         Wl = params
-        W0 = jtu.tree_map(lambda p: p[0], params)
         if mode == "async":
+            W0 = jtu.tree_map(lambda p: p[0], params)
             ost0 = jtu.tree_map(
                 lambda l, sp: l[0] if sp == P(axis) else
                 lax.pcast(l, (axis,), to="varying"),
@@ -360,20 +360,34 @@ def _run_1f1b(stage_fn, loss_fn, stage_params, opt, opt_state, x, y, extras,
     )(stage_params, opt_state, xs, ys, exs)
 
 
+def _permute_stages(stacked, perm, S, V, who):
+    def apply(l):
+        # jnp gathers CLAMP out-of-bounds indices, so a wrong leading dim
+        # would silently produce duplicated-row garbage that then passes
+        # pipedream_grads' S*V check — validate instead
+        if l.shape[0] != S * V:
+            raise ValueError(
+                f"{who}: leaf leading dim {l.shape[0]} != S*V = {S * V} "
+                f"(S={S}, V={V})")
+        return l[perm]
+
+    return jtu.tree_map(apply, stacked)
+
+
 def interleave_stages(stacked, S: int, V: int):
     """Depth-order stacked stage params ([S*V, ...] leaves, virtual stage
     ``u`` at index ``u``) -> the device-major layout ``_run_1f1b`` shards
     (position ``d*V + v`` holds virtual stage ``u = v*S + d``, so the
     ``P(axis)`` split hands device ``d`` exactly its V chunks)."""
     perm = jnp.asarray([(p % V) * S + p // V for p in range(S * V)])
-    return jtu.tree_map(lambda l: l[perm], stacked)
+    return _permute_stages(stacked, perm, S, V, "interleave_stages")
 
 
 def uninterleave_stages(stacked, S: int, V: int):
     """Inverse of :func:`interleave_stages` (device-major -> depth order);
     apply to the grads returned by ``pipedream_grads(virtual_stages=V)``."""
     perm = jnp.asarray([(u % S) * V + u // S for u in range(S * V)])
-    return jtu.tree_map(lambda l: l[perm], stacked)
+    return _permute_stages(stacked, perm, S, V, "uninterleave_stages")
 
 
 def pipedream_schedule_stats(S: int, V: int, M: int,
