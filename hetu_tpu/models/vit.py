@@ -14,7 +14,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import normal, truncated_normal, zeros
 from hetu_tpu.layers import LayerNorm, Linear, TransformerBlock
@@ -34,6 +34,9 @@ class ViTConfig:
     mlp_ratio: int = 4
     num_classes: int = 1000
     dropout_rate: float = 0.0
+    # per-block rematerialization (core.module.maybe_remat): exact
+    # numerics, O(layers) activation memory
+    remat: bool = False
     dtype: object = jnp.float32
 
     @property
@@ -120,8 +123,11 @@ class ViT(Module):
         x = jnp.concatenate([cls, x], axis=1) + self.pos_embed.astype(x.dtype)
         keys = (jax.random.split(key, len(self.blocks)) if key is not None
                 else [None] * len(self.blocks))
+        step = maybe_remat(
+            lambda b_, xx, kk: b_(xx, key=kk, training=training),
+            self.config.remat)
         for blk, k in zip(self.blocks, keys):
-            x = blk(x, key=k, training=training)
+            x = step(blk, x, k)
         return self.head(self.ln(x[:, 0]))
 
     def loss(self, images, labels, *, key=None, training=True):

@@ -351,3 +351,32 @@ def test_t5_remat_is_exact():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_vit_remat_is_exact():
+    """ViTConfig(remat=True): same bit-exactness contract."""
+    import jax
+
+    from hetu_tpu.models.vit import ViT, ViTConfig
+
+    def build(remat):
+        set_random_seed(0)
+        return ViT(ViTConfig(image_size=16, patch_size=4, hidden_size=32,
+                             num_layers=2, num_heads=4, num_classes=5,
+                             dropout_rate=0.1, remat=remat))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (2,)), jnp.int32)
+    key = jax.random.key(2)
+
+    def loss(m):
+        out = m.loss(x, y, key=key, training=True)
+        return out[0] if isinstance(out, tuple) else out
+
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
