@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import truncated_normal, zeros
 from hetu_tpu.layers import LayerNorm, Linear
@@ -40,6 +40,8 @@ class SwinConfig:
     window_size: int = 7
     mlp_ratio: int = 4
     num_classes: int = 1000
+    # per-block rematerialization (core.module.maybe_remat)
+    remat: bool = False
     dtype: object = jnp.float32
 
 
@@ -230,9 +232,10 @@ class Swin(Module):
 
     def __call__(self, images, *, key=None, training=False):
         x = self.patch_ln(self.patch_embed(images))
+        step = maybe_remat(lambda b, xx: b(xx), self.config.remat)
         for si, blocks in enumerate(self.stages):
             for blk in blocks:
-                x = blk(x)
+                x = step(blk, x)
             if si < len(self.stages) - 1:
                 x = self.merges[si](x)
         x = self.final_ln(x)

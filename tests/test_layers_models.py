@@ -380,3 +380,27 @@ def test_vit_remat_is_exact():
     for a, b in zip(jax.tree_util.tree_leaves(g0),
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_swin_remat_is_exact():
+    """SwinConfig(remat=True): bit-exactness across the windowed stages."""
+    import jax
+
+    from hetu_tpu.models.swin import Swin, SwinConfig
+
+    def build(remat):
+        set_random_seed(0)
+        return Swin(SwinConfig(image_size=32, patch_size=4, embed_dim=16,
+                               depths=(1, 1), num_heads=(2, 2),
+                               window_size=4, num_classes=5, remat=remat))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (2,)), jnp.int32)
+    loss = lambda m: m.loss(x, y, training=False)[0]  # noqa: E731
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
