@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from hetu_tpu.core.module import Module
+from hetu_tpu.core.module import Module, maybe_remat
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import normal
 from hetu_tpu.layers import Embedding, LayerNorm, MultiHeadAttention
@@ -44,6 +44,9 @@ class MoELMConfig:
     # the Trainer/Logger pick them up — the numbers that catch silent
     # router collapse or capacity starvation (layers.moe.routing_stats)
     log_routing_stats: bool = False
+    # per-block rematerialization (core.module.maybe_remat): exact
+    # numerics; recomputes the expert dispatch in the backward
+    remat: bool = False
     dtype: object = jnp.float32
 
 
@@ -97,8 +100,11 @@ class MoELM(Module):
         x = self.wte(input_ids) + self.wpe(jnp.arange(s))
         aux_total = 0.0
         stats_acc, n_moe = None, 0
+        step = maybe_remat(
+            lambda b, xx: b(xx, training=training, with_stats=with_stats),
+            self.config.remat)
         for blk in self.blocks:
-            x, aux = blk(x, training=training, with_stats=with_stats)
+            x, aux = step(blk, x)
             if with_stats:
                 aux, stats = aux
                 if stats is not None:

@@ -433,3 +433,27 @@ def test_moe_lm_logs_routing_stats():
     assert {"aux", "overflow_frac", "load_entropy"} <= set(metrics)
     assert np.isfinite(float(loss))
     assert 0.0 <= float(metrics["overflow_frac"]) <= 1.0
+
+
+def test_moelm_remat_is_exact():
+    """MoELMConfig(remat=True): the expert dispatch recomputes in the
+    backward with bit-equal loss/grads (incl. the aux balance losses)."""
+    import jax
+
+    from hetu_tpu.models.moe_lm import MoELM, MoELMConfig
+
+    def build(remat):
+        set_random_seed(0)
+        return MoELM(MoELMConfig(vocab_size=128, hidden_size=32,
+                                 num_layers=2, num_heads=4, num_experts=2,
+                                 max_seq_len=32, remat=remat))
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    loss = lambda m: m.loss(ids, training=False)[0]  # noqa: E731
+    l0, g0 = jax.value_and_grad(loss)(build(False))
+    l1, g1 = jax.value_and_grad(loss)(build(True))
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
