@@ -435,6 +435,9 @@ def test_moe_lm_logs_routing_stats():
     assert 0.0 <= float(metrics["overflow_frac"]) <= 1.0
 
 
+# slow tier: compiles the MoE LM twice; the BERT canary covers the
+# maybe_remat mechanism fast
+@pytest.mark.slow
 def test_moelm_remat_is_exact():
     """MoELMConfig(remat=True): the expert dispatch recomputes in the
     backward with bit-equal loss/grads (incl. the aux balance losses)."""

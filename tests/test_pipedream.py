@@ -47,6 +47,8 @@ def pp4_mesh():
     return make_mesh(MeshSpec(pp=4, dp=2), devices=jax.devices())
 
 
+# slow tier (r5 budget, 1-core box): the dp-axis variant and the interleaved V tests keep the sync schedule gated fast
+@pytest.mark.slow
 def test_sync_1f1b_grads_match_sequential(pp4_mesh):
     rng = np.random.default_rng(0)
     S, d, B, M = 4, 8, 16, 8

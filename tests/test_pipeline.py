@@ -90,6 +90,8 @@ def test_pipelined_module_fwd_and_grad(pp_mesh):
     )
 
 
+# slow tier (r5 budget, 1-core box): dryrun config A runs the Pipelined transformer every driver round
+@pytest.mark.slow
 def test_pipelined_transformer_blocks(pp_mesh):
     """Real transformer blocks through the pipeline, with mask extras."""
     set_random_seed(2)
