@@ -2,6 +2,7 @@ from hetu_tpu.core.module import (
     FrozenDict,
     Module,
     logical_axes,
+    maybe_remat,
     named_parameters,
     param_count,
     trainable_mask,
