@@ -20,7 +20,7 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
   (reference distributed_strategies/ + tools/Galvatron)
 """
 
-__version__ = "0.1.0"
+__version__ = "1.0.0"
 
 from hetu_tpu import core, init, ops, optim
 from hetu_tpu.core import (
