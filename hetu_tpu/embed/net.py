@@ -30,6 +30,13 @@ from hetu_tpu.embed.sharded import ShardedHostEmbedding
 __all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
            "RemoteHostEmbedding", "attach_loads_client"]
 
+# Fault-injection seam (hetu_tpu.exec.faults.install wires this up; None in
+# production, so the RPC hot path costs one global load).  Called with
+# ("ps_rpc", table) before each RPC executes; a non-None return is taken as
+# the RPC status INSTEAD of running it — returning -10 fakes a dead socket
+# and drives the real reconnect machinery below.
+_fault_hook = None
+
 
 def _lib():
     lib = _load()
@@ -298,11 +305,24 @@ class RemoteEmbeddingTable:
         redialing a second time."""
         while True:
             gen = self._gen
-            st = call(self._c)
-            if st not in self._NET_ERRS or self.reconnect_attempts <= 0:
+            st = _fault_hook("ps_rpc", self) if _fault_hook is not None \
+                else None
+            if st is None:
+                st = call(self._c)
+            if st not in self._NET_ERRS:
                 break
+            if self.reconnect_attempts <= 0:
+                raise ConnectionError(
+                    f"remote {what} failed: connection to {self.address} "
+                    f"was lost (dead socket, status {st}) and reconnection "
+                    f"is disabled — construct the table with "
+                    f"reconnect_attempts > 0 to ride out server restarts")
             if not self._reconnect(gen):
-                break
+                raise ConnectionError(
+                    f"remote {what} failed: connection to {self.address} "
+                    f"was lost (dead socket, status {st}) and all "
+                    f"{self.reconnect_attempts} redial attempts failed — "
+                    f"the server looks gone for good")
         self._check(st, what)
 
     def _check(self, st, what):

@@ -1,6 +1,8 @@
 from hetu_tpu.exec.executor import Executor, Trainer, TrainState
 from hetu_tpu.exec.checkpoint import (
     AsyncCheckpointer,
+    CheckpointCorrupt,
+    CheckpointError,
     load_checkpoint,
     load_state_dict,
     save_checkpoint,
@@ -8,4 +10,12 @@ from hetu_tpu.exec.checkpoint import (
 )
 from hetu_tpu.exec.logger import Logger, WandbLogger
 from hetu_tpu.exec.profiler import audit_donation
-from hetu_tpu.exec import metrics
+from hetu_tpu.exec.resilience import (
+    BackendUnresponsive,
+    Preempted,
+    ResilientTrainer,
+    TrainingDiverged,
+    latest_good_checkpoint,
+    list_checkpoints,
+)
+from hetu_tpu.exec import faults, metrics

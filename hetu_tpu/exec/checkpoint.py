@@ -17,8 +17,10 @@ from __future__ import annotations
 import copy
 import os
 import pickle
+import struct
 import sys
 import threading
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -29,7 +31,32 @@ from hetu_tpu.core import get_seed_status, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
 
 __all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
-           "load_state_dict", "AsyncCheckpointer"]
+           "load_state_dict", "AsyncCheckpointer", "CheckpointError",
+           "CheckpointCorrupt"]
+
+
+class CheckpointError(Exception):
+    """A checkpoint file could not be loaded (torn write, wrong file, ...)."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """The integrity footer is present but the CRC32 does not match: the
+    bytes were damaged on disk AFTER a complete write (bit rot, a concurrent
+    writer, or deliberate fault injection) — as opposed to a torn write,
+    which loses the footer entirely."""
+
+
+# Integrity footer appended after the pickle payload: 8-byte magic +
+# CRC32 of the payload.  A torn write truncates the footer away (the
+# legacy-load path then diagnoses it); in-place corruption keeps the
+# footer but fails the CRC.
+_FOOTER_MAGIC = b"HTCKPT1\x00"
+_FOOTER = struct.Struct("<8sI")
+
+# Fault-injection seam (exec.faults.install wires this up; None in
+# production, so the hot path costs one global load).  Called with
+# ("ckpt_write", final_path) after every durable write.
+_fault_hook = None
 
 
 def _snap(x):
@@ -56,10 +83,15 @@ def _make_payload(state: Any, extra: Optional[dict]) -> dict:
 
 def _atomic_write(path: str, payload: dict) -> None:
     """tmp-write + fsync + rename + directory fsync: a crash at any point
-    leaves either the old or the new checkpoint, never a torn one."""
+    leaves either the old or the new checkpoint, never a torn one.  The
+    payload is followed by a CRC32 integrity footer so silent on-disk
+    corruption is detected at load time."""
+    buf = pickle.dumps(payload)
+    footer = _FOOTER.pack(_FOOTER_MAGIC, zlib.crc32(buf) & 0xFFFFFFFF)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f)
+        f.write(buf)
+        f.write(footer)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -68,6 +100,8 @@ def _atomic_write(path: str, payload: dict) -> None:
         os.fsync(dfd)  # make the rename itself durable
     finally:
         os.close(dfd)
+    if _fault_hook is not None:
+        _fault_hook("ckpt_write", path)
 
 
 def save_checkpoint(path: str, state: Any, extra: Optional[dict] = None) -> None:
@@ -124,11 +158,72 @@ class AsyncCheckpointer:
             raise err
 
 
+def _parse_payload(raw: bytes, path: str) -> dict:
+    """Decode checkpoint bytes, verifying the CRC32 footer when present.
+
+    Raises ``CheckpointCorrupt`` on a CRC mismatch and ``CheckpointError``
+    (naming the path and the likely cause) when the bytes do not decode at
+    all — instead of the raw ``EOFError``/``UnpicklingError`` pickle emits
+    on a truncated file."""
+    if len(raw) >= _FOOTER.size:
+        magic, crc = _FOOTER.unpack_from(raw, len(raw) - _FOOTER.size)
+        if magic == _FOOTER_MAGIC:
+            # memoryview: no second multi-GB copy of the payload
+            body = memoryview(raw)[:len(raw) - _FOOTER.size]
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: CRC32 mismatch — the file was "
+                    f"corrupted on disk after a complete write (bit rot or "
+                    f"an interfering writer); pick an older checkpoint")
+            try:
+                return pickle.loads(body)
+            except Exception as e:  # CRC passed yet unpickle failed: not
+                raise CheckpointError(  # our bytes at all
+                    f"checkpoint {path}: integrity footer valid but payload "
+                    f"does not unpickle ({e!r}) — is this really a "
+                    f"checkpoint file?") from e
+    # No footer: a legacy (pre-footer) checkpoint or a torn write that
+    # truncated the footer away.  Let pickle decide, but translate its
+    # stream errors into a diagnosis.
+    try:
+        return pickle.loads(raw)
+    except Exception as e:
+        raise CheckpointError(
+            f"cannot load checkpoint {path}: {e!r} — most likely a "
+            f"torn/truncated write (the file lacks the integrity footer "
+            f"current saves append), or the path is not a checkpoint file "
+            f"at all") from e
+
+
 def load_checkpoint(path: str, restore_rng: bool = True):
     """Returns (state, extra).  Restores the RNG stream by default so resumed
-    training replays the identical randomness (reference executor.py:653)."""
+    training replays the identical randomness (reference executor.py:653).
+
+    Raises ``CheckpointCorrupt`` when the CRC32 footer does not match the
+    bytes and ``CheckpointError`` for torn/alien files — both carry the path
+    and a likely cause, so resume loops can skip bad files with a clear
+    diagnosis instead of dying on a raw pickle error."""
+    import mmap
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        try:
+            # OS-paged view: no private heap copy of a multi-GB file on
+            # top of the unpickled arrays
+            raw = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError):  # empty file / mmap-less fs
+            raw = f.read()
+        try:
+            payload = _parse_payload(raw, path)
+        finally:
+            if isinstance(raw, mmap.mmap):
+                try:
+                    raw.close()
+                except BufferError:
+                    pass  # a memoryview pinned by an in-flight traceback
+                    #       still references it; GC closes it later
+    if not isinstance(payload, dict) or "state" not in payload:
+        raise CheckpointError(
+            f"checkpoint {path} decoded to {type(payload).__name__} without "
+            f"a 'state' entry — wrong file?")
     if restore_rng and "rng" in payload:
         reset_seed_seqnum(*payload["rng"])
     return payload["state"], payload.get("extra", {})

@@ -25,6 +25,24 @@ from hetu_tpu.optim.optimizers import Optimizer
 
 __all__ = ["TrainState", "Trainer", "Executor"]
 
+# Fault-injection seam (exec.faults.install wires this up; None in
+# production).  Called with ("grad", batch) before each train step; a
+# non-None return replaces the batch — the deterministic NaN-poisoning
+# path of the chaos harness (a NaN input poisons every gradient).
+_fault_hook = None
+
+
+def _global_grad_norm(grads):
+    """Global L2 norm over every floating grad leaf — the anomaly signal
+    the resilience layer watches (a single NaN/Inf anywhere in the grads
+    makes it non-finite).  float32 accumulation so bf16 models do not
+    overflow the sum of squares."""
+    total = jnp.zeros((), jnp.float32)
+    for g in jax.tree_util.tree_leaves(grads):
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating):
+            total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
 
 def _apply_refreshes(model):
     """Fold HBM-cached embeddings' pending refresh leaves into their cache
@@ -74,6 +92,19 @@ class Trainer:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.strategy = strategy
+        # Recorded so wrappers (exec.resilience) can tell whether the
+        # pre-step state survives the jitted call; strategies always jit
+        # with donation (strategies.py install).
+        self.donate = bool(donate) or strategy is not None
+        # Optional commit gate: ``grad_guard(metrics) -> bool`` runs after
+        # the jitted step but BEFORE the new state is committed and staged
+        # embedding grads are pushed; returning False discards the update
+        # (metrics come back with ``skipped=True``).  The resilience
+        # layer's NaN/Inf anomaly policy hangs here — rejecting before the
+        # staged push matters, because a NaN pushed to a parameter server
+        # cannot be rolled back.  Attach BEFORE the first step: the guard's
+        # ``grad_norm`` metric is added at trace time.
+        self.grad_guard: Optional[Callable[[dict], bool]] = None
         self._state = TrainState(model, optimizer.init(model))
         # Non-trainable state (BatchNorm statistics) must not see weight decay
         # or moment updates; the mask is static model structure, closed over.
@@ -104,6 +135,12 @@ class Trainer:
                 grads, state.opt_state, base, mask=param_mask
             )
             metrics = {"loss": loss, **aux}
+            # trace-time check: only guarded trainers (exec.resilience
+            # attaches grad_guard before the first step) pay for the
+            # all-gradients reduction; a plain Trainer's program — and the
+            # benchmarked scan_steps path — is unchanged
+            if self.grad_guard is not None:
+                metrics["grad_norm"] = _global_grad_norm(grads)
             if self._has_staged:
                 metrics["_staged_rows_grads"] = [
                     m.rows for m in _find_staged(grads)]
@@ -147,6 +184,10 @@ class Trainer:
     def step(self, batch, key=None) -> dict:
         if key is None:
             key = next_key()
+        if _fault_hook is not None:
+            poisoned = _fault_hook("grad", batch)
+            if poisoned is not None:
+                batch = poisoned
         if self._has_staged:
             # validate freshness BEFORE the jitted step runs: a step on
             # stale rows would advance the dense params on wrong gradients
@@ -157,7 +198,15 @@ class Trainer:
                         "staged host embedding has no fresh rows: call "
                         "stage(ids) on every module from staged_modules() "
                         "before each training step")
-        self._state, metrics = self._train_step(self._state, batch, key)
+        new_state, metrics = self._train_step(self._state, batch, key)
+        if self.grad_guard is not None and not self.grad_guard(metrics):
+            # rejected update: keep the pre-step state, drop the staged
+            # grads (never push an anomalous gradient to the host/PS
+            # stores — there is no undo on that side)
+            metrics.pop("_staged_rows_grads", None)
+            metrics["skipped"] = True
+            return metrics
+        self._state = new_state
         if self._has_staged:
             gs = metrics.pop("_staged_rows_grads")
             for m, g in zip(_find_staged(self._state.model), gs):

@@ -1,0 +1,291 @@
+"""Deterministic fault-injection harness for the resilience layer.
+
+A ``FaultPlan`` is a seeded, fully deterministic schedule of ``(step,
+Fault)`` events.  Installing a plan wires lightweight hooks into the real
+production code paths — no monkeypatching, the seams ship in the modules
+themselves and cost one ``is None`` check when no plan is active:
+
+=================  ====================================  ===================
+fault kind         hook site (module seam)               effect
+=================  ====================================  ===================
+``ps_socket_kill`` ``embed.net.RemoteEmbeddingTable``    the next RPC at the
+                   ``._rpc`` (``net._fault_hook``)       step reports dead-
+                                                         socket status -10
+                                                         and must survive
+                                                         via the reconnect
+                                                         protocol
+``ckpt_truncate``  ``exec.checkpoint._atomic_write``     the just-written
+``ckpt_corrupt``   (``checkpoint._fault_hook``)          checkpoint file is
+                                                         truncated to half /
+                                                         has a payload byte
+                                                         flipped on disk
+``grad_nan``       ``exec.executor.Trainer.step``        the step's batch is
+                   (``executor._fault_hook``)            NaN-poisoned, so
+                                                         loss and every
+                                                         gradient go NaN
+``hang``           ``exec.resilience`` step body         the step body
+                   (via :func:`fire` ``"step_begin"``)   sleeps ``arg``
+                                                         seconds — the
+                                                         unresponsive-
+                                                         backend shape the
+                                                         watchdog must catch
+``worker_kill``    ``launch.simulate_workers(faults=)``  worker ``step`` is
+                                                         signalled after
+                                                         ``arg`` seconds
+=================  ====================================  ===================
+
+Every event fires exactly once; ``plan.fired`` records what actually
+triggered, so chaos tests can assert the schedule was exercised.  Two plans
+built from the same seed are identical (``FaultPlan.random``), and a plan
+replayed against the same training run injects at the same steps — the
+lineage tests rely on this to compare a faulted run bitwise against a clean
+one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import re
+import signal as _signal
+import threading
+import time
+from typing import Iterable, Optional, Union
+
+__all__ = ["Fault", "FaultPlan", "install", "uninstall", "inject", "fire",
+           "active_plan", "KINDS"]
+
+KINDS = ("ps_socket_kill", "ckpt_truncate", "ckpt_corrupt", "grad_nan",
+         "hang", "worker_kill")
+
+# C-client dead-socket status (net.RemoteEmbeddingTable._NET_ERRS)
+_DEAD_SOCKET = -10
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injectable fault.  ``arg`` is kind-specific: sleep seconds for
+    ``hang``, kill delay seconds for ``worker_kill`` (unused otherwise).
+    ``sig`` is the signal a ``worker_kill`` delivers (default SIGKILL)."""
+
+    kind: str
+    arg: Optional[float] = None
+    sig: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+
+
+class FaultPlan:
+    """A deterministic schedule of ``(step, fault)`` events.
+
+    Steps are the 1-based driver step counter (``ResilientTrainer``
+    advances the plan at the top of every step; standalone users call
+    :meth:`advance` themselves).  For ``worker_kill`` events the "step" is
+    reinterpreted by ``launch.simulate_workers`` as the worker index.
+    """
+
+    def __init__(self, events: Iterable[tuple]):
+        self._lock = threading.Lock()
+        self._events: list = []
+        for step, fault in events:
+            if isinstance(fault, str):
+                fault = Fault(fault)
+            self._events.append((int(step), fault))
+        self._events.sort(key=lambda e: e[0])
+        self._step = 0
+        self.fired: list = []  # [(step, Fault)] in firing order
+
+    @classmethod
+    def random(cls, seed: int, n_steps: int, *,
+               kinds: Iterable[str] = ("ps_socket_kill", "grad_nan"),
+               rate: float = 0.05) -> "FaultPlan":
+        """Seeded random schedule: each step draws each kind independently
+        with probability ``rate``.  Same seed → bit-identical plan."""
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        events = []
+        for step in range(1, n_steps + 1):
+            for kind in kinds:
+                if rng.random() < rate:
+                    events.append((step, Fault(kind)))
+        return cls(events)
+
+    # -- schedule interface -------------------------------------------------
+
+    def advance(self, step: int) -> None:
+        """Set the current step; hooks fire events scheduled for it."""
+        with self._lock:
+            self._step = int(step)
+
+    def take(self, *kinds: str, late_ok: bool = False,
+             now: Optional[int] = None) -> Optional[Fault]:
+        """Pop (at most) one pending event of the given kinds scheduled for
+        step ``now`` (default: the current step; with ``late_ok``, at or
+        before it).  Thread-safe: concurrent hook calls (e.g. the shard
+        router's parallel pulls) fire the event exactly once."""
+        with self._lock:
+            at = self._step if now is None else int(now)
+            for i, (step, fault) in enumerate(self._events):
+                hit = step == at or (late_ok and step <= at)
+                if hit and fault.kind in kinds:
+                    del self._events[i]
+                    self.fired.append((step, fault))
+                    return fault
+        return None
+
+    def worker_kills(self, n_workers: Optional[int] = None) -> list:
+        """``[(worker_index, delay_seconds, signal)]`` — consumed by
+        ``launch.simulate_workers(faults=plan)``, which passes its gang
+        size so an event aimed at a worker that does not exist stays
+        pending (and shows up in ``remaining()``) instead of being
+        reported as fired."""
+        out = []
+        with self._lock:
+            rest = []
+            for step, fault in self._events:
+                in_range = n_workers is None or 0 <= step < n_workers
+                if fault.kind == "worker_kill" and in_range:
+                    out.append((step, fault.arg or 0.0,
+                                fault.sig or _signal.SIGKILL))
+                    self.fired.append((step, fault))
+                else:
+                    rest.append((step, fault))
+            self._events = rest
+        return out
+
+    def remaining(self) -> list:
+        """Events that have not fired (a clean chaos run drains the plan)."""
+        with self._lock:
+            return list(self._events)
+
+    # -- hook dispatch ------------------------------------------------------
+
+    def _fire(self, site: str, payload=None):
+        if site == "ps_rpc":
+            if self.take("ps_socket_kill") is not None:
+                return _DEAD_SOCKET
+            return None
+        if site == "ckpt_write":
+            # checkpoint writes are asynchronous: the background write for
+            # step N can land while the plan is already at step N+k, so an
+            # event is matched against the STEP IN THE FILENAME when the
+            # path is a resilience checkpoint (ckpt.step_NNN) — fully
+            # deterministic regardless of writer timing; other paths fall
+            # back to the plan step.  ``late_ok``: fire on the first write
+            # at or after the scheduled step.
+            m = re.search(r"ckpt\.step_(\d+)$", payload or "")
+            now = int(m.group(1)) if m else None
+            fault = self.take("ckpt_truncate", "ckpt_corrupt",
+                              late_ok=True, now=now)
+            if fault is not None:
+                _mangle_file(payload, fault.kind)
+            return None
+        if site == "grad":
+            if self.take("grad_nan") is not None:
+                return _poison_batch(payload)
+            return None
+        if site == "step_begin":
+            fault = self.take("hang")
+            if fault is not None:
+                time.sleep(fault.arg if fault.arg is not None else 3600.0)
+            return None
+        return None
+
+
+def _mangle_file(path: str, kind: str) -> None:
+    """Damage a checkpoint ON DISK the way real failures do: ``truncate``
+    = torn write (tail, incl. the integrity footer, lost); ``corrupt`` =
+    silent bit rot (one payload byte flipped; footer intact → CRC trips)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if kind == "ckpt_truncate":
+            f.truncate(max(size // 2, 1))
+        else:
+            pos = max(size // 3, 0)
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _poison_batch(batch):
+    """Replace the first floating leaf of the batch with NaNs: the forward
+    pass and every gradient downstream of it go NaN — the deterministic
+    stand-in for a corrupted gradient all-reduce."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    leaves, treedef = jtu.tree_flatten(batch)
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.floating):
+            leaves[i] = jnp.full_like(jnp.asarray(leaf), jnp.nan)
+            break
+    else:
+        raise ValueError("grad_nan fault: batch has no floating leaf "
+                         "to poison")
+    return jtu.tree_unflatten(treedef, leaves)
+
+
+# -- plan installation ------------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def fire(site: str, payload=None):
+    """Hook entry point.  The instrumented modules hold this (or call it
+    directly) while a plan is installed; returns the site-specific override
+    or None."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan._fire(site, payload)
+
+
+def install(plan: FaultPlan) -> None:
+    """Arm ``plan``: wire the dispatch hook into every instrumented seam."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already installed")
+    _ACTIVE = plan
+    from hetu_tpu.embed import net as _net
+    from hetu_tpu.exec import checkpoint as _ckpt
+    from hetu_tpu.exec import executor as _exec
+    _net._fault_hook = fire
+    _ckpt._fault_hook = fire
+    _exec._fault_hook = fire
+
+
+def uninstall() -> None:
+    """Disarm: every seam back to its zero-overhead None."""
+    global _ACTIVE
+    _ACTIVE = None
+    from hetu_tpu.embed import net as _net
+    from hetu_tpu.exec import checkpoint as _ckpt
+    from hetu_tpu.exec import executor as _exec
+    _net._fault_hook = None
+    _ckpt._fault_hook = None
+    _exec._fault_hook = None
+
+
+@contextlib.contextmanager
+def inject(plan: Union[FaultPlan, Iterable[tuple]]):
+    """``with faults.inject(plan):`` — install for the block, always
+    disarm on the way out (even when the chaos run dies)."""
+    if not isinstance(plan, FaultPlan):
+        plan = FaultPlan(plan)
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()

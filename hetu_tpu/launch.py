@@ -204,11 +204,28 @@ def launch(cfg: DistConfig, argv: Sequence[str],
 
 
 def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
-                     timeout: float = 120.0, port: int = 0) -> list:
+                     timeout: float = 120.0, port: int = 0, faults=None,
+                     restart_once: bool = False) -> list:
     """Run ``script`` in ``n`` local CPU processes joined into one jax
     distributed world.  Returns each process's stdout.  The CPU analogue of
-    the reference's mpirun-on-localhost test pattern (tests/test_comm.py)."""
+    the reference's mpirun-on-localhost test pattern (tests/test_comm.py).
+
+    ``timeout`` is ONE shared deadline for the whole gang (it used to be
+    applied per process sequentially, making the worst case ``n×timeout``).
+
+    ``faults``: an ``exec.faults.FaultPlan`` whose ``worker_kill`` events
+    are honored here — each event ``(worker_index, Fault("worker_kill",
+    arg=delay_seconds, sig=...))`` signals that worker mid-run (SIGKILL by
+    default), the chaos harness's process-crash injection.
+
+    ``restart_once``: a worker that exits non-zero (including killed ones)
+    is relaunched ONCE with the same command and environment — the
+    preemption-restart shape; its returned output is both runs
+    concatenated.  Only the restarted worker's deadline is re-armed; the
+    rest of the gang keeps the original one."""
     import socket
+    import threading
+    import time
     if port == 0:
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
@@ -216,24 +233,61 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
         s.close()
     cfg = DistConfig(hosts=[HostSpec("127.0.0.1", workers=n, chief=True)],
                      port=port)
-    procs = []
+
+    def spawn(env):
+        return subprocess.Popen([sys.executable, "-c", script], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    envs, procs = [], []
     for _host, _lr, pid in cfg.process_table():
         env = worker_env(cfg, pid)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU jax (sitecustomize)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count={cpu_devices_per_proc}").strip()
-        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
-                                      stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True))
-    outs = []
+        envs.append(env)
+        procs.append(spawn(env))
+    def kill_worker(proc, sig):
+        # bound to the ORIGINAL incarnation at arm time: a kill whose
+        # delay outlives that run is a no-op (inherent to wall-clock
+        # chaos) — it must not hit a restart_once replacement and burn
+        # the gang's only retry
+        if proc.poll() is None:
+            proc.send_signal(sig)
+
+    timers = []
+    if faults is not None:
+        for widx, delay, sig in faults.worker_kills(len(procs)):
+            t = threading.Timer(delay, kill_worker, (procs[widx], sig))
+            t.daemon = True
+            t.start()
+            timers.append(t)
+    outs = [""] * len(procs)
+    # one shared deadline; a restarted worker gets a fresh PERSONAL budget
+    # (others keep the gang deadline — re-arming it for everyone would
+    # quietly reintroduce the n×timeout worst case)
+    deadlines = [time.monotonic() + timeout] * len(procs)
+    restarted = set()
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
+        i = 0
+        while i < len(procs):
+            p = procs[i]
+            out, _ = p.communicate(
+                timeout=max(deadlines[i] - time.monotonic(), 0.001))
+            outs[i] += out
             if p.returncode != 0:
-                raise RuntimeError(f"worker failed (rc={p.returncode}):\n{out}")
+                if restart_once and i not in restarted:
+                    restarted.add(i)
+                    deadlines[i] = time.monotonic() + timeout
+                    procs[i] = spawn(envs[i])
+                    continue  # collect the restarted run's output
+                raise RuntimeError(
+                    f"worker {i} failed (rc={p.returncode}):\n{outs[i]}")
+            i += 1
     finally:
+        for t in timers:
+            t.cancel()
         # a failed/timed-out peer leaves the others blocked in distributed
         # init — reap everything before surfacing the error
         for p in procs:

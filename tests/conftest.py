@@ -50,6 +50,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running integration tests")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection / resilience tests "
+                   "(exec.faults + exec.resilience); the ones that kill OS "
+                   "processes are additionally marked slow")
 
 
 @pytest.fixture

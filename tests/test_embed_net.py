@@ -592,6 +592,44 @@ def test_transient_drop_does_not_roll_back_live_server(server, tmp_path):
         proxy.close()
 
 
+def test_concurrent_dead_socket_exactly_one_redial(server):
+    """The _reconnect generation protocol under actual concurrency: two
+    threads whose RPCs hit a dead socket at the same time must produce
+    exactly ONE redial — the first thread to take the lock reconnects and
+    bumps the generation, the second sees the bump and just retries on the
+    fresh connection (previously only the single-threaded path was
+    tested)."""
+    proxy = _FlakyProxy(server.port)
+    try:
+        t = RemoteEmbeddingTable(f"127.0.0.1:{proxy.port}", 980, 32, 4,
+                                 optimizer="sgd", lr=1.0,
+                                 reconnect_attempts=20,
+                                 reconnect_backoff=0.01)
+        t.pull(np.arange(4))  # warm the connection through the proxy
+        proxy.sever()  # both threads' next RPC sees a dead socket
+        start = threading.Barrier(2)
+        results, errs = [], []
+
+        def puller():
+            try:
+                start.wait(5)
+                results.append(t.pull(np.arange(8)))
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(e)
+
+        ths = [threading.Thread(target=puller) for _ in range(2)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(15)
+        assert not errs, errs
+        assert len(results) == 2
+        assert t._gen == 1, f"expected exactly one redial, got {t._gen}"
+        np.testing.assert_array_equal(results[0], results[1])
+    finally:
+        proxy.close()
+
+
 def test_push_replay_same_seq_applied_once(server):
     """Server-side push dedup (at-most-once across reconnects): replaying
     a (client_id, seq) the server has already applied is a no-op — the

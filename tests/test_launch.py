@@ -83,6 +83,105 @@ class TestLaunch:
         assert "[0]" in out and "[3]" in out
 
 
+class TestSimulateWorkersResilience:
+    def test_shared_deadline_across_workers(self):
+        """Satellite: ``timeout`` is one shared gang deadline.  Worker 0
+        exits quickly; worker 1 sleeps far past the budget.  The old
+        per-process timeout re-armed the clock after worker 0 (worst case
+        n×timeout); the shared deadline trips at ~timeout total."""
+        import subprocess
+        import time
+
+        script = ("import os, time; "
+                  "time.sleep(1.0 if os.environ['HETU_TPU_PROC_ID'] == '0'"
+                  " else 60)")
+        t0 = time.monotonic()
+        with pytest.raises(subprocess.TimeoutExpired):
+            simulate_workers(2, script, timeout=3.0)
+        elapsed = time.monotonic() - t0
+        # old behavior: 1.0 elapses, then worker 1 gets a FRESH 3 s → ~4 s
+        # minimum; the shared deadline stays under it
+        assert elapsed < 4.0, f"deadline not shared: {elapsed:.1f}s"
+
+    def test_restart_once_relaunches_failed_worker(self, tmp_path):
+        """A worker that dies is relaunched once with the same env; the
+        returned output covers both runs."""
+        marker = str(tmp_path / "attempt")
+        script = (
+            f"import os, sys\n"
+            f"m = {marker!r}\n"
+            f"if not os.path.exists(m):\n"
+            f"    open(m, 'w').write('x')\n"
+            f"    print('FIRST RUN DYING', flush=True)\n"
+            f"    sys.exit(13)\n"
+            f"print('SECOND RUN OK', flush=True)\n")
+        outs = simulate_workers(1, script, timeout=60.0, restart_once=True)
+        assert "FIRST RUN DYING" in outs[0]
+        assert "SECOND RUN OK" in outs[0]
+
+    def test_failure_without_restart_still_raises(self):
+        with pytest.raises(RuntimeError, match="rc=7"):
+            simulate_workers(1, "import sys; sys.exit(7)", timeout=60.0)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_worker_kill_fault_restart_resumes(tmp_path):
+    """End-to-end chaos: a FaultPlan ``worker_kill`` event SIGTERMs a real
+    training process mid-run; the ResilientTrainer inside performs its
+    final save and exits; ``restart_once`` relaunches it; the restart
+    resumes from the auto-save and finishes."""
+    import signal
+    import textwrap
+
+    from hetu_tpu.exec import faults
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    script = textwrap.dedent(f"""
+        import sys, time
+        import numpy as np
+        import jax.numpy as jnp
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.exec import Trainer, ResilientTrainer, Preempted
+        from hetu_tpu.models import MLP
+        from hetu_tpu.optim import SGDOptimizer
+        from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+        set_random_seed(0)
+        tr = Trainer(MLP((8, 16, 3)), SGDOptimizer(0.1),
+                     lambda m, b, k: (softmax_cross_entropy_sparse(
+                         m(b['x']), b['y']).mean(), {{}}),
+                     donate=False)
+        rt = ResilientTrainer(tr, {ckpt_dir!r}, save_every=1, keep=5,
+                              handle_signals=True)
+        start = rt.resume() or 0
+        if start:
+            print('RESUMED', start, flush=True)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        b = {{'x': jnp.asarray(x),
+             'y': jnp.asarray((x[:, 0] > 0).astype(np.int32))}}
+        try:
+            for _ in range(start, 100):
+                rt.step(b)
+                time.sleep(0.3)
+            print('DONE', rt.step_count, flush=True)
+        except Preempted as e:
+            print('PREEMPTED', e.step, flush=True)
+            sys.exit(13)
+    """)
+    plan = faults.FaultPlan(
+        [(0, faults.Fault("worker_kill", arg=20.0, sig=signal.SIGTERM))])
+    outs = simulate_workers(1, script, timeout=240.0, faults=plan,
+                            restart_once=True)
+    out = outs[0]
+    assert "PREEMPTED" in out, out
+    preempt_step = int(out.split("PREEMPTED")[1].split()[0])
+    assert preempt_step >= 1
+    assert f"RESUMED {preempt_step}" in out, out
+    assert "DONE 100" in out, out
+
+
 @pytest.mark.slow
 class TestRealWorld:
     def test_two_process_cpu_world(self):

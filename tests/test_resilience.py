@@ -1,0 +1,612 @@
+"""Chaos suite: the resilience layer under deterministic fault injection.
+
+Every fault here goes through the production seams that ``exec.faults``
+arms (no monkeypatching): forced dead-socket statuses drive the real PS
+reconnect protocol, on-disk byte mangling drives the real CRC32 footer,
+NaN-poisoned batches drive the real anomaly policy, and signals drive the
+real preemption path.  The lineage tests assert the strongest property a
+resilient trainer can have: a fault-injected run finishes **bitwise
+identical** to an uninjected run of the surviving steps.
+"""
+
+import os
+import signal
+import subprocess
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.core.module import Module
+from hetu_tpu.exec import (BackendUnresponsive, CheckpointCorrupt,
+                           CheckpointError, Preempted, ResilientTrainer,
+                           Trainer, TrainingDiverged, faults,
+                           load_checkpoint, save_checkpoint)
+from hetu_tpu.exec.resilience import (checkpoint_path, latest_good_checkpoint,
+                                      list_checkpoints)
+from hetu_tpu.models import MLP
+from hetu_tpu.optim import SGDOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------- helpers
+
+def make_trainer():
+    set_random_seed(0)
+    model = MLP((8, 16, 3))
+
+    def loss_fn(model, batch, key):
+        logits = model(batch["x"])
+        return softmax_cross_entropy_sparse(logits, batch["y"]).mean(), {}
+
+    # donate=False: the anomaly policy keeps the pre-step state alive
+    return Trainer(model, SGDOptimizer(0.1), loss_fn, donate=False)
+
+
+def make_batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        out.append({"x": jnp.asarray(x),
+                    "y": jnp.asarray((x[:, 0] > 0).astype(np.int32))})
+    return out
+
+
+def params_of(tr):
+    return np.asarray(tr.state.model.layers[0].w)
+
+
+# ------------------------------------------------- checkpoint integrity
+
+class TestCheckpointIntegrity:
+    def test_footer_roundtrip_and_legacy(self, tmp_path):
+        p = str(tmp_path / "c")
+        save_checkpoint(p, {"w": jnp.arange(4.0)}, extra={"k": 1})
+        state, extra = load_checkpoint(p)
+        np.testing.assert_array_equal(np.asarray(state["w"]), np.arange(4.0))
+        assert extra == {"k": 1}
+        # a legacy (pre-footer) file — raw pickle — still loads
+        import pickle
+        legacy = str(tmp_path / "legacy")
+        with open(legacy, "wb") as f:
+            pickle.dump({"state": {"w": np.ones(2)}, "extra": {}}, f)
+        state, _ = load_checkpoint(legacy, restore_rng=False)
+        np.testing.assert_array_equal(state["w"], np.ones(2))
+
+    def test_truncated_raises_checkpoint_error(self, tmp_path):
+        """Satellite: a torn write must surface as CheckpointError naming
+        the path and the likely cause, not a raw EOFError."""
+        p = str(tmp_path / "c")
+        save_checkpoint(p, {"w": jnp.arange(64.0)})
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(CheckpointError, match="torn/truncated") as ei:
+            load_checkpoint(p, restore_rng=False)
+        assert p in str(ei.value)
+
+    def test_corrupt_crc_raises_checkpoint_corrupt(self, tmp_path):
+        p = str(tmp_path / "c")
+        save_checkpoint(p, {"w": jnp.arange(64.0)})
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.seek(size // 3)
+            byte = f.read(1)
+            f.seek(size // 3)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        with pytest.raises(CheckpointCorrupt, match="CRC32"):
+            load_checkpoint(p, restore_rng=False)
+
+    def test_not_a_checkpoint_diagnosed(self, tmp_path):
+        p = str(tmp_path / "weights.txt")
+        with open(p, "w") as f:
+            f.write("definitely not a pickle")
+        with pytest.raises(CheckpointError, match="not a checkpoint"):
+            load_checkpoint(p, restore_rng=False)
+
+    def test_resume_scan_skips_corrupt_and_torn(self, tmp_path):
+        """Fast deterministic fault-injection #1: auto-resume scans
+        ckpt.step_* newest-first and skips damaged files with a clear
+        diagnosis, landing on the newest intact one."""
+        d = str(tmp_path)
+        for step, w in ((2, 1.0), (4, 2.0), (6, 3.0), (8, 4.0)):
+            save_checkpoint(checkpoint_path(d, step),
+                            {"w": np.full(4, w)}, extra={"step": step})
+        # newest torn, second-newest corrupt — injected through the same
+        # on-disk mangling the FaultPlan uses
+        faults._mangle_file(checkpoint_path(d, 8), "ckpt_truncate")
+        faults._mangle_file(checkpoint_path(d, 6), "ckpt_corrupt")
+        step, path, state, extra, report = latest_good_checkpoint(
+            d, restore_rng=False)
+        assert step == 4 and extra["step"] == 4
+        np.testing.assert_array_equal(state["w"], np.full(4, 2.0))
+        diags = {s: diag for s, _p, diag in report}
+        assert "torn/truncated" in diags[8]
+        assert "CRC32" in diags[6]
+        assert diags[4] is None
+        # all four files intact in the listing; only two were examined
+        # past the diagnosis
+        assert [s for s, _ in list_checkpoints(d)] == [2, 4, 6, 8]
+
+    def test_rolling_retention(self, tmp_path):
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=1, keep=3)
+        for b in make_batches(7):
+            rt.step(b)
+        rt.close()
+        assert [s for s, _ in list_checkpoints(str(tmp_path))] == [5, 6, 7]
+
+
+# ------------------------------------------------------------ fault plan
+
+class TestFaultPlan:
+    def test_seeded_determinism(self):
+        a = faults.FaultPlan.random(7, 50, kinds=("grad_nan", "hang"),
+                                    rate=0.2)
+        b = faults.FaultPlan.random(7, 50, kinds=("grad_nan", "hang"),
+                                    rate=0.2)
+        c = faults.FaultPlan.random(8, 50, kinds=("grad_nan", "hang"),
+                                    rate=0.2)
+        assert a.remaining() == b.remaining()
+        assert a.remaining() != c.remaining()
+        assert a.remaining()  # rate 0.2 over 50 steps: non-empty
+
+    def test_events_fire_once_even_concurrently(self):
+        import threading
+        plan = faults.FaultPlan([(1, "ps_socket_kill")])
+        plan.advance(1)
+        hits = []
+        barrier = threading.Barrier(4)
+
+        def worker():
+            barrier.wait(5)
+            f = plan.take("ps_socket_kill")
+            if f is not None:
+                hits.append(f)
+
+        ths = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(5)
+        assert len(hits) == 1
+        assert plan.fired == [(1, hits[0])]
+
+    def test_wrong_step_does_not_fire(self):
+        plan = faults.FaultPlan([(3, "grad_nan")])
+        plan.advance(2)
+        assert plan.take("grad_nan") is None
+        plan.advance(3)
+        assert plan.take("grad_nan") is not None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.Fault("cosmic_ray")
+
+    def test_ckpt_event_keyed_on_filename_step(self, tmp_path):
+        """Regression: checkpoint writes are async, so a straggling write
+        for an EARLIER step can land after the plan advanced past the
+        event's step — the event must key on the step in the filename,
+        not on writer timing."""
+        plan = faults.FaultPlan([(8, "ckpt_corrupt")])
+        plan.advance(9)  # the driver is already past the scheduled step
+        p4 = checkpoint_path(str(tmp_path), 4)
+        p8 = checkpoint_path(str(tmp_path), 8)
+        save_checkpoint(p4, {"w": np.ones(4)})
+        save_checkpoint(p8, {"w": np.ones(4)})
+        plan._fire("ckpt_write", p4)  # late step-4 write: must NOT fire
+        assert plan.remaining()
+        plan._fire("ckpt_write", p8)  # the step-8 write is the target
+        assert plan.remaining() == []
+        load_checkpoint(p4, restore_rng=False)  # intact
+        with pytest.raises(CheckpointCorrupt):
+            load_checkpoint(p8, restore_rng=False)
+
+    def test_out_of_range_worker_kill_stays_pending(self):
+        """A worker_kill aimed at a worker that does not exist must stay in
+        remaining(), not be reported as fired (a chaos test asserting
+        plan.remaining() == [] would otherwise pass without the kill ever
+        being exercised)."""
+        plan = faults.FaultPlan([(5, faults.Fault("worker_kill", arg=0.1))])
+        assert plan.worker_kills(2) == []  # gang of 2: index 5 absent
+        assert plan.remaining() and plan.fired == []
+        assert plan.worker_kills(8) == [(5, 0.1, signal.SIGKILL)]
+        assert plan.remaining() == []
+
+    def test_install_is_exclusive_and_uninstalls(self):
+        from hetu_tpu.embed import net
+        from hetu_tpu.exec import checkpoint as ckpt_mod
+        from hetu_tpu.exec import executor as exec_mod
+        with faults.inject(faults.FaultPlan([])):
+            assert net._fault_hook is faults.fire
+            assert ckpt_mod._fault_hook is faults.fire
+            assert exec_mod._fault_hook is faults.fire
+            with pytest.raises(RuntimeError, match="already installed"):
+                faults.install(faults.FaultPlan([]))
+        assert net._fault_hook is None
+        assert ckpt_mod._fault_hook is None
+        assert exec_mod._fault_hook is None
+
+
+# ------------------------------------------------------- anomaly policy
+
+class TestAnomalyPolicy:
+    def test_nan_skip_preserves_lineage(self, tmp_path):
+        """Fast deterministic fault-injection #2a: one poisoned step is
+        rejected (state AND the RNG stream rewound), and the surviving
+        steps are bitwise identical to an uninjected run of them."""
+        bs = make_batches(8)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path / "a"), save_every=0)
+        injected = []
+        with faults.inject(faults.FaultPlan([(4, "grad_nan")])) as plan:
+            for b in bs:
+                m = rt.step(b)
+                if not m.get("skipped"):
+                    injected.append(float(m["loss"]))
+        assert plan.remaining() == []
+        assert rt.anomalies and rt.anomalies[0][0] == 4
+        assert rt.step_count == 7  # 8 batches, one rejected
+        rt.close()
+
+        tr2 = make_trainer()
+        rt2 = ResilientTrainer(tr2, str(tmp_path / "b"), save_every=0)
+        surviving = [b for i, b in enumerate(bs) if i != 3]
+        oracle = [float(rt2.step(b)["loss"]) for b in surviving]
+        rt2.close()
+        assert injected == oracle  # bitwise: float equality, no tolerance
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+
+    def test_nan_skip_then_rollback(self, tmp_path):
+        """Fast deterministic fault-injection #2b: K consecutive anomalies
+        roll the state back to the newest intact checkpoint."""
+        bs = make_batches(8)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=2, keep=3,
+                              max_consecutive_anomalies=2)
+        snap = {}
+        plan = faults.FaultPlan([(5, "grad_nan"), (5, "grad_nan")])
+        with faults.inject(plan):
+            rolled = []
+            for b in bs[:6]:
+                m = rt.step(b)
+                if rt.step_count in (2, 4) and not m.get("skipped"):
+                    rt._ck.wait()
+                    snap[rt.step_count] = params_of(tr).copy()
+                if "rolled_back_to" in m:
+                    rolled.append(m["rolled_back_to"])
+        assert plan.remaining() == []
+        assert len(rt.anomalies) == 2
+        assert rt.rollbacks == [(4, 4)]  # at step 4 (post-skip), back to 4
+        assert rolled == [4]
+        # the rollback restored exactly the step-4 checkpoint state
+        np.testing.assert_array_equal(snap[4], params_of(tr))
+        rt.close()
+
+    def test_policy_raise(self, tmp_path):
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0,
+                              anomaly_policy="raise")
+        with faults.inject(faults.FaultPlan([(1, "grad_nan")])):
+            with pytest.raises(TrainingDiverged, match="non-finite"):
+                rt.step(make_batches(1)[0])
+        rt.close()
+
+    def test_rollback_without_checkpoint_diverges(self, tmp_path):
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0,
+                              max_consecutive_anomalies=1)
+        with faults.inject(faults.FaultPlan([(1, "grad_nan")])):
+            with pytest.raises(TrainingDiverged, match="no intact"):
+                rt.step(make_batches(1)[0])
+        rt.close()
+
+    def test_late_wrap_warns_loss_only_detection(self, tmp_path):
+        """A Trainer jitted before ResilientTrainer wraps it has no
+        grad_norm in its cached program — detection degrades to loss-only
+        and must say so (once), not silently weaken."""
+        import warnings
+        tr = make_trainer()
+        b = make_batches(1)[0]
+        tr.step(b)  # traced without the guard
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert "grad_norm" not in rt.step(b)
+            rt.step(b)
+        assert len([w for w in caught
+                    if "LOSS-ONLY" in str(w.message)]) == 1
+        rt.close()
+
+    def test_donating_trainer_rejected(self, tmp_path):
+        set_random_seed(0)
+        model = MLP((8, 16, 3))
+        tr = Trainer(model, SGDOptimizer(0.1),
+                     lambda m, b, k: (m(b["x"]).sum(), {}))  # donate=True
+        with pytest.raises(ValueError, match="donate=False"):
+            ResilientTrainer(tr, str(tmp_path))
+        # fine with the anomaly policy off
+        ResilientTrainer(tr, str(tmp_path), anomaly_policy="off").close()
+
+
+# ------------------------------------------------------------- watchdog
+
+class TestWatchdog:
+    def test_backend_unresponsive(self, tmp_path):
+        tr = make_trainer()
+        b = make_batches(1)[0]
+        tr.step(b)  # compile OUTSIDE the watchdog window
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0,
+                              step_timeout=0.3)
+        key = jax.random.key(0)  # explicit key: the timed-out thread must
+        #                          not touch the global RNG when it drains
+        assert "loss" in rt.step(b, key=key)
+        plan = faults.FaultPlan([(2, faults.Fault("hang", arg=1.2))])
+        with faults.inject(plan):
+            with pytest.raises(BackendUnresponsive, match="did not complete"):
+                rt.step(b, key=key)
+        assert plan.remaining() == []
+        rt.close()
+        time.sleep(1.1)  # let the hung step drain before the next test
+
+    def test_timed_out_step_never_commits(self, tmp_path):
+        """The zombie thread of a timed-out step eventually finishes its
+        device program — the commit gate must fence it so it cannot mutate
+        trainer state (or push staged grads) behind the caller's back."""
+        tr = make_trainer()
+        b = make_batches(1)[0]
+        tr.step(b)  # compile outside the watchdog window
+        params0 = params_of(tr).copy()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0,
+                              step_timeout=0.25)
+        plan = faults.FaultPlan([(1, faults.Fault("hang", arg=0.8))])
+        with faults.inject(plan):
+            with pytest.raises(BackendUnresponsive):
+                rt.step(b, key=jax.random.key(0))
+        time.sleep(1.0)  # the zombie drains and tries to commit...
+        np.testing.assert_array_equal(params_of(tr), params0)  # ...fenced
+        rt.close()
+
+
+# ----------------------------------------------------------- preemption
+
+class TestPreemption:
+    def test_sigterm_final_save_then_restart_resumes(self, tmp_path):
+        """Acceptance: a run killed by SIGTERM restarts from its final
+        auto-save — and the restarted lineage is bitwise identical to an
+        uninterrupted run."""
+        bs = make_batches(10)
+        d = str(tmp_path)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, d, save_every=4, keep=3,
+                              handle_signals=True)
+        losses = []
+        try:
+            for i, b in enumerate(bs):
+                if i == 6:
+                    os.kill(os.getpid(), signal.SIGTERM)  # preemption notice
+                losses.append(float(rt.step(b)["loss"]))
+            pytest.fail("expected Preempted")
+        except Preempted as e:
+            # the flag is honored at the next step boundary: 6 steps
+            # completed, the driver saved synchronously and raised before
+            # running the 7th
+            assert e.step == 6
+            assert len(losses) == 6
+        finally:
+            rt.close()
+        assert os.path.exists(checkpoint_path(d, 6))
+
+        # "restart": fresh trainer, resume from the final auto-save
+        tr2 = make_trainer()
+        rt2 = ResilientTrainer(tr2, d, save_every=4, keep=3)
+        assert rt2.resume() == 6
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+        losses += [float(rt2.step(b)["loss"]) for b in bs[6:]]
+        rt2.close()
+
+        tr3 = make_trainer()
+        rt3 = ResilientTrainer(tr3, str(tmp_path / "oracle"), save_every=0)
+        oracle = [float(rt3.step(b)["loss"]) for b in bs]
+        rt3.close()
+        assert losses == oracle
+        np.testing.assert_array_equal(params_of(tr2), params_of(tr3))
+
+    def test_sigint_between_steps(self, tmp_path):
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), save_every=0,
+                              handle_signals=True)
+        b = make_batches(1)[0]
+        try:
+            rt.step(b)
+            os.kill(os.getpid(), signal.SIGINT)
+            with pytest.raises(Preempted):
+                rt.step(b)  # caught at the step boundary, before the step
+            assert rt.step_count == 1
+        finally:
+            rt.close()
+        assert latest_good_checkpoint(str(tmp_path),
+                                      restore_rng=False)[0] == 1
+
+    def test_handlers_and_guard_restored_on_close(self, tmp_path):
+        old_term = signal.getsignal(signal.SIGTERM)
+        old_int = signal.getsignal(signal.SIGINT)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, str(tmp_path), handle_signals=True)
+        assert signal.getsignal(signal.SIGTERM) == rt._on_signal
+        assert tr.grad_guard is not None
+        rt.close()
+        assert signal.getsignal(signal.SIGTERM) == old_term
+        assert signal.getsignal(signal.SIGINT) == old_int
+        # the commit gate is detached too: plain Trainer semantics return
+        assert tr.grad_guard is None
+        assert "skipped" not in tr.step(make_batches(1)[0])
+
+
+# ------------------------------------------------------------- PS faults
+
+class TestPsFaults:
+    def test_socket_kill_recovery(self):
+        """Fast deterministic fault-injection #3: a forced dead-socket
+        status on a live server drives one real redial and the retried RPC
+        returns bit-identical data."""
+        from hetu_tpu.embed.engine import HostEmbeddingTable
+        from hetu_tpu.embed.net import EmbeddingServer, RemoteEmbeddingTable
+
+        with EmbeddingServer() as srv:
+            t = RemoteEmbeddingTable(f"127.0.0.1:{srv.port}", 880, 32, 4,
+                                     optimizer="sgd", lr=0.5, seed=9,
+                                     reconnect_attempts=5,
+                                     reconnect_backoff=0.01)
+            local = HostEmbeddingTable(32, 4, optimizer="sgd", lr=0.5,
+                                       seed=9)
+            plan = faults.FaultPlan([(2, "ps_socket_kill"),
+                                     (3, "ps_socket_kill")])
+            with faults.inject(plan):
+                plan.advance(1)
+                np.testing.assert_array_equal(t.pull([1, 5]),
+                                              local.pull([1, 5]))
+                plan.advance(2)  # pull survives a forced dead socket
+                np.testing.assert_array_equal(t.pull(np.arange(32)),
+                                              local.pull(np.arange(32)))
+                assert t._gen == 1
+                plan.advance(3)  # push too (dedup'd replay on the server)
+                g = np.ones((2, 4), np.float32)
+                t.push([3, 4], g)
+                local.push([3, 4], g)
+                np.testing.assert_array_equal(t.pull(np.arange(32)),
+                                              local.pull(np.arange(32)))
+            assert t._gen == 2
+            assert plan.remaining() == []
+
+    def test_exhausted_reconnect_names_address_and_attempts(self):
+        """Satellite: the terminal error says which server was lost and how
+        many redials failed — not an opaque 'status -10'."""
+        from hetu_tpu.embed.net import EmbeddingServer, RemoteEmbeddingTable
+
+        srv = EmbeddingServer()
+        addr = f"127.0.0.1:{srv.port}"
+        t = RemoteEmbeddingTable(addr, 881, 8, 2, reconnect_attempts=2,
+                                 reconnect_backoff=0.01)
+        t2 = RemoteEmbeddingTable(addr, 882, 8, 2)  # reconnect disabled
+        srv.stop()
+        time.sleep(0.1)
+        with pytest.raises(ConnectionError) as ei:
+            t.pull([0])
+        msg = str(ei.value)
+        assert addr in msg and "2" in msg and "redial" in msg
+        with pytest.raises(ConnectionError, match="reconnection is "
+                                                  "disabled") as ei2:
+            t2.pull([0])
+        assert addr in str(ei2.value)
+
+
+# -------------------------------------------------- the lineage acceptance
+
+def test_chaos_lineage(tmp_path):
+    """THE acceptance test: one ResilientTrainer run over a PS-backed CTR
+    model is injected with a PS socket kill (step 2), NaN grads (step 5),
+    and checkpoint corruption (the step-8 periodic save), then preempted by
+    SIGTERM; the restarted run resumes from the final auto-save and the
+    full surviving lineage — losses, dense params, AND server-side
+    embedding rows — is bitwise identical to an uninjected run of the
+    surviving steps."""
+    from hetu_tpu.embed.net import EmbeddingServer, RemoteHostEmbedding
+    from hetu_tpu.layers import Linear
+    from hetu_tpu.ops import binary_cross_entropy_with_logits
+    from hetu_tpu.optim import AdamOptimizer
+
+    rng = np.random.default_rng(3)
+    sps = [rng.integers(0, 60, (8, 4)) for _ in range(14)]
+    bs = [{"sp": jnp.asarray(sp),
+           "y": jnp.asarray((sp.sum(1) % 2).astype(np.float32))}
+          for sp in sps]
+
+    def build(port):
+        set_random_seed(0)
+
+        class M(Module):
+            def __init__(self):
+                self.embed = RemoteHostEmbedding(
+                    60, 4, servers=[f"127.0.0.1:{port}"], table_id=890,
+                    optimizer="sgd", lr=0.1, seed=5,
+                    reconnect_attempts=5, reconnect_backoff=0.01)
+                self.head = Linear(16, 1)
+
+            def loss(self, sp, y):
+                e = self.embed(sp).reshape(sp.shape[0], -1)
+                return binary_cross_entropy_with_logits(
+                    self.head(e)[:, 0], y).mean()
+
+        m = M()
+        tr = Trainer(m, AdamOptimizer(1e-2),
+                     lambda mm, b, k: (mm.loss(b["sp"], b["y"]), {}),
+                     donate=False)
+        return m, tr
+
+    def drive(rt, i):
+        for mod in rt.trainer.staged_modules():
+            mod.stage(sps[i])
+        return rt.step(bs[i])
+
+    d = str(tmp_path / "ckpts")
+    inj_losses = []
+    with EmbeddingServer() as srv:
+        m, tr = build(srv.port)
+        rt = ResilientTrainer(tr, d, save_every=4, keep=4,
+                              handle_signals=True)
+        plan = faults.FaultPlan([(2, "ps_socket_kill"), (5, "grad_nan"),
+                                 (8, "ckpt_corrupt")])
+        try:
+            with faults.inject(plan):
+                for i in range(10):
+                    mtr = drive(rt, i)
+                    if not mtr.get("skipped"):
+                        inj_losses.append(float(mtr["loss"]))
+                # preemption notice arrives; it is honored at the next
+                # step boundary: final synchronous save, then Preempted
+                os.kill(os.getpid(), signal.SIGTERM)
+                with pytest.raises(Preempted) as ei:
+                    drive(rt, 10)
+        finally:
+            rt.close()
+        assert plan.remaining() == []  # every fault actually fired
+        assert m.embed.tables[0]._gen == 1  # the socket kill really redialed
+        assert rt.anomalies and rt.anomalies[0][0] == 5
+        assert ei.value.step == 9  # 10 batches driven, one rejected
+        # the corrupted periodic save is diagnosed as such...
+        with pytest.raises(CheckpointCorrupt):
+            load_checkpoint(checkpoint_path(d, 8), restore_rng=False)
+        # ...while the SIGTERM final save is intact and newest
+
+        # "restart": rebuild against the SAME live server (the worker was
+        # preempted, the PS was not) and resume
+        m2, tr2 = build(srv.port)
+        rt2 = ResilientTrainer(tr2, d, save_every=4, keep=4)
+        assert rt2.resume() == 9
+        assert rt2.resume_report[0][2] is None  # newest examined file: good
+        for i in range(10, 14):
+            inj_losses.append(float(drive(rt2, i)["loss"]))
+        rt2.close()
+        inj_rows = m2.embed.pull_rows(np.arange(60))
+        inj_params = np.asarray(tr2.state.model.head.w)
+
+    # oracle: uninjected run of the surviving steps on a fresh server
+    with EmbeddingServer() as srv2:
+        m3, tr3 = build(srv2.port)
+        rt3 = ResilientTrainer(tr3, str(tmp_path / "oracle"), save_every=0)
+        oracle = []  # every batch except the poisoned one (batch 10 was
+        for i in [i for i in range(14) if i != 4]:  # re-driven after resume)
+            oracle.append(float(drive(rt3, i)["loss"]))
+        rt3.close()
+        oracle_rows = m3.embed.pull_rows(np.arange(60))
+        oracle_params = np.asarray(tr3.state.model.head.w)
+
+    assert inj_losses == oracle  # bitwise: plain float equality
+    np.testing.assert_array_equal(inj_rows, oracle_rows)
+    np.testing.assert_array_equal(inj_params, oracle_params)
