@@ -14,6 +14,8 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
   (reference gpu_ops/executor.py)
 * ``hetu_tpu.embed``  — host-side cached sparse-embedding engine (HET;
   reference src/hetu_cache + ps-lite)
+* ``hetu_tpu.obs``    — runtime telemetry: metrics registry, tracing
+  spans, resilience event journal, /metrics endpoint
 * ``hetu_tpu.models`` — model zoo (reference examples/)
 * ``hetu_tpu.data``   — dataloaders (reference dataloader.py)
 * ``hetu_tpu.autoparallel`` — cost-model-driven parallelism search
@@ -22,7 +24,7 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
 
 __version__ = "1.0.0"
 
-from hetu_tpu import core, init, ops, optim
+from hetu_tpu import core, init, obs, ops, optim
 from hetu_tpu.core import (
     Module,
     Policy,

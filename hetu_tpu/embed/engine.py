@@ -10,16 +10,20 @@ partner matching.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import pathlib
 import subprocess
 
 import numpy as np
 
+from hetu_tpu.obs import registry as _obs
+
 __all__ = [
     "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "PReduceGroup", "decode_preduce_mask",
     "PREDUCE_QUORUM_FAIL_BIT", "OPTIMIZERS", "POLICIES",
+    "publish_cache_stats",
 ]
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
@@ -103,6 +107,48 @@ def _load():
     return lib
 
 
+_cache_metrics = None
+# default telemetry names for caches constructed without one; the counter
+# is process-local, so names are deterministic per construction order
+_cache_names = itertools.count(0)
+
+
+def publish_cache_stats(name: str, stats: dict) -> None:
+    """Mirror one HET cache's cumulative hit/miss counters (and current
+    size) into the process registry under the ``cache`` label.  Shared by
+    the in-process ``CacheTable`` and the network ``RemoteCacheTable`` so
+    both expose one scrape surface.  Evictions are derived: every miss
+    inserts, so ``misses - size`` rows have been evicted since the cache
+    started empty."""
+    global _cache_metrics
+    if not _obs.enabled():
+        return
+    if _cache_metrics is None:
+        reg = _obs.get_registry()
+        _cache_metrics = {
+            "hits": reg.counter("hetu_cache_hits_total",
+                                "HET cache hits (mirrored from the C "
+                                "engine's cumulative counters)", ("cache",)),
+            "misses": reg.counter("hetu_cache_misses_total",
+                                  "HET cache misses", ("cache",)),
+            "evictions": reg.counter(
+                "hetu_cache_evictions_total",
+                "HET cache evictions (derived: misses - resident size)",
+                ("cache",)),
+            "size": reg.gauge("hetu_cache_size_rows",
+                              "HET cache resident rows", ("cache",)),
+            "hit_rate": reg.gauge("hetu_cache_hit_rate",
+                                  "lifetime hit fraction", ("cache",)),
+        }
+    m = _cache_metrics
+    m["hits"].labels(cache=name).set_total(stats["hits"])
+    m["misses"].labels(cache=name).set_total(stats["misses"])
+    m["evictions"].labels(cache=name).set_total(
+        max(stats["misses"] - stats["size"], 0))
+    m["size"].labels(cache=name).set(stats["size"])
+    m["hit_rate"].labels(cache=name).set(stats["hit_rate"])
+
+
 def _i64(a):
     a = np.ascontiguousarray(a, dtype=np.int64)
     return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
@@ -184,10 +230,13 @@ class CacheTable:
 
     def __init__(self, table: HostEmbeddingTable, capacity: int, *,
                  policy: str = "lru", pull_bound: int = 0,
-                 push_bound: int = 0):
+                 push_bound: int = 0, name: str | None = None):
         self._lib = _load()
         self.table = table
         self.dim = table.dim
+        # telemetry label (see publish_cache_stats); pass an explicit name
+        # when you need run-to-run stable labels across rebuilds
+        self.name = name if name is not None else f"cache{next(_cache_names)}"
         self._h = self._lib.het_cache_create(
             table._h, capacity, POLICIES[policy], pull_bound, push_bound)
 
@@ -202,6 +251,8 @@ class CacheTable:
         self._lib.het_cache_sync(self._h, kp, len(keys),
                                  out.ctypes.data_as(
                                      ctypes.POINTER(ctypes.c_float)))
+        if _obs.enabled():
+            self.stats()  # refresh the registry mirror for live scrapes
         return out
 
     def push(self, keys, grads):
@@ -216,9 +267,11 @@ class CacheTable:
         h, m = ctypes.c_uint64(), ctypes.c_uint64()
         self._lib.het_cache_stats(self._h, ctypes.byref(h), ctypes.byref(m))
         total = h.value + m.value
-        return {"hits": h.value, "misses": m.value, "size":
-                int(self._lib.het_cache_size(self._h)),
-                "hit_rate": h.value / total if total else 0.0}
+        out = {"hits": h.value, "misses": m.value, "size":
+               int(self._lib.het_cache_size(self._h)),
+               "hit_rate": h.value / total if total else 0.0}
+        publish_cache_stats(self.name, out)
+        return out
 
 
 class AsyncEngine:

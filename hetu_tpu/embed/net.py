@@ -21,11 +21,15 @@ from __future__ import annotations
 
 import ctypes
 import itertools
+import time
 
 import numpy as np
 
 from hetu_tpu.embed.engine import OPTIMIZERS, _load
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs import tracing as _obs_tracing
 
 __all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
            "RemoteHostEmbedding", "attach_loads_client"]
@@ -36,6 +40,38 @@ __all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
 # the RPC status INSTEAD of running it — returning -10 fakes a dead socket
 # and drives the real reconnect machinery below.
 _fault_hook = None
+
+# PS-client metric families, built on first use so importing this module
+# registers nothing; every mutator is a no-op while obs is disabled.
+_ps_metrics = None
+
+
+def _ps_m() -> dict:
+    global _ps_metrics
+    if _ps_metrics is None:
+        reg = _obs.get_registry()
+        _ps_metrics = {
+            "latency": reg.histogram(
+                "hetu_ps_rpc_latency_seconds",
+                "PS RPC wall latency by op (successful calls)",
+                ("op",)),
+            "total": reg.counter(
+                "hetu_ps_rpc_total", "PS RPCs completed, by op", ("op",)),
+            "bytes": reg.counter(
+                "hetu_ps_rpc_bytes_total",
+                "PS RPC payload bytes, by op and direction (tx=sent keys/"
+                "grads/values, rx=received rows)", ("op", "direction")),
+            "errors": reg.counter(
+                "hetu_ps_rpc_errors_total",
+                "PS RPC failures by type (dead_socket: the C client saw a "
+                "dead connection; app: the server reported an error)",
+                ("type",)),
+            "redials": reg.counter(
+                "hetu_ps_redials_total",
+                "successful PS reconnects, by server address",
+                ("address",)),
+        }
+    return _ps_metrics
 
 
 def _lib():
@@ -293,16 +329,51 @@ class RemoteEmbeddingTable:
                             f"restore from {self.restore_path} failed "
                             f"after reconnect (status {st})")
                 self._gen += 1
+                # telemetry: one successful redial per dead socket that
+                # actually did the work (threads that found the bumped
+                # generation and just retried are not redials)
+                if _obs.enabled():
+                    _ps_m()["redials"].labels(
+                        address=self.address).inc()
+                    _obs_journal.record(
+                        "ps_redial", address=self.address,
+                        table_id=self.table_id, attempt=attempt + 1,
+                        table_created=created)
                 return True
             return False
 
-    def _rpc(self, what: str, call):
+    def _rpc(self, what: str, call, *, tx_bytes: int = 0,
+             rx_bytes: int = 0):
         """Run ``call(conn) -> status``; on a dead socket, reconnect (if
-        enabled) and retry once per successful redial.  The generation is
-        snapshotted BEFORE each call: a thread whose RPC died on a
-        connection another thread has already replaced sees the bumped
-        gen inside _reconnect and retries immediately instead of
-        redialing a second time."""
+        enabled) and retry once per successful redial.  With telemetry
+        enabled, a successful RPC lands in the per-op latency histogram
+        and byte/total counters (``tx_bytes``/``rx_bytes`` are the
+        payload sizes the caller already knows); with a recording tracer
+        it also becomes a ``ps.rpc`` span — a child of whatever span
+        (e.g. ``train.step``) is context-current."""
+        if not _obs.enabled():
+            return self._rpc_inner(what, call)
+        t0 = time.perf_counter()
+        tracer = _obs_tracing.get_tracer()
+        if tracer.recording:
+            with tracer.span("ps.rpc", op=what, table=self.table_id,
+                             address=self.address):
+                self._rpc_inner(what, call)
+        else:
+            self._rpc_inner(what, call)
+        m = _ps_m()
+        m["latency"].labels(op=what).observe(time.perf_counter() - t0)
+        m["total"].labels(op=what).inc()
+        if tx_bytes:
+            m["bytes"].labels(op=what, direction="tx").inc(tx_bytes)
+        if rx_bytes:
+            m["bytes"].labels(op=what, direction="rx").inc(rx_bytes)
+
+    def _rpc_inner(self, what: str, call):
+        """The retry loop proper.  The generation is snapshotted BEFORE
+        each call: a thread whose RPC died on a connection another thread
+        has already replaced sees the bumped gen inside _reconnect and
+        retries immediately instead of redialing a second time."""
         while True:
             gen = self._gen
             st = _fault_hook("ps_rpc", self) if _fault_hook is not None \
@@ -311,6 +382,8 @@ class RemoteEmbeddingTable:
                 st = call(self._c)
             if st not in self._NET_ERRS:
                 break
+            if _obs.enabled():
+                _ps_m()["errors"].labels(type="dead_socket").inc()
             if self.reconnect_attempts <= 0:
                 raise ConnectionError(
                     f"remote {what} failed: connection to {self.address} "
@@ -327,6 +400,8 @@ class RemoteEmbeddingTable:
 
     def _check(self, st, what):
         if st != 0:
+            if _obs.enabled():
+                _ps_m()["errors"].labels(type="app").inc()
             raise RuntimeError(f"remote {what} failed (status {st})")
 
     def pull(self, keys) -> np.ndarray:
@@ -335,7 +410,8 @@ class RemoteEmbeddingTable:
         self._rpc("pull", lambda c: self._lib.het_ps_pull(
             c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            self.dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+            self.dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            tx_bytes=keys.nbytes, rx_bytes=out.nbytes)
         return out
 
     def push(self, keys, grads):
@@ -353,7 +429,8 @@ class RemoteEmbeddingTable:
             c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
             self.dim, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            self._client_id, seq))
+            self._client_id, seq),
+            tx_bytes=keys.nbytes + grads.nbytes)
 
     def set_rows(self, keys, values):
         keys = _i64(np.asarray(keys).ravel())
@@ -361,7 +438,8 @@ class RemoteEmbeddingTable:
         self._rpc("set_rows", lambda c: self._lib.het_ps_set_rows(
             c, self.table_id,
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            self.dim, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+            self.dim, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            tx_bytes=keys.nbytes + values.nbytes)
 
     def set_lr(self, lr: float):
         self._rpc("set_lr",
@@ -454,12 +532,16 @@ class RemoteCacheTable:
 
     def __init__(self, table: RemoteEmbeddingTable, capacity: int, *,
                  policy: str = "lru", pull_bound: int = 0,
-                 push_bound: int = 0):
+                 push_bound: int = 0, name: str | None = None):
         from hetu_tpu.embed.engine import POLICIES
         if capacity <= 0:
             raise ValueError("cache capacity must be > 0")
         self.table = table  # keeps the connection alive
         self.dim = table.dim
+        # telemetry label; default is deterministic across runs (table ids
+        # are allocated in SPMD construction order), so chaos tests can
+        # assert identical per-cache counters between seeded runs
+        self.name = name if name is not None else f"table{table.table_id}"
         self._lib = _lib()
         self._h = self._lib.het_rcache_create(
             table._c, table.table_id, table.dim, capacity, POLICIES[policy],
@@ -476,6 +558,8 @@ class RemoteCacheTable:
             self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             keys.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
             "sync")
+        if _obs.enabled():
+            self.stats()  # refresh the registry mirror for live scrapes
         return out
 
     # plain pull = cache-served read (sync without new semantics); the shard
@@ -515,13 +599,20 @@ class RemoteCacheTable:
         return int(self._lib.het_rcache_size(self._h))
 
     def stats(self) -> dict:
+        """Same surface as the in-process ``CacheTable.stats()`` (hits/
+        misses/size/hit_rate), and the same registry routing — local and
+        remote HET caches are interchangeable to dashboards."""
         hits = ctypes.c_uint64()
         misses = ctypes.c_uint64()
         self._lib.het_rcache_stats(self._h, ctypes.byref(hits),
                                    ctypes.byref(misses))
         total = hits.value + misses.value
-        return {"hits": hits.value, "misses": misses.value,
-                "hit_rate": hits.value / total if total else 0.0}
+        out = {"hits": hits.value, "misses": misses.value,
+               "size": self.size(),
+               "hit_rate": hits.value / total if total else 0.0}
+        from hetu_tpu.embed.engine import publish_cache_stats
+        publish_cache_stats(self.name, out)
+        return out
 
     def close(self):
         if getattr(self, "_h", None):
@@ -608,8 +699,9 @@ class RemoteHostEmbedding(ShardedHostEmbedding):
             self.stores = [
                 RemoteCacheTable(t, per, policy=policy,
                                  pull_bound=pull_bound,
-                                 push_bound=push_bound)
-                for t in self.tables
+                                 push_bound=push_bound,
+                                 name=f"table{table_id}.shard{s}")
+                for s, t in enumerate(self.tables)
             ]
         else:
             self.stores = list(self.tables)

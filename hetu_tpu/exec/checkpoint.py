@@ -17,9 +17,11 @@ from __future__ import annotations
 import copy
 import os
 import pickle
+import re
 import struct
 import sys
 import threading
+import time
 import zlib
 from typing import Any, Optional
 
@@ -29,6 +31,8 @@ import numpy as np
 
 from hetu_tpu.core import get_seed_status, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
 
 __all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
            "load_state_dict", "AsyncCheckpointer", "CheckpointError",
@@ -58,6 +62,34 @@ _FOOTER = struct.Struct("<8sI")
 # ("ckpt_write", final_path) after every durable write.
 _fault_hook = None
 
+# Step number baked into resilience checkpoint names (ckpt.step_NNN,
+# written by resilience.checkpoint_path); journaled when present so events
+# correlate with the driver's counter.  Canonical search pattern — the
+# fault harness keys checkpoint events on it too, so a rename of the
+# checkpoint scheme must change them together.
+_STEP_IN_NAME = re.compile(r"ckpt\.step_(\d+)$")
+
+_ckpt_metrics = None
+
+
+def _ckpt_m() -> dict:
+    global _ckpt_metrics
+    if _ckpt_metrics is None:
+        reg = _obs.get_registry()
+        _ckpt_metrics = {
+            "seconds": reg.histogram(
+                "hetu_checkpoint_write_seconds",
+                "durable checkpoint write time (pickle+fsync+rename, on "
+                "whichever thread ran it)"),
+            "bytes": reg.counter(
+                "hetu_checkpoint_bytes_total",
+                "bytes durably written as checkpoints"),
+            "writes": reg.counter(
+                "hetu_checkpoint_writes_total",
+                "checkpoints durably written"),
+        }
+    return _ckpt_metrics
+
 
 def _snap(x):
     """Host snapshot of one leaf; always a fresh buffer (device_get is a
@@ -86,8 +118,10 @@ def _atomic_write(path: str, payload: dict) -> None:
     leaves either the old or the new checkpoint, never a torn one.  The
     payload is followed by a CRC32 integrity footer so silent on-disk
     corruption is detected at load time."""
+    t0 = time.perf_counter() if _obs.enabled() else None
     buf = pickle.dumps(payload)
-    footer = _FOOTER.pack(_FOOTER_MAGIC, zlib.crc32(buf) & 0xFFFFFFFF)
+    crc = zlib.crc32(buf) & 0xFFFFFFFF
+    footer = _FOOTER.pack(_FOOTER_MAGIC, crc)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(buf)
@@ -100,6 +134,18 @@ def _atomic_write(path: str, payload: dict) -> None:
         os.fsync(dfd)  # make the rename itself durable
     finally:
         os.close(dfd)
+    if t0 is not None:
+        dt = time.perf_counter() - t0
+        nbytes = len(buf) + _FOOTER.size
+        m = _ckpt_m()
+        m["seconds"].observe(dt)
+        m["bytes"].inc(nbytes)
+        m["writes"].inc()
+        step = _STEP_IN_NAME.search(path)
+        _obs_journal.record(
+            "checkpoint_saved", path=path,
+            step=int(step.group(1)) if step else None,
+            bytes=nbytes, crc32=crc, duration_s=round(dt, 6))
     if _fault_hook is not None:
         _fault_hook("ckpt_write", path)
 

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module, trainable_mask
 from hetu_tpu.core.rng import next_key
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs import tracing as _obs_tracing
 from hetu_tpu.optim.optimizers import Optimizer
 
 __all__ = ["TrainState", "Trainer", "Executor"]
@@ -30,6 +32,48 @@ __all__ = ["TrainState", "Trainer", "Executor"]
 # non-None return replaces the batch — the deterministic NaN-poisoning
 # path of the chaos harness (a NaN input poisons every gradient).
 _fault_hook = None
+
+# Train-loop metric families, built on first instrumented step (never
+# while telemetry is disabled — the disabled path must register nothing).
+_step_metrics = None
+
+
+def _step_m() -> dict:
+    global _step_metrics
+    if _step_metrics is None:
+        reg = _obs.get_registry()
+        _step_metrics = {
+            "latency": reg.histogram(
+                "hetu_step_latency_seconds",
+                "Trainer.step wall latency (host-side, dispatch-"
+                "inclusive; device time is exec.profiler's job)"),
+            "steps": reg.counter(
+                "hetu_train_steps_total",
+                "train steps by outcome (ok, or skipped by the anomaly "
+                "guard)", ("outcome",)),
+            "examples": reg.counter(
+                "hetu_train_examples_total",
+                "examples consumed by committed train steps"),
+            "eps": reg.gauge(
+                "hetu_examples_per_second",
+                "throughput of the most recent committed step"),
+            "grad_norm": reg.gauge(
+                "hetu_grad_norm",
+                "global gradient L2 norm of the last committed step "
+                "(guarded trainers only — the plain program carries no "
+                "grad_norm)"),
+        }
+    return _step_metrics
+
+
+def _batch_examples(batch) -> int:
+    """Leading dim of the first array-ish leaf — the batch size for
+    throughput accounting (0 when the batch carries no arrays)."""
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
 
 
 def _global_grad_norm(grads):
@@ -182,6 +226,40 @@ class Trainer:
         return _find_staged(self._state.model)
 
     def step(self, batch, key=None) -> dict:
+        """One train step.  With telemetry enabled (the default) the
+        step's wall latency, outcome, and throughput land in the process
+        metrics registry, and — when the tracer is recording — the step
+        becomes a ``train.step`` span that parents any PS RPC spans
+        issued inside it.  With telemetry disabled the cost over the
+        bare step is one module-global load and branch."""
+        if not _obs.enabled():
+            return self._step_impl(batch, key)
+        t0 = time.perf_counter()
+        tracer = _obs_tracing.get_tracer()
+        if tracer.recording:
+            with tracer.span("train.step"):
+                metrics = self._step_impl(batch, key)
+        else:
+            metrics = self._step_impl(batch, key)
+        dt = time.perf_counter() - t0
+        m = _step_m()
+        skipped = bool(metrics.get("skipped"))
+        m["steps"].labels(outcome="skipped" if skipped else "ok").inc()
+        m["latency"].observe(dt)
+        if not skipped:
+            n = _batch_examples(batch)
+            if n:
+                m["examples"].inc(n)
+                if dt > 0:
+                    m["eps"].set(n / dt)
+            if "grad_norm" in metrics:
+                # guarded trainers already fetched this to the host in
+                # grad_guard, so the float() here is a cached read, not a
+                # fresh device sync
+                m["grad_norm"].set(float(metrics["grad_norm"]))
+        return metrics
+
+    def _step_impl(self, batch, key=None) -> dict:
         if key is None:
             key = next_key()
         if _fault_hook is not None:

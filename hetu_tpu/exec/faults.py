@@ -47,7 +47,6 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
-import re
 import signal as _signal
 import threading
 import time
@@ -177,7 +176,8 @@ class FaultPlan:
             # deterministic regardless of writer timing; other paths fall
             # back to the plan step.  ``late_ok``: fire on the first write
             # at or after the scheduled step.
-            m = re.search(r"ckpt\.step_(\d+)$", payload or "")
+            from hetu_tpu.exec.checkpoint import _STEP_IN_NAME
+            m = _STEP_IN_NAME.search(payload or "")
             now = int(m.group(1)) if m else None
             fault = self.take("ckpt_truncate", "ckpt_corrupt",
                               late_ok=True, now=now)

@@ -50,6 +50,8 @@ from hetu_tpu.exec import faults as _faults
 from hetu_tpu.exec.checkpoint import (AsyncCheckpointer, CheckpointError,
                                       load_checkpoint, load_state_dict,
                                       save_checkpoint)
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
 
 __all__ = ["ResilientTrainer", "BackendUnresponsive", "Preempted",
            "TrainingDiverged", "list_checkpoints", "latest_good_checkpoint",
@@ -80,6 +82,32 @@ class TrainingDiverged(RuntimeError):
 
 
 _CKPT_RE = re.compile(r"^ckpt\.step_(\d+)$")
+
+# Resilience-event counters (the journal carries the full records; these
+# are the scrapeable aggregates).  Built on first event, never while
+# telemetry is disabled.
+_res_metrics = None
+
+
+def _res_m() -> dict:
+    global _res_metrics
+    if _res_metrics is None:
+        reg = _obs.get_registry()
+        _res_metrics = {
+            "anomalies": reg.counter(
+                "hetu_anomaly_skips_total",
+                "train steps rejected by the NaN/Inf anomaly policy"),
+            "rollbacks": reg.counter(
+                "hetu_rollbacks_total",
+                "checkpoint rollbacks after consecutive anomalies"),
+            "watchdog": reg.counter(
+                "hetu_watchdog_fires_total",
+                "steps abandoned by the per-step watchdog"),
+            "preemptions": reg.counter(
+                "hetu_preemptions_total",
+                "SIGTERM/SIGINT preemptions honored at a step boundary"),
+        }
+    return _res_metrics
 
 
 def checkpoint_path(ckpt_dir: str, step: int) -> str:
@@ -291,6 +319,7 @@ class ResilientTrainer:
         self._load_into_trainer(state)
         self._step = int(extra.get("step", step))
         self._consec = 0
+        _obs_journal.record("resume", step=self._step, path=path)
         return self._step
 
     def _capture(self) -> dict:
@@ -349,6 +378,10 @@ class ResilientTrainer:
                 f"(scanned: {[(s, d) for s, _p, d in report]})")
         self._load_into_trainer(state)
         self.rollbacks.append((self._step, int(extra.get("step", step))))
+        if _obs.enabled():
+            _res_m()["rollbacks"].inc()
+            _obs_journal.record("rollback", at_step=self._step,
+                                to_step=int(extra.get("step", step)))
         self._step = int(extra.get("step", step))
         return self._step
 
@@ -395,6 +428,10 @@ class ResilientTrainer:
                 f"non-finite training signal at step {self._step}: "
                 f"loss={loss}, grad_norm={gnorm}")
         self.anomalies.append((self._step, loss, gnorm))
+        if _obs.enabled():
+            _res_m()["anomalies"].inc()
+            _obs_journal.record("nan_skip", step=self._step, loss=loss,
+                                grad_norm=gnorm)
         return False
 
     def _run_step(self, batch, key):
@@ -428,6 +465,11 @@ class ResilientTrainer:
                 committing = epoch in self._committing
                 if not committing:
                     self._abandoned.add(epoch)
+            if _obs.enabled():
+                _res_m()["watchdog"].inc()
+                _obs_journal.record("watchdog_fired", step=self._step,
+                                    timeout_s=self.step_timeout,
+                                    committing=committing)
             if not committing:
                 last = self._saved[-1] if self._saved else None
                 raise BackendUnresponsive(
@@ -504,4 +546,8 @@ class ResilientTrainer:
         self._ck.wait()  # order after any in-flight periodic save
         save_checkpoint(checkpoint_path(self.ckpt_dir, self._step),
                         self._capture(), extra={"step": self._step})
+        if _obs.enabled():
+            _res_m()["preemptions"].inc()
+            _obs_journal.record("preemption", step=self._step,
+                                signum=signum)
         raise Preempted(self._step, signum)

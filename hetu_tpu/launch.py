@@ -23,6 +23,8 @@ import subprocess
 import sys
 from typing import Optional, Sequence
 
+from hetu_tpu.obs import registry as _obs
+
 __all__ = ["DistConfig", "HostSpec", "initialize", "launch", "simulate_workers",
            "worker_env", "embed_server_addresses", "main"]
 
@@ -222,7 +224,17 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
     is relaunched ONCE with the same command and environment — the
     preemption-restart shape; its returned output is both runs
     concatenated.  Only the restarted worker's deadline is re-armed; the
-    rest of the gang keeps the original one."""
+    rest of the gang keeps the original one.
+
+    With telemetry enabled, a monitor thread publishes per-worker
+    heartbeat ages (``hetu_worker_heartbeat_age_seconds{worker=...}`` —
+    a heartbeat is "the process was observed alive", so a live worker's
+    age hovers near the poll interval and a dead one's grows) and the
+    straggler gauge ``hetu_worker_straggler_seconds`` — how far the
+    still-running tail lags behind the first finisher (the quantity
+    partial reduce exists to bound, SIGMOD'21).  The gauge keeps its
+    last value after the gang drains, so post-run scrapes see the
+    final spread."""
     import socket
     import threading
     import time
@@ -263,6 +275,36 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
             t.daemon = True
             t.start()
             timers.append(t)
+    mon_stop = threading.Event()
+    if _obs.enabled():
+        reg = _obs.get_registry()
+        hb_gauge = reg.gauge(
+            "hetu_worker_heartbeat_age_seconds",
+            "seconds since each simulated worker was last observed alive "
+            "(live workers hover near the poll interval; a grown age is "
+            "a dead or reaped worker)", ("worker",))
+        strag_gauge = reg.gauge(
+            "hetu_worker_straggler_seconds",
+            "lag of the still-running tail behind the gang's first "
+            "finisher (holds its last value once the gang drains)")
+        last_alive = [time.monotonic()] * len(procs)
+
+        def monitor():
+            poll_s = 0.05
+            while not mon_stop.wait(poll_s):
+                now = time.monotonic()
+                exited = []
+                for w in range(len(procs)):
+                    if procs[w].poll() is None:  # sees restart_once swaps
+                        last_alive[w] = now
+                    else:
+                        exited.append(last_alive[w])
+                    hb_gauge.labels(worker=str(w)).set(now - last_alive[w])
+                if exited and len(exited) < len(procs):
+                    strag_gauge.set(now - min(exited))
+
+        threading.Thread(target=monitor, daemon=True,
+                         name="hetu-worker-heartbeats").start()
     outs = [""] * len(procs)
     # one shared deadline; a restarted worker gets a fresh PERSONAL budget
     # (others keep the gang deadline — re-arming it for everyone would
@@ -286,6 +328,7 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                     f"worker {i} failed (rc={p.returncode}):\n{outs[i]}")
             i += 1
     finally:
+        mon_stop.set()
         for t in timers:
             t.cancel()
         # a failed/timed-out peer leaves the others blocked in distributed

@@ -1,0 +1,45 @@
+"""Unified runtime telemetry: metrics registry, tracing spans, event
+journal, and the ``/metrics``+``/healthz`` scrape endpoint.
+
+The reference stack's observability is offline (timer subexecutors,
+per-op re-execution profiling — SURVEY §5.1); the HET architecture it
+headlines (cache-enabled PS, VLDB'22) is operated on *live* cache-hit
+and staleness telemetry.  This package is the always-on layer the
+production seams write to:
+
+- :mod:`~hetu_tpu.obs.registry` — thread-safe process-wide
+  ``MetricsRegistry`` (labeled counters/gauges/histograms, ``snapshot``
+  deltas, Prometheus text exposition, JSONL export);
+- :mod:`~hetu_tpu.obs.tracing` — cross-layer spans (trace/span/parent
+  ids, context propagation, deterministic clock) exporting Chrome
+  trace-event JSON mergeable with XProf traces;
+- :mod:`~hetu_tpu.obs.journal` — append-only JSONL resilience event
+  journal with monotonic sequence numbers;
+- :mod:`~hetu_tpu.obs.server` — stdlib-HTTP ``/metrics`` / ``/healthz``
+  endpoint (the ``exec/graphboard.py`` server pattern).
+
+Instrumented seams: ``embed.net.RemoteEmbeddingTable._rpc`` (latency,
+bytes, redials, errors), the HET caches (hit/miss), ``Trainer.step``
+(latency, examples/s, grad-norm), ``exec.checkpoint`` (write duration/
+bytes/CRC + journal), ``exec.resilience`` (journal events), and
+``launch.simulate_workers`` (heartbeat-age straggler gauges).  All of it
+is disabled in one switch — ``obs.disable()`` or ``HETU_OBS=0`` — and
+the disabled path is a single global load + branch per seam.
+"""
+
+from hetu_tpu.obs.journal import (EventJournal, get_journal, record,
+                                  set_journal, use)
+from hetu_tpu.obs.registry import (DEFAULT_BUCKETS, Counter, Gauge,
+                                   Histogram, MetricsRegistry, disable,
+                                   enable, enabled, get_registry)
+from hetu_tpu.obs.server import TelemetryServer, serve
+from hetu_tpu.obs.tracing import (Span, Tracer, current_span, get_tracer,
+                                  span)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "get_registry", "enabled", "enable", "disable",
+    "Tracer", "Span", "get_tracer", "span", "current_span",
+    "EventJournal", "get_journal", "set_journal", "use", "record",
+    "TelemetryServer", "serve",
+]

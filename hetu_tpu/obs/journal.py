@@ -1,0 +1,150 @@
+"""Append-only resilience event journal (JSONL, monotonic sequence).
+
+The resilience layer (PR 1) makes survival *decisions* — skip a NaN
+step, roll back, redial a PS, save under SIGTERM — but until now they
+only existed as in-memory lists on one ``ResilientTrainer``.  The
+journal is the durable, ordered record: one JSON object per line with a
+monotonic ``seq`` (gaps reveal lost writes) and a wall-clock ``ts``,
+written with an optional fsync so the tail survives the very crash it
+is documenting.  Event kinds emitted by the instrumented seams:
+
+==================  =====================================================
+kind                fields (beyond ``seq``/``ts``)
+==================  =====================================================
+``checkpoint_saved``  ``path``, ``step``, ``bytes``, ``crc32``,
+                      ``duration_s``
+``rollback``          ``at_step``, ``to_step``
+``nan_skip``          ``step``, ``loss``, ``grad_norm``
+``watchdog_fired``    ``step``, ``timeout_s``, ``committing``
+``preemption``        ``step``, ``signum``
+``ps_redial``         ``address``, ``table_id``, ``attempt``,
+                      ``table_created``
+``resume``            ``step``, ``path``
+==================  =====================================================
+
+A journal is installed process-wide with :func:`set_journal` (or the
+:func:`use` context manager); the seams emit through :func:`record`,
+which is a no-op when no journal is installed or telemetry is disabled.
+``seq`` is assigned under a lock, so events from the async checkpoint
+writer thread interleave with driver events in a total order.  The
+clock is injectable for deterministic tests.  Correlate with a chaos
+run by matching the journal's ``step`` fields against the installed
+``FaultPlan``'s schedule (see README "Observability").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from hetu_tpu.obs import registry as _registry
+
+__all__ = ["EventJournal", "get_journal", "set_journal", "use", "record"]
+
+
+class EventJournal:
+    """Append-only JSONL event log.
+
+    ``path=None`` keeps events in memory only (tests, probes); with a
+    path every record is appended and flushed, and ``fsync=True`` makes
+    each one durable before ``record`` returns (the preemption-path
+    setting: the final events must survive the kill).
+    """
+
+    def __init__(self, path: Optional[str] = None, *, fsync: bool = False,
+                 clock: Optional[Callable[[], float]] = None):
+        self.path = path
+        self.fsync = fsync
+        self.clock = clock if clock is not None else time.time
+        self.events: list = []  # in-memory mirror, append order == seq order
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._f = open(path, "a") if path else None
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one event; returns the full record (with ``seq``/``ts``).
+        Thread-safe; seq numbers are gapless and strictly increasing."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": self.clock(), "kind": kind,
+                   **fields}
+            self.events.append(rec)
+            if self._f is not None:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+                if self.fsync:
+                    os.fsync(self._f.fileno())
+        return rec
+
+    def of_kind(self, *kinds: str) -> list:
+        return [e for e in self.events if e["kind"] in kinds]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @staticmethod
+    def read(path: str) -> list:
+        """Load a journal file back into a list of event dicts, verifying
+        the sequence is gapless (raises ``ValueError`` naming the first
+        gap — a gap means a write was lost)."""
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        for i, rec in enumerate(out, 1):
+            if rec.get("seq") != i:
+                raise ValueError(
+                    f"journal {path}: sequence gap at line {i} "
+                    f"(expected seq {i}, found {rec.get('seq')}) — a "
+                    f"write was lost or the file was truncated/merged")
+        return out
+
+
+_active: Optional[EventJournal] = None
+
+
+def get_journal() -> Optional[EventJournal]:
+    return _active
+
+
+def set_journal(journal: Optional[EventJournal]) -> None:
+    """Install ``journal`` as the process-wide sink for :func:`record`
+    (None uninstalls)."""
+    global _active
+    _active = journal
+
+
+@contextlib.contextmanager
+def use(journal: EventJournal):
+    """Install for the block, restore the previous journal on exit."""
+    global _active
+    prev = _active
+    _active = journal
+    try:
+        yield journal
+    finally:
+        _active = prev
+
+
+def record(kind: str, **fields) -> Optional[dict]:
+    """Emit to the installed journal; no-op (one global load + branch)
+    when none is installed or telemetry is disabled."""
+    j = _active
+    if j is None or not _registry.enabled():
+        return None
+    return j.record(kind, **fields)

@@ -1,0 +1,113 @@
+"""Scrape endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
+
+The stdlib-HTTP pattern of ``exec/graphboard.py`` (BaseHTTPRequestHandler,
+zero dependencies, ``port=0`` for ephemeral) applied to telemetry:
+
+- ``/metrics``       Prometheus text exposition 0.0.4 of the registry
+- ``/metrics.json``  the same samples as a JSON snapshot
+- ``/healthz``       liveness JSON: status, pid, uptime, last journal seq
+- ``/journal``       tail of the installed event journal (``?n=100``)
+
+``serve()`` returns a started :class:`TelemetryServer` whose daemon
+thread renders each scrape on demand — a training loop needs no extra
+calls for its counters to be visible live.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _registry
+
+__all__ = ["TelemetryServer", "serve"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryServer:
+    """HTTP scrape server over a registry (default: the process-wide one)
+    and the installed journal.  ``port=0`` binds an ephemeral port (read
+    it back from ``.port``)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[_registry.MetricsRegistry] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry if registry is not None else _registry.get_registry()
+        t0 = time.time()
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, payload: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    self._send(reg.render_prometheus().encode(),
+                               PROM_CONTENT_TYPE)
+                elif url.path == "/metrics.json":
+                    self._send(json.dumps(reg.snapshot()).encode(),
+                               "application/json")
+                elif url.path == "/healthz":
+                    j = _journal.get_journal()
+                    body = {"status": "ok",
+                            "uptime_s": round(time.time() - t0, 3),
+                            "telemetry_enabled": _registry.enabled(),
+                            "journal_seq": j._seq if j is not None else None}
+                    self._send(json.dumps(body).encode(), "application/json")
+                elif url.path == "/journal":
+                    j = _journal.get_journal()
+                    n = int(parse_qs(url.query).get("n", ["100"])[0])
+                    events = j.events[-n:] if j is not None else []
+                    self._send(json.dumps(events).encode(),
+                               "application/json")
+                else:
+                    self._send(b"not found", "text/plain", 404)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "TelemetryServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="hetu-obs-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(5)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve(port: int = 0, host: str = "127.0.0.1",
+          registry: Optional[_registry.MetricsRegistry] = None
+          ) -> TelemetryServer:
+    """Start a telemetry scrape server on a daemon thread and return it
+    (``.port`` has the bound port, ``.stop()`` shuts it down)."""
+    return TelemetryServer(port, host, registry).start()

@@ -1,0 +1,223 @@
+"""Cross-layer structured tracing spans.
+
+The reference's timer subexecutor attributes wall time to graph nodes
+inside one executor; what it cannot do is follow one *training step*
+across runtime layers — driver → ``Trainer.step`` → PS RPCs → checkpoint
+writes.  These spans do: each carries ``trace_id``/``span_id``/
+``parent_id``, parentage propagates through a ``contextvars`` context
+variable (a PS RPC issued inside a step span becomes its child; worker
+threads that should inherit parentage run under
+``contextvars.copy_context()``), and the
+collected spans export as Chrome trace-event JSON that merges into the
+XProf traces ``exec/profiler.trace()`` already captures — one timeline
+with device ops and host-side runtime seams side by side.
+
+Recording is opt-in (``tracer.start()`` / ``with tracer.collect():``);
+when off — the production default — ``span()`` is a single flag check.
+The clock and the id sequence are injectable/deterministic so tests can
+assert exact span trees and timings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import gzip
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from hetu_tpu.obs import registry as _registry
+
+__all__ = ["Span", "Tracer", "get_tracer", "span", "current_span"]
+
+# Chrome trace-event pid reserved for runtime spans: far away from XProf's
+# device/host pids so a merged trace shows them as their own process row.
+SPAN_PID = 88888
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "hetu_obs_span", default=None)
+
+
+class Span:
+    """One timed operation.  ``end()`` is idempotent; attributes set
+    after creation ride along into the Chrome ``args``."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end_time", "attrs", "_tracer", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: str, parent_id: Optional[str], start: float,
+                 attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end_time: Optional[float] = None
+        self.attrs = attrs
+        self._tracer = tracer
+        self._token = None
+
+    @property
+    def duration(self) -> Optional[float]:
+        if self.end_time is None:
+            return None
+        return self.end_time - self.start
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def end(self) -> None:
+        if self.end_time is None:
+            self.end_time = self._tracer.clock()
+            self._tracer._record(self)
+
+
+class Tracer:
+    """Span collector with deterministic ids and an injectable clock.
+
+    ``clock`` returns seconds (monotonic by convention); ids are drawn
+    from a plain counter, so two identical runs produce identical span
+    trees — the property the chaos suite asserts.  Thread-safe: spans
+    started on worker threads (the shard router's parallel pulls) land in
+    the same buffer, parented by whatever span was current when the
+    thread's context was copied.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self.clock = clock if clock is not None else time.perf_counter
+        self.recording = False
+        self._spans: list = []
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.recording = True
+
+    def stop(self) -> None:
+        self.recording = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans = []
+        self._ids = itertools.count(1)
+
+    @contextlib.contextmanager
+    def collect(self):
+        """Record spans for the block; yields the tracer."""
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    # -- span API -----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Open a child span of the context-current span.  When the tracer
+        is not recording (or telemetry is disabled) this is a no-op that
+        yields None — the production fast path."""
+        if not (self.recording and _registry.enabled()):
+            yield None
+            return
+        parent = _current.get()
+        sid = f"{next(self._ids):08x}"
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = f"t{sid}", None
+        sp = Span(self, name, trace_id, sid, parent_id, self.clock(), attrs)
+        token = _current.set(sp)
+        try:
+            yield sp
+        finally:
+            _current.reset(token)
+            sp.end()
+
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            self._spans.append(sp)
+
+    @property
+    def spans(self) -> list:
+        """Finished spans in end order."""
+        with self._lock:
+            return list(self._spans)
+
+    # -- export -------------------------------------------------------------
+
+    def to_chrome_events(self) -> list:
+        """Complete (``ph: X``) trace events plus a process_name metadata
+        event, timestamps in microseconds — the traceEvents schema XProf
+        emits, so the two merge by list concatenation."""
+        events = [{"ph": "M", "name": "process_name", "pid": SPAN_PID,
+                   "args": {"name": "hetu-tpu runtime spans"}}]
+        for sp in self.spans:
+            events.append({
+                "ph": "X", "name": sp.name, "pid": SPAN_PID,
+                "tid": 1 if sp.parent_id is None else 2,
+                "ts": sp.start * 1e6,
+                "dur": (sp.duration or 0.0) * 1e6,
+                "args": {"trace_id": sp.trace_id, "span_id": sp.span_id,
+                         "parent_id": sp.parent_id,
+                         **{k: str(v) for k, v in sp.attrs.items()}},
+            })
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Write ``{"traceEvents": [...]}`` (gzipped when the path ends in
+        ``.gz``); loadable by chrome://tracing / Perfetto."""
+        payload = json.dumps({"traceEvents": self.to_chrome_events()})
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                f.write(payload)
+        else:
+            with open(path, "w") as f:
+                f.write(payload)
+        return path
+
+    def merge_with_xprof(self, logdir: str, out_path: str) -> str:
+        """Merge these spans into the newest ``*.trace.json.gz`` under
+        ``logdir`` (as captured by ``exec.profiler.trace``) and write the
+        combined Chrome trace to ``out_path`` — device ops and runtime
+        spans on one timeline."""
+        import glob
+        paths = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                          recursive=True)
+        if not paths:
+            raise FileNotFoundError(f"no trace under {logdir}")
+        with gzip.open(sorted(paths)[-1], "rt") as f:
+            base = json.load(f)
+        base.setdefault("traceEvents", []).extend(self.to_chrome_events())
+        payload = json.dumps(base)
+        if out_path.endswith(".gz"):
+            with gzip.open(out_path, "wt") as f:
+                f.write(payload)
+        else:
+            with open(out_path, "w") as f:
+                f.write(payload)
+        return out_path
+
+
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def span(name: str, **attrs):
+    """Module-level shorthand: a span on the default tracer."""
+    return _default.span(name, **attrs)
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()

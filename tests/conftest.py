@@ -54,6 +54,9 @@ def pytest_configure(config):
         "markers", "chaos: deterministic fault-injection / resilience tests "
                    "(exec.faults + exec.resilience); the ones that kill OS "
                    "processes are additionally marked slow")
+    config.addinivalue_line(
+        "markers", "obs: runtime telemetry tests (hetu_tpu.obs registry/"
+                   "tracing/journal/endpoint and the instrumented seams)")
 
 
 @pytest.fixture

@@ -117,6 +117,74 @@ def test_device_op_breakdown_parses_trace(tmp_path):
         assert v >= 0.0
 
 
+def test_device_op_breakdown_synthetic_fixture(tmp_path):
+    """Satellite: exercise the trace parser against a hand-built
+    ``*.trace.json.gz`` with known contents — device-pid filtering via
+    process_name metadata, ``deduplicated_name`` aggregation across
+    repeated fusions, host-frame/program-envelope rejection, the
+    per-``steps`` division, and the ``copy_s`` relayout total."""
+    import gzip
+    import json
+    import os
+
+    from hetu_tpu.exec.profiler import device_op_breakdown
+
+    us = 1_000_000  # trace durations are microseconds
+    events = [
+        # pid 1 is a device timeline, pid 2 is the host python timeline
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python"}},
+        # the same fusion repeated across layers aggregates by
+        # deduplicated_name
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 2 * us,
+         "name": "fusion.1", "args": {"deduplicated_name": "fusion.1"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1 * us,
+         "name": "fusion.42", "args": {"deduplicated_name": "fusion.1"}},
+        # relayout copies: counted into copy_s
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": us // 2,
+         "name": "copy.3", "args": {"deduplicated_name": "copy.3"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": us // 4,
+         "name": "copy_fusion.2"},  # no dedup name: falls back to name
+        # filtered: wrong (host) pid
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 0, "dur": 9 * us,
+         "name": "hostwork"},
+        # filtered on the device pid: program envelope, bare step number,
+        # counter-style $ name, python-frame parens (incl. transpose_jvp
+        # SCOPE names, which are not data transposes)
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9 * us,
+         "name": "jit_train_step"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9 * us,
+         "name": "1234"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9 * us,
+         "name": "$async"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9 * us,
+         "name": "transpose_jvp(foo)/mul"},
+        # filtered: not complete events / no duration
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "name": "fusion.1"},
+        {"ph": "C", "pid": 1, "ts": 0, "dur": 1, "name": "mem"},
+    ]
+    d = os.path.join(str(tmp_path), "plugins", "profile", "run1")
+    os.makedirs(d)
+    with gzip.open(os.path.join(d, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    per, totals = device_op_breakdown(str(tmp_path), steps=2)
+    assert set(per) == {"fusion.1", "copy.3", "copy_fusion.2"}
+    assert per["fusion.1"] == pytest.approx((2.0 + 1.0) / 2)
+    assert per["copy.3"] == pytest.approx(0.5 / 2)
+    assert per["copy_fusion.2"] == pytest.approx(0.25 / 2)
+    assert totals["copy_s"] == pytest.approx((0.5 + 0.25) / 2)
+    assert totals["device_s"] == pytest.approx((2 + 1 + 0.5 + 0.25) / 2)
+    # ranking + top-N truncation
+    per_top, _ = device_op_breakdown(str(tmp_path), steps=2, top=1)
+    assert list(per_top) == ["fusion.1"]
+    # no trace -> a clear error, not an empty report
+    with pytest.raises(FileNotFoundError, match="no trace"):
+        device_op_breakdown(str(tmp_path / "empty"))
+
+
 def test_audit_donation_reports_aliasing():
     """SURVEY §5.2's prescribed donation/aliasing audit: the train state's
     buffers must actually be aliased input->output by the compiled step
