@@ -16,6 +16,9 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
   reference src/hetu_cache + ps-lite)
 * ``hetu_tpu.obs``    — runtime telemetry: metrics registry, tracing
   spans, resilience event journal, /metrics endpoint
+* ``hetu_tpu.serve``  — online inference: paged KV cache, continuous
+  batching engine, /infer endpoint (imported lazily — serving pulls in
+  models)
 * ``hetu_tpu.models`` — model zoo (reference examples/)
 * ``hetu_tpu.data``   — dataloaders (reference dataloader.py)
 * ``hetu_tpu.autoparallel`` — cost-model-driven parallelism search

@@ -230,13 +230,20 @@ class CacheTable:
 
     def __init__(self, table: HostEmbeddingTable, capacity: int, *,
                  policy: str = "lru", pull_bound: int = 0,
-                 push_bound: int = 0, name: str | None = None):
+                 push_bound: int = 0, name: str | None = None,
+                 read_only: bool = False):
         self._lib = _load()
         self.table = table
         self.dim = table.dim
         # telemetry label (see publish_cache_stats); pass an explicit name
         # when you need run-to-run stable labels across rebuilds
         self.name = name if name is not None else f"cache{next(_cache_names)}"
+        # Serving mode: pushes raise instead of training the table.  The C
+        # engine sizes optimizer slots lazily on the first gradient apply
+        # (embed_engine.cpp ensure_slots), so a read-only cache also never
+        # allocates optimizer state — an inference worker pays for rows
+        # only, not rows + momentum/adam moments.
+        self.read_only = bool(read_only)
         self._h = self._lib.het_cache_create(
             table._h, capacity, POLICIES[policy], pull_bound, push_bound)
 
@@ -256,11 +263,19 @@ class CacheTable:
         return out
 
     def push(self, keys, grads):
+        if self.read_only:
+            raise RuntimeError(
+                f"cache {self.name!r} is read-only (serving mode): "
+                f"gradient pushes are disabled so inference cannot "
+                f"silently train the table")
         keys, kp = _i64(keys)
         grads, gp = _f32(grads)
         self._lib.het_cache_push(self._h, kp, len(keys), gp)
 
     def flush(self):
+        # deliberately NOT gated on read_only: pushes buffered BEFORE the
+        # flag was flipped (push_bound accumulation during training) must
+        # stay drainable, and flushing an empty buffer is a no-op
         self._lib.het_cache_flush(self._h)
 
     def stats(self) -> dict:
@@ -298,6 +313,12 @@ class AsyncEngine:
         return t, out
 
     def push_async(self, cache: CacheTable, keys, grads):
+        if cache.read_only:
+            # same invariant as the synchronous push(): a frozen serving
+            # cache must not be trainable through ANY entry point
+            raise RuntimeError(
+                f"cache {cache.name!r} is read-only (serving mode): "
+                f"async gradient pushes are disabled")
         keys, kp = _i64(keys)
         grads, gp = _f32(grads)
         t = self._lib.het_cache_push_async(self._h, cache._h, kp, len(keys),

@@ -9,7 +9,12 @@ from hetu_tpu.layers.norm import (
     LayerNorm,
     RMSNorm,
 )
-from hetu_tpu.layers.attention import MultiHeadAttention, dot_product_attention
+from hetu_tpu.layers.attention import (
+    MultiHeadAttention,
+    decode_attention,
+    dot_product_attention,
+    ragged_cache_update,
+)
 from hetu_tpu.layers.transformer import TransformerBlock, TransformerMLP
 from hetu_tpu.layers.moe import (
     BalanceGate,

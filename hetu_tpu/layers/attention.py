@@ -22,7 +22,8 @@ from hetu_tpu.init import xavier_uniform, zeros
 from hetu_tpu.ops import dropout as dropout_op
 
 __all__ = ["MultiHeadAttention", "dot_product_attention",
-           "dot_product_attention_bhsd"]
+           "dot_product_attention_bhsd", "decode_attention",
+           "ragged_cache_update"]
 
 
 def _dpa_core(q, k, v, mask, scale, causal, qk_spec: str, pv_spec: str):
@@ -68,6 +69,45 @@ def dot_product_attention_bhsd(q, k, v, mask=None, *,
 dot_product_attention_bhsd.bhsd = True
 
 
+def ragged_cache_update(cache, new, index):
+    """Write ``new`` (batch, s, heads, head_dim) into ``cache`` (batch,
+    max_len, heads, head_dim) at per-row offsets ``index`` (batch,) —
+    the ragged KV-cache append of a continuous-batching decode step,
+    where every sequence in the batch sits at a different length.
+    Functional (returns the updated cache); offsets must satisfy
+    ``index + s <= max_len`` (dynamic_update_slice clamps, which would
+    silently shift the write)."""
+    return jax.vmap(
+        lambda c, n, i: jax.lax.dynamic_update_slice(
+            c, n.astype(c.dtype), (i, 0, 0)))(cache, new, index)
+
+
+def decode_attention(q, k_cache, v_cache, cache_index, *,
+                     scale: float | None = None, mask=None):
+    """Causal attention of ``s`` new query positions against a padded KV
+    cache holding each sequence's full history at a per-row offset.
+
+    q: (batch, s, heads, head_dim) — queries for the s NEW tokens, whose
+    global positions are ``cache_index[b] + i`` (i in [0, s)).
+    k_cache/v_cache: (batch, max_len, heads, head_dim) with rows
+    [0, cache_index[b] + s) valid (the new tokens already appended via
+    :func:`ragged_cache_update`); everything at or beyond is masked out,
+    so padded garbage never contributes.  This is the incremental-decode
+    core: with ``cache_index = 0`` and ``s = seq_len`` it is exactly
+    ``dot_product_attention(..., causal=True)`` restricted to the valid
+    prefix — the prefill-vs-incremental parity guarantee the serving
+    tests assert."""
+    s = q.shape[1]
+    max_len = k_cache.shape[1]
+    jpos = jnp.arange(max_len)[None, None, :]                  # (1, 1, L)
+    ipos = cache_index[:, None, None] + jnp.arange(s)[None, :, None]
+    valid = (jpos <= ipos)[:, None, :, :]                      # (b, 1, s, L)
+    if mask is not None:
+        valid = valid & mask.astype(bool)
+    return dot_product_attention(q, k_cache, v_cache, valid, scale=scale,
+                                 causal=False)
+
+
 class MultiHeadAttention(Module):
     """MHA with fused qkv projection (reference layers/attention.py:5)."""
 
@@ -89,7 +129,10 @@ class MultiHeadAttention(Module):
         self.dropout_rate = dropout_rate
         self.attn_fn = attn_fn  # static; None -> dot_product_attention
 
-    def __call__(self, x, mask=None, *, key=None, training: bool = False):
+    def __call__(self, x, mask=None, *, key=None, training: bool = False,
+                 kv_cache=None, cache_index=None):
+        if kv_cache is not None:
+            return self._call_cached(x, mask, kv_cache, cache_index)
         if getattr(self.attn_fn, "bhsd", False):
             return self._call_bhsd(x, mask, key=key, training=training)
         b, s, d = x.shape
@@ -109,6 +152,31 @@ class MultiHeadAttention(Module):
         if self.bo is not None:
             y = y + self.bo.astype(x.dtype)
         return y
+
+    def _call_cached(self, x, mask, kv_cache, cache_index):
+        """Incremental-decode path: project the s new tokens, append their
+        K/V into the per-sequence cache at ragged offsets, and attend each
+        query over the full valid prefix.  Returns ``(y, (k_cache,
+        v_cache))`` with the caches updated — the serving engine threads
+        them back into its page pool.  Inference-only (no dropout); the
+        (B, S, H, D) reference core is used regardless of ``attn_fn``
+        because flash/ring tilings assume untruncated causal layouts."""
+        b, s, d = x.shape
+        qkv = x @ self.wqkv.astype(x.dtype)
+        if self.bqkv is not None:
+            qkv = qkv + self.bqkv.astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        k_cache, v_cache = kv_cache
+        k_cache = ragged_cache_update(k_cache, k, cache_index)
+        v_cache = ragged_cache_update(v_cache, v, cache_index)
+        out = decode_attention(q, k_cache, v_cache, cache_index, mask=mask)
+        y = out.reshape(b, s, d) @ self.wo.astype(x.dtype)
+        if self.bo is not None:
+            y = y + self.bo.astype(x.dtype)
+        return y, (k_cache, v_cache)
 
     def _call_bhsd(self, x, mask=None, *, key=None, training: bool = False):
         """Native-kernel-layout path: q/k/v are PROJECTED into (B, H, S, D)

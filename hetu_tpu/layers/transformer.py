@@ -93,7 +93,10 @@ class TransformerBlock(Module):
                else self.mlp(x))
         return out if isinstance(out, tuple) else (out, None)
 
-    def __call__(self, x, mask=None, *, key=None, training: bool = False):
+    def __call__(self, x, mask=None, *, key=None, training: bool = False,
+                 kv_cache=None, cache_index=None):
+        if kv_cache is not None:
+            return self._call_cached(x, mask, kv_cache, cache_index)
         ka = k1 = k2 = None
         if key is not None:
             ka, k1, k2 = jax.random.split(key, 3)
@@ -119,6 +122,29 @@ class TransformerBlock(Module):
             y, aux = self._ffn(self.ln2(x), training)
             x = x + self._drop(y, k2, training)
         return x if aux is None else (x, aux)
+
+    def _call_cached(self, x, mask, kv_cache, cache_index):
+        """Incremental-decode step: same residual wiring as the training
+        paths, attention routed through the KV cache (inference-only — no
+        dropout, no fused post-LN kernel, no MoE aux loss).  Returns
+        ``(x, (k_cache, v_cache))`` with this block's caches updated."""
+        if self.post_ln:
+            a, kv = self.attn(x, mask, kv_cache=kv_cache,
+                              cache_index=cache_index)
+            x = self.ln1(x + a)
+            y, aux = self._ffn(x, training=False)
+            x = self.ln2(x + y)
+        else:
+            a, kv = self.attn(self.ln1(x), mask, kv_cache=kv_cache,
+                              cache_index=cache_index)
+            x = x + a
+            y, aux = self._ffn(self.ln2(x), training=False)
+            x = x + y
+        if aux is not None:
+            raise NotImplementedError(
+                "aux-returning FFNs (MoE) have no incremental-decode path "
+                "yet — serve dense blocks or drop the kv_cache")
+        return x, kv
 
     def _drop(self, x, key, training):
         if training and self.dropout_rate > 0.0 and key is not None:

@@ -81,15 +81,53 @@ class GPT(Module):
         return self.wte.weight.T if self.lm_head is None else self.lm_head
 
     def __call__(self, input_ids, *, key=None, training: bool = False,
-                 compute_dtype=None):
-        x = self.hidden_states(input_ids, key=key, training=training,
-                               compute_dtype=compute_dtype)
-        return x @ self._head().astype(x.dtype)
+                 compute_dtype=None, kv_cache=None, cache_index=None,
+                 seq_lengths=None):
+        """Logits.  Training/eval (``kv_cache=None``): full (batch, seq,
+        vocab) logits, as before.
+
+        Incremental decode (``kv_cache`` = per-block list of ``(k_cache,
+        v_cache)`` pairs, ``cache_index`` = per-sequence history lengths):
+        ``input_ids`` (batch, s) are s NEW tokens appended at each row's
+        offset — s = the padded prompt bucket on prefill, 1 per decode
+        step after.  Returns ``(last_logits, new_kv_cache)`` where
+        ``last_logits`` (batch, vocab) is the next-token distribution at
+        each row's LAST VALID new position (``seq_lengths``, default s —
+        pass true prompt lengths when the prefill batch is right-padded
+        to a bucket), so the (s, vocab) logits matrix is never
+        materialized during serving."""
+        if kv_cache is None:
+            x = self.hidden_states(input_ids, key=key, training=training,
+                                   compute_dtype=compute_dtype)
+            return x @ self._head().astype(x.dtype)
+        x, new_kv = self.hidden_states(
+            input_ids, training=False, compute_dtype=compute_dtype,
+            kv_cache=kv_cache, cache_index=cache_index)
+        if seq_lengths is None:
+            last = x[:, -1]
+        else:
+            last = jnp.take_along_axis(
+                x, (seq_lengths - 1)[:, None, None], axis=1)[:, 0]
+        return last @ self._head().astype(last.dtype), new_kv
 
     def hidden_states(self, input_ids, *, key=None, training: bool = False,
-                      compute_dtype=None):
-        """Final-layer-norm hidden states (no LM-head projection)."""
+                      compute_dtype=None, kv_cache=None, cache_index=None):
+        """Final-layer-norm hidden states (no LM-head projection).  With
+        ``kv_cache``/``cache_index``, runs the incremental-decode path and
+        returns ``(hidden, new_kv_cache)``; positions are each row's
+        ``cache_index + arange(s)`` so ragged batches place the new
+        tokens' position embeddings correctly."""
         s = input_ids.shape[-1]
+        if kv_cache is not None:
+            positions = cache_index[:, None] + jnp.arange(s)[None, :]
+            x = self.wte(input_ids) + self.wpe(positions)
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+            new_kv = []
+            for blk, kv in zip(self.blocks, kv_cache):
+                x, kv = blk(x, kv_cache=kv, cache_index=cache_index)
+                new_kv.append(kv)
+            return self.ln_f(x), new_kv
         x = self.wte(input_ids) + self.wpe(jnp.arange(s))
         if compute_dtype is not None:
             x = x.astype(compute_dtype)

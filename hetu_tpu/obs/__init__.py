@@ -32,7 +32,8 @@ from hetu_tpu.obs.journal import (EventJournal, get_journal, record,
 from hetu_tpu.obs.registry import (DEFAULT_BUCKETS, Counter, Gauge,
                                    Histogram, MetricsRegistry, disable,
                                    enable, enabled, get_registry)
-from hetu_tpu.obs.server import TelemetryServer, serve
+from hetu_tpu.obs.server import (Routes, RoutedHTTPServer, TelemetryServer,
+                                 serve, telemetry_routes)
 from hetu_tpu.obs.tracing import (Span, Tracer, current_span, get_tracer,
                                   span)
 
@@ -41,5 +42,6 @@ __all__ = [
     "get_registry", "enabled", "enable", "disable",
     "Tracer", "Span", "get_tracer", "span", "current_span",
     "EventJournal", "get_journal", "set_journal", "use", "record",
-    "TelemetryServer", "serve",
+    "TelemetryServer", "serve", "Routes", "RoutedHTTPServer",
+    "telemetry_routes",
 ]

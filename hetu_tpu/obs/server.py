@@ -1,12 +1,22 @@
 """Scrape endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
 
 The stdlib-HTTP pattern of ``exec/graphboard.py`` (BaseHTTPRequestHandler,
-zero dependencies, ``port=0`` for ephemeral) applied to telemetry:
+zero dependencies, ``port=0`` for ephemeral) applied to telemetry — and,
+since the serving subsystem arrived, factored into a reusable route table
+so other endpoints (``hetu_tpu/serve/server.py``'s ``/infer``/``/stats``)
+register handlers instead of copy-pasting the HTTP plumbing:
 
-- ``/metrics``       Prometheus text exposition 0.0.4 of the registry
-- ``/metrics.json``  the same samples as a JSON snapshot
-- ``/healthz``       liveness JSON: status, pid, uptime, last journal seq
-- ``/journal``       tail of the installed event journal (``?n=100``)
+- :class:`Routes` — ``(method, path) -> handler`` table; a handler takes
+  ``(query, body)`` and returns ``payload`` bytes/str, ``(payload,
+  content_type)``, or ``(payload, content_type, status)``.
+- :class:`RoutedHTTPServer` — threaded stdlib HTTP server dispatching
+  GET/POST through a :class:`Routes`; ``port=0`` binds ephemeral.
+- :func:`telemetry_routes` — the standard telemetry surface:
+
+  - ``/metrics``       Prometheus text exposition 0.0.4 of the registry
+  - ``/metrics.json``  the same samples as a JSON snapshot
+  - ``/healthz``       liveness JSON: status, uptime, last journal seq
+  - ``/journal``       tail of the installed event journal (``?n=100``)
 
 ``serve()`` returns a started :class:`TelemetryServer` whose daemon
 thread renders each scrape on demand — a training loop needs no extra
@@ -18,28 +28,80 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Optional
+import traceback
+from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.obs import registry as _registry
 
-__all__ = ["TelemetryServer", "serve"]
+__all__ = ["Routes", "RoutedHTTPServer", "TelemetryServer",
+           "telemetry_routes", "serve"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-class TelemetryServer:
-    """HTTP scrape server over a registry (default: the process-wide one)
-    and the installed journal.  ``port=0`` binds an ephemeral port (read
-    it back from ``.port``)."""
+class Routes:
+    """``(method, path) -> handler`` dispatch table.
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[_registry.MetricsRegistry] = None):
+    A handler is ``fn(query: dict[str, list[str]], body: bytes)`` and may
+    return ``bytes``/``str`` (served as a 200 ``application/json``
+    payload, the common case), or ``(payload, content_type)``, or
+    ``(payload, content_type, status)``.  Raising maps to a 500 with the
+    exception's message in a JSON error body — endpoint bugs surface in
+    the scrape, not as a silently dropped connection.
+    """
+
+    def __init__(self):
+        self._routes: dict = {}
+
+    def add(self, method: str, path: str, handler: Callable) -> "Routes":
+        """Register (and return self, so registrations chain)."""
+        self._routes[(method.upper(), path)] = handler
+        return self
+
+    def paths(self) -> list:
+        return sorted({p for _, p in self._routes})
+
+    def dispatch(self, method: str, path: str, query: dict,
+                 body: bytes) -> tuple:
+        """Resolve + invoke; always returns ``(payload_bytes, content_type,
+        status)``."""
+        handler = self._routes.get((method.upper(), path))
+        if handler is None:
+            if any(p == path for _, p in self._routes):
+                return (json.dumps({"error": "method not allowed"}).encode(),
+                        "application/json", 405)
+            return b"not found", "text/plain", 404
+        try:
+            out = handler(query, body)
+        except Exception as e:  # surface handler bugs to the client
+            line = traceback.format_exception_only(type(e), e)[-1].strip()
+            return (json.dumps({"error": line}).encode(),
+                    "application/json", 500)
+        ctype, status = "application/json", 200
+        if isinstance(out, tuple):
+            if len(out) == 3:
+                out, ctype, status = out
+            else:
+                out, ctype = out
+        if isinstance(out, str):
+            out = out.encode()
+        return out, ctype, status
+
+
+class RoutedHTTPServer:
+    """Threaded stdlib HTTP server over a :class:`Routes` table — the
+    shared plumbing under the telemetry and serving endpoints.  ``port=0``
+    binds an ephemeral port (read it back from ``.port``)."""
+
+    def __init__(self, routes: Routes, port: int = 0,
+                 host: str = "127.0.0.1", thread_name: str = "hetu-http"):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        reg = registry if registry is not None else _registry.get_registry()
-        t0 = time.time()
+        table = routes
+        self.routes = routes
+        self._thread_name = thread_name
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, payload: bytes, ctype: str, code: int = 200):
@@ -49,29 +111,17 @@ class TelemetryServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
-            def do_GET(self):  # noqa: N802
+            def _dispatch(self, method: str, body: bytes):
                 url = urlparse(self.path)
-                if url.path == "/metrics":
-                    self._send(reg.render_prometheus().encode(),
-                               PROM_CONTENT_TYPE)
-                elif url.path == "/metrics.json":
-                    self._send(json.dumps(reg.snapshot()).encode(),
-                               "application/json")
-                elif url.path == "/healthz":
-                    j = _journal.get_journal()
-                    body = {"status": "ok",
-                            "uptime_s": round(time.time() - t0, 3),
-                            "telemetry_enabled": _registry.enabled(),
-                            "journal_seq": j._seq if j is not None else None}
-                    self._send(json.dumps(body).encode(), "application/json")
-                elif url.path == "/journal":
-                    j = _journal.get_journal()
-                    n = int(parse_qs(url.query).get("n", ["100"])[0])
-                    events = j.events[-n:] if j is not None else []
-                    self._send(json.dumps(events).encode(),
-                               "application/json")
-                else:
-                    self._send(b"not found", "text/plain", 404)
+                self._send(*table.dispatch(
+                    method, url.path, parse_qs(url.query), body))
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET", b"")
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                self._dispatch("POST", self.rfile.read(n) if n else b"")
 
             def log_message(self, *a):
                 pass
@@ -85,9 +135,9 @@ class TelemetryServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def start(self) -> "TelemetryServer":
+    def start(self):
         self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="hetu-obs-http")
+                                        daemon=True, name=self._thread_name)
         self._thread.start()
         return self
 
@@ -103,6 +153,55 @@ class TelemetryServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
+                     t0: Optional[float] = None) -> Routes:
+    """The standard telemetry route set over ``registry`` (default: the
+    process-wide one) and the installed journal — reused verbatim by the
+    serving endpoint so one port scrapes both."""
+    reg = registry if registry is not None else _registry.get_registry()
+    started = t0 if t0 is not None else time.time()
+    routes = Routes()
+    routes.add("GET", "/metrics", lambda q, b: (
+        reg.render_prometheus().encode(), PROM_CONTENT_TYPE))
+
+    routes.add("GET", "/metrics.json", lambda q, b: (
+        json.dumps(reg.snapshot()).encode(), "application/json"))
+
+    def healthz(q, b):
+        j = _journal.get_journal()
+        body = {"status": "ok",
+                "uptime_s": round(time.time() - started, 3),
+                "telemetry_enabled": _registry.enabled(),
+                "journal_seq": j._seq if j is not None else None}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/healthz", healthz)
+
+    def journal_tail(q, b):
+        j = _journal.get_journal()
+        n = int(q.get("n", ["100"])[0])
+        events = j.events[-n:] if j is not None else []
+        return json.dumps(events).encode(), "application/json"
+
+    routes.add("GET", "/journal", journal_tail)
+    return routes
+
+
+class TelemetryServer(RoutedHTTPServer):
+    """HTTP scrape server over a registry (default: the process-wide one)
+    and the installed journal.  ``port=0`` binds an ephemeral port (read
+    it back from ``.port``)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[_registry.MetricsRegistry] = None):
+        super().__init__(telemetry_routes(registry), port, host,
+                         thread_name="hetu-obs-http")
+
+    def start(self) -> "TelemetryServer":
+        super().start()
+        return self
 
 
 def serve(port: int = 0, host: str = "127.0.0.1",

@@ -19,6 +19,7 @@ from hetu_tpu.core.rng import next_key
 __all__ = [
     "rand", "normal_sample", "uniform_sample", "truncated_normal_sample",
     "gumbel_sample", "randint_sample",
+    "greedy_sample", "temperature_sample", "top_k_sample",
 ]
 
 
@@ -56,3 +57,39 @@ def gumbel_sample(shape, dtype=jnp.float32, key=None):
 
 def randint_sample(shape, low: int, high: int, dtype=jnp.int32, key=None):
     return jax.random.randint(_key(key), shape, low, high, dtype)
+
+
+# -- token-sampling helpers (the serving decode loop, hetu_tpu/serve) -------
+#
+# All three take logits whose LAST axis is the vocabulary (leading axes are
+# batch) and return int32 token ids with the last axis reduced away.  Every
+# draw is a pure function of (logits, key): the serving engine derives one
+# key per (request, position), so a token stream is reproducible bit-for-bit
+# regardless of how requests were batched together.
+
+
+def greedy_sample(logits):
+    """Deterministic argmax decode (ties -> lowest id, jnp.argmax order)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def temperature_sample(logits, temperature: float = 1.0, key=None):
+    """Softmax sampling at ``temperature``; ``temperature <= 0`` collapses
+    to :func:`greedy_sample` (the conventional T->0 limit)."""
+    if temperature <= 0.0:
+        return greedy_sample(logits)
+    return jax.random.categorical(
+        _key(key), logits.astype(jnp.float32) / temperature).astype(jnp.int32)
+
+
+def top_k_sample(logits, k: int, temperature: float = 1.0, key=None):
+    """Sample among the ``k`` highest-scoring tokens at ``temperature``
+    (temperature <= 0 -> greedy; ``k`` >= vocab -> plain temperature
+    sampling over the full distribution, top_k being a no-op there)."""
+    if temperature <= 0.0:
+        return greedy_sample(logits)
+    k = min(int(k), logits.shape[-1])  # lax.top_k rejects k > minor dim
+    vals, idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    choice = jax.random.categorical(_key(key), vals / temperature)
+    return jnp.take_along_axis(
+        idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)

@@ -1,0 +1,45 @@
+"""Online inference subsystem: continuous batching over a paged KV cache.
+
+The ROADMAP's north star is a system that *serves* heavy traffic, and the
+paper's headline capability — the cache-enabled parameter server for huge
+embedding tables (HET, VLDB'22) — is as much a serving story as a training
+one.  This package is the inference path the training stack feeds:
+
+- :mod:`~hetu_tpu.serve.kv_cache` — block-allocated KV-cache pool with
+  per-sequence page tables (alloc/grow/free/defrag) behind fixed padded
+  shapes, so XLA compiles one decode program and one prefill program per
+  prompt bucket;
+- :mod:`~hetu_tpu.serve.batcher` — Orca-style continuous batching
+  (OSDI'22): admission queue with depth limit and per-request deadlines,
+  prefill/decode interleave, slot recycling the moment a sequence
+  finishes;
+- :mod:`~hetu_tpu.serve.engine` — ``ServingEngine`` driving seeded GPT
+  generation through the decode seams in ``layers/attention.py`` /
+  ``models/gpt.py``, plus a CTR inference path that pulls embeddings
+  READ-ONLY through the HET caches (no gradient push; PS faults from
+  ``exec/faults.py`` remain injectable);
+- :mod:`~hetu_tpu.serve.server` — stdlib-HTTP ``/infer`` + ``/stats``
+  endpoint registered on the ``obs.server`` route table, sharing a port
+  with ``/metrics``;
+- :mod:`~hetu_tpu.serve.loadgen` — seeded deterministic load generator
+  (the acceptance tests replay identical request schedules).
+
+Everything is deterministic under a fixed seed: same schedule, same
+tokens, bit-for-bit — the serving counterpart of the training stack's
+chaos-lineage guarantee.
+"""
+
+from hetu_tpu.serve.batcher import (AdmissionQueueFull, ContinuousBatcher,
+                                    Request)
+from hetu_tpu.serve.engine import RequestHandle, ServingEngine
+from hetu_tpu.serve.kv_cache import KVCachePool, OutOfPages, PageTable
+from hetu_tpu.serve.loadgen import LoadItem, generate_load
+from hetu_tpu.serve.server import ServingServer, serve_engine
+
+__all__ = [
+    "KVCachePool", "PageTable", "OutOfPages",
+    "ContinuousBatcher", "Request", "AdmissionQueueFull",
+    "ServingEngine", "RequestHandle",
+    "ServingServer", "serve_engine",
+    "generate_load", "LoadItem",
+]

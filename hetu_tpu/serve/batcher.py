@@ -1,0 +1,150 @@
+"""Continuous-batching scheduler (Orca, OSDI'22 — iteration-level
+scheduling restated for the paged pool).
+
+The batcher owns the *decisions*; the engine owns the *compute*.  Each
+scheduler tick (:meth:`ContinuousBatcher.poll`):
+
+1. expire — waiting requests past their admission deadline are dropped
+   (they never held a slot; serving them late is serving them wrong);
+2. admit — free slots are filled FIFO from the queue, but only when the
+   KV pool can actually hold the request's worst case *prompt* (its
+   decode growth is page-at-a-time, backstopped by per-slot headroom);
+3. the engine prefill-then-decodes whatever :meth:`active` returns, and
+   recycles slots via :meth:`finish` the moment a sequence hits EOS or
+   its token budget — the next tick's admissions take over mid-flight,
+   which is the whole point of continuous batching.
+
+Everything is deterministic given the same submit/poll sequence and an
+injected clock: FIFO admission, lowest-free-slot placement, sorted
+expiry.  The engine exploits this for bitwise-replayable serving runs.
+
+Prompt length buckets quantize prefill shapes (``bucket_for``), so XLA
+compiles one prefill program per bucket instead of one per prompt
+length; decode always runs at the fixed (num_slots, 1) shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["Request", "ContinuousBatcher", "AdmissionQueueFull",
+           "SchedulerTick"]
+
+
+class AdmissionQueueFull(RuntimeError):
+    """The waiting queue is at its depth limit — shed load upstream."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as the scheduler sees it."""
+
+    id: int
+    prompt: list
+    max_new_tokens: int
+    arrival: float
+    deadline_s: Optional[float] = None  # waiting-time budget; None = never
+    # engine-owned running state
+    tokens: list = dataclasses.field(default_factory=list)  # generated
+    prefill_at: Optional[float] = None
+    slot: Optional[int] = None
+
+    @property
+    def total_budget(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+    def expired(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.arrival > self.deadline_s)
+
+
+@dataclasses.dataclass
+class SchedulerTick:
+    """What one :meth:`ContinuousBatcher.poll` decided."""
+
+    expired: list          # Requests dropped at their deadline
+    admitted: list         # Requests placed into slots this tick
+
+
+class ContinuousBatcher:
+    """Admission queue + slot map.  Pure scheduling — no jax, no model —
+    so its behavior is unit-testable and deterministic by construction."""
+
+    def __init__(self, num_slots: int, *, queue_depth: int = 64,
+                 prompt_buckets=(16, 32, 64, 128, 256, 512, 1024)):
+        if num_slots <= 0:
+            raise ValueError("need at least one slot")
+        self.num_slots = num_slots
+        self.queue_depth = queue_depth
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self._waiting: list = []
+        self._slots: list = [None] * num_slots
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        """Queue a request; raises :exc:`AdmissionQueueFull` at the depth
+        limit (the engine counts the rejection and journals it)."""
+        if len(self._waiting) >= self.queue_depth:
+            raise AdmissionQueueFull(
+                f"admission queue at depth limit {self.queue_depth}")
+        self._waiting.append(request)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest configured bucket holding ``prompt_len`` (prefill pads
+        right up to it)."""
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(f"prompt of {prompt_len} tokens exceeds the "
+                         f"largest bucket {self.prompt_buckets[-1]}")
+
+    # -- the scheduler tick -------------------------------------------------
+
+    def poll(self, now: float, can_admit=None) -> SchedulerTick:
+        """Expire + admit.  ``can_admit(request) -> bool`` is the engine's
+        capacity gate (KV pages); admission stops at the first refusal to
+        preserve FIFO order — skipping ahead would starve long prompts."""
+        expired = [r for r in self._waiting if r.expired(now)]
+        if expired:
+            self._waiting = [r for r in self._waiting
+                             if not r.expired(now)]
+        admitted = []
+        while self._waiting and None in self._slots:
+            head = self._waiting[0]
+            if can_admit is not None and not can_admit(head):
+                break
+            self._waiting.pop(0)
+            slot = self._slots.index(None)  # lowest free slot: deterministic
+            head.slot = slot
+            self._slots[slot] = head
+            admitted.append(head)
+        return SchedulerTick(expired=expired, admitted=admitted)
+
+    # -- running state ------------------------------------------------------
+
+    def active(self) -> list:
+        """[(slot, Request)] currently decoding, slot-ordered."""
+        return [(i, r) for i, r in enumerate(self._slots) if r is not None]
+
+    def finish(self, slot: int) -> Request:
+        """Recycle a slot (EOS / budget exhausted / engine abort)."""
+        r = self._slots[slot]
+        if r is None:
+            raise ValueError(f"slot {slot} is already free")
+        self._slots[slot] = None
+        r.slot = None
+        return r
+
+    @property
+    def queue_len(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def idle(self) -> bool:
+        return not self._waiting and self.active_slots == 0

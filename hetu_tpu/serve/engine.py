@@ -1,0 +1,502 @@
+"""``ServingEngine``: the online-inference driver.
+
+Marries the decode seams (``models/gpt.py`` ``kv_cache=``/``cache_index=``)
+to the paged pool and the continuous batcher, and carries the two serving
+workloads the stack trains:
+
+- **Generation** — seeded greedy/top-k sampling over a GPT.  One jitted
+  step function serves both phases: prefill calls it at ``(1,
+  bucket_len)`` (one compile per prompt bucket), decode at the fixed
+  ``(num_slots, 1)`` shape (one compile, ever).  Sampling keys derive
+  from ``(seed, request.id, position)``, so a request's token stream is a
+  pure function of the seed and its own prompt — independent of which
+  neighbors shared its batch.  Two same-seed runs of the same schedule
+  produce bitwise-identical streams; the acceptance test asserts it.
+
+- **CTR inference** — :meth:`infer_ctr` pulls embedding rows READ-ONLY
+  through the model's existing HET stores (``CacheTable`` /
+  ``RemoteEmbeddingTable``): stage-then-forward, never a gradient push.
+  Local ``CacheTable`` stores are flipped to ``read_only`` at engine
+  construction so a miswired training step raises instead of silently
+  updating the table.  Remote pulls keep riding ``embed.net._rpc`` — the
+  ``exec/faults.py`` PS seams stay injectable, so a socket kill under
+  load must surface as a counted redial, not a wrong answer.
+
+Telemetry (lazily registered, all no-ops when obs is disabled): queue
+depth and active-slot gauges, TTFT and per-token latency histograms,
+token/request counters by outcome, tokens/s gauge; admission rejections
+and deadline expiries are journaled (``serve_reject`` /
+``serve_deadline``).  The clock is injectable — the deterministic tests
+drive a virtual clock, production defaults to ``time.monotonic``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
+                                 top_k_sample)
+from hetu_tpu.serve.batcher import (AdmissionQueueFull, ContinuousBatcher,
+                                    Request)
+from hetu_tpu.serve.kv_cache import (KVCachePool, OutOfPages, gather_views,
+                                     scatter_views)
+
+__all__ = ["ServingEngine", "RequestHandle"]
+
+_serve_metrics = None
+
+
+def _serve_m() -> dict:
+    global _serve_metrics
+    if _serve_metrics is None:
+        reg = _obs.get_registry()
+        _serve_metrics = {
+            "requests": reg.counter(
+                "hetu_serve_requests_total",
+                "serving requests by outcome (admitted at slot placement; "
+                "every submitted request ends completed, rejected, "
+                "expired, or — under an overcommitted pool — evicted)",
+                ("outcome",)),
+            "tokens": reg.counter(
+                "hetu_serve_tokens_total", "generated tokens"),
+            "queue": reg.gauge(
+                "hetu_serve_queue_depth", "requests waiting for a slot"),
+            "slots": reg.gauge(
+                "hetu_serve_active_slots", "slots currently decoding"),
+            "ttft": reg.histogram(
+                "hetu_serve_ttft_seconds",
+                "time to first token (arrival -> prefill sample)"),
+            "tok_latency": reg.histogram(
+                "hetu_serve_token_latency_seconds",
+                "per-token decode latency (one batched step amortized "
+                "over its active slots)"),
+            "tps": reg.gauge(
+                "hetu_serve_tokens_per_second",
+                "decode throughput over the last step"),
+            "ctr": reg.counter(
+                "hetu_serve_ctr_requests_total", "CTR inference batches"),
+        }
+    return _serve_metrics
+
+
+class RequestHandle:
+    """Caller-side future for one generation request."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._done = threading.Event()
+        # completed | rejected | expired | evicted (overcommitted pool only)
+        self.status: Optional[str] = None
+        self.tokens: list = []
+        self.ttft_s: Optional[float] = None
+        self.latency_s: Optional[float] = None
+
+    def _finish(self, status: str, tokens=(), ttft_s=None, latency_s=None):
+        self.status = status
+        self.tokens = list(tokens)
+        self.ttft_s = ttft_s
+        self.latency_s = latency_s
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class ServingEngine:
+    """Continuous-batching inference over one GPT (and optionally one CTR
+    model sharing the process' HET stores)."""
+
+    def __init__(self, model, *, num_slots: int = 8, page_size: int = 16,
+                 max_seq_len: Optional[int] = None,
+                 num_pages: Optional[int] = None, queue_depth: int = 64,
+                 prompt_buckets=(8, 16, 32, 64, 128),
+                 sampling: str = "greedy", top_k: int = 5,
+                 temperature: float = 1.0, eos_id: Optional[int] = None,
+                 seed: int = 0, clock=time.monotonic,
+                 defrag_every: int = 0, ctr_model=None):
+        cfg = model.config
+        self.model = model
+        self.eos_id = eos_id
+        if sampling not in ("greedy", "top_k", "temperature"):
+            raise ValueError(f"unknown sampling mode {sampling!r}; one of "
+                             f"'greedy', 'top_k', 'temperature'")
+        if sampling == "top_k" and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.sampling = sampling
+        self.top_k = top_k
+        self.temperature = temperature
+        self.clock = clock
+        self.defrag_every = defrag_every
+        self.max_seq_len = min(max_seq_len or cfg.max_seq_len,
+                               cfg.max_seq_len)
+        if self.max_seq_len % page_size:
+            self.max_seq_len -= self.max_seq_len % page_size
+        pages_per_seq = self.max_seq_len // page_size
+        self.pool = KVCachePool(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            num_pages=(num_pages if num_pages is not None
+                       else 1 + num_slots * pages_per_seq),
+            page_size=page_size, max_seq_len=self.max_seq_len,
+            dtype=cfg.dtype)
+        buckets = tuple(b for b in sorted(prompt_buckets)
+                        if b <= self.max_seq_len) or (self.max_seq_len,)
+        self.batcher = ContinuousBatcher(num_slots, queue_depth=queue_depth,
+                                         prompt_buckets=buckets)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._lock = threading.RLock()
+        self._handles: dict = {}
+        self._next_id = 0
+        self._recycled = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._step_fn = jax.jit(self._step_impl)
+        self._sample_fn = jax.jit(self._sample_impl)
+        self.ctr_model = ctr_model
+        if ctr_model is not None:
+            _mark_stores_read_only(ctr_model)
+
+    # -- jitted compute -----------------------------------------------------
+
+    def _step_impl(self, model, k, v, page_idx, cache_index, tokens,
+                   seq_lengths):
+        """One serving step at any bucket shape: gather the paged views,
+        run the model's incremental path, scatter the updated KV back.
+        Prefill and decode differ only in the shapes they call this at."""
+        k_view, v_view = gather_views(k, v, page_idx)
+        kv = [(k_view[i], v_view[i]) for i in range(self.pool.num_layers)]
+        logits, new_kv = model(tokens, kv_cache=kv, cache_index=cache_index,
+                               seq_lengths=seq_lengths)
+        k_upd = jnp.stack([kv_l[0] for kv_l in new_kv])
+        v_upd = jnp.stack([kv_l[1] for kv_l in new_kv])
+        k, v = scatter_views(k, v, page_idx, k_upd, v_upd)
+        return logits, k, v
+
+    def _sample_impl(self, logits, request_ids, positions):
+        """Per-row seeded sampling (vmapped: one dispatch per step).  Keys
+        derive INSIDE the jitted program from ``(seed, request id, token
+        position)``, so batch composition cannot perturb any request's
+        stream and the host loop ships two int32 vectors, not keys."""
+        if self.sampling == "greedy":
+            return greedy_sample(logits)
+
+        def row(lg, rid, pos):
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._base_key, rid), pos)
+            if self.sampling == "temperature":
+                return temperature_sample(lg, self.temperature, key=key)
+            return top_k_sample(lg, self.top_k, self.temperature, key=key)
+
+        return jax.vmap(row)(logits, request_ids, positions)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Queue one generation request; never blocks.  Returns a handle
+        that resolves when the request completes, is rejected (queue
+        depth / too long), or expires at its deadline."""
+        prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            handle = RequestHandle(rid)
+            req = Request(id=rid, prompt=prompt,
+                          max_new_tokens=int(max_new_tokens),
+                          arrival=self.clock(), deadline_s=deadline_s)
+            reason = None
+            max_bucket = self.batcher.prompt_buckets[-1]
+            if not prompt:
+                reason = "empty prompt"
+            elif req.max_new_tokens < 1:
+                reason = (f"max_new_tokens must be >= 1, got "
+                          f"{req.max_new_tokens}")
+            elif req.total_budget > self.max_seq_len:
+                reason = (f"prompt+budget {req.total_budget} exceeds "
+                          f"max_seq_len {self.max_seq_len}")
+            elif len(prompt) > max_bucket:
+                reason = (f"prompt of {len(prompt)} tokens exceeds the "
+                          f"largest prefill bucket {max_bucket}")
+            if reason is None:
+                try:
+                    self.batcher.submit(req)
+                except AdmissionQueueFull as e:
+                    reason = str(e)
+            if reason is not None:
+                _serve_m()["requests"].labels(outcome="rejected").inc()
+                _journal.record("serve_reject", request_id=rid,
+                                reason=reason,
+                                queue_depth=self.batcher.queue_len)
+                handle._finish("rejected")
+                return handle
+            self._handles[rid] = handle
+            _serve_m()["queue"].set(self.batcher.queue_len)
+        return handle
+
+    # -- the scheduler loop -------------------------------------------------
+
+    def step(self) -> int:
+        """One scheduler tick: expire, admit+prefill, one decode step.
+        Returns the number of tokens produced (0 when idle)."""
+        with self._lock:
+            now = self.clock()
+            m = _serve_m()
+            # reserving gate: poll admits several requests before any of
+            # them allocates, so the budget must be decremented as each
+            # one passes — gating on live pool state alone would overcommit
+            budget = self.pool.free_pages
+
+            def gate(r):
+                nonlocal budget
+                need = self.pool.pages_needed(len(r.prompt))
+                if need > budget:
+                    return False
+                budget -= need
+                return True
+
+            tick = self.batcher.poll(now, can_admit=gate)
+            for req in tick.expired:
+                _journal.record("serve_deadline", request_id=req.id,
+                                waited_s=round(now - req.arrival, 6))
+                m["requests"].labels(outcome="expired").inc()
+                self._handles.pop(req.id)._finish("expired")
+            for req in tick.admitted:
+                m["requests"].labels(outcome="admitted").inc()
+                self._prefill(req, now)
+            produced = self._decode()
+            m["queue"].set(self.batcher.queue_len)
+            m["slots"].set(self.batcher.active_slots)
+            return produced
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            self.step()
+            if self.batcher.idle:
+                return
+        raise RuntimeError(f"not idle after {max_steps} scheduler steps")
+
+    def start(self, poll_interval: float = 0.001) -> "ServingEngine":
+        """Run the scheduler on a daemon thread (the HTTP-serving mode)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                with self._lock:
+                    idle = self.batcher.idle
+                if idle:
+                    time.sleep(poll_interval)
+                else:
+                    self.step()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="hetu-serve-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(10)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- phases -------------------------------------------------------------
+
+    def _prefill(self, req: Request, now: float) -> None:
+        """Right-pad the prompt to its bucket, run one (1, bucket) step,
+        sample the first token at the prompt's true last position."""
+        plen = len(req.prompt)
+        bucket = self.batcher.bucket_for(plen)
+        self.pool.alloc(req.id, plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = req.prompt
+        logits, k, v = self._step_fn(
+            self.model, self.pool.k, self.pool.v,
+            self.pool.gather_indices([req.id]),
+            jnp.zeros((1,), jnp.int32), jnp.asarray(tokens),
+            jnp.asarray([plen], jnp.int32))
+        self.pool.commit(k, v)
+        # the bucket's pad positions wrote garbage K/V beyond plen; the
+        # table's length stays plen, so decode overwrites them in turn
+        self.pool.table(req.id).length = plen
+        tok = int(self._sample_fn(
+            logits, jnp.asarray([req.id], jnp.int32),
+            jnp.asarray([plen], jnp.int32))[0])
+        req.prefill_at = now
+        self._append_token(req, tok, now, ttft=now - req.arrival)
+
+    def _decode(self) -> int:
+        """One fixed-shape (num_slots, 1) decode step over every active
+        slot; idle slots ride along masked into the scratch page."""
+        active = self.batcher.active()
+        if not active:
+            return 0
+        t0 = time.perf_counter()
+        seq_ids = [None] * self.batcher.num_slots
+        tokens = np.zeros((self.batcher.num_slots, 1), np.int32)
+        index = np.zeros(self.batcher.num_slots, np.int32)
+        rids = np.zeros(self.batcher.num_slots, np.int32)
+        positions = np.zeros(self.batcher.num_slots, np.int32)
+        evicted = []
+        for slot, req in active:
+            # the fed token's K/V lands at index ``length``; its successor
+            # is sampled at global position ``length + 1``
+            try:
+                self.pool.ensure(req.id, self.pool.table(req.id).length + 1)
+            except OutOfPages:
+                # only reachable under an explicitly overcommitted pool
+                # (custom num_pages below full per-slot capacity); growth
+                # takes ANY free page, so a full pool is really full —
+                # retire the request with the tokens it has rather than
+                # wedging the scheduler loop
+                evicted.append((slot, req))
+                continue
+            seq_ids[slot] = req.id
+            tokens[slot, 0] = req.tokens[-1]
+            index[slot] = self.pool.table(req.id).length
+            rids[slot] = req.id
+            positions[slot] = self.pool.table(req.id).length + 1
+        for slot, req in evicted:
+            self._retire(req, "evicted", self.clock())
+        active = [(s, r) for s, r in active
+                  if r.slot is not None]  # drop the evicted
+        if not active:
+            return 0
+        logits, k, v = self._step_fn(
+            self.model, self.pool.k, self.pool.v,
+            self.pool.gather_indices(seq_ids),
+            jnp.asarray(index), jnp.asarray(tokens), None)
+        self.pool.commit(k, v)
+        toks = np.asarray(self._sample_fn(logits, jnp.asarray(rids),
+                                          jnp.asarray(positions)))
+        now = self.clock()
+        for slot, req in active:
+            self.pool.table(req.id).length += 1  # fed token's K/V written
+            self._append_token(req, int(toks[slot]), now)
+        dt = time.perf_counter() - t0
+        m = _serve_m()
+        m["tok_latency"].observe(dt / max(len(active), 1))
+        m["tps"].set(len(active) / dt if dt > 0 else 0.0)
+        return len(active)
+
+    def _append_token(self, req: Request, tok: int, now: float,
+                      ttft: Optional[float] = None) -> None:
+        """Account one generated token (its own K/V is written by the NEXT
+        decode step, at index ``pool.table(id).length``); retire the
+        request on EOS, budget exhaustion, or context exhaustion."""
+        pt = self.pool.table(req.id)
+        req.tokens.append(tok)
+        m = _serve_m()
+        m["tokens"].inc()
+        if ttft is not None:
+            m["ttft"].observe(max(ttft, 0.0))
+        done = (tok == self.eos_id if self.eos_id is not None else False) \
+            or len(req.tokens) >= req.max_new_tokens \
+            or pt.length >= self.max_seq_len
+        if done:
+            self._retire(req, "completed", now)
+
+    def _retire(self, req: Request, outcome: str, now: float) -> None:
+        """Recycle the slot and pages, close the handle.  ``outcome`` is
+        ``completed`` or — only under an overcommitted pool — ``evicted``
+        (the request keeps the tokens generated so far)."""
+        self.batcher.finish(req.slot)
+        self.pool.free(req.id)
+        self._recycled += 1
+        if self.defrag_every and self._recycled % self.defrag_every == 0:
+            self.pool.defrag()
+        if outcome == "evicted":
+            _journal.record("serve_evict", request_id=req.id,
+                            tokens_generated=len(req.tokens))
+        _serve_m()["requests"].labels(outcome=outcome).inc()
+        self._handles.pop(req.id)._finish(
+            outcome, req.tokens,
+            ttft_s=(None if req.prefill_at is None
+                    else req.prefill_at - req.arrival),
+            latency_s=now - req.arrival)
+
+    # -- CTR inference ------------------------------------------------------
+
+    def infer_ctr(self, dense, sparse) -> np.ndarray:
+        """Read-only CTR scoring: stage the batch's embedding rows (host/
+        remote pull through the HET caches — the fault-injectable PS path)
+        and run the dense forward.  No gradients exist, so nothing can
+        push; the stores are additionally flipped read-only at engine
+        construction."""
+        if self.ctr_model is None:
+            raise RuntimeError("engine was built without a ctr_model")
+        dense = jnp.asarray(np.asarray(dense, np.float32))
+        sparse_np = np.asarray(sparse, np.int64)
+        # stage-then-forward mutates the shared modules' staged rows, and
+        # the HTTP front end is one-thread-per-request: serialize against
+        # both concurrent CTR calls and the generation scheduler
+        with self._lock:
+            for mod in _staged_modules(self.ctr_model):
+                mod.stage(sparse_np)
+            logits = self.ctr_model.logits(dense, jnp.asarray(sparse_np))
+        _serve_m()["ctr"].inc()
+        return np.asarray(jax.nn.sigmoid(logits))
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``/stats`` payload: scheduler + pool occupancy and the
+        serving counters' current values."""
+        with self._lock:
+            reg = _obs.get_registry()
+            snap = {k: v for k, v in reg.snapshot().items()
+                    if k.startswith("hetu_serve_") and "_bucket" not in k}
+            return {
+                "queue_len": self.batcher.queue_len,
+                "active_slots": self.batcher.active_slots,
+                "num_slots": self.batcher.num_slots,
+                "pool": self.pool.utilization(),
+                "max_seq_len": self.max_seq_len,
+                "sampling": self.sampling,
+                "metrics": snap,
+            }
+
+
+def _staged_modules(model) -> list:
+    """Every staged host-embedding submodule of ``model`` (the Trainer's
+    own discovery rule, reused)."""
+    from hetu_tpu.exec.executor import _find_staged
+    return _find_staged(model)
+
+
+def _mark_stores_read_only(model) -> None:
+    """Flip every local ``CacheTable`` store under ``model`` to read-only
+    (serving must not train; see embed/engine.py).  A model that trained
+    before being handed to the engine may hold buffered gradient pushes
+    (``push_bound > 0``) and queued async pushes — drain them FIRST, so
+    flipping the flag freezes the table instead of silently dropping the
+    tail of training."""
+    from hetu_tpu.embed.engine import CacheTable
+    for mod in _staged_modules(model):
+        flush_pushes = getattr(mod, "flush_pushes", None)
+        if flush_pushes is not None:
+            flush_pushes()
+        stores = getattr(mod, "stores", None) or [getattr(mod, "store", None)]
+        for st in stores:
+            if isinstance(st, CacheTable):
+                st.flush()  # apply buffered grads before freezing
+                st.read_only = True

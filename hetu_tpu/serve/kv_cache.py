@@ -1,0 +1,225 @@
+"""Block-allocated KV-cache pool with per-sequence page tables.
+
+The serving memory manager (the vLLM/Orca idea restated TPU-first): the
+KV cache for all concurrent sequences lives in ONE pair of device arrays
+
+    k, v : (num_layers, num_pages, page_size, num_heads, head_dim)
+
+and each sequence owns an ordered list of physical pages (its *page
+table*).  Sequences grow a page at a time, free their pages the moment
+they finish, and never copy — admission capacity is bounded by free
+pages, not by worst-case padded sequences.
+
+XLA, however, wants static shapes.  The bridge is the *bucketed view*:
+``gather_indices(seq_ids)`` pads every page table to the same
+``pages_per_seq`` with the reserved scratch page 0, so the jitted decode
+step always sees
+
+    page_idx : (batch, pages_per_seq)                       — int32
+    view     : k[:, page_idx] -> (L, batch, max_len, H, D)  — one gather
+
+and writes back with one scatter.  Shapes depend only on (batch bucket,
+length bucket), so XLA compiles ONE decode program and one prefill
+program per bucket, ever.  Page 0 is never allocated to a sequence:
+padded table entries read (masked) garbage from it and scatter their
+dead rows back into it, keeping both directions legal without per-row
+conditionals.
+
+Host-side management (alloc/grow/free/defrag) is plain Python over a
+sorted free list — deterministic: the same request schedule produces the
+same physical placement, which the bitwise-replay acceptance tests rely
+on.  ``defrag()`` compacts live pages toward low indices (the long-lived
+server shape: after hours of ragged arrivals, a fresh long request needs
+contiguous-ish headroom only the compactor can guarantee).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+__all__ = ["KVCachePool", "PageTable", "OutOfPages", "SCRATCH_PAGE"]
+
+# Physical page 0 is reserved: page-table padding points at it, and the
+# scatter of a padded decode batch dumps dead rows into it.  Never
+# allocated, never trusted.
+SCRATCH_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """The pool cannot satisfy an allocation — admission control should
+    hold the request in the queue until sequences retire."""
+
+
+@dataclasses.dataclass
+class PageTable:
+    """One sequence's allocation: ordered physical pages + token length."""
+
+    seq_id: int
+    pages: list
+    length: int = 0  # valid tokens written so far
+
+    def capacity(self, page_size: int) -> int:
+        return len(self.pages) * page_size
+
+
+class KVCachePool:
+    """Paged KV storage for all layers of one model + its allocator.
+
+    The jitted serving step treats ``k``/``v`` as inputs and returns the
+    updated arrays; the engine stores them back via :meth:`commit` — the
+    pool itself stays a plain host-side object (no tracers).
+    """
+
+    def __init__(self, *, num_layers: int, num_heads: int, head_dim: int,
+                 num_pages: int, page_size: int, max_seq_len: int,
+                 dtype=jnp.float32):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the reserved "
+                             "scratch page)")
+        if max_seq_len % page_size:
+            raise ValueError(f"max_seq_len {max_seq_len} must be a "
+                             f"multiple of page_size {page_size}")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_seq_len = max_seq_len
+        self.pages_per_seq = max_seq_len // page_size
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # ascending free list => lowest-index-first placement, deterministic
+        self._free: list = list(range(1, num_pages))
+        self._tables: dict = {}
+
+    # -- allocator ----------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_sequences(self) -> int:
+        return len(self._tables)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.page_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    def alloc(self, seq_id: int, n_tokens: int) -> PageTable:
+        """Reserve capacity for ``n_tokens`` (>=1 page).  Raises
+        :exc:`OutOfPages` without side effects when the pool is short."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        need = self.pages_needed(n_tokens)
+        if n_tokens > self.max_seq_len:
+            raise ValueError(f"sequence of {n_tokens} tokens exceeds "
+                             f"max_seq_len {self.max_seq_len}")
+        if need > len(self._free):
+            raise OutOfPages(f"need {need} pages, {len(self._free)} free")
+        pt = PageTable(seq_id, [self._free.pop(0) for _ in range(need)])
+        self._tables[seq_id] = pt
+        return pt
+
+    def ensure(self, seq_id: int, n_tokens: int) -> PageTable:
+        """Grow ``seq_id``'s allocation to cover ``n_tokens`` (the
+        one-page-at-a-time growth of a decoding sequence)."""
+        pt = self._tables[seq_id]
+        if n_tokens > self.max_seq_len:
+            raise ValueError(f"sequence {seq_id} would exceed max_seq_len "
+                             f"{self.max_seq_len}")
+        while pt.capacity(self.page_size) < n_tokens:
+            if not self._free:
+                raise OutOfPages(f"growing sequence {seq_id}: no free pages")
+            pt.pages.append(self._free.pop(0))
+        return pt
+
+    def free(self, seq_id: int) -> None:
+        """Return the sequence's pages to the pool (sorted re-insert keeps
+        placement deterministic)."""
+        pt = self._tables.pop(seq_id)
+        self._free = sorted(self._free + pt.pages)
+
+    def table(self, seq_id: int) -> PageTable:
+        return self._tables[seq_id]
+
+    def defrag(self) -> int:
+        """Compact live pages into the lowest physical indices, moving the
+        K/V rows along (one permutation gather per array) and rewriting the
+        page tables.  Returns the number of pages moved.  Call between
+        steps — the arrays are replaced, so in-flight views are stale."""
+        live = [(pt.seq_id, i, p)
+                for pt in sorted(self._tables.values(),
+                                 key=lambda t: t.seq_id)
+                for i, p in enumerate(pt.pages)]
+        # target layout: scratch, then live pages packed in (seq, pos) order
+        mapping = {old: new for new, (_, _, old) in enumerate(live, start=1)}
+        moved = sum(1 for old, new in mapping.items() if old != new)
+        if moved == 0:
+            return 0
+        perm = list(range(self.num_pages))  # perm[new] = old
+        for old, new in mapping.items():
+            perm[new] = old
+        moved_from = set(mapping)  # old indices already placed
+        spare = iter(p for p in range(1, self.num_pages)
+                     if p not in moved_from)
+        for new in range(1 + len(live), self.num_pages):
+            perm[new] = next(spare)
+        perm_arr = jnp.asarray(perm, jnp.int32)
+        self.k = jnp.take(self.k, perm_arr, axis=1)
+        self.v = jnp.take(self.v, perm_arr, axis=1)
+        for pt in self._tables.values():
+            pt.pages = [mapping[p] for p in pt.pages]
+        self._free = list(range(1 + len(live), self.num_pages))
+        return moved
+
+    # -- the static-shape bridge -------------------------------------------
+
+    def gather_indices(self, seq_ids) -> jnp.ndarray:
+        """(batch, pages_per_seq) int32 page-table matrix for the jitted
+        step, padded with the scratch page.  ``None`` entries (idle slots)
+        become all-scratch rows."""
+        rows = []
+        for sid in seq_ids:
+            pages = [] if sid is None else self._tables[sid].pages
+            rows.append(pages + [SCRATCH_PAGE] *
+                        (self.pages_per_seq - len(pages)))
+        return jnp.asarray(rows, jnp.int32)
+
+    def commit(self, k, v) -> None:
+        """Adopt the updated arrays a jitted step returned."""
+        self.k = k
+        self.v = v
+
+    def utilization(self) -> dict:
+        used = self.num_pages - 1 - len(self._free)
+        return {"pages_total": self.num_pages - 1, "pages_used": used,
+                "sequences": len(self._tables),
+                "page_size": self.page_size}
+
+
+def gather_views(k, v, page_idx):
+    """Inside-jit helper: materialize the bucket-padded contiguous views
+    ``(L, batch, max_len, H, D)`` from the page arrays — one gather each."""
+    L, _, page, H, D = k.shape
+    b, P = page_idx.shape
+    kv_shape = (L, b, P * page, H, D)
+    return (k[:, page_idx].reshape(kv_shape),
+            v[:, page_idx].reshape(kv_shape))
+
+
+def scatter_views(k, v, page_idx, k_view, v_view):
+    """Inside-jit helper: write updated contiguous views back into the
+    page arrays.  Every live page belongs to exactly one (sequence, slot),
+    so the scatter is conflict-free except for the scratch page, whose
+    content is never read unmasked."""
+    L, _, page, H, D = k.shape
+    b, P = page_idx.shape
+    pg_shape = (L, b, P, page, H, D)
+    return (k.at[:, page_idx].set(k_view.reshape(pg_shape)),
+            v.at[:, page_idx].set(v_view.reshape(pg_shape)))

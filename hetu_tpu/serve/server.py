@@ -1,0 +1,85 @@
+"""Serving endpoint: ``/infer`` + ``/stats`` on the obs route table.
+
+The satellite payoff of the ``obs/server.py`` refactor: this module
+registers handlers on a :class:`~hetu_tpu.obs.server.Routes` table and
+inherits every line of HTTP plumbing — plus the full telemetry surface
+(``/metrics``, ``/metrics.json``, ``/healthz``, ``/journal``), so one
+ephemeral port scrapes the serving SLO metrics next to the endpoints
+they describe.
+
+- ``POST /infer`` with ``{"prompt": [ids...], "max_new_tokens": n,
+  "deadline_s": s?, "timeout_s": s?}`` blocks until the request resolves
+  and returns ``{"request_id", "status", "tokens", "ttft_s",
+  "latency_s"}`` — 200 on completion, 429 on admission rejection, 504 on
+  deadline expiry.
+- ``POST /infer`` with ``{"dense": [[...]], "sparse": [[...]]}`` runs
+  the read-only CTR path and returns ``{"pred": [...]}``.
+- ``GET /stats`` returns the engine's scheduler/pool/counter snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+
+from hetu_tpu.obs.server import Routes, RoutedHTTPServer, telemetry_routes
+
+__all__ = ["ServingServer", "serve_engine"]
+
+
+def serving_routes(engine) -> Routes:
+    """Telemetry routes + the serving endpoints over ``engine``.  Always
+    scrapes the process-wide registry — that is where the engine's
+    ``hetu_serve_*`` metrics live, so accepting a custom registry here
+    would serve a /metrics with none of the serving SLO series."""
+    routes = telemetry_routes()
+
+    def infer(query, body):
+        req = json.loads(body or b"{}")
+        if "dense" in req or "sparse" in req:
+            pred = engine.infer_ctr(req["dense"], req["sparse"])
+            return json.dumps({"pred": [float(p) for p in pred]}).encode()
+        handle = engine.submit(
+            req["prompt"], int(req.get("max_new_tokens", 16)),
+            deadline_s=req.get("deadline_s"))
+        # `or`: a JSON null (or 0) timeout_s must not disable the timeout
+        # and hang this handler thread forever
+        if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
+            return (json.dumps({"request_id": handle.request_id,
+                                "status": "pending"}).encode(),
+                    "application/json", 504)
+        status = {"completed": 200, "rejected": 429,
+                  "expired": 504, "evicted": 503}[handle.status]
+        return (json.dumps({
+            "request_id": handle.request_id,
+            "status": handle.status,
+            "tokens": handle.tokens,
+            "ttft_s": handle.ttft_s,
+            "latency_s": handle.latency_s,
+        }).encode(), "application/json", status)
+
+    routes.add("POST", "/infer", infer)
+    routes.add("GET", "/stats",
+               lambda q, b: json.dumps(engine.stats()).encode())
+    return routes
+
+
+class ServingServer(RoutedHTTPServer):
+    """HTTP front end over a :class:`~hetu_tpu.serve.engine.ServingEngine`
+    (which should be :meth:`~hetu_tpu.serve.engine.ServingEngine.start`-ed
+    so its scheduler loop drains the queue)."""
+
+    def __init__(self, engine, port: int = 0, host: str = "127.0.0.1"):
+        super().__init__(serving_routes(engine), port, host,
+                         thread_name="hetu-serve-http")
+        self.engine = engine
+
+
+def serve_engine(engine, port: int = 0,
+                 host: str = "127.0.0.1") -> ServingServer:
+    """Start the engine's scheduler thread and an HTTP front end for it;
+    returns the started server (``.port`` has the bound port; ``stop()``
+    stops the HTTP thread — stop the engine separately)."""
+    engine.start()
+    srv = ServingServer(engine, port, host)
+    srv.start()
+    return srv

@@ -57,6 +57,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: runtime telemetry tests (hetu_tpu.obs registry/"
                    "tracing/journal/endpoint and the instrumented seams)")
+    config.addinivalue_line(
+        "markers", "serve: online-inference tests (hetu_tpu.serve KV-cache "
+                   "pool / continuous batcher / engine / endpoint and the "
+                   "incremental-decode seams)")
 
 
 @pytest.fixture
