@@ -16,6 +16,9 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
   reference src/hetu_cache + ps-lite)
 * ``hetu_tpu.obs``    — runtime telemetry: metrics registry, tracing
   spans, resilience event journal, /metrics endpoint
+* ``hetu_tpu.mem``    — memory planning: jaxpr live-range estimator,
+  named remat-policy registry, (policy, microbatch) planner, host
+  offload (reference src/memory_pool/ BFC allocator + swap)
 * ``hetu_tpu.serve``  — online inference: paged KV cache, continuous
   batching engine, /infer endpoint (imported lazily — serving pulls in
   models)
@@ -27,7 +30,7 @@ reference mounted at /root/reference) designed around JAX/XLA/Pallas/pjit:
 
 __version__ = "1.0.0"
 
-from hetu_tpu import core, init, obs, ops, optim
+from hetu_tpu import core, init, mem, obs, ops, optim
 from hetu_tpu.core import (
     Module,
     Policy,

@@ -49,16 +49,19 @@ __all__ = [
 ]
 
 
-def maybe_remat(call, remat: bool):
-    """``call(block, x, key) -> x'`` wrapped in ``jax.checkpoint`` when
-    ``remat`` — the one place the per-block rematerialization policy
-    lives (BertConfig/GPTConfig/T5Config ``remat=True``): exact numerics,
-    activations recomputed in the backward instead of saved.  A future
-    checkpoint policy (e.g. ``jax.checkpoint_policies.save_only_these``)
-    changes here, not in every model."""
-    import jax
+def maybe_remat(call, remat):
+    """``call(block, x, key) -> x'`` wrapped under a named remat policy —
+    the one place per-block rematerialization lives (BertConfig/GPTConfig/
+    T5Config ``remat='full'``): exact numerics, the policy decides which
+    activations are recomputed in the backward instead of saved.
 
-    return jax.checkpoint(call) if remat else call
+    ``remat`` is a policy name from the :mod:`hetu_tpu.mem.policy`
+    registry ('none', 'full', 'dots_saveable', 'offload_dots', ...), a
+    raw ``jax.checkpoint`` policy callable, or — deprecated — a boolean
+    (``True`` -> 'full', ``False`` -> 'none')."""
+    from hetu_tpu.mem.policy import apply_policy
+
+    return apply_policy(call, remat)
 
 
 def is_array(x: Any) -> bool:

@@ -132,10 +132,21 @@ class Trainer:
     """
 
     def __init__(self, model: Module, optimizer: Optimizer,
-                 loss_fn: Callable, *, strategy=None, donate: bool = True):
+                 loss_fn: Callable, *, strategy=None, donate: bool = True,
+                 memory_plan=None):
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.strategy = strategy
+        # mem.planner.MemoryPlan (or None): the planner's (policy,
+        # microbatch) decision this trainer is expected to run under.
+        # Stored for audit and published to the metrics registry so
+        # /metrics shows planned-vs-actual peak bytes side by side; the
+        # policy itself lives in the model config (maybe_remat reads it).
+        self.memory_plan = memory_plan
+        if memory_plan is not None and _obs.enabled():
+            from hetu_tpu.mem.estimator import record_memory_gauges
+            record_memory_gauges(
+                predicted=memory_plan.predicted_peak_bytes)
         # Recorded so wrappers (exec.resilience) can tell whether the
         # pre-step state survives the jitted call; strategies always jit
         # with donation (strategies.py install).
@@ -339,12 +350,24 @@ class Trainer:
 
     def profile(self, batch, key=None, iters: int = 10) -> dict:
         """Wall-time + cost profile of one train step on the given batch
-        (reference executor.profile, executor.py:501)."""
+        (reference executor.profile, executor.py:501).  Includes the
+        compiled step's ``memory_analysis()`` byte sizes
+        (``argument_bytes``/``output_bytes``/``temp_bytes``) and — with
+        telemetry enabled — publishes them as ``hetu_mem_xla_*`` gauges
+        on /metrics, next to the planner's predicted peak."""
         from hetu_tpu.exec.profiler import profile_fn
         if key is None:
             key = next_key()
-        return profile_fn(self._train_step, self._state, batch, key,
+        prof = profile_fn(self._train_step, self._state, batch, key,
                           iters=iters)
+        if self.memory_plan is not None:
+            prof["memory_plan"] = self.memory_plan.describe()
+            prof["predicted_peak_bytes"] = \
+                self.memory_plan.predicted_peak_bytes
+        if _obs.enabled() and prof.get("temp_bytes") is not None:
+            from hetu_tpu.mem.estimator import record_memory_gauges
+            record_memory_gauges(xla=prof)
+        return prof
 
 
 class Executor:

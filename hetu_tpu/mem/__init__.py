@@ -1,0 +1,49 @@
+"""Memory planning: live-range estimation, remat policies, host offload.
+
+The capability the reference devotes a runtime layer to (BFC allocator +
+swap-to-host in src/memory_pool/, memory-constrained Galvatron search)
+rebuilt for the XLA runtime, where the allocator is the compiler's and
+the controllable surface is *what the backward saves*:
+
+- :mod:`~hetu_tpu.mem.estimator` — jaxpr live-range simulation predicting
+  a step's peak temp bytes without compiling, cross-checked against
+  ``compiled.memory_analysis()``;
+- :mod:`~hetu_tpu.mem.policy` — named remat-policy registry ('none',
+  'full', 'dots_saveable', 'offload_dots', ...) replacing the boolean
+  ``remat`` flag on model configs and pipeline stages; every policy is
+  numerically exact (``jax.checkpoint``);
+- :mod:`~hetu_tpu.mem.planner` — deterministic search for the cheapest
+  (policy, microbatch) pair whose predicted peak fits a per-device HBM
+  budget; the same policy vocabulary feeds the Galvatron search's memory
+  cost model (``parallel/autoparallel``) so OOM configs are pruned or
+  rescued by remat instead of scoring as "fast";
+- :mod:`~hetu_tpu.mem.offload` — optimizer-state / activation host
+  offload via XLA memory kinds, with a CPU-safe fallback.
+
+Predicted and XLA-reported peak bytes are published as ``hetu_mem_*``
+gauges on ``/metrics`` (``obs``).
+"""
+
+from hetu_tpu.mem.estimator import (MemoryEstimate, cross_check,
+                                    estimate_peak_bytes,
+                                    estimate_train_peak,
+                                    record_memory_gauges)
+from hetu_tpu.mem.offload import (host_memory_kind, offload_optimizer_state,
+                                  offload_to_host, restore_to_device,
+                                  supports_host_offload)
+from hetu_tpu.mem.planner import CandidateEval, MemoryPlan, plan_memory
+from hetu_tpu.mem.policy import (RematPolicy, apply_policy,
+                                 available_policies, get_policy,
+                                 normalize_remat, normalize_remat_field,
+                                 policy_names, register_policy)
+
+__all__ = [
+    "MemoryEstimate", "estimate_peak_bytes", "estimate_train_peak",
+    "cross_check", "record_memory_gauges",
+    "RematPolicy", "register_policy", "get_policy", "policy_names",
+    "available_policies", "normalize_remat", "normalize_remat_field",
+    "apply_policy",
+    "MemoryPlan", "CandidateEval", "plan_memory",
+    "supports_host_offload", "host_memory_kind", "offload_to_host",
+    "restore_to_device", "offload_optimizer_state",
+]

@@ -1,0 +1,95 @@
+"""Host-offload helpers: optimizer state and activation residuals in host
+memory via XLA memory kinds.
+
+The reference swaps oversized tensors to host through its BFC allocator's
+swap path (src/memory_pool/); on TPU the same capability is ``jax.device_put``
+with a ``TransferToMemoryKind('pinned_host')`` sharding — XLA then stages
+the transfer.  Every helper here degrades safely on backends without a
+host memory space (the CPU test mesh): the tree is returned unchanged and
+``supports_host_offload()`` reports False, so callers — and the
+``offload_dots`` remat policy — can gate on capability instead of
+platform strings.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+__all__ = [
+    "supports_host_offload", "host_memory_kind", "offload_to_host",
+    "restore_to_device", "offload_optimizer_state",
+]
+
+
+@functools.lru_cache(maxsize=None)
+def _memory_kinds() -> tuple:
+    import jax
+
+    try:
+        dev = jax.local_devices()[0]
+        return tuple(m.kind for m in dev.addressable_memories())
+    except Exception:
+        return ()
+
+
+def supports_host_offload() -> bool:
+    """True when the default backend exposes a ``pinned_host`` memory
+    space (the kind jax.checkpoint offload policies require)."""
+    return "pinned_host" in _memory_kinds()
+
+
+def host_memory_kind() -> str | None:
+    """Best available host memory kind (``pinned_host`` preferred,
+    ``unpinned_host`` accepted), or None when the backend has neither."""
+    kinds = _memory_kinds()
+    for k in ("pinned_host", "unpinned_host"):
+        if k in kinds:
+            return k
+    return None
+
+
+def _transfer(tree: Any, kind: str | None) -> Any:
+    import jax
+
+    if kind is None:
+        return tree
+
+    def move(x):
+        if not isinstance(x, jax.Array):
+            return x
+        try:
+            return jax.device_put(
+                x, jax.sharding.TransferToMemoryKind(kind))
+        except Exception:
+            return x  # backend refused the kind: keep the array in place
+
+    return jax.tree_util.tree_map(move, tree)
+
+
+def offload_to_host(tree: Any) -> Any:
+    """Every jax array leaf moved to host memory (no-op tree passthrough
+    on backends without a host memory space)."""
+    return _transfer(tree, host_memory_kind())
+
+
+def restore_to_device(tree: Any) -> Any:
+    """Inverse of :func:`offload_to_host`: leaves moved back to the
+    default device memory space."""
+    import jax
+
+    kinds = _memory_kinds()
+    if not kinds:
+        return tree
+    # 'device' is the default space name on TPU/GPU; CPU backends name
+    # their default space unpinned_host
+    kind = "device" if "device" in kinds else kinds[0]
+    return _transfer(tree, kind)
+
+
+def offload_optimizer_state(opt_state: Any) -> Any:
+    """Optimizer-state host offload (Adam m/v + master weights are 6x the
+    bf16 params — the reference's swap-to-host case).  The state must be
+    restored (or re-fetched by XLA on use) before the next update; with
+    donation the transfer overlaps the step."""
+    return offload_to_host(opt_state)

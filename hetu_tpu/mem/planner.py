@@ -1,0 +1,122 @@
+"""Deterministic memory planner: pick the cheapest (remat policy,
+microbatch) pair whose predicted peak fits the per-device HBM budget.
+
+The Galvatron searcher prunes parallelism strategies that exceed HBM
+(reference tools/Galvatron cost_model.py); Checkmate frames the remaining
+freedom — *what to save per layer* — as an optimization problem.  This
+planner is the executable version of both for the jit runtime: it
+enumerates the registered remat policies x candidate microbatch sizes,
+predicts each pair's device peak with the jaxpr live-range estimator
+(:mod:`hetu_tpu.mem.estimator`), and returns the pair with the least
+recompute overhead (preferring larger microbatches — fewer steps per
+batch) whose prediction fits the budget.
+
+Everything is deterministic: candidates are enumerated in sorted order,
+the estimator is a pure jaxpr walk, and ``MemoryPlan.to_json()``
+serializes with sorted keys — the same (config, mesh, budget) input
+yields a byte-identical plan across runs (tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Optional, Sequence
+
+from hetu_tpu.mem.estimator import estimate_train_peak, record_memory_gauges
+from hetu_tpu.mem.policy import get_policy, policy_names
+
+__all__ = ["CandidateEval", "MemoryPlan", "plan_memory"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CandidateEval:
+    """One evaluated (policy, microbatch) point."""
+
+    policy: str
+    microbatch: int
+    predicted_peak_bytes: int
+    recompute_factor: float
+    fits: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """The planner's decision, with the full candidate table for audit."""
+
+    policy: str
+    microbatch: int
+    predicted_peak_bytes: int
+    budget_bytes: int
+    fits: bool
+    candidates: tuple = ()
+
+    def describe(self) -> str:
+        verdict = "fits" if self.fits else "OVER BUDGET"
+        return (f"policy={self.policy} microbatch={self.microbatch} "
+                f"predicted={self.predicted_peak_bytes / 1e6:.1f}MB "
+                f"budget={self.budget_bytes / 1e6:.1f}MB ({verdict})")
+
+    def to_json(self) -> str:
+        """Canonical serialization: sorted keys, integral bytes — byte-
+        identical across runs for identical inputs."""
+        return json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":"))
+
+
+def plan_memory(loss_fn: Callable, model_builder: Callable,
+                batch_builder: Callable, budget_bytes: float, *,
+                policies: Optional[Sequence[str]] = None,
+                microbatch_options: Sequence[int] = (1,),
+                ) -> MemoryPlan:
+    """Search (policy, microbatch) for the cheapest pair under budget.
+
+    ``model_builder(policy_name) -> model`` builds the model with that
+    remat policy (e.g. ``lambda p: GPT(dataclasses.replace(cfg,
+    remat=p))``); ``batch_builder(microbatch) -> batch`` builds an
+    example batch of that size; ``loss_fn(model, batch) -> scalar`` is
+    the training loss.  Prediction covers the full
+    ``value_and_grad(loss_fn)`` step (params + grads + residuals +
+    transients).
+
+    Cost order: larger microbatch beats smaller (fewer accumulation
+    steps), then lower recompute factor, then policy name — so 'none'
+    wins whenever it fits, and heavier recompute is bought only when the
+    budget demands it.  Returns the minimum-memory candidate flagged
+    ``fits=False`` when nothing fits.
+    """
+    names = list(policies) if policies is not None else list(policy_names())
+    for n in names:
+        get_policy(n)  # validate up front, with the registered names
+    micros = sorted(set(int(m) for m in microbatch_options))
+    if not micros or micros[0] < 1:
+        raise ValueError(f"microbatch_options must be >= 1: {micros}")
+
+    # one example batch per microbatch size — batch construction may load
+    # real data, only the per-(policy, mb) trace is inherent to the grid
+    batches = {mb: batch_builder(mb) for mb in micros}
+    evals = []
+    for policy in sorted(names):
+        model = model_builder(policy)
+        # cost_knobs: the recompute factor of the policy the backend
+        # actually executes (offload policies degrade off-host)
+        rc = get_policy(policy).cost_knobs()[1]
+        for mb in micros:
+            est = estimate_train_peak(loss_fn, model, batches[mb])
+            peak = est.device_peak_bytes
+            evals.append(CandidateEval(policy, mb, int(peak), rc,
+                                       peak <= budget_bytes))
+
+    # deterministic preference: biggest microbatch, least recompute, name
+    ranked = sorted(evals, key=lambda e: (-e.microbatch,
+                                          e.recompute_factor, e.policy))
+    chosen = next((e for e in ranked if e.fits), None)
+    if chosen is None:  # nothing fits: surface the min-memory point
+        chosen = min(evals, key=lambda e: (e.predicted_peak_bytes,
+                                           -e.microbatch, e.policy))
+    plan = MemoryPlan(chosen.policy, chosen.microbatch,
+                      chosen.predicted_peak_bytes, int(budget_bytes),
+                      chosen.fits, tuple(sorted(
+                          evals, key=lambda e: (e.policy, e.microbatch))))
+    record_memory_gauges(predicted=plan.predicted_peak_bytes)
+    return plan

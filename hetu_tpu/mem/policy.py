@@ -1,0 +1,214 @@
+"""Named rematerialization-policy registry.
+
+The reference runtime treats memory as a first-class runtime layer (BFC
+allocator in src/memory_pool/, swap-to-host for oversized tensors); its
+Galvatron searcher treats per-device memory as a hard constraint.  On TPU
+the allocator is XLA's, so the controllable surface is *what the backward
+saves*: ``jax.checkpoint`` policies.  This module replaces the blind
+``remat: bool`` switch with a registry of named policies — each carrying
+the two numbers the analytic cost model needs (fraction of per-layer
+activations still resident, extra forward fraction recomputed in the
+backward) — so model configs, ``Pipelined`` stages, and the Galvatron
+search all speak the same policy vocabulary.
+
+Every policy is *exact*: ``jax.checkpoint`` replays the forward with the
+same primitives, so loss and gradients are bitwise-identical across all
+registered policies (tested in tests/test_mem.py).
+
+Offload policies store residuals in host memory via XLA memory kinds;
+on backends without a ``pinned_host`` memory space (CPU) they fall back
+to their on-device equivalent, so programs stay portable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Callable, Optional
+
+__all__ = [
+    "RematPolicy", "register_policy", "get_policy", "policy_names",
+    "available_policies", "normalize_remat", "normalize_remat_field",
+    "apply_policy",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class RematPolicy:
+    """One named remat policy.
+
+    ``jax_policy``: the ``jax.checkpoint`` policy callable (None for the
+    two degenerate cases: identity for 'none', default-save-nothing for
+    'full').  ``activation_fraction``/``recompute_factor`` are the
+    analytic cost-model knobs: fraction of a layer's saved-activation
+    bytes still resident on device, and extra forward fraction recomputed
+    in the backward (0 = none, 1 = the whole forward again).
+    """
+
+    name: str
+    activation_fraction: float
+    recompute_factor: float
+    doc: str = ""
+    # lazily resolved: () -> Optional[jax policy callable]; lazy because
+    # offload policies must probe the backend's memory kinds first
+    _resolve: Optional[Callable] = None
+    identity: bool = False
+    # policy this one silently degrades to on backends without host
+    # offload — the analytic cost knobs must degrade with it, or the
+    # Galvatron search would mark plans feasible at the optimistic
+    # offload numbers while the runtime executes the fallback
+    fallback: Optional[str] = None
+
+    def cost_knobs(self) -> tuple:
+        """(activation_fraction, recompute_factor) as the CURRENT backend
+        will actually execute this policy — the fallback's numbers when
+        host offload is required but unavailable."""
+        if self.fallback is not None:
+            from hetu_tpu.mem.offload import supports_host_offload
+            if not supports_host_offload():
+                return get_policy(self.fallback).cost_knobs()
+        return (self.activation_fraction, self.recompute_factor)
+
+    def wrap(self, call: Callable) -> Callable:
+        """``call`` wrapped under this policy (identity for 'none')."""
+        import jax
+
+        if self.identity:
+            return call
+        pol = self._resolve() if self._resolve is not None else None
+        if pol is None:
+            return jax.checkpoint(call)
+        return jax.checkpoint(call, policy=pol)
+
+
+_REGISTRY: dict[str, RematPolicy] = {}
+
+
+def register_policy(name: str, *, activation_fraction: float,
+                    recompute_factor: float, resolve: Optional[Callable] = None,
+                    identity: bool = False, doc: str = "",
+                    fallback: Optional[str] = None) -> RematPolicy:
+    """Register (or replace) a named policy.  ``resolve`` is a zero-arg
+    callable returning the ``jax.checkpoint`` policy (or None for the
+    save-nothing default); called at wrap time so backend probes (host
+    offload support) happen late.  ``fallback`` names the policy this
+    one degrades to on backends without host offload (its cost knobs
+    degrade too — see :meth:`RematPolicy.cost_knobs`)."""
+    pol = RematPolicy(name, float(activation_fraction),
+                      float(recompute_factor), doc, resolve, identity,
+                      fallback)
+    _REGISTRY[name] = pol
+    return pol
+
+
+def get_policy(name: str) -> RematPolicy:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat policy {name!r}; registered: {policy_names()}"
+        ) from None
+
+
+def policy_names() -> tuple:
+    """Registered policy names, sorted — the planner's deterministic
+    candidate order."""
+    return tuple(sorted(_REGISTRY))
+
+
+def available_policies() -> dict:
+    """name -> RematPolicy snapshot of the registry."""
+    return dict(_REGISTRY)
+
+
+def normalize_remat(value, *, warn: bool = True) -> str:
+    """Canonicalize a config's ``remat`` field to a policy name.
+
+    Accepts the legacy boolean form (``True`` -> ``"full"``, ``False`` ->
+    ``"none"``; deprecation-warned), ``None`` (-> ``"none"``), or a
+    registered policy name (validated).  Callables (raw ``jax.checkpoint``
+    policies) pass through untouched for power users.
+    """
+    if value is None:
+        return "none"
+    if isinstance(value, bool):
+        if warn:
+            warnings.warn(
+                "boolean `remat` is deprecated: use a policy name "
+                f"(True -> 'full', False -> 'none'; registered: "
+                f"{policy_names()})", DeprecationWarning, stacklevel=3)
+        return "full" if value else "none"
+    if isinstance(value, str):
+        get_policy(value)  # validate, raising with the known names
+        return value
+    if callable(value):
+        return value
+    raise TypeError(f"remat must be a policy name, bool, None, or a "
+                    f"jax.checkpoint policy callable; got {type(value)}")
+
+
+def normalize_remat_field(cfg) -> None:
+    """``__post_init__`` helper shared by the frozen model-config
+    dataclasses (GPT/BERT/T5/ViT/Swin/MoELM): canonicalize ``cfg.remat``
+    in place so an unknown policy fails at construction, not trace
+    time."""
+    object.__setattr__(cfg, "remat", normalize_remat(cfg.remat))
+
+
+def apply_policy(call: Callable, policy) -> Callable:
+    """``call`` wrapped under ``policy`` — a registered name, legacy bool,
+    None, or a raw ``jax.checkpoint`` policy callable."""
+    import jax
+
+    policy = normalize_remat(policy)
+    if callable(policy):
+        return jax.checkpoint(call, policy=policy)
+    return get_policy(policy).wrap(call)
+
+
+# ---------------------------------------------------------------------------
+# Built-in policies
+# ---------------------------------------------------------------------------
+
+def _jax_policies():
+    import jax
+    return jax.checkpoint_policies
+
+
+def _offload_dots_policy():
+    """Residual dots offloaded to host memory; falls back to the on-device
+    equivalent on backends without a pinned_host memory space (CPU)."""
+    from hetu_tpu.mem.offload import supports_host_offload
+    cp = _jax_policies()
+    if supports_host_offload():
+        return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    return cp.dots_with_no_batch_dims_saveable
+
+
+register_policy(
+    "none", activation_fraction=1.0, recompute_factor=0.0, identity=True,
+    doc="save every activation (no checkpoint); fastest backward, "
+        "O(layers x seq x hidden) activation memory")
+register_policy(
+    "full", activation_fraction=0.08, recompute_factor=1.0,
+    doc="jax.checkpoint default: save only block inputs, recompute the "
+        "whole block forward in the backward (~1/3 more step FLOPs)")
+register_policy(
+    "save_nothing", activation_fraction=0.08, recompute_factor=1.0,
+    resolve=lambda: _jax_policies().nothing_saveable,
+    doc="explicit nothing_saveable policy — same trade as 'full'")
+register_policy(
+    "dots_saveable", activation_fraction=0.55, recompute_factor=0.45,
+    resolve=lambda: _jax_policies().dots_saveable,
+    doc="save matmul outputs, recompute elementwise chains — the cheap "
+        "middle ground (Checkmate's save-the-expensive-ops heuristic)")
+register_policy(
+    "dots_no_batch", activation_fraction=0.35, recompute_factor=0.6,
+    resolve=lambda: _jax_policies().dots_with_no_batch_dims_saveable,
+    doc="save only batch-free matmuls (weight-stationary contractions); "
+        "activation-shaped dots are recomputed")
+register_policy(
+    "offload_dots", activation_fraction=0.10, recompute_factor=0.6,
+    resolve=_offload_dots_policy, fallback="dots_no_batch",
+    doc="batch-free matmul residuals offloaded to pinned host memory "
+        "(jax memory kinds); on-device dots_no_batch fallback on CPU")

@@ -56,12 +56,18 @@ class BertConfig:
     # HBM pass per direction; see ops/pallas/fused_ln.py).  Off by
     # default: measured per-config on TPU before enabling in a bench
     fused_ln: bool = False
-    # rematerialize each transformer block in the backward
-    # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) activation
-    # memory — the knob that lifts the seq-512 batch cap (24 -> 48 on
-    # 16 GB; numerically exact, tested).  Off by default; bench probes it
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry:
+    # 'none', 'full', 'dots_saveable', 'offload_dots', ...): numerically
+    # exact, the policy picks what the backward saves — the knob that
+    # lifts the seq-512 batch cap (24 -> 48 on 16 GB with 'full'; bench
+    # probes it).  Legacy booleans still work (True -> 'full'),
+    # deprecation-warned.
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
 
 def bert_base(**kw) -> BertConfig:

@@ -35,11 +35,17 @@ class GPTConfig:
     # / very long sequences (ops.lm_head_cross_entropy; where the logits
     # fit, the default materialized path is faster)
     streamed_head_chunk: int = 0
-    # rematerialize each block in the backward (jax.checkpoint): exact
-    # numerics, ~1/3 more backward FLOPs for O(layers) activation memory
-    # (the long-context batch-cap knob; same as BertConfig.remat)
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry:
+    # 'none', 'full', 'dots_saveable', 'offload_dots', ...): exact
+    # numerics, the policy picks what the backward saves — the
+    # long-context batch-cap knob (same as BertConfig.remat).  Legacy
+    # booleans still work (True -> 'full'), deprecation-warned.
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
 
 def gpt2_small(**kw):

@@ -44,10 +44,15 @@ class MoELMConfig:
     # the Trainer/Logger pick them up — the numbers that catch silent
     # router collapse or capacity starvation (layers.moe.routing_stats)
     log_routing_stats: bool = False
-    # per-block rematerialization (core.module.maybe_remat): exact
-    # numerics; recomputes the expert dispatch in the backward
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry):
+    # exact numerics; the backward recomputes what the policy drops,
+    # including the expert dispatch.  Legacy booleans deprecation-warned.
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
 
 class MoEBlock(Module):

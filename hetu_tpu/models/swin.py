@@ -40,9 +40,14 @@ class SwinConfig:
     window_size: int = 7
     mlp_ratio: int = 4
     num_classes: int = 1000
-    # per-block rematerialization (core.module.maybe_remat)
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry;
+    # legacy booleans deprecation-warned)
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
 
 def swin_tiny(**kw) -> SwinConfig:

@@ -40,10 +40,14 @@ class T5Config:
     relative_buckets: int = 32
     relative_max_distance: int = 128
     dropout_rate: float = 0.1
-    # rematerialize each block in the backward (jax.checkpoint): exact
-    # numerics, activation memory O(layers) (same knob as BertConfig.remat)
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry;
+    # same knob as BertConfig.remat).  Legacy booleans deprecation-warned.
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
 
 def t5_small(**kw) -> T5Config:

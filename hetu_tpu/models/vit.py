@@ -34,10 +34,15 @@ class ViTConfig:
     mlp_ratio: int = 4
     num_classes: int = 1000
     dropout_rate: float = 0.0
-    # per-block rematerialization (core.module.maybe_remat): exact
-    # numerics, O(layers) activation memory
-    remat: bool = False
+    # per-block rematerialization policy (hetu_tpu.mem.policy registry):
+    # exact numerics, O(layers) activation memory under 'full'.  Legacy
+    # booleans deprecation-warned.
+    remat: object = "none"
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        from hetu_tpu.mem.policy import normalize_remat_field
+        normalize_remat_field(self)
 
     @property
     def num_patches(self) -> int:

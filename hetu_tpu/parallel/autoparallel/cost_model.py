@@ -6,12 +6,21 @@ activations / optimizer states per strategy, and
 communication with DP-overlap discounting.  Same accounting here, in terms
 of TPU quantities: bf16 weights + f32 master/Adam moments, per-axis ICI
 bandwidths, MXU peak flops.
+
+Both models additionally understand the named remat policies of
+:mod:`hetu_tpu.mem.policy`: a policy scales the resident activation bytes
+by its ``activation_fraction`` and the compute by its
+``recompute_factor`` — so the searcher can price "this config OOMs at
+'none' but fits (30% slower) under 'full'" instead of scoring OOM
+configs as fast.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
+
+from hetu_tpu.mem.policy import get_policy
 
 __all__ = [
     "ClusterSpec", "LayerSpec", "ParallelChoice", "MemoryCostModel",
@@ -107,7 +116,8 @@ class MemoryCostModel:
         self.cluster = cluster
 
     def layer_bytes(self, layer: LayerSpec, choice: ParallelChoice,
-                    batch_per_replica: int, n_microbatches: int = 1) -> float:
+                    batch_per_replica: int, n_microbatches: int = 1,
+                    remat_policy: str = "none") -> float:
         tp_split = choice.tp * layer.tp_shardable + (1 - layer.tp_shardable)
         p = layer.params / tp_split
         weights = p * self.BYTES_WEIGHT
@@ -118,6 +128,10 @@ class MemoryCostModel:
             grads /= choice.dp
         micro_batch = math.ceil(batch_per_replica / n_microbatches)
         acts = (layer.activation_per_sample * micro_batch / choice.tp)
+        # cost_knobs, not the raw fields: offload policies degrade to
+        # their on-device fallback (and its residency) on backends
+        # without host offload
+        acts *= get_policy(remat_policy).cost_knobs()[0]
         return weights + state + grads + acts
 
 
@@ -133,10 +147,13 @@ class TimeCostModel:
         self.dp_overlap = dp_overlap
 
     def layer_time(self, layer: LayerSpec, choice: ParallelChoice,
-                   batch_per_replica: int) -> float:
+                   batch_per_replica: int, remat_policy: str = "none") -> float:
         c = self.cluster
-        # fwd + bwd = 3x fwd flops, spread over tp
-        compute = 3 * layer.flops_per_sample * batch_per_replica \
+        # fwd + bwd = 3x fwd flops, spread over tp; a remat policy replays
+        # its recompute_factor of the forward in the backward (cost_knobs:
+        # the factor of the policy the backend actually executes)
+        flops_factor = 3 + get_policy(remat_policy).cost_knobs()[1]
+        compute = flops_factor * layer.flops_per_sample * batch_per_replica \
             / choice.tp / (c.peak_flops * self.mfu)
         tp_comm = 3 * layer.tp_comm_per_sample * batch_per_replica
         tp_time = c.allreduce_time(tp_comm, choice.tp)

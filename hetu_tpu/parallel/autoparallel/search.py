@@ -41,6 +41,10 @@ class Plan:
     # interleaved virtual stages per device (pipedream schedule; the
     # runtime knob is pipedream_grads(virtual_stages=V))
     virtual_stages: int = 1
+    # named remat policy the memory/time accounting assumed
+    # (hetu_tpu.mem.policy registry; the runtime knob is the model
+    # config's `remat` field)
+    remat_policy: str = "none"
 
     @property
     def dominant(self) -> ParallelChoice:
@@ -51,7 +55,9 @@ class Plan:
     def describe(self) -> str:
         d = self.dominant
         v = f" V={self.virtual_stages}" if self.virtual_stages > 1 else ""
-        return (f"pp={self.pp} micro={self.n_microbatches}{v} {d} "
+        r = (f" remat={self.remat_policy}"
+             if self.remat_policy != "none" else "")
+        return (f"pp={self.pp} micro={self.n_microbatches}{v} {d}{r} "
                 f"time={self.time * 1e3:.2f}ms "
                 f"mem={self.peak_bytes / 1e9:.2f}GB")
 
@@ -77,7 +83,8 @@ def _stage_layers(n_layers: int, pp: int) -> list[int]:
 def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
               pp: int, n_micro: int, global_batch: int,
               cluster: ClusterSpec, mem_model: MemoryCostModel,
-              time_model: TimeCostModel) -> tuple[float, float]:
+              time_model: TimeCostModel,
+              remat_policy: str = "none") -> tuple[float, float]:
     """(step_time, peak_stage_bytes) for a per-layer assignment."""
     counts = _stage_layers(len(layers), pp)
     idx = 0
@@ -88,8 +95,9 @@ def _evaluate(layers: Sequence[LayerSpec], choices: Sequence[ParallelChoice],
         for li in range(idx, idx + cnt):
             ch = choices[li]
             bpr = math.ceil(global_batch / ch.dp)
-            t += time_model.layer_time(layers[li], ch, bpr)
-            m += mem_model.layer_bytes(layers[li], ch, bpr, n_micro)
+            t += time_model.layer_time(layers[li], ch, bpr, remat_policy)
+            m += mem_model.layer_bytes(layers[li], ch, bpr, n_micro,
+                                       remat_policy)
             if li + 1 == idx + cnt and stage + 1 < pp:
                 # this boundary's output tensor crosses once per microbatch
                 # in each direction (GPipe critical path, no async overlap)
@@ -112,7 +120,8 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
               global_batch: int, *, mem_model: MemoryCostModel | None = None,
               time_model: TimeCostModel | None = None,
               microbatch_options: Sequence[int] = (1, 2, 4, 8),
-              uniform: bool = False, max_pp: int | None = None) -> Plan:
+              uniform: bool = False, max_pp: int | None = None,
+              remat_policies: Sequence[str] = ("none",)) -> Plan:
     """Search pp_deg x per-layer choices; returns the fastest feasible plan.
 
     With ``uniform=False`` a dynamic program picks each layer's choice
@@ -121,7 +130,17 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     adds and time adds within a stage, greedy-per-layer minimization under
     the budget is exact for uniform stages; feasibility is re-checked on the
     assembled plan.
+
+    ``remat_policies`` widens the search over named remat policies
+    (hetu_tpu.mem.policy): each policy scales activation memory by its
+    ``activation_fraction`` and compute by its ``recompute_factor``, so a
+    config that OOMs at 'none' can be *rescued* by e.g. 'full' instead of
+    being discarded — the searcher then weighs the recompute slowdown
+    against alternative parallelism.  Default ('none',) keeps the legacy
+    behavior.
     """
+    if not remat_policies:
+        raise ValueError("remat_policies must name at least one policy")
     mem_model = mem_model or MemoryCostModel(cluster)
     time_model = time_model or TimeCostModel(cluster)
     best: Optional[Plan] = None
@@ -139,43 +158,53 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
         for n_micro in microbatch_options:
             if pp == 1 and n_micro > 1:
                 continue
-            if uniform:
-                assignments = [[c] * len(layers) for c in cands]
-            else:
-                # per-layer: pick the fastest choice that fits a pro-rata
-                # memory slice; fall back to min-memory choice
-                budget = cluster.hbm_bytes
-                counts = _stage_layers(len(layers), pp)
-                per_layer_budget = [budget / counts[s]
-                                    for s in range(pp) for _ in range(counts[s])]
-                chosen = []
-                for li, layer in enumerate(layers):
-                    def key(c):
-                        bpr = math.ceil(global_batch / c.dp)
-                        return time_model.layer_time(layer, c, bpr)
-                    fits = [c for c in cands
-                            if mem_model.layer_bytes(
-                                layer, c, math.ceil(global_batch / c.dp),
-                                n_micro) <= per_layer_budget[li]]
-                    pool = fits or cands
-                    chosen.append(min(pool, key=key))
-                assignments = [chosen]
-            for choices in assignments:
-                t, m = _evaluate(layers, choices, pp, n_micro, global_batch,
-                                 cluster, mem_model, time_model)
-                plan = Plan(pp, n_micro, list(choices), t, m,
-                            m <= cluster.hbm_bytes)
-                if plan.feasible and (best is None or t < best.time):
-                    best = plan
+            for policy in remat_policies:
+                if uniform:
+                    assignments = [[c] * len(layers) for c in cands]
+                else:
+                    # per-layer: pick the fastest choice that fits a
+                    # pro-rata memory slice; fall back to min-memory choice
+                    budget = cluster.hbm_bytes
+                    counts = _stage_layers(len(layers), pp)
+                    per_layer_budget = [budget / counts[s]
+                                        for s in range(pp)
+                                        for _ in range(counts[s])]
+                    chosen = []
+                    for li, layer in enumerate(layers):
+                        def key(c):
+                            bpr = math.ceil(global_batch / c.dp)
+                            return time_model.layer_time(layer, c, bpr,
+                                                         policy)
+                        fits = [c for c in cands
+                                if mem_model.layer_bytes(
+                                    layer, c, math.ceil(global_batch / c.dp),
+                                    n_micro, policy) <= per_layer_budget[li]]
+                        pool = fits or cands
+                        chosen.append(min(pool, key=key))
+                    assignments = [chosen]
+                for choices in assignments:
+                    t, m = _evaluate(layers, choices, pp, n_micro,
+                                     global_batch, cluster, mem_model,
+                                     time_model, policy)
+                    plan = Plan(pp, n_micro, list(choices), t, m,
+                                m <= cluster.hbm_bytes,
+                                remat_policy=policy)
+                    if plan.feasible and (best is None or t < best.time):
+                        best = plan
         pp *= 2
     if best is None:  # nothing fits: return min-memory plan, flagged
+        from hetu_tpu.mem.policy import get_policy
         pp = min(cluster.n_devices, len(layers))
         per_stage = max(cluster.n_devices // pp, 1)
         c = ParallelChoice(dp=1, tp=per_stage, zero=False)
         choices = [c] * len(layers)
+        # the genuinely most memory-saving candidate, not whichever the
+        # caller happened to list last
+        policy = min(remat_policies,
+                     key=lambda p: (get_policy(p).cost_knobs()[0], p))
         t, m = _evaluate(layers, choices, pp, 8, global_batch, cluster,
-                         mem_model, time_model)
-        best = Plan(pp, 8, choices, t, m, False)
+                         mem_model, time_model, policy)
+        best = Plan(pp, 8, choices, t, m, False, remat_policy=policy)
     return best
 
 

@@ -175,12 +175,17 @@ class Pipelined(Module):
     stacked into ``[n_layers, ...]`` leaves (annotated logical axis
     ``layers`` → rules map it to ``pp``), evenly striped across stages;
     within a stage the layers run under ``lax.scan`` (optionally
-    rematerialized — the memory/compute trade ``jax.checkpoint`` gives for
-    free where the reference relies on its memory planner).
+    rematerialized — ``remat`` names a policy from the
+    ``hetu_tpu.mem.policy`` registry ('full' by default, 'none' to save
+    everything, 'dots_saveable'/'offload_dots'/... for the intermediate
+    trades); legacy booleans are accepted and deprecation-warned.  The
+    memory/compute trade ``jax.checkpoint`` gives for free where the
+    reference relies on its memory planner.
     """
 
     def __init__(self, blocks, *, n_microbatches: int, mesh: Optional[Mesh] = None,
-                 axis: str = "pp", remat: bool = True):
+                 axis: str = "pp", remat="full"):
+        from hetu_tpu.mem.policy import normalize_remat
         n_stages = mesh.shape[axis] if mesh is not None else 1
         if len(blocks) % max(n_stages, 1):
             raise ValueError(
@@ -191,13 +196,13 @@ class Pipelined(Module):
         self.n_microbatches = n_microbatches
         self.axis = axis
         self.mesh = mesh
-        self.remat = remat
+        self.remat = normalize_remat(remat)
 
     def _block_apply(self, blk, h, mask, key, training):
+        from hetu_tpu.mem.policy import apply_policy
+
         fn = lambda b, v, m: b(v, m, key=key, training=training)
-        if self.remat:
-            fn = jax.checkpoint(fn)
-        return fn(blk, h, mask)
+        return apply_policy(fn, self.remat)(blk, h, mask)
 
     def __call__(self, x, mask=None, *, key=None, training: bool = False):
         mesh = self.mesh

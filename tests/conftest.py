@@ -61,6 +61,11 @@ def pytest_configure(config):
         "markers", "serve: online-inference tests (hetu_tpu.serve KV-cache "
                    "pool / continuous batcher / engine / endpoint and the "
                    "incremental-decode seams)")
+    config.addinivalue_line(
+        "markers", "mem: memory-planner tests (hetu_tpu.mem estimator / "
+                   "policy registry / planner / offload and the remat "
+                   "seams); full planner searches are additionally marked "
+                   "slow")
 
 
 @pytest.fixture
