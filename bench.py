@@ -536,6 +536,15 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric,
         device=kind, batch=t["batch"], seq=t["seq"], **_tinfo(t))
 
 
+# Ordered BEST-MEASURED-FIRST: when the soft deadline trips, _bert_mfu
+# degrades to variants[0] without probing, so the head of this list must
+# be the fastest variant a past round actually measured — the XLA bhsd
+# core (TPU_CHECKS_r04: 225 ms vs r03 flash's 274 at seq 512).  A round
+# that measures a new winner should rotate it to the front.
+BERT512_VARIANTS = [("xla", False), ("flash", False),
+                    ("xla", True), ("flash", True)]
+
+
 def bench_bert_long(on_tpu, kind, peak):
     # batch 24: 48 (token parity with the seq-128 headline) OOMs on 16 GB —
     # seq-512 MLP activation temps are 4x larger per token batch.
@@ -562,8 +571,7 @@ def bench_bert_long(on_tpu, kind, peak):
     # 16 GB); per-block remat may buy the doubled batch back at ~1/3 more
     # backward FLOPs — probed, decided by samples/sec
     return _bert_mfu(on_tpu, kind, peak, seq=512, batch=24, k=3,
-                     variants=[("flash", False), ("xla", False),
-                               ("flash", True), ("xla", True)],
+                     variants=BERT512_VARIANTS,
                      metric="bert_large_seq512_mfu", remat_batch=48)
 
 

@@ -18,4 +18,14 @@ from hetu_tpu.exec.resilience import (
     latest_good_checkpoint,
     list_checkpoints,
 )
-from hetu_tpu.exec import faults, metrics
+from hetu_tpu.exec.gang import (
+    ElasticGang,
+    GangCheckpointer,
+    GangError,
+    GangManifestError,
+    GangMembership,
+    gang_data_partition,
+    load_gang_checkpoint,
+    worker_rng_key,
+)
+from hetu_tpu.exec import faults, gang, metrics

@@ -36,7 +36,7 @@ from hetu_tpu.obs import registry as _obs
 
 __all__ = ["save_checkpoint", "load_checkpoint", "state_dict",
            "load_state_dict", "AsyncCheckpointer", "CheckpointError",
-           "CheckpointCorrupt"]
+           "CheckpointCorrupt", "read_footer_crc"]
 
 
 class CheckpointError(Exception):
@@ -113,19 +113,15 @@ def _make_payload(state: Any, extra: Optional[dict]) -> dict:
     }
 
 
-def _atomic_write(path: str, payload: dict) -> None:
+def _atomic_write_bytes(path: str, *chunks: bytes) -> None:
     """tmp-write + fsync + rename + directory fsync: a crash at any point
-    leaves either the old or the new checkpoint, never a torn one.  The
-    payload is followed by a CRC32 integrity footer so silent on-disk
-    corruption is detected at load time."""
-    t0 = time.perf_counter() if _obs.enabled() else None
-    buf = pickle.dumps(payload)
-    crc = zlib.crc32(buf) & 0xFFFFFFFF
-    footer = _FOOTER.pack(_FOOTER_MAGIC, crc)
+    leaves either the old or the new file, never a torn one.  Shared by
+    the pickle checkpoint writer and the gang manifest writer (chunks are
+    written back to back — no concatenation copy of a multi-GB payload)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(buf)
-        f.write(footer)
+        for chunk in chunks:
+            f.write(chunk)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -134,6 +130,34 @@ def _atomic_write(path: str, payload: dict) -> None:
         os.fsync(dfd)  # make the rename itself durable
     finally:
         os.close(dfd)
+
+
+def read_footer_crc(path: str) -> Optional[int]:
+    """The CRC32 recorded in a checkpoint file's integrity footer, or None
+    when the file is missing, too short, or carries no footer (legacy file
+    or torn write).  Reads 12 bytes — cheap enough for a gang manifest to
+    collect every shard's CRC without re-reading the payloads."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < _FOOTER.size:
+                return None
+            f.seek(size - _FOOTER.size)
+            magic, crc = _FOOTER.unpack(f.read(_FOOTER.size))
+    except OSError:
+        return None
+    return crc if magic == _FOOTER_MAGIC else None
+
+
+def _atomic_write(path: str, payload: dict) -> None:
+    """Durable pickle write with a CRC32 integrity footer so silent
+    on-disk corruption is detected at load time."""
+    t0 = time.perf_counter() if _obs.enabled() else None
+    buf = pickle.dumps(payload)
+    crc = zlib.crc32(buf) & 0xFFFFFFFF
+    footer = _FOOTER.pack(_FOOTER_MAGIC, crc)
+    _atomic_write_bytes(path, buf, footer)
     if t0 is not None:
         dt = time.perf_counter() - t0
         nbytes = len(buf) + _FOOTER.size

@@ -31,8 +31,31 @@ fault kind         hook site (module seam)               effect
                                                          watchdog must catch
 ``worker_kill``    ``launch.simulate_workers(faults=)``  worker ``step`` is
                                                          signalled after
-                                                         ``arg`` seconds
+                                                         ``arg`` seconds; in
+                                                         ``exec.gang`` (with
+                                                         ``worker=`` set) the
+                                                         target rank dies at
+                                                         the scheduled step
+``worker_stall``   ``launch.simulate_workers(faults=)``  the worker process is
+                   / ``exec.gang.ElasticGang``           SIGSTOP'd for
+                                                         ``duration`` seconds
+                                                         (process harness) or
+                                                         rank ``worker`` stops
+                                                         heartbeating for
+                                                         ``arg`` steps (gang)
+``shard_loss``     ``exec.gang.ElasticGang``             rank ``worker``'s
+                                                         shard directory is
+                                                         deleted — recovery
+                                                         must ride the ring
+                                                         replica
 =================  ====================================  ===================
+
+Two scheduling conventions coexist for the worker-targeted kinds: in
+``simulate_workers`` the event's *step* is the worker index and ``worker``
+is left None (wall-clock chaos); in the gang runtime the step is the
+1-based global training step and ``worker=`` names the target rank at
+fire time (deterministic step-clock chaos).  Each harness only consumes
+events written in its own convention.
 
 Every event fires exactly once; ``plan.fired`` records what actually
 triggered, so chaos tests can assert the schedule was exercised.  Two plans
@@ -56,7 +79,7 @@ __all__ = ["Fault", "FaultPlan", "install", "uninstall", "inject", "fire",
            "active_plan", "KINDS"]
 
 KINDS = ("ps_socket_kill", "ckpt_truncate", "ckpt_corrupt", "grad_nan",
-         "hang", "worker_kill")
+         "hang", "worker_kill", "worker_stall", "shard_loss")
 
 # C-client dead-socket status (net.RemoteEmbeddingTable._NET_ERRS)
 _DEAD_SOCKET = -10
@@ -65,12 +88,20 @@ _DEAD_SOCKET = -10
 @dataclasses.dataclass(frozen=True)
 class Fault:
     """One injectable fault.  ``arg`` is kind-specific: sleep seconds for
-    ``hang``, kill delay seconds for ``worker_kill`` (unused otherwise).
-    ``sig`` is the signal a ``worker_kill`` delivers (default SIGKILL)."""
+    ``hang``, kill/stall delay seconds for ``worker_kill``/``worker_stall``
+    under ``simulate_workers``, stall length in steps for ``worker_stall``
+    under the gang runtime (unused otherwise).  ``sig`` is the signal a
+    ``worker_kill`` delivers (default SIGKILL).  ``worker`` names the
+    target rank for gang-runtime events (None = the ``simulate_workers``
+    convention, where the event's *step* is the worker index).
+    ``duration`` is the SIGSTOP length in seconds for a process-level
+    ``worker_stall``."""
 
     kind: str
     arg: Optional[float] = None
     sig: Optional[int] = None
+    worker: Optional[int] = None
+    duration: Optional[float] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -121,15 +152,24 @@ class FaultPlan:
             self._step = int(step)
 
     def take(self, *kinds: str, late_ok: bool = False,
-             now: Optional[int] = None) -> Optional[Fault]:
+             now: Optional[int] = None,
+             require_worker: Optional[bool] = None) -> Optional[Fault]:
         """Pop (at most) one pending event of the given kinds scheduled for
         step ``now`` (default: the current step; with ``late_ok``, at or
         before it).  Thread-safe: concurrent hook calls (e.g. the shard
-        router's parallel pulls) fire the event exactly once."""
+        router's parallel pulls) fire the event exactly once.
+
+        ``require_worker=True`` only matches events with ``worker=`` set
+        (the gang runtime's step-clock convention), leaving
+        ``simulate_workers``-convention events pending for their own
+        harness — the each-harness-consumes-its-own-convention rule."""
         with self._lock:
             at = self._step if now is None else int(now)
             for i, (step, fault) in enumerate(self._events):
                 hit = step == at or (late_ok and step <= at)
+                if require_worker is not None and \
+                        (fault.worker is not None) != require_worker:
+                    continue
                 if hit and fault.kind in kinds:
                     del self._events[i]
                     self.fired.append((step, fault))
@@ -147,9 +187,36 @@ class FaultPlan:
             rest = []
             for step, fault in self._events:
                 in_range = n_workers is None or 0 <= step < n_workers
-                if fault.kind == "worker_kill" and in_range:
+                # fault.worker set = a gang-runtime event (step-scheduled);
+                # it stays pending for ElasticGang instead of being
+                # misread as a worker index here
+                if (fault.kind == "worker_kill" and fault.worker is None
+                        and in_range):
                     out.append((step, fault.arg or 0.0,
                                 fault.sig or _signal.SIGKILL))
+                    self.fired.append((step, fault))
+                else:
+                    rest.append((step, fault))
+            self._events = rest
+        return out
+
+    def worker_stalls(self, n_workers: Optional[int] = None) -> list:
+        """``[(worker_index, delay_seconds, stall_seconds)]`` — consumed by
+        ``launch.simulate_workers(faults=plan)``, which SIGSTOPs the worker
+        after the delay and SIGCONTs it ``stall_seconds`` later (the
+        straggler/GC-pause shape).  Same conventions as
+        :meth:`worker_kills`: gang-runtime events (``worker=`` set) stay
+        pending."""
+        out = []
+        with self._lock:
+            rest = []
+            for step, fault in self._events:
+                in_range = n_workers is None or 0 <= step < n_workers
+                if (fault.kind == "worker_stall" and fault.worker is None
+                        and in_range):
+                    out.append((step, fault.arg or 0.0,
+                                fault.duration if fault.duration is not None
+                                else 1.0))
                     self.fired.append((step, fault))
                 else:
                     rest.append((step, fault))

@@ -1,0 +1,941 @@
+"""Elastic gang runtime: sharded + ring-replicated checkpoints,
+worker-loss recovery, deterministic rescale.
+
+Hetu's headline capability is trillion-parameter training across many
+workers, where the dominant failure mode is losing a *worker*
+(preemption, OOM, host death) — not the single-process faults PR 1's
+``ResilientTrainer`` survives.  This module adds the gang-level story,
+following the Megatron-LM distributed-checkpoint shape and the
+elastic-membership designs surveyed in PAPERS.md (Varuna's morphing
+under spot preemptions):
+
+1. **Sharded checkpoints with ring replication.**  Each worker durably
+   writes its own parameter/optimizer shard (a deterministic slice of
+   the flat state dict, ``shard_owner``) through the existing
+   ``checkpoint._atomic_write`` CRC path, *plus a replica of its ring
+   successor's shard*, plus — on rank 0 — a signed manifest recording
+   (step, generation, world size, RNG state, per-shard CRC32s).  Loss of
+   any single worker's storage is survivable: its shard is recovered
+   from the ring predecessor's replica (journal event
+   ``shard_restore``).  Loading composes every shard back into one flat
+   state dict and restores it with ``load_state_dict(
+   consider_splits=True)``, so a checkpoint taken by an n-worker gang
+   restores into a differently-sized gang.
+
+2. **Gang membership.**  :class:`GangMembership` implements heartbeat
+   leases with generation numbers over a shared directory — the
+   coordination substrate the ``launch.simulate_workers`` harness (and
+   any shared-filesystem deployment) provides.  A worker whose lease
+   goes stale past ``lease_ttl`` is *lost* (journal ``worker_lost``);
+   survivors barrier on a new generation (``gang_rescale``) and resume
+   from the newest intact manifest.
+
+3. **Deterministic elastic rescale.**  Per-worker data assignment
+   (:func:`gang_data_partition`) and per-worker RNG streams
+   (:func:`worker_rng_key`) are pure functions of
+   ``(seed, generation, world_size)`` — and the *global* computation is
+   invariant under the partition (shards compose back in global index
+   order), so an n→n kill/recover replay is bitwise identical to an
+   uninterrupted run, and two replays of the same seeded
+   :class:`~hetu_tpu.exec.faults.FaultPlan` are bitwise identical to
+   each other.
+
+:class:`ElasticGang` is the deterministic in-process simulation of the
+whole lifecycle (the chaos-testable runtime: ``worker_kill`` /
+``worker_stall`` / ``shard_loss`` fault kinds fire on a step clock);
+:class:`GangCheckpointer` + :class:`GangMembership` are the per-process
+pieces real multi-process gangs (``simulate_workers``) compose with
+``ResilientTrainer(gang=...)``.
+
+Observability: ``hetu_gang_*`` gauges/counters through ``obs.registry``
+and ``worker_lost`` / ``gang_rescale`` / ``shard_restore`` events
+through ``obs.journal``.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import re
+import shutil
+import threading
+import time
+import zlib
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.core import get_seed_status, next_key, reset_seed_seqnum
+from hetu_tpu.core.module import named_parameters
+from hetu_tpu.exec import faults as _faults
+from hetu_tpu.exec.checkpoint import (CheckpointError, _atomic_write_bytes,
+                                      load_checkpoint, load_state_dict,
+                                      read_footer_crc, save_checkpoint)
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["GangError", "GangManifestError", "shard_owner", "ring_neighbor",
+           "shard_path", "replica_path", "manifest_path", "save_shard",
+           "write_manifest", "read_manifest", "list_manifests",
+           "compose_state", "load_gang_checkpoint", "prune_gang",
+           "gang_data_partition", "worker_rng_key", "GangCheckpointer",
+           "GangMembership", "ElasticGang"]
+
+
+class GangError(RuntimeError):
+    """The gang cannot make progress (e.g. no intact checkpoint to
+    rescale from, or every worker lost)."""
+
+
+class GangManifestError(CheckpointError):
+    """A gang manifest could not be used: torn write (unparseable JSON),
+    signature mismatch (tampered/corrupt), or missing fields.  Subclasses
+    ``CheckpointError`` so resume loops treat it like any other damaged
+    checkpoint file: skip with a diagnosis, fall back to an older one."""
+
+
+# Content signature over the canonical manifest body.  This is
+# tamper/torn-*evidence*, not secrecy: anyone with the key string can
+# re-sign, but a torn write, a stray editor, or on-disk bit rot cannot
+# produce a manifest whose signature still verifies.
+_SIGN_KEY = b"hetu-tpu-gang-manifest-v1"
+MANIFEST_FORMAT = "hetu-gang-ckpt-v1"
+
+_MANIFEST_RE = re.compile(r"^manifest\.step_(\d+)\.json$")
+
+
+# ---------------------------------------------------------------- layout
+
+def shard_owner(name: str, world_size: int) -> int:
+    """Which rank owns parameter ``name`` in a ``world_size`` gang — a
+    pure function of the dotted path alone, so every worker (and a
+    differently-sized reloading gang) computes the same assignment
+    without coordination."""
+    if world_size <= 0:
+        raise ValueError(f"world_size must be positive, got {world_size}")
+    return zlib.crc32(name.encode()) % world_size
+
+
+def ring_neighbor(rank: int, world_size: int) -> int:
+    """The ring successor whose shard ``rank`` replicates.  Loss of rank
+    w's storage is covered by rank ``(w - 1) % world``'s replica."""
+    return (rank + 1) % world_size
+
+
+def worker_dir(gang_dir: str, rank: int) -> str:
+    return os.path.join(gang_dir, f"worker_{rank:04d}")
+
+
+def shard_path(gang_dir: str, rank: int, step: int) -> str:
+    return os.path.join(worker_dir(gang_dir, rank),
+                        f"shard.step_{step:08d}")
+
+
+def replica_path(gang_dir: str, holder: int, owner: int, step: int) -> str:
+    """The copy of ``owner``'s shard that ``holder`` wrote."""
+    return os.path.join(worker_dir(gang_dir, holder),
+                        f"replica_{owner:04d}.step_{step:08d}")
+
+
+def manifest_path(gang_dir: str, step: int) -> str:
+    return os.path.join(gang_dir, f"manifest.step_{step:08d}.json")
+
+
+# ------------------------------------------------------------- telemetry
+
+_gang_metrics = None
+
+
+def _gang_m() -> dict:
+    global _gang_metrics
+    if _gang_metrics is None:
+        reg = _obs.get_registry()
+        _gang_metrics = {
+            "generation": reg.gauge(
+                "hetu_gang_generation",
+                "current gang membership generation (bumps on every "
+                "shrink/grow)"),
+            "size": reg.gauge(
+                "hetu_gang_size", "live workers in the gang"),
+            "alive": reg.gauge(
+                "hetu_gang_worker_alive",
+                "1 while the worker holds a fresh lease; the series is "
+                "removed (not frozen) when the worker leaves the gang",
+                ("worker",)),
+            "lost": reg.counter(
+                "hetu_gang_worker_lost_total",
+                "workers evicted after a missed heartbeat lease"),
+            "rescales": reg.counter(
+                "hetu_gang_rescales_total",
+                "membership generations committed (shrinks and grows)"),
+            "shard_restores": reg.counter(
+                "hetu_gang_shard_restores_total",
+                "checkpoint shards recovered from a ring replica because "
+                "the primary was missing or damaged"),
+        }
+    return _gang_metrics
+
+
+# ------------------------------------------------- sharded save / restore
+
+def save_shard(gang_dir: str, rank: int, world_size: int, step: int,
+               sd: dict, *, generation: int = 0,
+               extra: Optional[dict] = None) -> str:
+    """Durably write ``rank``'s slice of the flat state dict ``sd`` plus a
+    replica of its ring successor's slice (both through the atomic CRC32
+    checkpoint path).  ``sd`` is the full flat ``{dotted.path: array}``
+    dict — under data parallelism every worker holds a full replica, so
+    the slice is computed locally; a TP/sharded caller passes whatever
+    subset it holds and only matching names are written.
+
+    Returns the primary shard path."""
+    meta = {"rank": rank, "world_size": world_size, "step": step,
+            "generation": generation, **(extra or {})}
+    own = {k: v for k, v in sd.items()
+           if shard_owner(k, world_size) == rank}
+    p = shard_path(gang_dir, rank, step)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    save_checkpoint(p, own, extra=meta)
+    nbr = ring_neighbor(rank, world_size)
+    if nbr != rank:
+        rep = {k: v for k, v in sd.items()
+               if shard_owner(k, world_size) == nbr}
+        save_checkpoint(replica_path(gang_dir, rank, nbr, step), rep,
+                        extra={**meta, "replica_of": nbr})
+    return p
+
+
+def _sign(body: dict) -> str:
+    canon = json.dumps({k: v for k, v in body.items() if k != "sig"},
+                       sort_keys=True).encode()
+    return hashlib.sha256(_SIGN_KEY + canon).hexdigest()
+
+
+def write_manifest(gang_dir: str, step: int, generation: int,
+                   world_size: int, *, rng: Optional[tuple] = None,
+                   extra: Optional[dict] = None,
+                   wait_timeout: float = 0.0, poll: float = 0.05) -> str:
+    """Write the signed manifest for ``step``: per-shard CRC32s (read from
+    the 12-byte integrity footers — no payload re-read), generation,
+    world size, and the RNG state a resumed gang must replay from.
+
+    ``wait_timeout`` lets the manifest writer (rank 0 of a multi-process
+    gang) wait for peers' shard files to land before collecting CRCs;
+    the in-process runtime writes all shards itself, so 0 suffices."""
+    deadline = time.monotonic() + wait_timeout
+    shards = {}
+    for r in range(world_size):
+        p = shard_path(gang_dir, r, step)
+        crc = read_footer_crc(p)
+        while crc is None and time.monotonic() < deadline:
+            time.sleep(poll)
+            crc = read_footer_crc(p)
+        if crc is None:
+            raise GangError(
+                f"cannot write gang manifest for step {step}: shard for "
+                f"rank {r} never appeared at {p} (worker crashed before "
+                f"its save, or wait_timeout={wait_timeout}s too short)")
+        shards[str(r)] = {"crc32": crc,
+                          "relpath": os.path.relpath(p, gang_dir)}
+    body = {"format": MANIFEST_FORMAT, "step": int(step),
+            "generation": int(generation), "world_size": int(world_size),
+            "rng": list(rng if rng is not None else get_seed_status()),
+            "extra": dict(extra or {}), "shards": shards}
+    body["sig"] = _sign(body)
+    path = manifest_path(gang_dir, step)
+    _atomic_write_bytes(path, (json.dumps(body, sort_keys=True)
+                               + "\n").encode())
+    return path
+
+
+def read_manifest(path: str) -> dict:
+    """Parse and verify a manifest; raises :class:`GangManifestError`
+    naming the path and the diagnosis (torn vs tampered vs alien)."""
+    try:
+        with open(path) as f:
+            body = json.load(f)
+    except OSError as e:
+        raise GangManifestError(f"gang manifest {path}: unreadable "
+                                f"({e!r})") from e
+    except ValueError as e:
+        raise GangManifestError(
+            f"gang manifest {path}: not parseable JSON ({e}) — most "
+            f"likely a torn write; fall back to the previous "
+            f"generation") from e
+    if not isinstance(body, dict) or body.get("format") != MANIFEST_FORMAT:
+        raise GangManifestError(
+            f"gang manifest {path}: missing/unknown format tag "
+            f"{body.get('format') if isinstance(body, dict) else type(body).__name__!r}")
+    if body.get("sig") != _sign(body):
+        raise GangManifestError(
+            f"gang manifest {path}: signature mismatch — the file was "
+            f"modified after signing (partial write, bit rot, or an "
+            f"interfering writer); fall back to the previous generation")
+    for field in ("step", "generation", "world_size", "shards"):
+        if field not in body:
+            raise GangManifestError(
+                f"gang manifest {path}: missing field {field!r}")
+    return body
+
+
+def list_manifests(gang_dir: str) -> list:
+    """All manifests, ascending by step: ``[(step, path)]``."""
+    out = []
+    try:
+        names = os.listdir(gang_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return out
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(gang_dir, name)))
+    out.sort()
+    return out
+
+
+def compose_state(gang_dir: str, manifest: dict) -> tuple:
+    """Reassemble the full flat state dict from a manifest's shards.
+
+    A shard whose primary is missing, damaged, or not the bytes the
+    manifest signed (footer CRC != manifest CRC) is recovered from its
+    ring predecessor's replica — journaled as ``shard_restore``.  Raises
+    :class:`CheckpointError` when a shard is unrecoverable (caller falls
+    back to an older manifest).
+
+    Returns ``(sd, restored_ranks)``."""
+    world = int(manifest["world_size"])
+    step = int(manifest["step"])
+    sd: dict = {}
+    restored = []
+    for r in range(world):
+        ent = manifest["shards"][str(r)]
+        p = os.path.join(gang_dir, ent["relpath"])
+        part = None
+        primary_err = None
+        try:
+            if read_footer_crc(p) != int(ent["crc32"]):
+                raise CheckpointError(
+                    f"shard {p}: footer CRC does not match the manifest "
+                    f"(damaged, replaced, or torn)")
+            part, _extra = load_checkpoint(p, restore_rng=False)
+        except (CheckpointError, OSError) as e:
+            primary_err = e
+        if part is None:
+            holder = (r - 1) % world
+            rp = replica_path(gang_dir, holder, r, step)
+            try:
+                # the replica was pickled by a different writer, so its
+                # byte-level CRC may legitimately differ from the
+                # primary's; its OWN integrity footer still guards it
+                part, _extra = load_checkpoint(rp, restore_rng=False)
+            except (CheckpointError, OSError) as e:
+                raise CheckpointError(
+                    f"gang step {step}: shard for rank {r} is "
+                    f"unrecoverable — primary failed ({primary_err}) and "
+                    f"the ring replica at {rp} failed too ({e})") from e
+            restored.append(r)
+            if _obs.enabled():
+                _gang_m()["shard_restores"].inc()
+            _obs_journal.record("shard_restore", rank=r, from_rank=holder,
+                                step=step,
+                                generation=int(manifest["generation"]))
+        sd.update(part)
+    return sd, restored
+
+
+def load_gang_checkpoint(gang_dir: str, restore_rng: bool = True) -> tuple:
+    """Scan manifests newest-first, skipping torn/tampered ones and ones
+    whose shards are unrecoverable, and compose the newest intact gang
+    checkpoint.
+
+    Returns ``(step, generation, sd, extra, report)`` — or ``(None, None,
+    None, None, report)`` when nothing loads.  ``report`` mirrors
+    ``latest_good_checkpoint``: ``[(step, path, diagnosis_or_None)]``."""
+    report = []
+    for step, path in reversed(list_manifests(gang_dir)):
+        try:
+            man = read_manifest(path)
+            sd, _restored = compose_state(gang_dir, man)
+        except CheckpointError as e:
+            report.append((step, path, str(e)))
+            continue
+        if restore_rng and man.get("rng"):
+            reset_seed_seqnum(*man["rng"])
+        report.append((step, path, None))
+        return (int(man["step"]), int(man["generation"]), sd,
+                dict(man.get("extra", {})), report)
+    return None, None, None, None, report
+
+
+_STEP_SUFFIX_RE = re.compile(r"\.step_(\d+)$")
+
+
+def prune_gang(gang_dir: str, keep: int) -> None:
+    """Drop manifests of all but the newest ``keep`` steps, plus every
+    shard/replica file older than the oldest kept manifest — INCLUDING
+    orphans from ``manifest_skipped`` steps (a dead peer makes the
+    manifest fail soft but the survivors' shards still land; without the
+    sweep they would accumulate forever).  Best-effort, never fatal
+    (retention semantics match the monolithic path)."""
+    if keep <= 0:
+        return
+    steps = [s for s, _p in list_manifests(gang_dir)]
+    if len(steps) <= keep:
+        return
+    kept = steps[-keep:]
+    cutoff = kept[0]
+    doomed = [manifest_path(gang_dir, s) for s in steps[:-keep]]
+    for p in glob.glob(os.path.join(gang_dir, "worker_*", "*.step_*")):
+        m = _STEP_SUFFIX_RE.search(p)
+        # orphaned manifest-less steps newer than the cutoff are spared:
+        # they may be mid-save, about to get their manifest
+        if m and int(m.group(1)) < cutoff:
+            doomed.append(p)
+    for p in doomed:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+# ------------------------------------------ deterministic elastic rescale
+
+def gang_data_partition(seed: int, generation: int, world_size: int,
+                        step: int, global_batch_size: int) -> list:
+    """Assign the global batch's row indices to ranks — a pure function
+    of ``(seed, generation, world_size, step)``.  The union of the
+    returned index arrays is always exactly ``arange(global_batch_size)``
+    (a permutation, split near-evenly), so the *global* batch a gang
+    composes back in global index order is independent of how many
+    workers shared it — the invariance that makes an n→n kill/recover
+    replay bitwise identical to an uninterrupted run."""
+    if world_size <= 0:
+        raise ValueError(f"world_size must be positive, got {world_size}")
+    rng = np.random.default_rng(
+        [int(seed), int(generation), int(world_size), int(step)])
+    perm = rng.permutation(global_batch_size)
+    return np.array_split(perm, world_size)
+
+
+def worker_rng_key(seed: int, generation: int, world_size: int, rank: int):
+    """Per-worker PRNG key for rank-local randomness (local shuffles,
+    augmentation): a pure function of ``(seed, generation, world_size,
+    rank)``, so a rescaled gang re-derives every stream without any state
+    handoff from the dead worker."""
+    import jax.random as jrandom
+    key = jrandom.key(int(seed))
+    for x in (int(generation), int(world_size), int(rank)):
+        key = jrandom.fold_in(key, x)
+    return key
+
+
+# ------------------------------------------------------ per-process APIs
+
+class GangCheckpointer:
+    """One worker's handle on the sharded checkpoint protocol — the
+    object ``ResilientTrainer(gang=...)`` routes saves/restores through.
+
+    ``save`` writes this rank's shard + ring replica; the manifest writer
+    (rank 0 unless ``writes_manifest`` overrides) additionally waits for
+    every peer's shard (``manifest_timeout``), writes the signed
+    manifest, and prunes retention.  Call :meth:`rescale` after a
+    membership change so subsequent saves carry the new (rank, world,
+    generation)."""
+
+    def __init__(self, gang_dir: str, rank: int, world_size: int, *,
+                 generation: int = 0, keep: int = 3,
+                 manifest_timeout: float = 60.0,
+                 writes_manifest: Optional[bool] = None):
+        self.gang_dir = gang_dir
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.generation = int(generation)
+        self.keep = int(keep)
+        self.manifest_timeout = float(manifest_timeout)
+        self._writes_manifest = writes_manifest
+        os.makedirs(gang_dir, exist_ok=True)
+
+    @property
+    def writes_manifest(self) -> bool:
+        if self._writes_manifest is None:
+            return self.rank == 0
+        return bool(self._writes_manifest)
+
+    def rescale(self, rank: int, world_size: int, generation: int) -> None:
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.generation = int(generation)
+
+    def save(self, step: int, sd: dict, extra: Optional[dict] = None) -> str:
+        path = save_shard(self.gang_dir, self.rank, self.world_size, step,
+                          sd, generation=self.generation, extra=extra)
+        if self.writes_manifest:
+            try:
+                path = write_manifest(self.gang_dir, step, self.generation,
+                                      self.world_size,
+                                      rng=get_seed_status(), extra=extra,
+                                      wait_timeout=self.manifest_timeout)
+            except GangError as e:
+                # a peer never produced its shard — almost always a dead
+                # worker the membership layer is about to evict.  The
+                # elastic semantics are to fail SOFT: this checkpoint
+                # step simply never commits (shards without a manifest
+                # are invisible), and the coming rescale resumes from the
+                # previous manifest.
+                _obs_journal.record("manifest_skipped", step=step,
+                                    generation=self.generation,
+                                    reason=str(e))
+                return path
+            prune_gang(self.gang_dir, self.keep)
+        return path
+
+    def load_latest(self, restore_rng: bool = True) -> tuple:
+        return load_gang_checkpoint(self.gang_dir, restore_rng=restore_rng)
+
+
+class GangMembership:
+    """Heartbeat leases with generation numbers over a shared directory.
+
+    Each worker renews ``membership/worker_RRRR.lease`` (atomic replace)
+    every ``interval`` seconds; a peer whose lease is older than
+    ``lease_ttl`` is *lost*.  Survivors agree on a new generation with
+    :meth:`rescale`: everyone writes an ack under ``gen_GGGG/`` and waits
+    for the surviving set's acks — the barrier the issue's "survivors
+    barrier on a new generation" names.  Clean shutdown calls
+    :meth:`leave` (removes the lease); a crash leaves the lease to
+    expire, which is exactly the detection path.
+
+    The clock is injectable for deterministic tests; production uses
+    ``time.time`` because lease ages are compared across processes."""
+
+    def __init__(self, gang_dir: str, rank: int, *, lease_ttl: float = 3.0,
+                 interval: float = 0.5, generation: int = 0,
+                 clock: Callable[[], float] = time.time):
+        self.gang_dir = gang_dir
+        self.dir = os.path.join(gang_dir, "membership")
+        self.rank = int(rank)
+        self.lease_ttl = float(lease_ttl)
+        self.interval = float(interval)
+        self.generation = int(generation)
+        self.clock = clock
+        self._beat_n = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._announced: set = set()
+        os.makedirs(self.dir, exist_ok=True)
+
+    @classmethod
+    def from_env(cls, **kw) -> "GangMembership":
+        """Construct from the env the launcher composed
+        (``HETU_TPU_GANG_DIR`` + ``HETU_TPU_PROC_ID``)."""
+        from hetu_tpu.launch import ENV_GANG_DIR, ENV_PROC_ID
+        return cls(os.environ[ENV_GANG_DIR],
+                   int(os.environ.get(ENV_PROC_ID, "0")), **kw)
+
+    def _lease_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"worker_{rank:04d}.lease")
+
+    def heartbeat(self) -> None:
+        """Renew this worker's lease (atomic tmp+replace: readers never
+        see a torn lease)."""
+        self._beat_n += 1
+        rec = {"rank": self.rank, "generation": self.generation,
+               "beat": self._beat_n, "ts": self.clock()}
+        tmp = self._lease_path(self.rank) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(rec))
+        os.replace(tmp, self._lease_path(self.rank))
+        if _obs.enabled():
+            _gang_m()["alive"].labels(worker=str(self.rank)).set(1.0)
+
+    def start(self) -> None:
+        """Heartbeat now and keep renewing on a daemon thread."""
+        self.heartbeat()
+        self._stop.clear()
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.heartbeat()
+
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name=f"gang-heartbeat-{self.rank}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval + 1.0)
+            self._thread = None
+
+    def leave(self) -> None:
+        """Clean departure: stop heartbeating and remove the lease so
+        peers see an intentional exit, not a lost worker."""
+        self.stop()
+        try:
+            os.remove(self._lease_path(self.rank))
+        except OSError:
+            pass
+        if _obs.enabled():
+            _gang_m()["alive"].remove(worker=str(self.rank))
+
+    def read_lease(self, rank: int) -> Optional[dict]:
+        try:
+            with open(self._lease_path(rank)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def members(self) -> list:
+        """Every rank holding a lease file (fresh or stale), sorted."""
+        out = []
+        for name in os.listdir(self.dir):
+            m = re.match(r"^worker_(\d+)\.lease$", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def alive(self, now: Optional[float] = None) -> list:
+        """Ranks whose lease age is within ``lease_ttl``."""
+        now = self.clock() if now is None else now
+        out = []
+        for r in self.members():
+            lease = self.read_lease(r)
+            if lease is not None and now - lease.get("ts", 0) <= self.lease_ttl:
+                out.append(r)
+        return out
+
+    def lost(self, now: Optional[float] = None) -> list:
+        """Members whose lease expired.  Each is journaled as
+        ``worker_lost`` once per membership instance (the survivors all
+        detect; the journal dedupes per process)."""
+        now = self.clock() if now is None else now
+        alive = set(self.alive(now))
+        out = [r for r in self.members() if r not in alive]
+        for r in out:
+            if r not in self._announced:
+                self._announced.add(r)
+                lease = self.read_lease(r) or {}
+                if _obs.enabled():
+                    _gang_m()["lost"].inc()
+                    _gang_m()["alive"].remove(worker=str(r))
+                _obs_journal.record(
+                    "worker_lost", rank=r, generation=self.generation,
+                    reason="lease_expired",
+                    age_s=round(now - lease.get("ts", now), 3))
+        return out
+
+    def barrier(self, generation: int, ranks: Sequence[int],
+                timeout: float = 30.0, poll: float = 0.05) -> None:
+        """Write this worker's ack for ``generation`` and wait until every
+        rank in ``ranks`` has acked.  Raises ``TimeoutError`` naming the
+        stragglers."""
+        ack_dir = os.path.join(self.dir, f"gen_{int(generation):08d}")
+        os.makedirs(ack_dir, exist_ok=True)
+        with open(os.path.join(ack_dir, f"ack_{self.rank:04d}"), "w") as f:
+            f.write(str(self.clock()))
+        deadline = time.monotonic() + timeout
+        want = {int(r) for r in ranks}
+        while True:
+            have = {int(m.group(1)) for m in
+                    (re.match(r"^ack_(\d+)$", n)
+                     for n in os.listdir(ack_dir)) if m}
+            if want <= have:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"gang barrier for generation {generation} timed out: "
+                    f"waiting on ranks {sorted(want - have)}")
+            time.sleep(poll)
+
+    def rescale(self, timeout: float = 30.0) -> tuple:
+        """Commit a new membership generation after worker loss: the
+        surviving set is the current ``alive()`` ranks, the generation is
+        bumped, everyone barriers on it, and survivors re-rank densely
+        (old ranks sorted → new ranks 0..m-1).
+
+        Returns ``(generation, rank_map)`` where ``rank_map`` maps old
+        rank → new rank.  The caller then rebuilds/``rescale``s its
+        :class:`GangCheckpointer` and resumes from the manifest."""
+        old_world = len(self.members())
+        evicted = self.lost()  # journal any not-yet-announced evictions
+        survivors = self.alive()
+        if self.rank not in survivors:
+            survivors = sorted(set(survivors) | {self.rank})
+        self.generation += 1
+        self.heartbeat()  # lease now carries the new generation
+        self.barrier(self.generation, survivors, timeout=timeout)
+        # every survivor acked the new generation, so all of them have
+        # observed the eviction — the stale leases can go (otherwise the
+        # dead worker would be re-"detected" forever).  Best-effort and
+        # idempotent across the survivors racing to do it.
+        for r in evicted:
+            try:
+                os.remove(self._lease_path(r))
+            except OSError:
+                pass
+        rank_map = {old: new for new, old in enumerate(sorted(survivors))}
+        if _obs.enabled():
+            _gang_m()["generation"].set(self.generation)
+            _gang_m()["size"].set(len(survivors))
+            _gang_m()["rescales"].inc()
+        _obs_journal.record("gang_rescale", generation=self.generation,
+                            old_world=old_world,
+                            new_world=len(survivors),
+                            survivors=sorted(survivors))
+        return self.generation, rank_map
+
+
+# ------------------------------------------------- in-process simulation
+
+class ElasticGang:
+    """Deterministic in-process simulation of an elastic data-parallel
+    gang — the chaos-testable runtime for the whole lifecycle.
+
+    The gang drives ONE jitted trainer with the lock-step global update
+    (under data parallelism every worker's post-step state is identical,
+    so simulating N replicas means simulating the global step once); the
+    per-worker structure that matters for elasticity is simulated
+    faithfully: per-worker *storage* (shard + ring-replica directories),
+    per-worker *liveness* (a step-clock heartbeat lease), and per-worker
+    *data assignment* (:func:`gang_data_partition`; the global batch is
+    genuinely recomposed from the per-worker shards in global index
+    order every step, so partition invariance is exercised, not
+    assumed).  Honest multi-process behavior is covered by
+    ``GangMembership`` + ``GangCheckpointer`` over
+    ``launch.simulate_workers``.
+
+    Fault kinds consumed from the installed
+    :class:`~hetu_tpu.exec.faults.FaultPlan` at the top of each global
+    step (events must set ``worker=``):
+
+    - ``worker_kill``: the target rank stops heartbeating forever.
+    - ``worker_stall``: the target misses heartbeats for ``arg`` steps —
+      within ``lease_steps`` it rejoins silently; past it, it is evicted
+      exactly like a kill (and, being fenced by the generation bump,
+      never commits again).
+    - ``shard_loss``: the target's shard *directory* is deleted —
+      recovery must ride the ring replica (``shard_restore``).
+
+    A worker whose lease expires triggers: ``worker_lost`` journal event
+    → generation bump + dense re-rank (``gang_rescale``) → restore from
+    the newest intact manifest (state composed from shards, RNG stream
+    reset, step counter rewound).  With no manifest yet the gang rewinds
+    to its initial state (snapshotted at construction).  ``rejoin()``
+    grows the gang back — joiners adopt the survivors' replicated state
+    (a live broadcast; the manifest path is for cold joins), so an n→n
+    kill/recover run replays to a bitwise-identical end state.
+    """
+
+    def __init__(self, trainer, gang_dir: str, *, world_size: int,
+                 data_fn: Callable[[int], dict], global_batch_size: int,
+                 seed: int = 0, save_every: int = 2, keep: int = 4,
+                 lease_steps: int = 1):
+        if getattr(trainer, "_has_staged", False):
+            raise ValueError(
+                "ElasticGang drives dense data-parallel trainers; staged "
+                "host embeddings keep per-worker server state the gang "
+                "checkpoint does not cover")
+        self.trainer = trainer
+        self.gang_dir = gang_dir
+        self.world_size = int(world_size)
+        self.data_fn = data_fn
+        self.global_batch_size = int(global_batch_size)
+        self.seed = int(seed)
+        self.save_every = int(save_every)
+        self.keep = int(keep)
+        self.lease_steps = int(lease_steps)
+        self.generation = 0
+        self.step_count = 0
+        self.history: list = []        # every executed (step, loss), incl. replays
+        self.losses_by_step: dict = {}  # final lineage: step -> last loss
+        self.last_partition: Optional[list] = None
+        self.resume_report: list = []  # diagnoses from the last restore
+        self._dead: set = set()
+        self._stalled_until: dict = {}
+        self._last_beat = {w: 0 for w in range(self.world_size)}
+        os.makedirs(gang_dir, exist_ok=True)
+        # rescue floor for a loss before the first checkpoint: the
+        # pristine state + RNG, kept on host
+        import jax
+        self._initial_sd = {k: np.asarray(jax.device_get(v))
+                            for k, v in named_parameters(trainer.state)}
+        self._initial_rng = get_seed_status()
+        if _obs.enabled():
+            m = _gang_m()
+            m["generation"].set(0)
+            m["size"].set(self.world_size)
+            for w in range(self.world_size):
+                m["alive"].labels(worker=str(w)).set(1.0)
+
+    # -- gang checkpointing -------------------------------------------------
+
+    def save(self) -> str:
+        """Every live rank writes its shard + ring replica; then the
+        signed manifest for the current step."""
+        sd = dict(named_parameters(self.trainer.state))
+        rng = get_seed_status()
+        for r in range(self.world_size):
+            save_shard(self.gang_dir, r, self.world_size, self.step_count,
+                       sd, generation=self.generation,
+                       extra={"step": self.step_count})
+        path = write_manifest(self.gang_dir, self.step_count,
+                              self.generation, self.world_size, rng=rng,
+                              extra={"step": self.step_count})
+        prune_gang(self.gang_dir, self.keep)
+        return path
+
+    def _restore(self) -> int:
+        """Load the newest intact manifest into the trainer (ring replicas
+        cover lost shards); falls back to the initial snapshot when no
+        checkpoint exists yet.  Returns the restored step."""
+        step, _gen, sd, _extra, report = load_gang_checkpoint(self.gang_dir)
+        self.resume_report = report
+        if step is None:
+            sd, step = self._initial_sd, 0
+            reset_seed_seqnum(*self._initial_rng)
+        self.trainer.state = _to_device(load_state_dict(
+            self.trainer.state, sd, consider_splits=True))
+        self.step_count = step
+        return step
+
+    # -- membership ---------------------------------------------------------
+
+    def _consume_faults(self, step: int) -> None:
+        plan = _faults.active_plan()
+        if plan is None:
+            return
+        plan.advance(step)
+        while True:
+            # require_worker: a simulate_workers-convention event
+            # (worker=None, step-as-worker-index) stays PENDING for its
+            # own harness instead of being popped here
+            f = plan.take("worker_kill", "worker_stall", "shard_loss",
+                          require_worker=True)
+            if f is None:
+                return
+            w = int(f.worker)
+            if w >= self.world_size:
+                continue  # target already gone at fire time
+            if f.kind == "shard_loss":
+                # the STORAGE dies; orthogonal to process liveness (a
+                # killed worker's disk is usually the one that vanishes)
+                shutil.rmtree(worker_dir(self.gang_dir, w),
+                              ignore_errors=True)
+            elif w in self._dead:
+                continue
+            elif f.kind == "worker_kill":
+                self._dead.add(w)
+            else:  # worker_stall
+                self._stalled_until[w] = step + int(f.arg or 1)
+
+    def _rescale(self, lost: list, step: int) -> None:
+        for w in lost:
+            _obs_journal.record(
+                "worker_lost", rank=w, generation=self.generation,
+                step=step,
+                reason="dead" if w in self._dead else "lease_expired")
+            if _obs.enabled():
+                _gang_m()["lost"].inc()
+                _gang_m()["alive"].remove(worker=str(w))
+        survivors = [w for w in range(self.world_size) if w not in lost]
+        if not survivors:
+            raise GangError("every worker lost — nothing left to rescale")
+        old_world = self.world_size
+        remap = {old: new for new, old in enumerate(survivors)}
+        self.generation += 1
+        self.world_size = len(survivors)
+        self._dead = set()
+        self._stalled_until = {remap[o]: v for o, v in
+                               self._stalled_until.items() if o in remap}
+        resumed = self._restore()
+        self._last_beat = {w: resumed for w in range(self.world_size)}
+        _obs_journal.record("gang_rescale", generation=self.generation,
+                            old_world=old_world, new_world=self.world_size,
+                            resumed_step=resumed)
+        if _obs.enabled():
+            m = _gang_m()
+            m["generation"].set(self.generation)
+            m["size"].set(self.world_size)
+            m["rescales"].inc()
+            for w in range(self.world_size):
+                m["alive"].labels(worker=str(w)).set(1.0)
+
+    def rejoin(self, n: int = 1) -> None:
+        """Grow the gang by ``n`` workers (preempted capacity coming
+        back).  Joiners adopt the survivors' replicated state; the data
+        partition and worker keys re-derive from the bumped generation."""
+        old_world = self.world_size
+        self.world_size += int(n)
+        self.generation += 1
+        for w in range(old_world, self.world_size):
+            self._last_beat[w] = self.step_count
+        _obs_journal.record("gang_rescale", generation=self.generation,
+                            old_world=old_world, new_world=self.world_size,
+                            resumed_step=self.step_count)
+        if _obs.enabled():
+            m = _gang_m()
+            m["generation"].set(self.generation)
+            m["size"].set(self.world_size)
+            m["rescales"].inc()
+            for w in range(old_world, self.world_size):
+                m["alive"].labels(worker=str(w)).set(1.0)
+
+    # -- the step loop ------------------------------------------------------
+
+    def _one_step(self) -> Optional[dict]:
+        s = self.step_count + 1
+        self._consume_faults(s)
+        for w in range(self.world_size):
+            if w not in self._dead and s >= self._stalled_until.get(w, 0):
+                self._last_beat[w] = s
+        lost = [w for w in range(self.world_size)
+                if s - self._last_beat[w] > self.lease_steps]
+        if lost:
+            self._rescale(lost, s)
+            return None  # the step counter rewound; the loop re-drives
+        gb = self.data_fn(s)
+        parts = gang_data_partition(self.seed, self.generation,
+                                    self.world_size, s,
+                                    self.global_batch_size)
+        # each worker materializes its shard, then the gang composes the
+        # GLOBAL batch back in global index order — recomposition is the
+        # partition-invariance the n→n bitwise guarantee rests on
+        shards = [{k: np.asarray(v)[p] for k, v in gb.items()}
+                  for p in parts]
+        inv = np.argsort(np.concatenate(parts), kind="stable")
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(
+            np.concatenate([sh[k] for sh in shards])[inv]) for k in gb}
+        self.last_partition = parts
+        metrics = self.trainer.step(batch, next_key())
+        self.step_count = s
+        loss = float(metrics["loss"])
+        self.history.append((s, loss))
+        self.losses_by_step[s] = loss
+        if self.save_every > 0 and s % self.save_every == 0:
+            self.save()
+        return metrics
+
+    def run_until(self, target_step: int) -> None:
+        """Drive global steps (including any rescale/replay detours) until
+        the gang has committed ``target_step``."""
+        guard = 0
+        while self.step_count < target_step:
+            self._one_step()
+            guard += 1
+            if guard > 100 * target_step + 1000:
+                raise GangError(
+                    f"gang cannot reach step {target_step}: stuck "
+                    f"rescaling at step {self.step_count}")
+
+
+def _to_device(tree):
+    # mirror of resilience._to_device: only lift numpy leaves, keeping
+    # python scalars weakly typed so resumed jit programs promote the
+    # same way and the lineage stays bitwise
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    return jtu.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)

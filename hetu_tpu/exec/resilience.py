@@ -207,7 +207,16 @@ class ResilientTrainer:
     ``step_timeout`` (watchdog deadline in seconds; None disables — the
     deadline covers whatever the step does, INCLUDING the first step's jit
     compilation: warm the trainer up first or size it for compile+run),
-    ``handle_signals`` (install SIGTERM/SIGINT final-save handlers).
+    ``handle_signals`` (install SIGTERM/SIGINT final-save handlers),
+    ``gang`` (a :class:`~hetu_tpu.exec.gang.GangCheckpointer`: saves
+    become this worker's shard + ring replica + — on the manifest writer
+    — the signed gang manifest, and resume/rollback compose the newest
+    intact manifest instead of scanning monolithic files).
+
+    ``resume()`` auto-detects the checkpoint format either way: gang
+    manifests in ``ckpt_dir`` are preferred when present, and monolithic
+    ``ckpt.step_*`` files remain loadable (including as the fallback when
+    every manifest is torn).
 
     With PS-backed embeddings (``RemoteHostEmbedding``) note the division
     of labor: skip-step protects the server too (anomalous grads are
@@ -220,7 +229,7 @@ class ResilientTrainer:
                  keep: int = 3, anomaly_policy: str = "skip",
                  max_consecutive_anomalies: int = 3,
                  step_timeout: Optional[float] = None,
-                 handle_signals: bool = False):
+                 handle_signals: bool = False, gang=None):
         if anomaly_policy not in ("skip", "raise", "off"):
             raise ValueError(
                 f"anomaly_policy must be 'skip', 'raise' or 'off', "
@@ -238,6 +247,16 @@ class ResilientTrainer:
         self.anomaly_policy = anomaly_policy
         self.max_consecutive_anomalies = int(max_consecutive_anomalies)
         self.step_timeout = step_timeout
+        self.gang = gang
+        if gang is not None and (os.path.normpath(gang.gang_dir)
+                                 != os.path.normpath(ckpt_dir)):
+            # save() writes where the gang points but resume()/rollback
+            # scan ckpt_dir — a silent mismatch would lose every
+            # checkpoint on restart
+            raise ValueError(
+                f"gang.gang_dir {gang.gang_dir!r} must be ckpt_dir "
+                f"{ckpt_dir!r}: saves would land in one directory and "
+                f"resume would scan the other")
         os.makedirs(ckpt_dir, exist_ok=True)
         self._ck = AsyncCheckpointer()
         self._step = 0
@@ -306,18 +325,47 @@ class ResilientTrainer:
 
     # -- resume -------------------------------------------------------------
 
+    def _latest_gang_state(self):
+        """(step, sd, extra, report) from the newest intact gang manifest
+        in ``ckpt_dir`` — or (None, None, None, report).  Tried whenever a
+        gang checkpointer is attached OR manifests are present (format
+        auto-detection); keeps the gang generation in sync."""
+        from hetu_tpu.exec import gang as _gang
+        if self.gang is None and not _gang.list_manifests(self.ckpt_dir):
+            return None, None, None, []
+        step, generation, sd, extra, report = _gang.load_gang_checkpoint(
+            self.ckpt_dir)
+        if step is not None and self.gang is not None:
+            # never LOWER the generation: after a rescale the newest
+            # manifest usually predates the bump, and regressing would
+            # void the generation fence (an evicted zombie could sign
+            # manifests indistinguishable from the survivors')
+            self.gang.generation = max(self.gang.generation,
+                                       int(generation))
+        return step, sd, extra, report
+
     def resume(self) -> Optional[int]:
         """Load the newest intact checkpoint (skipping corrupt/torn files
         with a diagnosis in ``resume_report``), restore trainer state and
         the RNG stream, and return the resumed step — or None for a fresh
-        start."""
-        step, path, state, extra, report = latest_good_checkpoint(
+        start.  Gang manifests (sharded + ring-replicated checkpoints)
+        are auto-detected and preferred; monolithic ``ckpt.step_*`` files
+        remain the fallback."""
+        step, sd, extra, report = self._latest_gang_state()
+        if step is not None:
+            self.resume_report = report
+            self._load_into_trainer(sd, consider_splits=True)
+            self._step = int((extra or {}).get("step", step))
+            self._consec = 0
+            _obs_journal.record("resume", step=self._step, format="gang")
+            return self._step
+        mstep, path, state, mextra, mreport = latest_good_checkpoint(
             self.ckpt_dir)
-        self.resume_report = report
-        if step is None:
+        self.resume_report = report + mreport
+        if mstep is None:
             return None
         self._load_into_trainer(state)
-        self._step = int(extra.get("step", step))
+        self._step = int(mextra.get("step", mstep))
         self._consec = 0
         _obs_journal.record("resume", step=self._step, path=path)
         return self._step
@@ -340,15 +388,23 @@ class ResilientTrainer:
                   if not any(k.startswith(p) for p in prefixes)}
         return sd
 
-    def _load_into_trainer(self, sd: dict) -> None:
-        self.trainer.state = _to_device(
-            load_state_dict(self.trainer.state, sd))
+    def _load_into_trainer(self, sd: dict,
+                           consider_splits: bool = False) -> None:
+        self.trainer.state = _to_device(load_state_dict(
+            self.trainer.state, sd, consider_splits=consider_splits))
 
     # -- checkpointing ------------------------------------------------------
 
     def save(self, sync: bool = False) -> str:
         """Checkpoint the current state (async by default) and prune the
-        rolling retention window."""
+        rolling retention window.  With a gang checkpointer attached the
+        save is this worker's shard + ring replica (+ manifest on the
+        writer rank) and is synchronous: the manifest must not sign a
+        shard that is still in flight."""
+        if self.gang is not None:
+            self._ck.wait()  # order after any in-flight monolithic save
+            return self.gang.save(self._step, self._capture(),
+                                  extra={"step": self._step})
         path = checkpoint_path(self.ckpt_dir, self._step)
         self._ck.save(path, self._capture(), extra={"step": self._step})
         if sync:
@@ -369,14 +425,18 @@ class ResilientTrainer:
         # make it durable before scanning so we roll back as little as
         # possible
         self._ck.wait()
-        step, _path, state, extra, report = latest_good_checkpoint(
-            self.ckpt_dir)
+        gstep, gsd, gextra, greport = self._latest_gang_state()
+        if gstep is not None:
+            step, state, extra, report = gstep, gsd, gextra or {}, greport
+        else:
+            step, _path, state, extra, report = latest_good_checkpoint(
+                self.ckpt_dir)
         if step is None:
             raise TrainingDiverged(
                 f"{self._consec} consecutive anomalous steps and no intact "
                 f"checkpoint to roll back to in {self.ckpt_dir!r} "
-                f"(scanned: {[(s, d) for s, _p, d in report]})")
-        self._load_into_trainer(state)
+                f"(scanned: {[(s, d) for s, _p, d in greport + report]})")
+        self._load_into_trainer(state, consider_splits=gstep is not None)
         self.rollbacks.append((self._step, int(extra.get("step", step))))
         if _obs.enabled():
             _res_m()["rollbacks"].inc()
@@ -544,8 +604,12 @@ class ResilientTrainer:
             return
         signum, self._preempt_signum = self._preempt_signum, None
         self._ck.wait()  # order after any in-flight periodic save
-        save_checkpoint(checkpoint_path(self.ckpt_dir, self._step),
-                        self._capture(), extra={"step": self._step})
+        if self.gang is not None:
+            self.gang.save(self._step, self._capture(),
+                           extra={"step": self._step})
+        else:
+            save_checkpoint(checkpoint_path(self.ckpt_dir, self._step),
+                            self._capture(), extra={"step": self._step})
         if _obs.enabled():
             _res_m()["preemptions"].inc()
             _obs_journal.record("preemption", step=self._step,

@@ -32,6 +32,7 @@ ENV_COORD = "HETU_TPU_COORD"
 ENV_NPROC = "HETU_TPU_NPROC"
 ENV_PROC_ID = "HETU_TPU_PROC_ID"
 ENV_EMBED_SERVERS = "HETU_TPU_EMBED_SERVERS"
+ENV_GANG_DIR = "HETU_TPU_GANG_DIR"
 
 
 @dataclasses.dataclass
@@ -175,7 +176,7 @@ def launch(cfg: DistConfig, argv: Sequence[str],
     ``"server:<addr>"``."""
     procs = []
     carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, ENV_EMBED_SERVERS,
-             "JAX_PLATFORMS", "XLA_FLAGS",
+             ENV_GANG_DIR, "JAX_PLATFORMS", "XLA_FLAGS",
              "PYTHONPATH"] + sorted(extra_env or ())
     for host, port in cfg.server_table():
         srv_argv = [sys.executable, "-m", "hetu_tpu.embed.net",
@@ -207,7 +208,8 @@ def launch(cfg: DistConfig, argv: Sequence[str],
 
 def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                      timeout: float = 120.0, port: int = 0, faults=None,
-                     restart_once: bool = False) -> list:
+                     restart_once: bool = False, gang_dir: Optional[str] = None,
+                     allow_failures: bool = False) -> list:
     """Run ``script`` in ``n`` local CPU processes joined into one jax
     distributed world.  Returns each process's stdout.  The CPU analogue of
     the reference's mpirun-on-localhost test pattern (tests/test_comm.py).
@@ -225,6 +227,19 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
     preemption-restart shape; its returned output is both runs
     concatenated.  Only the restarted worker's deadline is re-armed; the
     rest of the gang keeps the original one.
+
+    ``gang_dir``: exported to every worker as ``HETU_TPU_GANG_DIR`` so
+    scripts can join the elastic-gang protocol
+    (``exec.gang.GangMembership.from_env()`` + ``GangCheckpointer``).
+
+    ``allow_failures``: a worker that still exits non-zero (after any
+    ``restart_once`` retry) is recorded — its output gains a trailing
+    ``[worker i exited rc=N]`` line — instead of failing the gang; the
+    elastic-membership shape, where survivors are expected to carry on
+    past a dead peer.  ``worker_stall`` fault events SIGSTOP the target
+    worker for the event's ``duration`` seconds then SIGCONT it (the
+    straggler/GC-pause shape the heartbeat lease must ride out or
+    evict).
 
     With telemetry enabled, a monitor thread publishes per-worker
     heartbeat ages (``hetu_worker_heartbeat_age_seconds{worker=...}`` —
@@ -254,6 +269,8 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
     envs, procs = [], []
     for _host, _lr, pid in cfg.process_table():
         env = worker_env(cfg, pid)
+        if gang_dir is not None:
+            env[ENV_GANG_DIR] = gang_dir
         env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU jax (sitecustomize)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
@@ -269,9 +286,29 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
             proc.send_signal(sig)
 
     timers = []
+
+    def stall_worker(proc, duration):
+        # SIGSTOP/SIGCONT pair bound to the original incarnation, like
+        # kill_worker: a stall must not freeze a restarted replacement
+        import signal as _sig
+        if proc.poll() is None:
+            proc.send_signal(_sig.SIGSTOP)
+            t2 = threading.Timer(
+                duration, lambda: proc.poll() is None
+                and proc.send_signal(_sig.SIGCONT))
+            t2.daemon = True
+            t2.start()
+            timers.append(t2)
+
     if faults is not None:
         for widx, delay, sig in faults.worker_kills(len(procs)):
             t = threading.Timer(delay, kill_worker, (procs[widx], sig))
+            t.daemon = True
+            t.start()
+            timers.append(t)
+        for widx, delay, duration in faults.worker_stalls(len(procs)):
+            t = threading.Timer(delay, stall_worker,
+                                (procs[widx], duration))
             t.daemon = True
             t.start()
             timers.append(t)
@@ -324,6 +361,11 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                     deadlines[i] = time.monotonic() + timeout
                     procs[i] = spawn(envs[i])
                     continue  # collect the restarted run's output
+                if allow_failures:
+                    # elastic gangs expect dead peers; record, don't raise
+                    outs[i] += f"\n[worker {i} exited rc={p.returncode}]"
+                    i += 1
+                    continue
                 raise RuntimeError(
                     f"worker {i} failed (rc={p.returncode}):\n{outs[i]}")
             i += 1

@@ -19,7 +19,17 @@ kind                fields (beyond ``seq``/``ts``)
 ``preemption``        ``step``, ``signum``
 ``ps_redial``         ``address``, ``table_id``, ``attempt``,
                       ``table_created``
-``resume``            ``step``, ``path``
+``resume``            ``step``, ``path`` (monolithic) or ``format="gang"``
+``worker_lost``       ``rank``, ``generation``, ``reason``
+                      (``dead``/``lease_expired``), ``step``/``age_s``
+``gang_rescale``      ``generation``, ``old_world``, ``new_world``,
+                      ``resumed_step``/``survivors``
+``shard_restore``     ``rank``, ``from_rank``, ``step``, ``generation``
+                      (a checkpoint shard recovered from its ring
+                      replica)
+``manifest_skipped``  ``step``, ``generation``, ``reason`` (a peer's
+                      shard never landed — the checkpoint step fails
+                      soft and the previous manifest stays newest)
 ==================  =====================================================
 
 A journal is installed process-wide with :func:`set_journal` (or the

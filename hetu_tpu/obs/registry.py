@@ -238,7 +238,7 @@ class _Family:
         if not self.labelnames:
             self.labels()  # materialize the single child eagerly
 
-    def labels(self, *values, **kv) -> _Child:
+    def _resolve(self, values, kv) -> tuple:
         if kv:
             if values:
                 raise ValueError("pass label values positionally or by "
@@ -254,6 +254,10 @@ class _Family:
             raise ValueError(
                 f"{self.name}: expected labels {self.labelnames}, "
                 f"got {values}")
+        return values
+
+    def labels(self, *values, **kv) -> _Child:
+        values = self._resolve(values, kv)
         child = self._children.get(values)
         if child is None:
             with self._lock:
@@ -265,6 +269,18 @@ class _Family:
                         child = _CHILD_TYPES[self.kind](values)
                     self._children[values] = child
         return child
+
+    def remove(self, *values, **kv) -> bool:
+        """Drop the child time series for these label values — elastic
+        membership support: a worker that left the gang should disappear
+        from scrapes and snapshots instead of freezing at its last value.
+        Returns True when a child existed.  A later ``labels()`` call
+        with the same values starts a fresh series from zero (correct
+        for a *rejoining* member's gauges; do not use this on counters
+        whose continuity matters)."""
+        values = self._resolve(values, kv)
+        with self._lock:
+            return self._children.pop(values, None) is not None
 
     # unlabeled convenience proxies
     def inc(self, amount: float = 1.0) -> None:

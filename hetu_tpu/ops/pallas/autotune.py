@@ -76,12 +76,25 @@ def tuned_blocks(Sq: int, Sk: int, D: int,
     or None if never autotuned.  Consulted by flash._block_sizes at trace
     time (shapes are static under jit, so this is a plain dict lookup).
     Falls back to the causal-complement entry: the block-size optimum
-    tracks the (seq, head_dim) footprint, not the mask."""
+    tracks the (seq, head_dim) footprint, not the mask.
+
+    A complement fallback is *tagged*: a copy lands under the exact-mask
+    key in the in-memory cache with ``complement_fallback: True``, so
+    cache dumps show which masks are running on borrowed measurements —
+    and since the tag only lives in memory (the save path merges from
+    disk and drops the memo), a later exact-mask ``autotune_flash_blocks``
+    supersedes it."""
     cache = _load()
-    for c in (causal, not causal):
-        hit = cache.get(_key(Sq, Sk, D, c, None))
-        if hit:
-            return int(hit["block_q"]), int(hit["block_k"])
+    hit = cache.get(_key(Sq, Sk, D, causal, None))
+    if hit:
+        return int(hit["block_q"]), int(hit["block_k"])
+    comp = cache.get(_key(Sq, Sk, D, not causal, None))
+    if comp:
+        cache[_key(Sq, Sk, D, causal, None)] = {
+            "block_q": int(comp["block_q"]),
+            "block_k": int(comp["block_k"]),
+            "complement_fallback": True}
+        return int(comp["block_q"]), int(comp["block_k"])
     return None
 
 
@@ -167,6 +180,15 @@ def autotune_flash_blocks(Sq: int, Sk: int, D: int, *, causal: bool = False,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and (Sq < 128 or Sk < 128 or Sq % 128 or Sk % 128):
+        # fail NOW with the constraint named, not after the whole grid
+        # comes back empty as 'no flash block candidate ran: {}'
+        raise ValueError(
+            f"autotune_flash_blocks: Sq={Sq}, Sk={Sk} must be multiples "
+            f"of 128 (and >= 128) on TPU — the Pallas flash kernel's "
+            f"block grid is 128-lane aligned, so no candidate block size "
+            f"can divide this shape; pad the sequence to a 128 multiple "
+            f"or pass interpret=True for a CPU-interpreter sweep")
     rng = np.random.default_rng(0)
     mk = lambda: jnp.asarray(  # noqa: E731
         rng.standard_normal((batch, heads, Sq, D)) * 0.1, dtype)

@@ -66,6 +66,12 @@ def pytest_configure(config):
                    "policy registry / planner / offload and the remat "
                    "seams); full planner searches are additionally marked "
                    "slow")
+    config.addinivalue_line(
+        "markers", "gang: elastic-gang runtime tests (exec.gang sharded/"
+                   "ring-replicated checkpoints, membership leases, "
+                   "deterministic rescale); multi-process gang chaos runs "
+                   "are additionally marked slow — a fast 2-worker smoke "
+                   "stays in tier-1")
 
 
 @pytest.fixture

@@ -126,3 +126,19 @@ def test_remat_oom_disqualifies(monkeypatch, capture):
     line = _run(monkeypatch, capture, stub, variants=V4, remat_batch=48)
     assert line["remat"] is False and line["batch"] == 24
     assert line["ab_probe_ms"]["b48+remat"].startswith("failed:")
+
+
+def test_deadline_fallback_headlines_best_measured(monkeypatch, capture):
+    """Satellite: when the soft deadline trips, _bert_mfu degrades to
+    variants[0] with no probes — so the bert512 list must lead with the
+    variant the last on-chip round actually measured fastest (the XLA
+    bhsd core, TPU_CHECKS_r04: 225 ms vs flash's 274)."""
+    assert bench.BERT512_VARIANTS[0] == ("xla", False)
+    monkeypatch.setattr(bench, "_behind_schedule", lambda: True)
+    stub = _Stub({("xla", False, False, 24): 0.25})
+    line = _run(monkeypatch, capture, stub,
+                variants=bench.BERT512_VARIANTS)
+    # exactly one measurement: the fallback variant, no A/B probes
+    assert [c[:2] for c in stub.calls] == [("xla", False)]
+    assert line["flash_attention"] is False and line["fused_ln"] is False
+    assert "ab_probe_ms" not in line

@@ -47,6 +47,56 @@ def test_autotune_runs_and_persists(tune_cache):
     assert at.tuned_blocks(16, 16, 4, causal=True) is None
 
 
+def test_alignment_validated_up_front(tune_cache):
+    """Satellite: on TPU (interpret=False), a sequence that is not a
+    multiple of 128 must be rejected immediately with the constraint
+    named — not after the whole candidate grid comes back empty as the
+    baffling 'no flash block candidate ran: {}'."""
+    for Sq, Sk in ((100, 128), (128, 100), (64, 64), (384, 200)):
+        with pytest.raises(ValueError, match="multiples\\s*of 128") as ei:
+            at.autotune_flash_blocks(Sq, Sk, 64, interpret=False)
+        assert f"Sq={Sq}" in str(ei.value)  # names the offending shape
+    # an aligned shape sails past the validation (and into measurement,
+    # which we stub out — the real sweep is the slow test's job)
+    with pytest.raises(RuntimeError, match="no flash block candidate"):
+        at.autotune_flash_blocks(
+            128, 128, 64, interpret=False, save=False, budget_s=-1.0)
+
+
+def test_complement_fallback_tagged_and_superseded(tune_cache,
+                                                   monkeypatch):
+    """Satellite: a complement-mask cache fallback is tagged in the
+    in-memory cache (identifiable as a borrowed measurement, never
+    persisted), and a later exact-mask tune supersedes it."""
+    key_c1 = at._key(8, 8, 4, True, None)
+    key_c0 = at._key(8, 8, 4, False, None)
+    tune_cache.write_text(json.dumps(
+        {key_c1: {"block_q": 8, "block_k": 8}}))
+    at.clear_tune_cache()
+    # exact miss, complement hit: returned AND tagged under the exact key
+    assert at.tuned_blocks(8, 8, 4, causal=False) == (8, 8)
+    assert at._load()[key_c0]["complement_fallback"] is True
+    # repeat lookups hit the tagged memo, same answer
+    assert at.tuned_blocks(8, 8, 4, causal=False) == (8, 8)
+    # the tag never reaches disk
+    assert key_c0 not in json.loads(tune_cache.read_text())
+    # a later exact-mask tune supersedes: run the real tuner with only
+    # the timer stubbed (the interpret-mode kernel sweep is the slow
+    # test's job) — its save path merges against disk and drops the
+    # memoized tag
+    monkeypatch.setattr(
+        at, "_time_fwd_bwd",
+        lambda bq, bk, *a, **kw: 1.0 if (bq, bk) != (4, 4) else 0.5)
+    entry = at.autotune_flash_blocks(8, 8, 4, causal=False, batch=1,
+                                     heads=1, dtype=jnp.float32,
+                                     interpret=True, n1=1, n2=2)
+    assert (entry["block_q"], entry["block_k"]) == (4, 4)
+    assert at.tuned_blocks(8, 8, 4, causal=False) == (4, 4)
+    assert "complement_fallback" not in at._load()[key_c0]
+    # the complement (causal=True) entry still answers exactly
+    assert at.tuned_blocks(8, 8, 4, causal=True) == (8, 8)
+
+
 def test_block_sizes_priority(tune_cache):
     # seed a fake measured entry
     tune_cache.write_text(json.dumps({

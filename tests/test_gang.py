@@ -1,0 +1,691 @@
+"""Elastic gang runtime under deterministic chaos.
+
+Every path here is the production code path: shards and replicas go
+through the real ``_atomic_write`` CRC pipeline, manifests through the
+real signature verification, membership through the real lease files,
+and faults through the ``exec.faults`` seams.  The acceptance tests
+assert the strongest property an elastic runtime can have: a 4-worker
+gang that loses a worker (and that worker's storage) mid-run recovers
+from ring-replicated shards, rescales, and a seeded replay of the same
+``FaultPlan`` is **bitwise identical** — journal, checkpoint CRCs, final
+loss, final parameters; and a kill-then-rejoin n→n run matches the
+uninterrupted run bitwise.
+"""
+
+import json
+import os
+import shutil
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec import (ResilientTrainer, Trainer, faults, gang)
+from hetu_tpu.exec.gang import (ElasticGang, GangCheckpointer,
+                                GangManifestError, GangMembership,
+                                gang_data_partition, load_gang_checkpoint,
+                                read_manifest, ring_neighbor, save_shard,
+                                shard_owner, worker_dir, worker_rng_key,
+                                write_manifest)
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.obs import registry as obs_registry
+from hetu_tpu.models import MLP
+from hetu_tpu.optim import SGDOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+pytestmark = [pytest.mark.gang, pytest.mark.chaos]
+
+
+# ---------------------------------------------------------------- helpers
+
+def make_trainer():
+    set_random_seed(0)
+    model = MLP((8, 16, 3))
+
+    def loss_fn(model, batch, key):
+        logits = model(batch["x"])
+        return softmax_cross_entropy_sparse(logits, batch["y"]).mean(), {}
+
+    return Trainer(model, SGDOptimizer(0.1), loss_fn, donate=False)
+
+
+def make_data(n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        out.append({"x": x, "y": (x[:, 0] > 0).astype(np.int32)})
+    return out
+
+
+def params_of(tr):
+    return np.asarray(tr.state.model.layers[0].w)
+
+
+def norm_events(jr):
+    """Journal events with wall-clock noise stripped: ``ts`` always,
+    write duration, and the tmp-dir prefix of checkpoint paths (the last
+    two path components — worker_RRRR/shard.step_N — stay)."""
+    out = []
+    for e in jr.events:
+        e = {k: v for k, v in e.items() if k != "ts"}
+        if e["kind"] == "checkpoint_saved":
+            e.pop("duration_s", None)
+            e["path"] = "/".join(e["path"].split(os.sep)[-2:])
+        out.append(e)
+    return out
+
+
+def build_gang(tmpdir, data, world=4, seed=0, save_every=2, lease_steps=1):
+    tr = make_trainer()
+    g = ElasticGang(tr, str(tmpdir), world_size=world,
+                    data_fn=lambda s: data[s - 1], global_batch_size=16,
+                    seed=seed, save_every=save_every,
+                    lease_steps=lease_steps)
+    return g, tr
+
+
+def flat_sd(n_params=8):
+    return {f"p{i}.w": np.full(3, float(i), np.float32)
+            for i in range(n_params)}
+
+
+# ----------------------------------------------- pure rescale functions
+
+class TestDeterministicRescale:
+    def test_shard_owner_pure_and_covers_all_ranks(self):
+        names = [f"layer{i}.block.{j}.w" for i in range(16)
+                 for j in range(4)]
+        for world in (1, 2, 3, 4, 7):
+            owners = {n: shard_owner(n, world) for n in names}
+            assert owners == {n: shard_owner(n, world) for n in names}
+            assert set(owners.values()) <= set(range(world))
+            # 64 names over <=7 ranks: a sane hash leaves nobody empty
+            assert set(owners.values()) == set(range(world))
+
+    def test_ring_neighbor(self):
+        assert [ring_neighbor(r, 4) for r in range(4)] == [1, 2, 3, 0]
+        assert ring_neighbor(0, 1) == 0
+
+    def test_partition_is_a_permutation_split(self):
+        parts = gang_data_partition(0, 0, 3, 5, 16)
+        assert len(parts) == 3
+        allidx = np.concatenate(parts)
+        assert sorted(allidx) == list(range(16))
+        # near-even split
+        assert {len(p) for p in parts} <= {5, 6}
+
+    def test_partition_pure_in_all_arguments(self):
+        a = gang_data_partition(0, 1, 4, 7, 16)
+        b = gang_data_partition(0, 1, 4, 7, 16)
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+        for other in (gang_data_partition(1, 1, 4, 7, 16),
+                      gang_data_partition(0, 2, 4, 7, 16),
+                      gang_data_partition(0, 1, 4, 8, 16)):
+            assert any(not np.array_equal(x, y)
+                       for x, y in zip(a, other))
+        # different world size: different shape but SAME global set
+        c = gang_data_partition(0, 1, 3, 7, 16)
+        assert sorted(np.concatenate(c)) == sorted(np.concatenate(a))
+
+    def test_worker_rng_key_pure_and_distinct(self):
+        import jax.random as jrandom
+        k = worker_rng_key(0, 1, 4, 2)
+        assert np.array_equal(jrandom.key_data(k),
+                              jrandom.key_data(worker_rng_key(0, 1, 4, 2)))
+        others = [worker_rng_key(0, 1, 4, 3), worker_rng_key(0, 2, 4, 2),
+                  worker_rng_key(0, 1, 3, 2), worker_rng_key(1, 1, 4, 2)]
+        for o in others:
+            assert not np.array_equal(jrandom.key_data(k),
+                                      jrandom.key_data(o))
+
+
+# ------------------------------------------------- manifests and shards
+
+class TestManifest:
+    def test_roundtrip_and_signature(self, tmp_path):
+        d = str(tmp_path)
+        sd = flat_sd()
+        for r in range(3):
+            save_shard(d, r, 3, 4, sd, generation=1)
+        p = write_manifest(d, 4, 1, 3, rng=(0, 7), extra={"step": 4})
+        man = read_manifest(p)
+        assert man["step"] == 4 and man["generation"] == 1
+        assert man["world_size"] == 3 and man["rng"] == [0, 7]
+        assert set(man["shards"]) == {"0", "1", "2"}
+
+    def test_tampered_manifest_rejected(self, tmp_path):
+        d = str(tmp_path)
+        sd = flat_sd()
+        save_shard(d, 0, 1, 2, sd)
+        p = write_manifest(d, 2, 0, 1)
+        body = json.loads(open(p).read())
+        body["step"] = 99  # tamper after signing
+        with open(p, "w") as f:
+            f.write(json.dumps(body))
+        with pytest.raises(GangManifestError, match="signature mismatch"):
+            read_manifest(p)
+
+    def test_torn_manifest_rejected(self, tmp_path):
+        d = str(tmp_path)
+        save_shard(d, 0, 1, 2, flat_sd())
+        p = write_manifest(d, 2, 0, 1)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(GangManifestError, match="torn"):
+            read_manifest(p)
+
+    def test_compose_roundtrip_all_world_sizes(self, tmp_path):
+        sd = flat_sd(13)
+        for world in (1, 2, 4):
+            d = str(tmp_path / f"w{world}")
+            for r in range(world):
+                save_shard(d, r, world, 6, sd)
+            write_manifest(d, 6, 0, world, rng=(0, 3))
+            step, gen, sd2, _extra, report = load_gang_checkpoint(
+                d, restore_rng=False)
+            assert step == 6 and gen == 0
+            assert set(sd2) == set(sd)
+            for k in sd:
+                np.testing.assert_array_equal(sd[k], sd2[k])
+            assert report[-1][2] is None
+
+    def test_any_single_shard_dir_loss_recovers_via_replica(self, tmp_path):
+        """Acceptance: deleting ANY one worker's shard directory still
+        composes the same state via the ring predecessor's replica, with
+        a shard_restore journal event."""
+        sd = flat_sd(13)
+        base = str(tmp_path / "base")
+        for r in range(4):
+            save_shard(base, r, 4, 6, sd)
+        write_manifest(base, 6, 0, 4, rng=(0, 3))
+        for victim in range(4):
+            d = str(tmp_path / f"loss{victim}")
+            shutil.copytree(base, d)
+            shutil.rmtree(worker_dir(d, victim))
+            jr = obs_journal.EventJournal(clock=lambda: 0.0)
+            with obs_journal.use(jr):
+                step, _gen, sd2, _extra, _rep = load_gang_checkpoint(
+                    d, restore_rng=False)
+            assert step == 6
+            for k in sd:
+                np.testing.assert_array_equal(sd[k], sd2[k])
+            events = jr.of_kind("shard_restore")
+            assert [(e["rank"], e["from_rank"]) for e in events] == \
+                [(victim, (victim - 1) % 4)]
+
+    def test_shard_and_its_replica_lost_falls_back_to_older_manifest(
+            self, tmp_path):
+        d = str(tmp_path)
+        sd_old, sd_new = flat_sd(8), {k: v + 1 for k, v in
+                                      flat_sd(8).items()}
+        for step, sd in ((2, sd_old), (4, sd_new)):
+            for r in range(4):
+                save_shard(d, r, 4, step, sd)
+            write_manifest(d, step, 0, 4, rng=(0, step))
+        # lose rank 1's step-4 shard AND the replica rank 0 held
+        os.remove(gang.shard_path(d, 1, 4))
+        os.remove(gang.replica_path(d, 0, 1, 4))
+        step, _gen, sd2, _extra, report = load_gang_checkpoint(
+            d, restore_rng=False)
+        assert step == 2
+        np.testing.assert_array_equal(sd2["p0.w"], sd_old["p0.w"])
+        assert "unrecoverable" in report[0][2]
+
+    def test_torn_manifest_falls_back_to_previous_generation(self, tmp_path):
+        """Satellite: a torn manifest next to perfectly good shards must
+        fall back to the previous generation's manifest, not fail the
+        resume — and ``latest_good_checkpoint`` (the monolithic scan)
+        stays out of the way."""
+        d = str(tmp_path)
+        sd_old, sd_new = flat_sd(8), {k: v + 1 for k, v in
+                                      flat_sd(8).items()}
+        for r in range(3):
+            save_shard(d, r, 3, 2, sd_old, generation=0)
+        write_manifest(d, 2, 0, 3, rng=(0, 2))
+        for r in range(2):
+            save_shard(d, r, 2, 5, sd_new, generation=1)
+        p = write_manifest(d, 5, 1, 2, rng=(0, 5))
+        with open(p, "r+b") as f:  # torn write of the newest manifest
+            f.truncate(os.path.getsize(p) // 3)
+        step, gen, sd2, _extra, report = load_gang_checkpoint(
+            d, restore_rng=False)
+        assert (step, gen) == (2, 0)
+        np.testing.assert_array_equal(sd2["p0.w"], sd_old["p0.w"])
+        assert "torn" in report[0][2] and report[1][2] is None
+        # the resume path composes the same fallback
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, d, save_every=0)
+        assert rt.resume() is not None
+        assert rt.step_count == 2
+        rt.close()
+
+
+# ------------------------------------------ ResilientTrainer integration
+
+class TestResilientTrainerGang:
+    def test_gang_save_resume_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, d, save_every=1, keep=3,
+                              gang=GangCheckpointer(d, 0, 1, keep=3))
+        bs = make_data(3)
+        import jax.numpy as jnp
+        for b in bs:
+            rt.step({k: jnp.asarray(v) for k, v in b.items()})
+        rt.close()
+        assert [s for s, _p in gang.list_manifests(d)] == [1, 2, 3]
+        tr2 = make_trainer()
+        rt2 = ResilientTrainer(tr2, d, save_every=0)  # no gang arg:
+        assert rt2.resume() == 3                      # format auto-detected
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+        rt2.close()
+
+    def test_gang_rollback_after_anomalies(self, tmp_path):
+        d = str(tmp_path)
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, d, save_every=1, keep=3,
+                              max_consecutive_anomalies=1,
+                              gang=GangCheckpointer(d, 0, 1, keep=3))
+        import jax.numpy as jnp
+        bs = [{k: jnp.asarray(v) for k, v in b.items()}
+              for b in make_data(4)]
+        with faults.inject(faults.FaultPlan([(3, "grad_nan")])) as plan:
+            rt.step(bs[0])
+            rt.step(bs[1])
+            m = rt.step(bs[2])  # poisoned: skip, then gang rollback
+        assert plan.remaining() == []
+        assert m.get("skipped") and m["rolled_back_to"] == 2
+        assert rt.rollbacks == [(2, 2)]
+        rt.close()
+
+    def test_auto_detect_from_elastic_gang_checkpoints(self, tmp_path):
+        data = make_data()
+        g, tr = build_gang(tmp_path, data)
+        g.run_until(6)
+        tr2 = make_trainer()
+        rt2 = ResilientTrainer(tr2, str(tmp_path), save_every=0)
+        assert rt2.resume() == 6
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+        rt2.close()
+
+
+# ------------------------------------------------ the chaos acceptance
+
+class TestElasticGangChaos:
+    def _chaos_run(self, d, data):
+        """One seeded 4-worker run: worker 2 dies at step 5 AND its shard
+        directory is wiped; survivors recover from the ring replica and
+        rescale 4→3."""
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        plan = faults.FaultPlan([
+            (5, faults.Fault("worker_kill", worker=2)),
+            (5, faults.Fault("shard_loss", worker=2))])
+        with obs_journal.use(jr):
+            g, tr = build_gang(d, data)
+            with faults.inject(plan):
+                g.run_until(10)
+        return g, tr, jr, plan
+
+    def test_kill_plus_shard_loss_recovers_and_replays_bitwise(
+            self, tmp_path):
+        """THE acceptance test: kill + storage loss mid-run; survivors
+        restore from ring-replicated shards and rescale 4→3; a seeded
+        replay of the same FaultPlan produces a bitwise-identical journal
+        (modulo wall clock), identical checkpoint CRC32s, and identical
+        final loss and parameters."""
+        data = make_data()
+        gA, trA, jA, planA = self._chaos_run(tmp_path / "a", data)
+        assert planA.remaining() == []  # every fault actually fired
+        assert gA.world_size == 3 and gA.generation == 1
+        kinds = [e["kind"] for e in jA.events
+                 if e["kind"] in ("worker_lost", "shard_restore",
+                                  "gang_rescale")]
+        assert kinds == ["worker_lost", "shard_restore", "gang_rescale"]
+        lost, = jA.of_kind("worker_lost")
+        assert (lost["rank"], lost["reason"]) == (2, "dead")
+        restore, = jA.of_kind("shard_restore")
+        assert (restore["rank"], restore["from_rank"],
+                restore["step"]) == (2, 1, 4)
+        rescale, = jA.of_kind("gang_rescale")
+        assert (rescale["old_world"], rescale["new_world"],
+                rescale["resumed_step"]) == (4, 3, 4)
+        # steps 5 and 6 were replayed after the rollback to step 4
+        assert len(gA.history) == 10 + 1
+        assert sorted(gA.losses_by_step) == list(range(1, 11))
+
+        gB, trB, jB, _planB = self._chaos_run(tmp_path / "b", data)
+        assert norm_events(jA) == norm_events(jB)  # incl. shard CRC32s
+        assert gA.losses_by_step == gB.losses_by_step  # plain float ==
+        np.testing.assert_array_equal(params_of(trA), params_of(trB))
+
+    def test_kill_then_rejoin_matches_uninterrupted_bitwise(self, tmp_path):
+        """Acceptance: a 4→3→4 kill/recover/rejoin run is bitwise
+        identical — every per-step loss and the final parameters — to an
+        uninterrupted 4-worker run."""
+        data = make_data()
+        g, tr = build_gang(tmp_path / "elastic", data)
+        plan = faults.FaultPlan([(5, faults.Fault("worker_kill",
+                                                  worker=1))])
+        with faults.inject(plan):
+            g.run_until(8)
+        assert plan.remaining() == []
+        assert (g.world_size, g.generation) == (3, 1)
+        g.rejoin(1)
+        assert (g.world_size, g.generation) == (4, 2)
+        g.run_until(12)
+
+        oracle, tro = build_gang(tmp_path / "oracle", data)
+        oracle.run_until(12)
+        assert g.losses_by_step == oracle.losses_by_step  # bitwise
+        np.testing.assert_array_equal(params_of(tr), params_of(tro))
+
+    def test_stall_within_lease_rides_out(self, tmp_path):
+        data = make_data()
+        g, _tr = build_gang(tmp_path, data, lease_steps=2)
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        plan = faults.FaultPlan([(3, faults.Fault("worker_stall",
+                                                  worker=1, arg=2))])
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(8)
+        assert plan.remaining() == []
+        assert (g.world_size, g.generation) == (4, 0)  # no eviction
+        assert jr.of_kind("worker_lost") == []
+
+    def test_stall_past_lease_evicts(self, tmp_path):
+        data = make_data()
+        g, _tr = build_gang(tmp_path, data, lease_steps=1)
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        plan = faults.FaultPlan([(3, faults.Fault("worker_stall",
+                                                  worker=1, arg=5))])
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(8)
+        assert (g.world_size, g.generation) == (3, 1)
+        lost, = jr.of_kind("worker_lost")
+        assert (lost["rank"], lost["reason"]) == (1, "lease_expired")
+
+    def test_rescale_before_first_checkpoint_restarts_clean(self, tmp_path):
+        data = make_data()
+        g, tr = build_gang(tmp_path, data, save_every=0)  # never saves
+        plan = faults.FaultPlan([(2, faults.Fault("worker_kill",
+                                                  worker=0))])
+        with faults.inject(plan):
+            g.run_until(4)
+        assert (g.world_size, g.generation) == (3, 1)
+        # rewound to the pristine snapshot and re-trained through step 4
+        assert sorted(g.losses_by_step) == [1, 2, 3, 4]
+
+    def test_gang_gauges_track_membership(self, tmp_path):
+        data = make_data()
+        reg = obs_registry.get_registry()
+        g, _tr = build_gang(tmp_path, data)
+        snap = reg.snapshot()
+        assert snap["hetu_gang_size"] == 4.0
+        assert snap['hetu_gang_worker_alive{worker="3"}'] == 1.0
+        plan = faults.FaultPlan([(3, faults.Fault("worker_kill",
+                                                  worker=3))])
+        with faults.inject(plan):
+            g.run_until(6)
+        snap = reg.snapshot()
+        assert snap["hetu_gang_size"] == 3.0
+        assert snap["hetu_gang_generation"] == 1.0
+        # the departed worker's series is REMOVED, not frozen at 1
+        assert 'hetu_gang_worker_alive{worker="3"}' not in snap
+
+
+# -------------------------------------------------- review regressions
+
+class TestReviewRegressions:
+    def test_mismatched_gang_dir_rejected(self, tmp_path):
+        """saves would land where the gang points while resume scans
+        ckpt_dir — the constructor must refuse the silent mismatch."""
+        tr = make_trainer()
+        with pytest.raises(ValueError, match="gang_dir"):
+            ResilientTrainer(tr, str(tmp_path / "a"),
+                             gang=GangCheckpointer(str(tmp_path / "b"),
+                                                   0, 1))
+
+    def test_resume_never_lowers_gang_generation(self, tmp_path):
+        """A post-rescale resume loads a manifest that predates the bump;
+        adopting its generation would void the generation fence."""
+        d = str(tmp_path)
+        save_shard(d, 0, 1, 2, flat_sd())
+        write_manifest(d, 2, 0, 1, rng=(0, 2))  # generation 0
+        tr = make_trainer()
+        ck = GangCheckpointer(d, 0, 1, generation=2)  # already rescaled
+        rt = ResilientTrainer(tr, d, save_every=0, gang=ck)
+        assert rt.resume() == 2
+        assert ck.generation == 2  # not regressed to the manifest's 0
+        rt.close()
+
+    def test_gang_leaves_simulate_workers_events_pending(self, tmp_path):
+        """Each harness only consumes events in its own convention: a
+        worker=None kill (step = worker index) must survive an
+        ElasticGang run untouched."""
+        data = make_data()
+        g, _tr = build_gang(tmp_path, data)
+        plan = faults.FaultPlan([
+            (3, faults.Fault("worker_kill", arg=1.0)),        # sim-workers
+            (3, faults.Fault("worker_stall", worker=1, arg=1))])  # gang
+        with faults.inject(plan):
+            g.run_until(6)
+        # the gang consumed only its own event; the process-level kill
+        # is still pending for simulate_workers
+        assert [(s, f.kind) for s, f in plan.remaining()] == \
+            [(3, "worker_kill")]
+        assert (g.world_size, g.generation) == (4, 0)
+
+    def test_prune_sweeps_orphaned_manifestless_shards(self, tmp_path):
+        """Shards of a manifest_skipped step older than the retention
+        cutoff must be swept, not leak forever."""
+        d = str(tmp_path)
+        sd = flat_sd()
+        for step in (2, 4, 6, 8):
+            for r in range(2):
+                save_shard(d, r, 2, step, sd)
+            if step != 4:  # step 4's manifest "failed soft"
+                write_manifest(d, step, 0, 2)
+        gang.prune_gang(d, keep=2)
+        assert [s for s, _p in gang.list_manifests(d)] == [6, 8]
+        leftover = sorted({int(p.rsplit("_", 1)[1]) for p in
+                           __import__("glob").glob(
+                               os.path.join(d, "worker_*", "*.step_*"))})
+        assert leftover == [6, 8]  # 2 AND the orphaned 4 are gone
+
+
+# --------------------------------------------------- registry elasticity
+
+def test_registry_remove_drops_series():
+    reg = obs_registry.get_registry()
+    fam = reg.gauge("test_gang_remove_gauge", "scratch", ("worker",))
+    fam.labels(worker="7").set(1.0)
+    assert 'test_gang_remove_gauge{worker="7"}' in reg.snapshot()
+    assert fam.remove(worker="7") is True
+    assert 'test_gang_remove_gauge{worker="7"}' not in reg.snapshot()
+    assert fam.remove(worker="7") is False
+    with pytest.raises(ValueError, match="expected labels"):
+        fam.remove("a", "b")
+
+
+# ------------------------------------------------------------ membership
+
+class TestGangMembership:
+    def test_lease_lifecycle_with_fake_clock(self, tmp_path):
+        now = [100.0]
+        clock = lambda: now[0]  # noqa: E731
+        ms = [GangMembership(str(tmp_path), r, lease_ttl=2.0, clock=clock)
+              for r in range(3)]
+        for m in ms:
+            m.heartbeat()
+        assert ms[0].members() == [0, 1, 2]
+        assert ms[0].alive() == [0, 1, 2]
+        now[0] += 3.0  # everyone stale
+        ms[0].heartbeat()
+        ms[1].heartbeat()  # 0 and 1 renew, 2 does not
+        jr = obs_journal.EventJournal(clock=clock)
+        with obs_journal.use(jr):
+            assert ms[0].lost() == [2]
+            assert ms[0].lost() == [2]  # detected again, journaled once
+        lost, = jr.of_kind("worker_lost")
+        assert lost["rank"] == 2 and lost["reason"] == "lease_expired"
+        assert lost["age_s"] == 3.0
+
+    def test_leave_is_clean_departure(self, tmp_path):
+        m = GangMembership(str(tmp_path), 0, lease_ttl=0.001)
+        m.heartbeat()
+        m.leave()
+        assert m.members() == []  # no lease left to expire
+
+    def test_barrier_and_rescale(self, tmp_path):
+        now = [0.0]
+        clock = lambda: now[0]  # noqa: E731
+        m0 = GangMembership(str(tmp_path), 0, lease_ttl=1.0, clock=clock)
+        m1 = GangMembership(str(tmp_path), 1, lease_ttl=1.0, clock=clock)
+        m2 = GangMembership(str(tmp_path), 2, lease_ttl=1.0, clock=clock)
+        for m in (m0, m1, m2):
+            m.heartbeat()
+        now[0] += 2.0
+        m0.heartbeat()
+        m1.heartbeat()  # worker 2 is now expired
+        results = {}
+
+        def rescale(m):
+            results[m.rank] = m.rescale(timeout=10.0)
+
+        t = threading.Thread(target=rescale, args=(m1,))
+        t.start()
+        results[0] = m0.rescale(timeout=10.0)
+        t.join(10.0)
+        assert results[0] == results[1] == (1, {0: 0, 1: 1})
+        assert m0.members() == [0, 1]  # the stale lease was cleared
+        assert m0.lost() == []
+
+    def test_barrier_timeout_names_stragglers(self, tmp_path):
+        m = GangMembership(str(tmp_path), 0)
+        with pytest.raises(TimeoutError, match=r"\[1\]"):
+            m.barrier(1, [0, 1], timeout=0.2, poll=0.02)
+
+
+# ----------------------------------------------- multi-process smokes
+
+def test_two_process_gang_smoke(tmp_path):
+    """Tier-1 smoke of the multi-process protocol: 2 real processes
+    heartbeat into a shared gang dir and write a sharded checkpoint with
+    ring replication; worker 1 dies WITHOUT removing its lease; worker 0
+    detects the expiry, commits generation 1 alone, and composes the full
+    state back from the manifest."""
+    from hetu_tpu.launch import simulate_workers
+
+    gang_dir = str(tmp_path / "gang")
+    script = textwrap.dedent("""
+        import os, time
+        import numpy as np
+        import hetu_tpu.exec.gang as G
+        from hetu_tpu.core import set_random_seed
+
+        rank = int(os.environ["HETU_TPU_PROC_ID"])
+        gd = os.environ["HETU_TPU_GANG_DIR"]
+        set_random_seed(0)
+        mem = G.GangMembership(gd, rank, lease_ttl=1.0, interval=0.1)
+        mem.start()
+        sd = {f"p{i}.w": np.full(2, float(i), np.float32)
+              for i in range(6)}
+        ck = G.GangCheckpointer(gd, rank, 2, keep=2, manifest_timeout=60.0)
+        ck.save(1, sd, extra={"step": 1})
+        print("SAVED", rank, flush=True)
+        if rank == 1:
+            os._exit(0)  # dies; the lease stays behind to expire
+        deadline = time.time() + 30
+        while time.time() < deadline and 1 not in mem.lost():
+            time.sleep(0.1)
+        assert 1 in mem.lost(), "peer loss never detected"
+        gen, rank_map = mem.rescale(timeout=15)
+        ck.rescale(rank_map[0], len(rank_map), gen)
+        step, g2, sd2, extra, report = G.load_gang_checkpoint(
+            gd, restore_rng=False)
+        ok = (step == 1 and len(sd2) == len(sd)
+              and all(np.array_equal(sd[k], sd2[k]) for k in sd))
+        print(f"SMOKE rank=0 gen={gen} world={len(rank_map)} ok={ok}",
+              flush=True)
+        mem.leave()
+    """)
+    outs = simulate_workers(2, script, timeout=120.0, gang_dir=gang_dir)
+    assert "SAVED 1" in outs[1]
+    assert "SMOKE rank=0 gen=1 world=1 ok=True" in outs[0], outs[0]
+    # the manifest + both shard dirs really landed on the shared dir
+    assert [s for s, _p in gang.list_manifests(gang_dir)] == [1]
+    assert os.path.isdir(worker_dir(gang_dir, 0))
+    assert os.path.isdir(worker_dir(gang_dir, 1))
+
+
+@pytest.mark.slow
+def test_multiprocess_gang_kill_rescale_resume(tmp_path):
+    """Full multi-process chaos: 3 worker processes train in lock-step
+    with gang-sharded checkpoints through ``ResilientTrainer(gang=...)``;
+    a ``worker_kill`` fault SIGKILLs worker 2 mid-run; the survivors'
+    heartbeat leases detect the loss, they barrier on generation 1,
+    resume from the newest manifest, and finish with bitwise-identical
+    parameters."""
+    from hetu_tpu.launch import simulate_workers
+
+    gang_dir = str(tmp_path / "gang")
+    script = textwrap.dedent("""
+        import os, time, zlib
+        import numpy as np
+        import jax.numpy as jnp
+        import hetu_tpu.exec.gang as G
+        from hetu_tpu.core import set_random_seed
+        from hetu_tpu.exec import ResilientTrainer, Trainer
+        from hetu_tpu.models import MLP
+        from hetu_tpu.optim import SGDOptimizer
+        from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+        rank = int(os.environ["HETU_TPU_PROC_ID"])
+        world = 3
+        gd = os.environ["HETU_TPU_GANG_DIR"]
+        set_random_seed(0)
+        tr = Trainer(MLP((8, 16, 3)), SGDOptimizer(0.1),
+                     lambda m, b, k: (softmax_cross_entropy_sparse(
+                         m(b["x"]), b["y"]).mean(), {}),
+                     donate=False)
+        mem = G.GangMembership(gd, rank, lease_ttl=1.5, interval=0.2)
+        mem.start()
+        ck = G.GangCheckpointer(gd, rank, world, keep=4,
+                                manifest_timeout=5.0)
+        rt = ResilientTrainer(tr, gd, save_every=2, gang=ck)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        b = {"x": jnp.asarray(x),
+             "y": jnp.asarray((x[:, 0] > 0).astype(np.int32))}
+        step = rt.resume() or 0
+        while step < 40:
+            if mem.lost():
+                gen, rank_map = mem.rescale(timeout=30)
+                ck.rescale(rank_map[rank], len(rank_map), gen)
+                step = rt.resume() or 0
+                print("RESCALED", rank, "gen", gen, "resumed", step,
+                      flush=True)
+                continue
+            rt.step(b)
+            step = rt.step_count
+            time.sleep(0.25)
+        w = np.asarray(tr.state.model.layers[0].w)
+        print(f"FINAL rank={rank} step={step} "
+              f"crc={zlib.crc32(w.tobytes()):08x}", flush=True)
+        mem.leave()
+    """)
+    plan = faults.FaultPlan([(2, faults.Fault("worker_kill", arg=10.0))])
+    outs = simulate_workers(3, script, timeout=280.0, faults=plan,
+                            gang_dir=gang_dir, allow_failures=True)
+    assert "[worker 2 exited" in outs[2], outs[2]
+    finals = {}
+    for r in (0, 1):
+        assert "RESCALED" in outs[r], outs[r]
+        line = [ln for ln in outs[r].splitlines()
+                if ln.startswith("FINAL")][0]
+        assert "step=40" in line
+        finals[r] = line.split("crc=")[1]
+    assert finals[0] == finals[1]  # survivors agree bitwise
