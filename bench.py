@@ -599,47 +599,71 @@ CONFIGS = [
 _TRANSIENT = ("rpc", "deadline", "unavailable", "connection", "stream")
 
 
-def _require_backend_alive(timeout_s: float = 240.0):
-    """Fail FAST with an honest artifact line if the device backend is
-    unreachable, instead of hanging forever on the first dispatch.  The
-    tunneled chip's relay can die (r04: gone for 8+ hours; a hung
-    make_c_api_client blocks in C and cannot be interrupted), so the
-    probe runs on a daemon thread and a watchdog hard-exits."""
+PREFLIGHT_RC = 3  # exit code: the device tunnel failed preflight — the
+# run produced NO results (this is a harness failure, not a regression)
+
+
+def _preflight_fail(reason: str, *, hard: bool = False):
+    """Named diagnosis on STDERR, exit ``PREFLIGHT_RC``, and — critically
+    — NOTHING on stdout: rounds 4-5 emitted a ``backend_unreachable``
+    metric line that the driver recorded as if it were a benchmark
+    result (BENCH_r04/r05.json).  A dead tunnel must read as a failed
+    preflight, never as a round of numbers.  ``hard`` uses ``os._exit``
+    for the hung-probe case (a wedged C client cannot be joined)."""
     import os
+    print(f"bench: PREFLIGHT FAILED — device tunnel unusable\n"
+          f"bench: diagnosis: {reason}\n"
+          f"bench: no metric lines were emitted; exit code {PREFLIGHT_RC} "
+          f"means 'no results this run', not a perf regression",
+          file=sys.stderr)
+    sys.stderr.flush()
+    if hard:
+        os._exit(PREFLIGHT_RC)
+    sys.exit(PREFLIGHT_RC)
+
+
+def _require_backend_alive(timeout_s: float = 240.0, probe=None,
+                           retry_wait: float = 5.0):
+    """Preflight: prove the device backend answers a trivial program
+    BEFORE any benchmark work, failing fast with a named diagnosis
+    (stderr + rc=3, see :func:`_preflight_fail`) instead of hanging on
+    the first dispatch or emitting a bogus round.  The tunneled chip's
+    relay can die (r04: gone for 8+ hours; a hung make_c_api_client
+    blocks in C and cannot be interrupted), so the probe runs on a
+    daemon thread and a watchdog hard-exits."""
     import threading
 
+    def default_probe():
+        x = jnp.ones((8, 8))
+        float((x @ x).sum())
+
+    probe = probe or default_probe
     for attempt in (0, 1):
         settled = threading.Event()
         err = []
 
-        def probe():
+        def run():
             try:
-                x = jnp.ones((8, 8))
-                float((x @ x).sum())
+                probe()
             except Exception as e:  # deterministic failure: report IT
                 err.append(f"{type(e).__name__}: {e}")
             settled.set()
 
-        threading.Thread(target=probe, daemon=True).start()
+        threading.Thread(target=run, daemon=True).start()
         if not settled.wait(timeout_s):
-            _line("backend_unreachable", 0.0, "none", 0.0,
-                  note=f"device backend did not answer a trivial program "
-                       f"within {timeout_s:.0f}s (dead tunnel relay?); "
-                       f"no perf numbers can be produced this run")
-            sys.stdout.flush()
-            os._exit(3)
+            _preflight_fail(
+                f"device backend did not answer a trivial program within "
+                f"{timeout_s:.0f}s (dead tunnel relay / hung C client)",
+                hard=True)
         if not err:
             return
         # transient tunnel/RPC blips get ONE retry, matching the
         # per-config retry policy in main(); anything else is terminal
         if attempt == 0 and any(s in err[0].lower() for s in _TRANSIENT):
-            time.sleep(5)
+            time.sleep(retry_wait)
             continue
-        _line("backend_unreachable", 0.0, "none", 0.0,
-              note=f"device backend failed a trivial program: "
-                   f"{err[0][:400]}")
-        sys.stdout.flush()
-        os._exit(3)
+        _preflight_fail(
+            f"device backend failed a trivial program: {err[0][:400]}")
 
 
 def main():

@@ -28,4 +28,9 @@ from hetu_tpu.exec.gang import (
     load_gang_checkpoint,
     worker_rng_key,
 )
-from hetu_tpu.exec import faults, gang, metrics
+from hetu_tpu.exec.partial import (
+    GradientBoard,
+    PartialReduceConfig,
+    PartialReducer,
+)
+from hetu_tpu.exec import faults, gang, metrics, partial

@@ -55,7 +55,14 @@ Two scheduling conventions coexist for the worker-targeted kinds: in
 is left None (wall-clock chaos); in the gang runtime the step is the
 1-based global training step and ``worker=`` names the target rank at
 fire time (deterministic step-clock chaos).  Each harness only consumes
-events written in its own convention.
+events written in its own convention.  ``grad_nan`` follows the same
+rule: untargeted events poison the whole batch at the ``Trainer.step``
+seam, while ``worker=``-targeted ones poison a single rank's shard in
+the gang's partial-reduce path (the NaN-late-fold chaos shape).  Under
+the gang's partial-reduce mode a ``worker_stall`` models a *straggler*
+(late gradient arrivals for ``arg`` steps), not a missed heartbeat;
+``FaultPlan.random(n_workers=..., stall_steps=...)`` draws realistic
+(heavy-tailed by default) stall lengths for such schedules.
 
 Every event fires exactly once; ``plan.fired`` records what actually
 triggered, so chaos tests can assert the schedule was exercised.  Two plans
@@ -132,16 +139,42 @@ class FaultPlan:
     @classmethod
     def random(cls, seed: int, n_steps: int, *,
                kinds: Iterable[str] = ("ps_socket_kill", "grad_nan"),
-               rate: float = 0.05) -> "FaultPlan":
+               rate: float = 0.05, n_workers: Optional[int] = None,
+               stall_steps=("pareto", 1.5, 1.0)) -> "FaultPlan":
         """Seeded random schedule: each step draws each kind independently
-        with probability ``rate``.  Same seed → bit-identical plan."""
+        with probability ``rate``.  Same seed → bit-identical plan.
+
+        With ``n_workers`` set, the worker-targeted kinds
+        (``worker_kill`` / ``worker_stall`` / ``shard_loss``) are emitted
+        in the gang step-clock convention — a uniformly drawn target rank
+        in ``worker=`` — and ``worker_stall`` additionally draws its
+        length in steps from the ``stall_steps`` distribution, so chaos
+        runs model realistic straggler schedules instead of unit stalls.
+        ``stall_steps`` specs: a bare number (constant), ``("const", k)``,
+        ``("uniform", lo, hi)`` (inclusive), ``("geometric", p)``, or the
+        heavy-tailed default ``("pareto", shape, scale)`` — a shifted
+        Pareto (Lomax + scale), matching the long-tail stragglers
+        measured on shared clusters: most stalls are ~1 step, a few are
+        10x that.  Draws happen only for steps where the event fires, in
+        (step, kind) order, so the schedule stays a pure function of the
+        seed."""
         import numpy as np
         rng = np.random.default_rng(seed)
+        worker_kinds = ("worker_kill", "worker_stall", "shard_loss")
         events = []
         for step in range(1, n_steps + 1):
             for kind in kinds:
                 if rng.random() < rate:
-                    events.append((step, Fault(kind)))
+                    if n_workers is not None and kind in worker_kinds:
+                        w = int(rng.integers(n_workers))
+                        if kind == "worker_stall":
+                            events.append((step, Fault(
+                                kind, worker=w,
+                                arg=float(_draw_stall(rng, stall_steps)))))
+                        else:
+                            events.append((step, Fault(kind, worker=w)))
+                    else:
+                        events.append((step, Fault(kind)))
         return cls(events)
 
     # -- schedule interface -------------------------------------------------
@@ -176,52 +209,50 @@ class FaultPlan:
                     return fault
         return None
 
-    def worker_kills(self, n_workers: Optional[int] = None) -> list:
-        """``[(worker_index, delay_seconds, signal)]`` — consumed by
-        ``launch.simulate_workers(faults=plan)``, which passes its gang
-        size so an event aimed at a worker that does not exist stays
-        pending (and shows up in ``remaining()``) instead of being
-        reported as fired."""
+    def worker_events(self, kind: str,
+                      n_workers: Optional[int] = None) -> list:
+        """``[(worker_index, delay_seconds, payload)]`` for every pending
+        ``simulate_workers``-convention event of ``kind`` — the payload is
+        the signal for ``worker_kill`` (default SIGKILL) and the SIGSTOP
+        duration in seconds for ``worker_stall`` (default 1.0).
+
+        ``launch.simulate_workers(faults=plan)`` passes its gang size so
+        an event aimed at a worker that does not exist stays pending (and
+        shows up in ``remaining()``) instead of being reported as fired;
+        gang-runtime events (``worker=`` set, step-scheduled) likewise
+        stay pending for ``ElasticGang`` instead of being misread as a
+        worker index here."""
+        if kind not in ("worker_kill", "worker_stall"):
+            raise ValueError(
+                f"worker_events handles 'worker_kill'/'worker_stall', "
+                f"got {kind!r}")
         out = []
         with self._lock:
             rest = []
             for step, fault in self._events:
                 in_range = n_workers is None or 0 <= step < n_workers
-                # fault.worker set = a gang-runtime event (step-scheduled);
-                # it stays pending for ElasticGang instead of being
-                # misread as a worker index here
-                if (fault.kind == "worker_kill" and fault.worker is None
-                        and in_range):
-                    out.append((step, fault.arg or 0.0,
-                                fault.sig or _signal.SIGKILL))
+                if fault.kind == kind and fault.worker is None and in_range:
+                    if kind == "worker_kill":
+                        payload = fault.sig or _signal.SIGKILL
+                    else:
+                        payload = (fault.duration
+                                   if fault.duration is not None else 1.0)
+                    out.append((step, fault.arg or 0.0, payload))
                     self.fired.append((step, fault))
                 else:
                     rest.append((step, fault))
             self._events = rest
         return out
 
+    def worker_kills(self, n_workers: Optional[int] = None) -> list:
+        """``[(worker_index, delay_seconds, signal)]`` — thin wrapper over
+        :meth:`worker_events`."""
+        return self.worker_events("worker_kill", n_workers)
+
     def worker_stalls(self, n_workers: Optional[int] = None) -> list:
-        """``[(worker_index, delay_seconds, stall_seconds)]`` — consumed by
-        ``launch.simulate_workers(faults=plan)``, which SIGSTOPs the worker
-        after the delay and SIGCONTs it ``stall_seconds`` later (the
-        straggler/GC-pause shape).  Same conventions as
-        :meth:`worker_kills`: gang-runtime events (``worker=`` set) stay
-        pending."""
-        out = []
-        with self._lock:
-            rest = []
-            for step, fault in self._events:
-                in_range = n_workers is None or 0 <= step < n_workers
-                if (fault.kind == "worker_stall" and fault.worker is None
-                        and in_range):
-                    out.append((step, fault.arg or 0.0,
-                                fault.duration if fault.duration is not None
-                                else 1.0))
-                    self.fired.append((step, fault))
-                else:
-                    rest.append((step, fault))
-            self._events = rest
-        return out
+        """``[(worker_index, delay_seconds, stall_seconds)]`` — thin
+        wrapper over :meth:`worker_events`."""
+        return self.worker_events("worker_stall", n_workers)
 
     def remaining(self) -> list:
         """Events that have not fired (a clean chaos run drains the plan)."""
@@ -252,7 +283,10 @@ class FaultPlan:
                 _mangle_file(payload, fault.kind)
             return None
         if site == "grad":
-            if self.take("grad_nan") is not None:
+            # worker-targeted grad_nan events belong to the gang runtime's
+            # partial-reduce path (poison ONE rank's shard); the executor
+            # seam only consumes the untargeted convention
+            if self.take("grad_nan", require_worker=False) is not None:
                 return _poison_batch(payload)
             return None
         if site == "step_begin":
@@ -261,6 +295,30 @@ class FaultPlan:
                 time.sleep(fault.arg if fault.arg is not None else 3600.0)
             return None
         return None
+
+
+def _draw_stall(rng, spec) -> int:
+    """Draw one stall length in steps from a ``stall_steps`` spec (see
+    :meth:`FaultPlan.random`); always >= 1."""
+    if isinstance(spec, (int, float)):
+        return max(1, int(spec))
+    name, *args = spec
+    if name == "const":
+        k = float(args[0])
+    elif name == "uniform":
+        k = float(rng.integers(int(args[0]), int(args[1]) + 1))
+    elif name == "geometric":
+        k = float(rng.geometric(float(args[0])))
+    elif name == "pareto":
+        # shifted Pareto (Lomax + scale): support [scale, inf), tail index
+        # `shape` — the measured long-tail straggler shape
+        shape, scale = float(args[0]), float(args[1])
+        k = scale * (1.0 + rng.pareto(shape))
+    else:
+        raise ValueError(
+            f"unknown stall_steps distribution {name!r}; one of "
+            f"const/uniform/geometric/pareto or a bare number")
+    return max(1, int(round(k)))
 
 
 def _mangle_file(path: str, kind: str) -> None:

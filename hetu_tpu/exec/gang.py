@@ -69,7 +69,9 @@ import numpy as np
 
 from hetu_tpu.core import get_seed_status, next_key, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
+from hetu_tpu.exec import executor as _executor
 from hetu_tpu.exec import faults as _faults
+from hetu_tpu.exec import partial as _partial
 from hetu_tpu.exec.checkpoint import (CheckpointError, _atomic_write_bytes,
                                       load_checkpoint, load_state_dict,
                                       read_footer_crc, save_checkpoint)
@@ -644,9 +646,11 @@ class GangMembership:
             if want <= have:
                 return
             if time.monotonic() > deadline:
-                raise TimeoutError(
+                err = TimeoutError(
                     f"gang barrier for generation {generation} timed out: "
                     f"waiting on ranks {sorted(want - have)}")
+                err.stragglers = sorted(want - have)
+                raise err
             time.sleep(poll)
 
     def rescale(self, timeout: float = 30.0) -> tuple:
@@ -665,7 +669,16 @@ class GangMembership:
             survivors = sorted(set(survivors) | {self.rank})
         self.generation += 1
         self.heartbeat()  # lease now carries the new generation
-        self.barrier(self.generation, survivors, timeout=timeout)
+        try:
+            self.barrier(self.generation, survivors, timeout=timeout)
+        except TimeoutError as e:
+            # journal hygiene: a stuck rescale barrier must be visible in
+            # post-mortems, not only in whichever process saw the raise
+            _obs_journal.record(
+                "rescale_timeout", generation=self.generation,
+                waiting_on=getattr(e, "stragglers", None),
+                timeout_s=float(timeout))
+            raise
         # every survivor acked the new generation, so all of them have
         # observed the eviction — the stale leases can go (otherwise the
         # dead worker would be re-"detected" forever).  Best-effort and
@@ -726,12 +739,36 @@ class ElasticGang:
     grows the gang back — joiners adopt the survivors' replicated state
     (a live broadcast; the manifest path is for cold joins), so an n→n
     kill/recover run replays to a bitwise-identical end state.
+
+    **Partial reduce** (``partial=PartialReduceConfig(...)``): the step
+    gains an *arrival-collection phase* — every live worker's shard
+    gradient is staged individually, the deadline cut
+    (:meth:`~hetu_tpu.exec.partial.PartialReduceConfig.cut`) picks the
+    contributors, and the update is the weighted mean over contributors
+    plus any matured late-gradient folds
+    (:class:`~hetu_tpu.exec.partial.PartialReducer`).  A
+    ``worker_stall`` then models a *straggler*, not a lost worker: the
+    stalled rank keeps its lease (it is slow, not dead — riding out
+    stragglers without eviction is the point of partial reduce) and its
+    gradients arrive late by the remaining stall length; only
+    ``worker_kill`` evicts.  The step clock (``sim_time``) charges each
+    step ``1 + wait``, where the synchronous barrier
+    (``deadline=inf``) waits for the slowest worker and the partial cut
+    waits at most the deadline — the throughput the chaos acceptance
+    measures.  Pending correction terms ride the sharded checkpoints
+    (reserved ``partialreduce.*`` entries), so a kill/recover replay
+    restores mid-flight folds bitwise; on rescale, survivors'
+    corrections re-key through the rank map and evicted workers' are
+    dropped (``stale_drop`` ``reason="worker_lost"``).  Step metrics
+    carry an ``arrivals`` field in both modes (the synchronous path
+    reports the full world).
     """
 
     def __init__(self, trainer, gang_dir: str, *, world_size: int,
                  data_fn: Callable[[int], dict], global_batch_size: int,
                  seed: int = 0, save_every: int = 2, keep: int = 4,
-                 lease_steps: int = 1):
+                 lease_steps: int = 1,
+                 partial: Optional["_partial.PartialReduceConfig"] = None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -748,6 +785,7 @@ class ElasticGang:
         self.lease_steps = int(lease_steps)
         self.generation = 0
         self.step_count = 0
+        self.sim_time = 0.0            # step-clock time spent (1 + wait per step)
         self.history: list = []        # every executed (step, loss), incl. replays
         self.losses_by_step: dict = {}  # final lineage: step -> last loss
         self.last_partition: Optional[list] = None
@@ -755,6 +793,11 @@ class ElasticGang:
         self._dead: set = set()
         self._stalled_until: dict = {}
         self._last_beat = {w: 0 for w in range(self.world_size)}
+        self.partial = partial
+        self.reducer: Optional[_partial.PartialReducer] = None
+        if partial is not None:
+            self.reducer = _partial.PartialReducer(partial)
+            self._grad_fn, self._apply_fn = _partial.grad_apply_fns(trainer)
         os.makedirs(gang_dir, exist_ok=True)
         # rescue floor for a loss before the first checkpoint: the
         # pristine state + RNG, kept on host
@@ -773,8 +816,13 @@ class ElasticGang:
 
     def save(self) -> str:
         """Every live rank writes its shard + ring replica; then the
-        signed manifest for the current step."""
+        signed manifest for the current step.  Pending partial-reduce
+        correction terms ride along as reserved ``partialreduce.*``
+        entries — sharded, ring-replicated, and manifest-signed like any
+        parameter."""
         sd = dict(named_parameters(self.trainer.state))
+        if self.reducer is not None:
+            sd.update(self.reducer.state_entries())
         rng = get_seed_status()
         for r in range(self.world_size):
             save_shard(self.gang_dir, r, self.world_size, self.step_count,
@@ -786,15 +834,22 @@ class ElasticGang:
         prune_gang(self.gang_dir, self.keep)
         return path
 
-    def _restore(self) -> int:
+    def _restore(self, rank_map: Optional[dict] = None) -> int:
         """Load the newest intact manifest into the trainer (ring replicas
         cover lost shards); falls back to the initial snapshot when no
-        checkpoint exists yet.  Returns the restored step."""
+        checkpoint exists yet.  Returns the restored step.  Partial-reduce
+        correction entries are split back out of the composed state and
+        reloaded into the reducer (re-keyed through ``rank_map`` after a
+        rescale; an evicted worker's corrections are dropped)."""
         step, _gen, sd, _extra, report = load_gang_checkpoint(self.gang_dir)
         self.resume_report = report
         if step is None:
             sd, step = self._initial_sd, 0
             reset_seed_seqnum(*self._initial_rng)
+        sd, corr = _partial.split_state_entries(sd)
+        if self.reducer is not None:
+            self.reducer.load_state_entries(corr, rank_map=rank_map,
+                                            step=step)
         self.trainer.state = _to_device(load_state_dict(
             self.trainer.state, sd, consider_splits=True))
         self.step_count = step
@@ -828,7 +883,21 @@ class ElasticGang:
             elif f.kind == "worker_kill":
                 self._dead.add(w)
             else:  # worker_stall
-                self._stalled_until[w] = step + int(f.arg or 1)
+                # overlapping stalls EXTEND, never shorten: a heavy-tailed
+                # schedule's 20-step stall must not be clipped by a later
+                # 1-step event on the same worker.  In partial mode the
+                # freeze is in SIM-TIME units, so time the gang spends
+                # waiting at a barrier drains the stall — a k-unit stall
+                # costs the synchronous (deadline=inf) baseline k units
+                # once, not k+(k-1)+...+1 (which would quadratically
+                # inflate the baseline the throughput gain is measured
+                # against); in sync mode it stays the step-indexed
+                # missed-heartbeat count the lease compares.
+                until = (self.sim_time + float(f.arg or 1)
+                         if self.partial is not None
+                         else float(step + int(f.arg or 1)))
+                self._stalled_until[w] = max(
+                    self._stalled_until.get(w, 0), until)
 
     def _rescale(self, lost: list, step: int) -> None:
         for w in lost:
@@ -849,7 +918,7 @@ class ElasticGang:
         self._dead = set()
         self._stalled_until = {remap[o]: v for o, v in
                                self._stalled_until.items() if o in remap}
-        resumed = self._restore()
+        resumed = self._restore(rank_map=remap)
         self._last_beat = {w: resumed for w in range(self.world_size)}
         _obs_journal.record("gang_rescale", generation=self.generation,
                             old_world=old_world, new_world=self.world_size,
@@ -888,7 +957,12 @@ class ElasticGang:
         s = self.step_count + 1
         self._consume_faults(s)
         for w in range(self.world_size):
-            if w not in self._dead and s >= self._stalled_until.get(w, 0):
+            # under partial reduce a stalled worker is a STRAGGLER, not a
+            # lost worker: it keeps heartbeating (slow, not dead) and its
+            # lateness is handled by the deadline cut, never the lease
+            beating = (self.partial is not None
+                       or s >= self._stalled_until.get(w, 0))
+            if w not in self._dead and beating:
                 self._last_beat[w] = s
         lost = [w for w in range(self.world_size)
                 if s - self._last_beat[w] > self.lease_steps]
@@ -904,12 +978,17 @@ class ElasticGang:
         # partition-invariance the n→n bitwise guarantee rests on
         shards = [{k: np.asarray(v)[p] for k, v in gb.items()}
                   for p in parts]
-        inv = np.argsort(np.concatenate(parts), kind="stable")
-        import jax.numpy as jnp
-        batch = {k: jnp.asarray(
-            np.concatenate([sh[k] for sh in shards])[inv]) for k in gb}
         self.last_partition = parts
-        metrics = self.trainer.step(batch, next_key())
+        if self.partial is not None:
+            metrics = self._partial_step(s, shards, parts)
+        else:
+            inv = np.argsort(np.concatenate(parts), kind="stable")
+            import jax.numpy as jnp
+            batch = {k: jnp.asarray(
+                np.concatenate([sh[k] for sh in shards])[inv]) for k in gb}
+            metrics = self.trainer.step(batch, next_key())
+            metrics["arrivals"] = self.world_size
+            self.sim_time += 1.0
         self.step_count = s
         loss = float(metrics["loss"])
         self.history.append((s, loss))
@@ -917,6 +996,111 @@ class ElasticGang:
         if self.save_every > 0 and s % self.save_every == 0:
             self.save()
         return metrics
+
+    def _partial_step(self, s: int, shards: list, parts: list) -> dict:
+        """The arrival-collection phase: stage every live worker's shard
+        gradient, apply the deadline cut, reduce over contributors plus
+        matured folds, and stash the late gradients as corrections."""
+        import jax
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        plan = _faults.active_plan()
+        poisoned: set = set()
+        if plan is not None:
+            while True:
+                # gang-convention grad_nan (worker= set): poison that
+                # rank's shard so ITS contribution goes non-finite — the
+                # NaN-late-fold chaos shape
+                f = plan.take("grad_nan", require_worker=True)
+                if f is None:
+                    break
+                if int(f.worker) < self.world_size:
+                    poisoned.add(int(f.worker))
+            # untargeted grad_nan = the sync path's whole-batch poisoning
+            # (executor's _fault_hook seam, which this path bypasses):
+            # every shard goes NaN, so the same plan drains — and injects
+            # the same chaos — in either mode
+            while plan.take("grad_nan", require_worker=False) is not None:
+                poisoned.update(range(self.world_size))
+        # arrival delay = how far into the future (in sim-time units) each
+        # worker's frozen-until lies at the START of this step
+        delays = {w: float(max(0.0, self._stalled_until.get(w, 0)
+                               - self.sim_time))
+                  for w in range(self.world_size)}
+        ontime, wait, degraded = self.partial.cut(delays)
+        self.sim_time += 1.0 + wait
+        key = next_key()  # ONE global draw per step, like the sync path
+        model = self.trainer.state.model
+        contributions: dict = {}
+        losses: dict = {}
+        template = None
+        for w in range(self.world_size):
+            n = float(len(parts[w]))
+            if w not in ontime:
+                delay = int(np.ceil(delays[w]))
+                if delay > self.partial.tau:
+                    # born stale: this gradient can never fold within tau,
+                    # so skip the jitted grad entirely — a 50-step
+                    # straggler must not cost 50 dead gradient
+                    # computations.  stage_late drops it at the door with
+                    # the same journal/counter record either way.
+                    self.reducer.stage_late(w, s, s + delay, n, {})
+                    continue
+            shard = {k: jnp.asarray(v) for k, v in shards[w].items()}
+            if w in poisoned:
+                shard = _faults._poison_batch(shard)
+            loss, grads = self._grad_fn(model, shard,
+                                        jax.random.fold_in(key, w))
+            flat = {}
+            for name, g in named_parameters(grads):
+                a = np.asarray(g)
+                if np.issubdtype(a.dtype, np.floating):
+                    flat[name] = a
+            losses[w] = (n, float(loss))
+            if w in ontime:
+                if template is None:
+                    template = grads
+                contributions[w] = (n, flat)
+            else:
+                self.reducer.stage_late(w, s, s + int(np.ceil(delays[w])),
+                                        n, flat)
+        combined, info = self.reducer.reduce(s, contributions,
+                                             degraded=degraded, waited=wait)
+        if combined is not None:
+            gtree = load_state_dict(template, combined)
+            self.trainer.state = self._apply_fn(self.trainer.state, gtree)
+        # reported loss: the used on-time contributors; when a step commits
+        # on folds alone (every on-time gradient was non-finite), fall back
+        # to whichever live workers' losses ARE finite this step, so a
+        # committed step never records NaN into the lineage
+        report = [w for w in info["used"] if w in losses]
+        if not report:
+            report = [w for w in sorted(losses)
+                      if np.isfinite(losses[w][1])]
+        total = sum(losses[w][0] for w in report)
+        loss = (sum(losses[w][0] * losses[w][1] for w in report) / total
+                if total else float("nan"))
+        if _obs.enabled():
+            # keep the hetu_step_* dashboard series alive: this path
+            # bypasses Trainer.step, which is where they normally come
+            # from — a gang flipped to partial mode must not flatline
+            # step latency / outcome / examples-per-sec monitoring
+            dt = time.perf_counter() - t0
+            sm = _executor._step_m()
+            sm["steps"].labels(
+                outcome="skipped" if combined is None else "ok").inc()
+            sm["latency"].observe(dt)
+            if combined is not None:
+                committed = int(sum(contributions[w][0]
+                                    for w in info["used"]))
+                if committed:
+                    sm["examples"].inc(committed)
+                    if dt > 0:
+                        sm["eps"].set(committed / dt)
+        return {"loss": loss, "arrivals": info["arrivals"],
+                "late_folds": info["late_folds"],
+                "dropped": info["dropped"], "degraded": info["degraded"],
+                "waited": wait}
 
     def run_until(self, target_step: int) -> None:
         """Drive global steps (including any rescale/replay detours) until

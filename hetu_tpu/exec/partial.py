@@ -1,0 +1,608 @@
+"""Partial-reduce: straggler-tolerant bounded-staleness gradient collectives.
+
+Hetu's SIGMOD'21 capability: a synchronous data-parallel step is only as
+fast as its slowest worker, and on shared clusters the slowest worker is
+routinely 2-10x the median (GC pauses, co-tenant interference, flaky
+NICs).  Partial reduce breaks the full barrier: each step reduces over
+whichever workers' gradients arrive within a **deadline**, scaling by
+the actual contributor count, and a late gradient is *not* discarded —
+it folds into a per-worker **correction term** applied at that worker's
+next on-time step, bounded by a **staleness limit** ``tau`` beyond which
+it is dropped and journaled.
+
+The policy is one dataclass, :class:`PartialReduceConfig`:
+
+- ``deadline`` — extra wait (step-clock units in the deterministic
+  in-process gang; wall seconds on a real :class:`GradientBoard`) the
+  reduce grants arrivals each step.  ``float("inf")`` degrades to the
+  synchronous full barrier (the baseline the chaos tests measure
+  against).
+- ``tau`` — staleness bound in steps: a correction older than ``tau``
+  at fold time is dropped (journal ``stale_drop``).
+- ``min_arrivals`` — quorum floor: when fewer workers make the deadline
+  the step degrades gracefully to *waiting out the full barrier* rather
+  than reducing over a quorum too small to trust.
+
+Determinism contract: everything here is a pure function of the arrival
+schedule.  :class:`PartialReducer` keeps no wall-clock state — folds and
+drops are decided by integer step arithmetic, reductions run in sorted
+worker/origin order — so replaying a seeded
+:class:`~hetu_tpu.exec.faults.FaultPlan` of ``worker_stall`` events
+through :class:`~hetu_tpu.exec.gang.ElasticGang` reproduces
+bitwise-identical journals, correction terms, and final parameters (the
+``tests/test_partial.py`` acceptance bar).  Pending corrections are part
+of the training state: :func:`PartialReducer.state_entries` renders them
+as flat ``{name: array}`` entries that ride the sharded + ring-replicated
+gang checkpoints, so a kill/recover replay restores mid-flight folds
+bitwise (``split_state_entries`` separates them back out on load).
+
+Composition with NaN-skip (``exec.resilience``): a non-finite *late
+fold* rolls back **the fold, not the step** — the poisoned correction is
+dropped (``stale_drop`` with ``reason="nonfinite"``) and the step
+commits on the healthy contributions, so ``ResilientTrainer``'s anomaly
+guard only ever sees genuine step-level NaNs.
+
+Observability: ``hetu_partial_arrivals_total{outcome}``,
+``hetu_partial_late_folds_total``, ``hetu_partial_dropped_total{reason}``
+counters, the ``hetu_partial_staleness_age_steps`` histogram, and
+``partial_step`` / ``late_fold`` / ``stale_drop`` journal kinds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["PartialReduceConfig", "PartialReducer", "GradientBoard",
+           "grad_apply_fns", "split_state_entries", "STATE_PREFIX"]
+
+# Reserved dotted-path prefix for pending-correction checkpoint entries.
+# shard_owner() hashes these names like any parameter, so corrections are
+# sharded + ring-replicated + manifest-signed for free.
+STATE_PREFIX = "partialreduce."
+
+_ENTRY_RE = re.compile(r"^w(\d+)\.t(\d+)\.a(\d+)\.n([0-9a-f]{16})\.(.+)$")
+
+# Staleness ages are small integers (steps), not latencies.
+_AGE_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialReduceConfig:
+    """Policy knobs for deadline-based partial gradient reduction.
+
+    ``deadline``: how much extra the step waits for arrivals (step-clock
+    units in the in-process gang, wall seconds over a
+    :class:`GradientBoard`).  0 reduces over instant arrivals only;
+    ``inf`` is the synchronous full barrier.
+    ``tau``: staleness bound in steps for late-gradient folds.
+    ``min_arrivals``: quorum floor below which the step degrades to the
+    full barrier instead of trusting a tiny contributor set.
+    """
+
+    deadline: float = 0.0
+    tau: int = 4
+    min_arrivals: int = 1
+
+    def __post_init__(self):
+        if self.deadline < 0:
+            raise ValueError(f"deadline must be >= 0, got {self.deadline}")
+        if self.tau < 0:
+            raise ValueError(f"tau must be >= 0, got {self.tau}")
+        if self.min_arrivals < 1:
+            raise ValueError(
+                f"min_arrivals must be >= 1, got {self.min_arrivals}")
+
+    @classmethod
+    def from_env(cls, **kw) -> Optional["PartialReduceConfig"]:
+        """Build from the deadline the launcher plumbed through
+        (``launch.simulate_workers(partial_deadline=...)`` →
+        ``HETU_TPU_PARTIAL_DEADLINE``); None when the env is unset.
+        Remaining knobs (``tau``, ``min_arrivals``) pass through ``kw``."""
+        from hetu_tpu.launch import ENV_PARTIAL_DEADLINE
+        raw = os.environ.get(ENV_PARTIAL_DEADLINE)
+        if raw is None:
+            return None
+        return cls(deadline=float(raw), **kw)
+
+    def cut(self, delays: Dict[int, float]) -> Tuple[list, float, bool]:
+        """The deadline cut for one step: given each live worker's arrival
+        delay, return ``(contributors, wait, degraded)``.
+
+        Contributors are the workers whose delay is within ``deadline``;
+        when they number fewer than ``min_arrivals`` the step *degrades*
+        to the full barrier (everyone contributes, the step waits out the
+        slowest — the graceful floor).  ``wait`` is the step-clock time
+        spent waiting on the slowest contributor."""
+        ontime = sorted(w for w, d in delays.items() if d <= self.deadline)
+        required = min(self.min_arrivals, len(delays))
+        if len(ontime) < required:
+            everyone = sorted(delays)
+            wait = max(delays.values()) if delays else 0.0
+            return everyone, float(wait), True
+        wait = max((delays[w] for w in ontime), default=0.0)
+        return ontime, float(wait), False
+
+
+# ------------------------------------------------------------- telemetry
+
+_partial_metrics = None
+
+
+def _partial_m() -> dict:
+    global _partial_metrics
+    if _partial_metrics is None:
+        reg = _obs.get_registry()
+        _partial_metrics = {
+            "arrivals": reg.counter(
+                "hetu_partial_arrivals_total",
+                "gradient arrivals at the partial-reduce cut, by outcome "
+                "(ontime = entered the step's reduce at the cut — on a "
+                "degraded full-barrier step this includes the waited-out "
+                "stragglers; late = staged as a correction term)",
+                ("outcome",)),
+            "late_folds": reg.counter(
+                "hetu_partial_late_folds_total",
+                "late gradients folded into a step as correction terms"),
+            "degraded": reg.counter(
+                "hetu_partial_degraded_steps_total",
+                "steps that fell below min_arrivals at the deadline and "
+                "degraded to the full barrier — a persistently degraded "
+                "gang is under-provisioned for its deadline"),
+            "dropped": reg.counter(
+                "hetu_partial_dropped_total",
+                "contributions dropped instead of folded (stale = past "
+                "tau, nonfinite = NaN/Inf late fold rolled back, "
+                "nonfinite_contribution = the step's own on-time gradient "
+                "was NaN/Inf, worker_lost = owner evicted before its next "
+                "on-time step)", ("reason",)),
+            "age": reg.histogram(
+                "hetu_partial_staleness_age_steps",
+                "staleness age (steps) of late contributions at fold or "
+                "drop time", buckets=_AGE_BUCKETS),
+        }
+    return _partial_metrics
+
+
+def _is_finite(flat: dict) -> bool:
+    for v in flat.values():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            return False
+    return True
+
+
+class PartialReducer:
+    """Bounded-staleness gradient combiner — the piece both harnesses
+    share: :class:`~hetu_tpu.exec.gang.ElasticGang` drives it on the
+    deterministic step clock, a multi-process gang drives it over a
+    :class:`GradientBoard`.
+
+    Gradients are flat ``{dotted.path: array}`` dicts (the state-dict
+    form); ``reduce`` returns the weighted mean over the on-time
+    contributions plus any matured correction folds — weights are shard
+    sizes, so the result is the exact per-example mean over every folded
+    sample.  All iteration is in sorted (worker, origin) order and all
+    arithmetic is plain float64-accumulating numpy, so the combine is
+    bitwise-reproducible for a given arrival schedule."""
+
+    def __init__(self, config: PartialReduceConfig):
+        self.config = config
+        # pending[worker] = [{origin, arrival, weight, grads}], sorted by
+        # origin — each entry is one late gradient awaiting its owner's
+        # next on-time step
+        self.pending: Dict[int, list] = {}
+
+    # -- staging ------------------------------------------------------------
+
+    def stage_late(self, worker: int, origin_step: int, arrival_step: int,
+                   weight: float, grads: dict) -> bool:
+        """Stage a gradient that missed the deadline.  Returns False (and
+        journals ``stale_drop``) when the arrival alone already exceeds
+        ``tau`` — a stall that long can never fold in time, so it is
+        dropped at the door instead of accumulating."""
+        worker, origin = int(worker), int(origin_step)
+        arrival = int(arrival_step)
+        age_at_arrival = arrival - origin
+        if _obs.enabled():
+            _partial_m()["arrivals"].labels(outcome="late").inc()
+        if age_at_arrival > self.config.tau:
+            self._drop(worker, origin, origin, age_at_arrival, "stale")
+            return False
+        entry = {"origin": origin, "arrival": arrival,
+                 "weight": float(weight),
+                 "grads": {k: np.asarray(v) for k, v in grads.items()}}
+        lst = self.pending.setdefault(worker, [])
+        lst.append(entry)
+        lst.sort(key=lambda e: (e["origin"], e["arrival"]))
+        return True
+
+    def _drop(self, worker: int, origin: int, step: int, age: int,
+              reason: str) -> None:
+        if _obs.enabled():
+            _partial_m()["dropped"].labels(reason=reason).inc()
+            _partial_m()["age"].observe(float(age))
+        _obs_journal.record("stale_drop", step=int(step), worker=int(worker),
+                            origin_step=int(origin), age=int(age),
+                            reason=reason)
+
+    # -- the reduce ---------------------------------------------------------
+
+    def reduce(self, step: int, contributions: Dict[int, tuple], *,
+               degraded: bool = False, waited: float = 0.0) -> tuple:
+        """Combine one step's on-time contributions with every matured
+        correction fold.
+
+        ``contributions``: ``{worker: (weight, flat_grads)}`` — the
+        workers that made the deadline cut (or everyone, on a degraded
+        full-barrier step).  A non-finite contribution is excluded and
+        journaled; a non-finite *fold* is rolled back — the fold, not the
+        step (``stale_drop`` with ``reason="nonfinite"``).  Matured
+        pendings older than ``tau`` are dropped, including those of
+        workers not contributing this step (so a worker that never comes
+        back cannot pin memory forever).
+
+        Returns ``(combined_flat_or_None, info)`` where ``info`` carries
+        ``arrivals`` (offered on-time contributions), ``used`` (the
+        workers whose current gradient entered the reduce),
+        ``late_folds``, ``dropped``, ``degraded``.  ``None`` means no
+        usable gradient this step (every contribution non-finite)."""
+        step = int(step)
+        used_terms: list = []   # (weight, flat_grads) in deterministic order
+        used_workers: list = []
+        folds = drops = 0
+        if degraded and _obs.enabled():
+            _partial_m()["degraded"].inc()
+        for w in sorted(contributions):
+            weight, grads = contributions[w]
+            if _obs.enabled():
+                # every on-time ARRIVAL counts, finite or not, so the
+                # counter agrees with the journal's arrivals field and
+                # dropped/arrivals ratios stay <= 1 under NaN chaos
+                _partial_m()["arrivals"].labels(outcome="ontime").inc()
+            if not _is_finite(grads):
+                # distinct from a rolled-back FOLD ("nonfinite"): here the
+                # step's own gradient was poisoned, no correction involved
+                self._drop(w, step, step, 0, "nonfinite_contribution")
+                drops += 1
+            else:
+                used_terms.append((float(weight), grads))
+                used_workers.append(w)
+            f, d = self._fold_for(w, step, used_terms)
+            folds += f
+            drops += d
+        # sweep non-contributors' matured pendings past tau (the owner may
+        # be stalled indefinitely; tau bounds how long we hold its mass)
+        for w in sorted(set(self.pending) - set(contributions)):
+            keep = []
+            for e in self.pending[w]:
+                age = step - e["origin"]
+                if e["arrival"] <= step and age > self.config.tau:
+                    self._drop(w, e["origin"], step, age, "stale")
+                    drops += 1
+                else:
+                    keep.append(e)
+            if keep:
+                self.pending[w] = keep
+            else:
+                del self.pending[w]
+        info = {"arrivals": len(contributions), "used": used_workers,
+                "late_folds": folds, "dropped": drops,
+                "degraded": bool(degraded)}
+        if not used_terms:
+            _obs_journal.record("partial_step", step=step,
+                                arrivals=len(contributions), late_folds=folds,
+                                dropped=drops, degraded=bool(degraded),
+                                waited=float(waited), skipped=True)
+            return None, info
+        total = sum(wt for wt, _g in used_terms)
+        keys = sorted(used_terms[0][1])
+        combined = {}
+        for k in keys:
+            acc = None
+            for wt, g in used_terms:
+                term = wt * np.asarray(g[k], np.float64)
+                acc = term if acc is None else acc + term
+            combined[k] = (acc / total).astype(
+                np.asarray(used_terms[0][1][k]).dtype)
+        _obs_journal.record("partial_step", step=step,
+                            arrivals=len(contributions), late_folds=folds,
+                            dropped=drops, degraded=bool(degraded),
+                            waited=float(waited))
+        return combined, info
+
+    def _fold_for(self, worker: int, step: int, used_terms: list) -> tuple:
+        """Fold ``worker``'s matured pendings into ``used_terms`` (it is
+        on time this step); drop the over-``tau`` and non-finite ones.
+        Returns ``(folds, drops)``."""
+        folds = drops = 0
+        keep = []
+        for e in self.pending.get(worker, []):
+            if e["arrival"] > step:
+                keep.append(e)
+                continue
+            age = step - e["origin"]
+            if age > self.config.tau:
+                self._drop(worker, e["origin"], step, age, "stale")
+                drops += 1
+            elif not _is_finite(e["grads"]):
+                # the NaN-late-fold contract: roll back the FOLD, not the
+                # step — the healthy contributions still commit
+                self._drop(worker, e["origin"], step, age, "nonfinite")
+                drops += 1
+            else:
+                used_terms.append((e["weight"], e["grads"]))
+                folds += 1
+                if _obs.enabled():
+                    _partial_m()["late_folds"].inc()
+                    _partial_m()["age"].observe(float(age))
+                _obs_journal.record("late_fold", step=step, worker=worker,
+                                    origin_step=e["origin"], age=age)
+        if keep:
+            self.pending[worker] = keep
+        else:
+            self.pending.pop(worker, None)
+        return folds, drops
+
+    # -- persistence --------------------------------------------------------
+
+    def pending_count(self) -> int:
+        return sum(len(v) for v in self.pending.values())
+
+    def state_entries(self) -> dict:
+        """Pending corrections as flat checkpoint entries
+        (``partialreduce.wRRRR.tSSSSSSSS.aSSSSSSSS.nNNNN.<param>``) — the
+        form :class:`~hetu_tpu.exec.gang.GangCheckpointer` shards,
+        replicates, and signs like any parameter, so kill/recover replays
+        restore mid-flight folds bitwise."""
+        import struct
+        out = {}
+        for w in sorted(self.pending):
+            for e in self.pending[w]:
+                # the weight is encoded as its IEEE-754 bits (16 hex
+                # chars): exact float round-trip, and no '.' to collide
+                # with the dotted-name delimiter
+                wbits = struct.pack(">d", float(e["weight"])).hex()
+                base = (f"{STATE_PREFIX}w{w:04d}.t{e['origin']:08d}"
+                        f".a{e['arrival']:08d}.n{wbits}")
+                for name, arr in e["grads"].items():
+                    out[f"{base}.{name}"] = np.asarray(arr)
+        return out
+
+    def load_state_entries(self, entries: dict,
+                           rank_map: Optional[dict] = None,
+                           step: Optional[int] = None) -> None:
+        """Rebuild pending corrections from checkpoint entries, replacing
+        the current state.  After a rescale, ``rank_map`` (old rank → new
+        rank, from ``GangMembership.rescale``) re-keys survivors'
+        corrections; an evicted worker's corrections are dropped and
+        journaled (``reason="worker_lost"``)."""
+        import struct
+        groups: dict = {}
+        for key, val in entries.items():
+            m = _ENTRY_RE.match(key[len(STATE_PREFIX):])
+            if not m:
+                raise ValueError(
+                    f"unparseable partial-reduce state entry {key!r}")
+            w, t, a, name = (int(m.group(1)), int(m.group(2)),
+                             int(m.group(3)), m.group(5))
+            n = struct.unpack(">d", bytes.fromhex(m.group(4)))[0]
+            groups.setdefault((w, t, a, n), {})[name] = np.asarray(val)
+        self.pending = {}
+        for (w, t, a, n), grads in sorted(groups.items()):
+            if rank_map is not None:
+                if w not in rank_map:
+                    self._drop(w, t, step if step is not None else a,
+                               (step - t) if step is not None else (a - t),
+                               "worker_lost")
+                    continue
+                w = rank_map[w]
+            self.pending.setdefault(w, []).append(
+                {"origin": t, "arrival": a, "weight": float(n),
+                 "grads": grads})
+        for lst in self.pending.values():
+            lst.sort(key=lambda e: (e["origin"], e["arrival"]))
+
+
+def split_state_entries(sd: dict) -> tuple:
+    """Split a flat state dict into ``(params, partial_entries)`` — the
+    load-side inverse of merging :meth:`PartialReducer.state_entries`
+    into a checkpoint.  Always safe to call: a checkpoint written without
+    partial reduce just yields an empty second dict."""
+    params, entries = {}, {}
+    for k, v in sd.items():
+        (entries if k.startswith(STATE_PREFIX) else params)[k] = v
+    return params, entries
+
+
+# ---------------------------------------------------- trainer primitives
+
+def grad_apply_fns(trainer) -> tuple:
+    """Split a built :class:`~hetu_tpu.exec.Trainer` into the per-worker
+    gradient-staging primitives partial reduce needs:
+
+    - ``grad_fn(model, batch, key) -> (loss, grads)`` — one worker's
+      shard gradient at the current parameters (jitted).
+    - ``apply_fn(state, grads) -> new_state`` — one optimizer update
+      from an already-combined gradient tree (jitted).
+
+    Loss functions that return an updated model in ``aux`` (BatchNorm-
+    style functional state) are not supported on the partial path — the
+    contributors' model updates would not compose."""
+    import jax
+
+    from hetu_tpu.core.module import trainable_mask
+    from hetu_tpu.exec.executor import TrainState
+
+    if getattr(trainer, "strategy", None) is not None:
+        raise ValueError(
+            "partial reduce cannot drive a Trainer built with a sharding "
+            "strategy: the per-worker grad/apply primitives re-jit "
+            "loss_fn/optimizer without the strategy's mesh and would "
+            "silently run unsharded — drive a plain data-parallel Trainer "
+            "(the partial cut IS the data-parallel axis here)")
+    loss_fn = trainer.loss_fn
+    optimizer = trainer.optimizer
+    mask = trainable_mask(trainer.state.model)
+
+    @jax.jit
+    def grad_fn(model, batch, key):
+        def wrapped(m):
+            loss, aux = loss_fn(m, batch, key)
+            if isinstance(aux, dict) and "model" in aux:
+                raise ValueError(
+                    "partial reduce cannot drive a loss_fn with functional "
+                    "model state (aux['model']): per-worker state updates "
+                    "do not compose across the partial cut")
+            return loss
+
+        return jax.value_and_grad(wrapped)(model)
+
+    @jax.jit
+    def apply_fn(state, grads):
+        params, opt_state = optimizer.update(
+            grads, state.opt_state, state.model, mask=mask)
+        return TrainState(params, opt_state)
+
+    return grad_fn, apply_fn
+
+
+# ------------------------------------------------- multi-process arrivals
+
+class GradientBoard:
+    """File-based per-step gradient exchange for multi-process gangs —
+    the arrival substrate over the shared gang directory that
+    ``launch.simulate_workers(gang_dir=..., partial_deadline=...)``
+    provides (the in-process :class:`~hetu_tpu.exec.gang.ElasticGang`
+    simulates arrivals on the step clock instead and never touches
+    this).
+
+    Posts are atomic (tmp + ``os.replace``), so a reader never sees a
+    torn gradient; the wall-clock ``collect`` deadline is inherently
+    non-deterministic — the bitwise replay guarantees live in the
+    step-clock harness."""
+
+    def __init__(self, gang_dir: str):
+        self.dir = os.path.join(gang_dir, "partial")
+
+    def _path(self, step: int, rank: int) -> str:
+        return os.path.join(self.dir, f"step_{int(step):08d}",
+                            f"grad_{int(rank):04d}.npz")
+
+    def post(self, step: int, rank: int, weight: float, grads: dict) -> str:
+        """Publish ``rank``'s gradient for ``step``."""
+        path = self._path(step, rank)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, __weight__=np.asarray(float(weight)),
+                     **{k: np.asarray(v) for k, v in grads.items()})
+        os.replace(tmp, path)
+        return path
+
+    def take(self, step: int, rank: int) -> Optional[tuple]:
+        """``(weight, flat_grads)`` for a posted gradient, or None when it
+        has not arrived yet."""
+        try:
+            with np.load(self._path(step, rank)) as z:
+                weight = float(z["__weight__"])
+                grads = {k: z[k] for k in z.files if k != "__weight__"}
+        except (OSError, ValueError):
+            return None
+        return weight, grads
+
+    def collect(self, step: int, ranks: Sequence[int], *,
+                deadline_s: float, min_arrivals: int = 1,
+                poll: float = 0.01, barrier_timeout: float = 120.0) -> tuple:
+        """Gather arrivals for ``step`` until every rank posted or the
+        deadline passes with at least ``min_arrivals`` present (below the
+        quorum the collect keeps waiting — the full-barrier degrade).
+        Returns ``({rank: (weight, grads)}, missing_ranks, degraded)`` —
+        pass ``degraded`` on to :meth:`PartialReducer.reduce` (and into
+        the cut record) so the under-provisioned-gang telemetry fires on
+        the multi-process path too; raises ``TimeoutError`` past
+        ``barrier_timeout`` (a wedged gang, not a straggler)."""
+        want = [int(r) for r in ranks]
+        got: dict = {}
+        deadline = time.monotonic() + float(deadline_s)
+        hard = time.monotonic() + float(barrier_timeout)
+        required = min(int(min_arrivals), len(want))
+        degraded = False
+        while True:
+            for r in want:
+                if r not in got:
+                    hit = self.take(step, r)
+                    if hit is not None:
+                        got[r] = hit
+            if len(got) == len(want):
+                break
+            now = time.monotonic()
+            if now > deadline:
+                if not degraded and len(got) >= required:
+                    break
+                # below quorum at the deadline: the decision is made once,
+                # and it is the FULL barrier (mirror of cut()'s degraded
+                # step) — not "first moment the quorum fills in"
+                degraded = True
+            if now > hard:
+                raise TimeoutError(
+                    f"partial-reduce collect for step {step} wedged: only "
+                    f"{sorted(got)} of {want} posted within "
+                    f"{barrier_timeout}s")
+            time.sleep(poll)
+        return got, [r for r in want if r not in got], degraded
+
+    # The cut record: one worker (rank 0 by convention) runs the wall-
+    # clock deadline and COMMITS the contributor set; every other worker
+    # reduces over exactly that set, so the whole gang applies the same
+    # update even though each rank observes arrivals at different times.
+    # Late folds then re-derive deterministically on every rank: a
+    # gradient cut out at its origin step is staged with
+    # ``arrival = origin + 1`` (a rule, not an observation) and folds at
+    # its owner's next committed-contributor step.
+
+    def _cut_path(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{int(step):08d}", "cut.json")
+
+    def post_cut(self, step: int, contributors: Sequence[int],
+                 degraded: bool = False) -> str:
+        """Commit the contributor set (and whether the step degraded to
+        the full barrier) for ``step`` — atomic; the decider rank calls
+        this after its :meth:`collect`."""
+        import json
+        path = self._cut_path(step)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step),
+                       "contributors": sorted(int(r) for r in contributors),
+                       "degraded": bool(degraded)},
+                      f)
+        os.replace(tmp, path)
+        return path
+
+    def read_cut(self, step: int, *, timeout_s: float = 120.0,
+                 poll: float = 0.01) -> dict:
+        """Wait for the decider's committed cut record for ``step``:
+        ``{"step", "contributors", "degraded"}``."""
+        import json
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            try:
+                with open(self._cut_path(step)) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no cut record for step {step} within {timeout_s}s — "
+                    f"the decider rank is gone or wedged")
+            time.sleep(poll)

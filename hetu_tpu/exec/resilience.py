@@ -50,6 +50,7 @@ from hetu_tpu.exec import faults as _faults
 from hetu_tpu.exec.checkpoint import (AsyncCheckpointer, CheckpointError,
                                       load_checkpoint, load_state_dict,
                                       save_checkpoint)
+from hetu_tpu.exec.partial import split_state_entries as _split_partial
 from hetu_tpu.obs import journal as _obs_journal
 from hetu_tpu.obs import registry as _obs
 
@@ -211,7 +212,21 @@ class ResilientTrainer:
     ``gang`` (a :class:`~hetu_tpu.exec.gang.GangCheckpointer`: saves
     become this worker's shard + ring replica + — on the manifest writer
     — the signed gang manifest, and resume/rollback compose the newest
-    intact manifest instead of scanning monolithic files).
+    intact manifest instead of scanning monolithic files),
+    ``partial`` (a :class:`~hetu_tpu.exec.partial.PartialReducer`: the
+    reducer's pending late-gradient correction terms become part of
+    every checkpoint — as reserved ``partialreduce.*`` state entries, so
+    with ``gang=`` they are sharded, ring-replicated, and
+    manifest-signed — and resume/rollback restore them, keeping
+    kill/recover replays bitwise even mid-fold).
+
+    Composition of partial reduce with the NaN/Inf anomaly policy: a
+    non-finite *late fold* is rolled back by the reducer itself — the
+    fold, not the step (``stale_drop`` with ``reason="nonfinite"`` in
+    the journal) — so the guard here only ever skips steps whose own
+    gradients are anomalous; checkpoints taken with ``partial=`` remain
+    loadable by a partial-less trainer (the reserved entries are split
+    out before ``load_state_dict``).
 
     ``resume()`` auto-detects the checkpoint format either way: gang
     manifests in ``ckpt_dir`` are preferred when present, and monolithic
@@ -229,7 +244,7 @@ class ResilientTrainer:
                  keep: int = 3, anomaly_policy: str = "skip",
                  max_consecutive_anomalies: int = 3,
                  step_timeout: Optional[float] = None,
-                 handle_signals: bool = False, gang=None):
+                 handle_signals: bool = False, gang=None, partial=None):
         if anomaly_policy not in ("skip", "raise", "off"):
             raise ValueError(
                 f"anomaly_policy must be 'skip', 'raise' or 'off', "
@@ -248,6 +263,7 @@ class ResilientTrainer:
         self.max_consecutive_anomalies = int(max_consecutive_anomalies)
         self.step_timeout = step_timeout
         self.gang = gang
+        self.partial = partial
         if gang is not None and (os.path.normpath(gang.gang_dir)
                                  != os.path.normpath(ckpt_dir)):
             # save() writes where the gang points but resume()/rollback
@@ -386,10 +402,18 @@ class ResilientTrainer:
         if prefixes:
             sd = {k: v for k, v in sd.items()
                   if not any(k.startswith(p) for p in prefixes)}
+        if self.partial is not None:
+            # pending correction terms are training state: losing them on
+            # a kill would silently forget late gradients the replay then
+            # cannot reproduce
+            sd.update(self.partial.state_entries())
         return sd
 
     def _load_into_trainer(self, sd: dict,
                            consider_splits: bool = False) -> None:
+        sd, corr = _split_partial(sd)
+        if self.partial is not None:
+            self.partial.load_state_entries(corr)
         self.trainer.state = _to_device(load_state_dict(
             self.trainer.state, sd, consider_splits=consider_splits))
 

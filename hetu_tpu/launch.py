@@ -33,6 +33,7 @@ ENV_NPROC = "HETU_TPU_NPROC"
 ENV_PROC_ID = "HETU_TPU_PROC_ID"
 ENV_EMBED_SERVERS = "HETU_TPU_EMBED_SERVERS"
 ENV_GANG_DIR = "HETU_TPU_GANG_DIR"
+ENV_PARTIAL_DEADLINE = "HETU_TPU_PARTIAL_DEADLINE"
 
 
 @dataclasses.dataclass
@@ -176,8 +177,8 @@ def launch(cfg: DistConfig, argv: Sequence[str],
     ``"server:<addr>"``."""
     procs = []
     carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, ENV_EMBED_SERVERS,
-             ENV_GANG_DIR, "JAX_PLATFORMS", "XLA_FLAGS",
-             "PYTHONPATH"] + sorted(extra_env or ())
+             ENV_GANG_DIR, ENV_PARTIAL_DEADLINE, "JAX_PLATFORMS",
+             "XLA_FLAGS", "PYTHONPATH"] + sorted(extra_env or ())
     for host, port in cfg.server_table():
         srv_argv = [sys.executable, "-m", "hetu_tpu.embed.net",
                     "--port", str(port)]
@@ -209,7 +210,8 @@ def launch(cfg: DistConfig, argv: Sequence[str],
 def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                      timeout: float = 120.0, port: int = 0, faults=None,
                      restart_once: bool = False, gang_dir: Optional[str] = None,
-                     allow_failures: bool = False) -> list:
+                     allow_failures: bool = False,
+                     partial_deadline: Optional[float] = None) -> list:
     """Run ``script`` in ``n`` local CPU processes joined into one jax
     distributed world.  Returns each process's stdout.  The CPU analogue of
     the reference's mpirun-on-localhost test pattern (tests/test_comm.py).
@@ -231,6 +233,12 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
     ``gang_dir``: exported to every worker as ``HETU_TPU_GANG_DIR`` so
     scripts can join the elastic-gang protocol
     (``exec.gang.GangMembership.from_env()`` + ``GangCheckpointer``).
+
+    ``partial_deadline``: exported as ``HETU_TPU_PARTIAL_DEADLINE`` —
+    the wall-clock arrival deadline (seconds) a worker script's
+    ``exec.partial.PartialReduceConfig.from_env()`` picks up for
+    straggler-tolerant partial gradient reduction over the shared
+    ``gang_dir`` (``exec.partial.GradientBoard``).
 
     ``allow_failures``: a worker that still exits non-zero (after any
     ``restart_once`` retry) is recorded — its output gains a trailing
@@ -271,6 +279,8 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
         env = worker_env(cfg, pid)
         if gang_dir is not None:
             env[ENV_GANG_DIR] = gang_dir
+        if partial_deadline is not None:
+            env[ENV_PARTIAL_DEADLINE] = str(float(partial_deadline))
         env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU jax (sitecustomize)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +

@@ -30,6 +30,22 @@ kind                fields (beyond ``seq``/``ts``)
 ``manifest_skipped``  ``step``, ``generation``, ``reason`` (a peer's
                       shard never landed — the checkpoint step fails
                       soft and the previous manifest stays newest)
+``rescale_timeout``   ``generation``, ``waiting_on``, ``timeout_s`` (a
+                      rescale barrier wedged on unacked survivors — the
+                      exception alone left nothing for post-mortems)
+``partial_step``      ``step``, ``arrivals``, ``late_folds``,
+                      ``dropped``, ``degraded``, ``waited`` (one
+                      partial-reduce cut; ``skipped=True`` when no
+                      finite contribution survived)
+``late_fold``         ``step``, ``worker``, ``origin_step``, ``age`` (a
+                      late gradient folded as a correction term at its
+                      owner's next on-time step)
+``stale_drop``        ``step``, ``worker``, ``origin_step``, ``age``,
+                      ``reason`` (``stale`` = past tau, ``nonfinite`` =
+                      NaN late fold rolled back,
+                      ``nonfinite_contribution`` = the step's own
+                      on-time gradient was NaN, ``worker_lost`` = owner
+                      evicted before folding)
 ==================  =====================================================
 
 A journal is installed process-wide with :func:`set_journal` (or the

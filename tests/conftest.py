@@ -72,6 +72,12 @@ def pytest_configure(config):
                    "deterministic rescale); multi-process gang chaos runs "
                    "are additionally marked slow — a fast 2-worker smoke "
                    "stays in tier-1")
+    config.addinivalue_line(
+        "markers", "partial: straggler-tolerant partial-reduce tests "
+                   "(exec.partial deadline cut / bounded-staleness folds / "
+                   "correction-term persistence); multi-worker chaos runs "
+                   "ride the slow tier — a 2-worker deadline-miss smoke "
+                   "stays in tier-1, mirroring the gang convention")
 
 
 @pytest.fixture

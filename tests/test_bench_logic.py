@@ -142,3 +142,52 @@ def test_deadline_fallback_headlines_best_measured(monkeypatch, capture):
     assert [c[:2] for c in stub.calls] == [("xla", False)]
     assert line["flash_attention"] is False and line["fused_ln"] is False
     assert "ab_probe_ms" not in line
+
+
+class TestPreflight:
+    """The bench preflight must fail FAST with a named stderr diagnosis
+    and rc=3, and emit NOTHING on stdout — rounds 4-5 recorded its old
+    'backend_unreachable' JSON line as if it were a benchmark result
+    (BENCH_r04/r05.json)."""
+
+    def test_deterministic_failure_exits_3_with_diagnosis(self, capsys):
+        def probe():
+            raise RuntimeError("xla client init failed: no such device")
+
+        with pytest.raises(SystemExit) as ei:
+            bench._require_backend_alive(timeout_s=5.0, probe=probe,
+                                         retry_wait=0.0)
+        assert ei.value.code == bench.PREFLIGHT_RC == 3
+        out, err = capsys.readouterr()
+        assert out == ""  # NO metric line a driver could record as a round
+        assert "PREFLIGHT FAILED" in err
+        assert "no such device" in err
+        assert "not a perf regression" in err
+
+    def test_transient_failure_retries_then_passes(self, capsys):
+        calls = []
+
+        def probe():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("connection reset by peer")
+
+        bench._require_backend_alive(timeout_s=5.0, probe=probe,
+                                     retry_wait=0.0)
+        assert len(calls) == 2
+        assert capsys.readouterr().out == ""
+
+    def test_transient_failure_twice_is_terminal(self, capsys):
+        def probe():
+            raise RuntimeError("connection reset by peer")
+
+        with pytest.raises(SystemExit) as ei:
+            bench._require_backend_alive(timeout_s=5.0, probe=probe,
+                                         retry_wait=0.0)
+        assert ei.value.code == 3
+        out, err = capsys.readouterr()
+        assert out == "" and "connection reset" in err
+
+    def test_healthy_backend_passes_silently(self, capsys):
+        bench._require_backend_alive(timeout_s=30.0)
+        assert capsys.readouterr().out == ""

@@ -118,6 +118,26 @@ class TestDeterministicRescale:
         # near-even split
         assert {len(p) for p in parts} <= {5, 6}
 
+    def test_partition_exact_cover_over_grid(self):
+        """Property, over a (seed, generation, world_size) grid: the
+        per-worker shards partition the global index set EXACTLY — no
+        drops, no duplicates — and are stable across calls."""
+        n = 37  # deliberately not divisible by any grid world size
+        for seed in (0, 1, 7):
+            for generation in (0, 1, 3):
+                for world in (1, 2, 3, 5, 8):
+                    for step in (1, 4):
+                        parts = gang_data_partition(seed, generation,
+                                                    world, step, n)
+                        assert len(parts) == world
+                        cat = np.concatenate(parts)
+                        # exact cover: a permutation of arange(n)
+                        assert np.array_equal(np.sort(cat), np.arange(n))
+                        again = gang_data_partition(seed, generation,
+                                                    world, step, n)
+                        assert all(np.array_equal(a, b)
+                                   for a, b in zip(parts, again))
+
     def test_partition_pure_in_all_arguments(self):
         a = gang_data_partition(0, 1, 4, 7, 16)
         b = gang_data_partition(0, 1, 4, 7, 16)
@@ -565,8 +585,27 @@ class TestGangMembership:
 
     def test_barrier_timeout_names_stragglers(self, tmp_path):
         m = GangMembership(str(tmp_path), 0)
-        with pytest.raises(TimeoutError, match=r"\[1\]"):
+        with pytest.raises(TimeoutError, match=r"\[1\]") as ei:
             m.barrier(1, [0, 1], timeout=0.2, poll=0.02)
+        assert ei.value.stragglers == [1]
+
+    def test_rescale_timeout_journals_stuck_barrier(self, tmp_path):
+        """Journal hygiene: a rescale barrier that times out must leave a
+        rescale_timeout event for post-mortems, not only an exception in
+        whichever process saw it."""
+        now = [0.0]
+        clock = lambda: now[0]  # noqa: E731
+        m0 = GangMembership(str(tmp_path), 0, lease_ttl=10.0, clock=clock)
+        m1 = GangMembership(str(tmp_path), 1, lease_ttl=10.0, clock=clock)
+        m0.heartbeat()
+        m1.heartbeat()  # alive but never acks the new generation
+        jr = obs_journal.EventJournal(clock=clock)
+        with obs_journal.use(jr):
+            with pytest.raises(TimeoutError, match=r"\[1\]"):
+                m0.rescale(timeout=0.3)
+        ev, = jr.of_kind("rescale_timeout")
+        assert ev["generation"] == 1
+        assert ev["waiting_on"] == [1] and ev["timeout_s"] == 0.3
 
 
 # ----------------------------------------------- multi-process smokes

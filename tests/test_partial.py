@@ -1,0 +1,853 @@
+"""Partial-reduce: straggler-tolerant bounded-staleness collectives under
+deterministic chaos.
+
+The acceptance bar is the ROADMAP's: a 4-worker gang under a *seeded*
+``worker_stall`` straggler schedule sustains >= 1.3x the synchronous
+barrier's steps/sec on the step clock, converges to matched loss on a
+real config, and a replay of the same ``FaultPlan`` is bitwise
+identical — journal, correction terms, final parameters.  The
+kill-during-late-fold variant proves pending corrections ride the
+sharded + ring-replicated gang checkpoints: the fold that happens after
+the recovery could only have come from the persisted state.
+"""
+
+import math
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec import (ElasticGang, GangCheckpointer, PartialReduceConfig,
+                           PartialReducer, ResilientTrainer, Trainer, faults,
+                           gang)
+from hetu_tpu.exec.partial import (STATE_PREFIX, GradientBoard,
+                                   grad_apply_fns, split_state_entries)
+from hetu_tpu.models import MLP
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.obs import registry as obs_registry
+from hetu_tpu.optim import SGDOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+
+pytestmark = [pytest.mark.partial, pytest.mark.chaos]
+
+
+# ---------------------------------------------------------------- helpers
+
+def make_trainer():
+    set_random_seed(0)
+    model = MLP((8, 16, 3))
+
+    def loss_fn(model, batch, key):
+        logits = model(batch["x"])
+        return softmax_cross_entropy_sparse(logits, batch["y"]).mean(), {}
+
+    return Trainer(model, SGDOptimizer(0.1), loss_fn, donate=False)
+
+
+def make_data(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        out.append({"x": x, "y": (x[:, 0] > 0).astype(np.int32)})
+    return out
+
+
+def params_of(tr):
+    return np.asarray(tr.state.model.layers[0].w)
+
+
+def norm_events(jr):
+    """Journal events minus wall-clock noise (mirrors test_gang)."""
+    out = []
+    for e in jr.events:
+        e = {k: v for k, v in e.items() if k != "ts"}
+        if e["kind"] == "checkpoint_saved":
+            e.pop("duration_s", None)
+            e["path"] = "/".join(e["path"].split(os.sep)[-2:])
+        out.append(e)
+    return out
+
+
+def build_partial_gang(tmpdir, data, cfg, world=4, seed=0, save_every=2,
+                       lease_steps=1):
+    tr = make_trainer()
+    g = ElasticGang(tr, str(tmpdir), world_size=world,
+                    data_fn=lambda s: data[s - 1], global_batch_size=16,
+                    seed=seed, save_every=save_every,
+                    lease_steps=lease_steps, partial=cfg)
+    return g, tr
+
+
+def straggler_plan(seed=7, steps=30):
+    """THE seeded straggler schedule of the acceptance tests: heavy-tailed
+    stall lengths drawn per event, gang step-clock convention."""
+    return faults.FaultPlan.random(seed, steps, kinds=("worker_stall",),
+                                   rate=0.2, n_workers=4,
+                                   stall_steps=("pareto", 1.5, 2.0))
+
+
+def flat(v, names=("a.w", "b.w")):
+    return {n: np.full(3, float(v), np.float32) for n in names}
+
+
+# ----------------------------------------------------------- the policy
+
+class TestPartialReduceConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="deadline"):
+            PartialReduceConfig(deadline=-1.0)
+        with pytest.raises(ValueError, match="tau"):
+            PartialReduceConfig(tau=-1)
+        with pytest.raises(ValueError, match="min_arrivals"):
+            PartialReduceConfig(min_arrivals=0)
+
+    def test_cut_deadline(self):
+        cfg = PartialReduceConfig(deadline=1.0, tau=4, min_arrivals=1)
+        ontime, wait, degraded = cfg.cut({0: 0.0, 1: 1.0, 2: 3.0, 3: 0.0})
+        assert ontime == [0, 1, 3] and wait == 1.0 and not degraded
+
+    def test_cut_below_quorum_degrades_to_full_barrier(self):
+        cfg = PartialReduceConfig(deadline=0.0, tau=4, min_arrivals=3)
+        ontime, wait, degraded = cfg.cut({0: 0.0, 1: 2.0, 2: 5.0, 3: 1.0})
+        assert ontime == [0, 1, 2, 3] and wait == 5.0 and degraded
+
+    def test_quorum_capped_at_world(self):
+        # a 2-worker gang with min_arrivals=3 is not permanently degraded
+        cfg = PartialReduceConfig(deadline=0.0, tau=4, min_arrivals=3)
+        ontime, wait, degraded = cfg.cut({0: 0.0, 1: 0.0})
+        assert ontime == [0, 1] and not degraded
+
+    def test_infinite_deadline_is_the_synchronous_barrier(self):
+        cfg = PartialReduceConfig(deadline=float("inf"), tau=4)
+        ontime, wait, degraded = cfg.cut({0: 0.0, 1: 7.0})
+        assert ontime == [0, 1] and wait == 7.0 and not degraded
+
+    def test_from_env(self, monkeypatch):
+        from hetu_tpu.launch import ENV_PARTIAL_DEADLINE
+        monkeypatch.delenv(ENV_PARTIAL_DEADLINE, raising=False)
+        assert PartialReduceConfig.from_env() is None
+        monkeypatch.setenv(ENV_PARTIAL_DEADLINE, "1.5")
+        cfg = PartialReduceConfig.from_env(tau=9)
+        assert cfg.deadline == 1.5 and cfg.tau == 9
+
+
+# ----------------------------------------------------------- the reducer
+
+class TestPartialReducer:
+    def test_weighted_mean_over_contributors(self):
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=4))
+        combined, info = r.reduce(1, {0: (8.0, flat(1.0)),
+                                      1: (4.0, flat(4.0))})
+        np.testing.assert_allclose(combined["a.w"], np.full(3, 2.0))
+        assert info["arrivals"] == 2 and info["used"] == [0, 1]
+        assert info["late_folds"] == 0 and not info["degraded"]
+
+    def test_late_fold_at_next_ontime_step(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=4))
+        with obs_journal.use(jr):
+            assert r.stage_late(1, 3, 5, 8.0, flat(6.0))
+            # step 4: worker 1 still away, its correction not yet arrived
+            c4, i4 = r.reduce(4, {0: (8.0, flat(2.0))})
+            np.testing.assert_allclose(c4["a.w"], np.full(3, 2.0))
+            assert i4["late_folds"] == 0 and r.pending_count() == 1
+            # step 5: worker 1 back on time -> its late grad folds
+            c5, i5 = r.reduce(5, {0: (8.0, flat(2.0)),
+                                  1: (8.0, flat(4.0))})
+            np.testing.assert_allclose(c5["a.w"], np.full(3, 4.0))
+            assert i5["late_folds"] == 1 and r.pending_count() == 0
+        fold, = jr.of_kind("late_fold")
+        assert (fold["worker"], fold["origin_step"], fold["age"]) == (1, 3, 2)
+
+    def test_stale_past_tau_dropped_at_the_door(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=2))
+        with obs_journal.use(jr):
+            assert not r.stage_late(1, 3, 8, 8.0, flat(6.0))  # age 5 > 2
+        assert r.pending_count() == 0
+        drop, = jr.of_kind("stale_drop")
+        assert (drop["reason"], drop["age"]) == ("stale", 5)
+
+    def test_matured_fold_past_tau_dropped(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=2))
+        r.stage_late(1, 3, 4, 8.0, flat(6.0))  # arrives at 4, tau-ok
+        with obs_journal.use(jr):
+            # worker 1 only comes back at step 7: age 4 > tau -> drop
+            _c, info = r.reduce(7, {0: (8.0, flat(2.0)),
+                                    1: (8.0, flat(2.0))})
+        assert info["late_folds"] == 0 and info["dropped"] == 1
+        drop, = jr.of_kind("stale_drop")
+        assert (drop["worker"], drop["origin_step"], drop["age"],
+                drop["reason"]) == (1, 3, 4, "stale")
+
+    def test_sweep_drops_nonparticipants_stale_mass(self):
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=1))
+        r.stage_late(2, 3, 4, 8.0, flat(6.0))
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr):
+            r.reduce(9, {0: (8.0, flat(1.0))})  # worker 2 still absent
+        assert r.pending_count() == 0
+        drop, = jr.of_kind("stale_drop")
+        assert drop["worker"] == 2 and drop["reason"] == "stale"
+
+    def test_nonfinite_fold_rolls_back_the_fold_not_the_step(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=4))
+        bad = flat(1.0)
+        bad["a.w"] = np.full(3, np.nan, np.float32)
+        r.stage_late(1, 3, 4, 8.0, bad)
+        with obs_journal.use(jr):
+            combined, info = r.reduce(4, {0: (8.0, flat(2.0)),
+                                          1: (8.0, flat(4.0))})
+        # the poisoned fold is gone; the step's own contributions commit
+        np.testing.assert_allclose(combined["a.w"], np.full(3, 3.0))
+        assert info["late_folds"] == 0 and info["dropped"] == 1
+        drop, = jr.of_kind("stale_drop")
+        assert drop["reason"] == "nonfinite" and drop["origin_step"] == 3
+        step, = jr.of_kind("partial_step")
+        assert "skipped" not in step
+
+    def test_nonfinite_contribution_excluded(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=4))
+        bad = flat(1.0)
+        bad["b.w"] = np.full(3, np.inf, np.float32)
+        with obs_journal.use(jr):
+            combined, info = r.reduce(1, {0: (8.0, flat(2.0)),
+                                          1: (8.0, bad)})
+        np.testing.assert_allclose(combined["a.w"], np.full(3, 2.0))
+        assert info["used"] == [0] and info["dropped"] == 1
+        # distinct reason from a rolled-back fold: no correction involved
+        drop, = jr.of_kind("stale_drop")
+        assert drop["reason"] == "nonfinite_contribution"
+
+    def test_all_nonfinite_skips_the_step(self):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=4))
+        bad = {k: np.full(3, np.nan, np.float32) for k in ("a.w", "b.w")}
+        with obs_journal.use(jr):
+            combined, info = r.reduce(1, {0: (8.0, bad)})
+        assert combined is None and info["used"] == []
+        step, = jr.of_kind("partial_step")
+        assert step["skipped"] is True
+
+    def test_state_entries_roundtrip(self):
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        r.stage_late(1, 3, 5, 8.0, flat(6.0))
+        r.stage_late(3, 4, 6, 4.0, flat(2.0))
+        entries = r.state_entries()
+        assert all(k.startswith(STATE_PREFIX) for k in entries)
+        r2 = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        r2.load_state_entries(entries)
+        assert r2.state_entries().keys() == entries.keys()
+        for k in entries:
+            np.testing.assert_array_equal(r2.state_entries()[k], entries[k])
+        # mixed into a parameter state dict, split recovers both halves
+        sd = {"model.w": np.ones(2), **entries}
+        params, part = split_state_entries(sd)
+        assert set(params) == {"model.w"} and part.keys() == entries.keys()
+
+    def test_fractional_weights_roundtrip_exactly(self):
+        """Review regression: the checkpoint key encodes the fold weight
+        as IEEE-754 bits, so non-integer weights survive save/load
+        bitwise instead of truncating to int."""
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        r.stage_late(1, 3, 5, 2.5, flat(6.0))
+        r.stage_late(2, 3, 5, 0.125, flat(1.0))
+        r2 = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        r2.load_state_entries(r.state_entries())
+        assert r2.pending[1][0]["weight"] == 2.5
+        assert r2.pending[2][0]["weight"] == 0.125
+
+    def test_load_remaps_ranks_and_drops_evicted(self):
+        r = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        r.stage_late(1, 3, 5, 8.0, flat(6.0))
+        r.stage_late(2, 3, 5, 8.0, flat(7.0))
+        entries = r.state_entries()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        r2 = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        with obs_journal.use(jr):
+            # worker 2 was evicted; survivors {0,1,3} re-rank densely
+            r2.load_state_entries(entries, rank_map={0: 0, 1: 1, 3: 2},
+                                  step=4)
+        assert sorted(r2.pending) == [1]
+        drop, = jr.of_kind("stale_drop")
+        assert (drop["worker"], drop["reason"]) == (2, "worker_lost")
+
+
+# ------------------------------------------------ gang integration: fast
+
+class TestElasticGangPartial:
+    def test_arrivals_field_in_both_modes(self, tmp_path):
+        data = make_data()
+        g, _tr = build_partial_gang(
+            tmp_path / "p", data, PartialReduceConfig(deadline=0.0, tau=4))
+        m = g._one_step()
+        assert m["arrivals"] == 4 and m["late_folds"] == 0
+        tr2 = make_trainer()
+        gs = ElasticGang(tr2, str(tmp_path / "s"), world_size=4,
+                         data_fn=lambda s: data[s - 1],
+                         global_batch_size=16, seed=0)
+        assert gs._one_step()["arrivals"] == 4
+
+    def test_full_arrival_matches_sync_path_closely(self, tmp_path):
+        """deadline=inf partial reduce IS the synchronous barrier: the
+        weighted mean of per-shard gradients equals the global-batch
+        gradient up to reduction order, so the two paths track to float
+        tolerance (bitwise identity is only promised replay-vs-replay)."""
+        data = make_data()
+        g, _ = build_partial_gang(
+            tmp_path / "p", data,
+            PartialReduceConfig(deadline=float("inf"), tau=4))
+        g.run_until(6)
+        tr2 = make_trainer()
+        gs = ElasticGang(tr2, str(tmp_path / "s"), world_size=4,
+                         data_fn=lambda s: data[s - 1],
+                         global_batch_size=16, seed=0)
+        gs.run_until(6)
+        for s in range(1, 7):
+            assert abs(g.losses_by_step[s] - gs.losses_by_step[s]) < 1e-4
+
+    def test_deadline_miss_folds_late_gradients(self, tmp_path):
+        """2-worker deadline miss (the tier-1 smoke shape): the stalled
+        worker's gradients fold as corrections at its return step."""
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        g, _ = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=6),
+            world=2)
+        plan = faults.FaultPlan([(2, faults.Fault("worker_stall", worker=1,
+                                                  arg=2))])
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(5)
+        assert plan.remaining() == []
+        assert (g.world_size, g.generation) == (2, 0)  # no eviction
+        steps = {e["step"]: e for e in jr.of_kind("partial_step")}
+        assert steps[2]["arrivals"] == 1 and steps[3]["arrivals"] == 1
+        assert steps[4]["arrivals"] == 2 and steps[4]["late_folds"] == 2
+        folds = jr.of_kind("late_fold")
+        assert [(e["origin_step"], e["age"]) for e in folds] == [(2, 2),
+                                                                 (3, 1)]
+        assert jr.of_kind("worker_lost") == []
+
+    def test_below_quorum_degrades_to_full_barrier(self, tmp_path):
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        reg = obs_registry.get_registry()
+        g, _ = build_partial_gang(
+            tmp_path, data,
+            PartialReduceConfig(deadline=0.0, tau=6, min_arrivals=2))
+        plan = faults.FaultPlan(
+            [(2, faults.Fault("worker_stall", worker=w, arg=2))
+             for w in (1, 2, 3)])
+        before = reg.snapshot()
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(3)
+        delta = reg.delta(reg.snapshot(), before)
+        assert delta["hetu_partial_degraded_steps_total"] == 1.0
+        steps = {e["step"]: e for e in jr.of_kind("partial_step")}
+        assert steps[2]["degraded"] is True and steps[2]["arrivals"] == 4
+        assert steps[2]["waited"] == 2.0
+        assert g.reducer.pending_count() == 0  # waited for = not late
+        # the barrier wait DRAINED the stalls (sim-time stall model): the
+        # gang paid the 2 units once, and step 3 is back to a full cut
+        assert steps[3]["degraded"] is False and steps[3]["arrivals"] == 4
+        assert g.sim_time == 5.0  # 3 steps + one 2-unit wait, charged once
+
+    def test_partial_counters_exact(self, tmp_path):
+        data = make_data()
+        reg = obs_registry.get_registry()
+        g, _ = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=6))
+        before = reg.snapshot()
+        plan = faults.FaultPlan([(3, faults.Fault("worker_stall", worker=1,
+                                                  arg=2))])
+        with faults.inject(plan):
+            g.run_until(6)
+        delta = reg.delta(reg.snapshot(), before)
+        assert delta['hetu_partial_arrivals_total{outcome="ontime"}'] == 22.0
+        assert delta['hetu_partial_arrivals_total{outcome="late"}'] == 2.0
+        assert delta["hetu_partial_late_folds_total"] == 2.0
+        assert delta.get(
+            'hetu_partial_dropped_total{reason="stale"}', 0.0) == 0.0
+        assert delta["hetu_partial_staleness_age_steps_count"] == 2.0
+
+    def test_overlapping_stalls_extend_not_clip(self, tmp_path):
+        """Review regression: a later (shorter) stall on an already-
+        stalled worker must EXTEND the stall, not overwrite it — the
+        heavy tail a pareto schedule draws would otherwise be clipped."""
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        g, _ = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=8))
+        plan = faults.FaultPlan([
+            (2, faults.Fault("worker_stall", worker=1, arg=5)),   # until 7
+            (3, faults.Fault("worker_stall", worker=1, arg=1))])  # NOT 4
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(8)
+        assert plan.remaining() == []
+        steps = {e["step"]: e["arrivals"]
+                 for e in jr.of_kind("partial_step")}
+        # worker 1 stays late through step 6 and returns at 7
+        assert [steps[s] for s in range(2, 8)] == [3, 3, 3, 3, 3, 4]
+
+    def test_untargeted_grad_nan_poisons_all_shards(self, tmp_path):
+        """Mode parity: an untargeted grad_nan (the sync path's whole-
+        batch poisoning) must drain — and inject — on the partial path
+        too: every shard goes NaN, the update is skipped, params hold."""
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        g, tr = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=6))
+        g._one_step()
+        before = params_of(tr).copy()
+        plan = faults.FaultPlan([(2, "grad_nan")])
+        with obs_journal.use(jr), faults.inject(plan):
+            g._one_step()
+        assert plan.remaining() == []  # the plan drains in partial mode
+        step2, = jr.of_kind("partial_step")
+        assert step2["skipped"] is True and step2["dropped"] == 4
+        np.testing.assert_array_equal(params_of(tr), before)  # no update
+
+    def test_long_stall_past_tau_journals_drops(self, tmp_path):
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        g, _ = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=3))
+        plan = faults.FaultPlan([(2, faults.Fault("worker_stall", worker=1,
+                                                  arg=5))])
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(8)
+        drops = jr.of_kind("stale_drop")
+        # steps 2 and 3 can never fold within tau=3 (arrival at 7);
+        # origins 4..6 make it
+        assert [(e["origin_step"], e["reason"]) for e in drops] == \
+            [(2, "stale"), (3, "stale")]
+        folds = jr.of_kind("late_fold")
+        assert [e["origin_step"] for e in folds] == [4, 5, 6]
+
+
+# --------------------------------------------- the chaos acceptance bar
+
+class TestPartialReduceChaos:
+    CFG = PartialReduceConfig(deadline=0.0, tau=6, min_arrivals=2)
+
+    def _straggler_run(self, d, data, cfg=None, steps=30):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr):
+            g, tr = build_partial_gang(d, data, cfg or self.CFG)
+            with faults.inject(straggler_plan(steps=steps)) as plan:
+                g.run_until(steps)
+        return g, tr, jr, plan
+
+    def test_throughput_gain_and_matched_convergence(self, tmp_path):
+        """THE acceptance: under the seeded heavy-tailed straggler
+        schedule, partial reduce sustains >= 1.3x the synchronous
+        barrier's steps/sec on the step clock, at matched converged
+        loss on the same real config."""
+        data = make_data(34)
+        gp, _trp, _jp, planp = self._straggler_run(tmp_path / "p", data)
+        gs, _trs, _js, plans = self._straggler_run(
+            tmp_path / "s", data,
+            cfg=PartialReduceConfig(deadline=float("inf"), tau=6))
+        assert planp.remaining() == [] and plans.remaining() == []
+        # no evictions: stragglers rode the deadline, not the lease
+        assert (gp.world_size, gp.generation) == (4, 0)
+        throughput_gain = (30 / gp.sim_time) / (30 / gs.sim_time)
+        assert throughput_gain >= 1.3, (gp.sim_time, gs.sim_time)
+        # matched convergence: same config, same data, loss within tol
+        assert gs.losses_by_step[30] < 0.6  # the sync run converged
+        assert abs(gp.losses_by_step[30] - gs.losses_by_step[30]) < 0.1
+
+    def test_straggler_replay_is_bitwise_identical(self, tmp_path):
+        """Replaying the same seeded FaultPlan reproduces the journal,
+        the correction terms, and the final parameters bitwise."""
+        data = make_data(34)
+        gA, trA, jA, _pA = self._straggler_run(tmp_path / "a", data)
+        gB, trB, jB, _pB = self._straggler_run(tmp_path / "b", data)
+        assert norm_events(jA) == norm_events(jB)
+        assert gA.losses_by_step == gB.losses_by_step  # plain float ==
+        np.testing.assert_array_equal(params_of(trA), params_of(trB))
+        entA, entB = (gA.reducer.state_entries(),
+                      gB.reducer.state_entries())
+        assert entA.keys() == entB.keys()
+        for k in entA:
+            np.testing.assert_array_equal(entA[k], entB[k])
+
+    def _kill_during_fold_run(self, d, data):
+        """worker 1 stalls at step 3 for 4 steps (its late gradients are
+        mid-flight corrections), a checkpoint lands at step 4, worker 2
+        is killed at step 5 — recovery MUST restore the pending
+        corrections from the persisted (sharded, ring-replicated)
+        checkpoint state, or the folds at step 7 could not happen."""
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        plan = faults.FaultPlan([
+            (3, faults.Fault("worker_stall", worker=1, arg=4)),
+            (5, faults.Fault("worker_kill", worker=2))])
+        with obs_journal.use(jr):
+            g, tr = build_partial_gang(d, data, self.CFG)
+            with faults.inject(plan):
+                g.run_until(10)
+        return g, tr, jr, plan
+
+    def test_kill_during_late_fold_recovers_via_persisted_state(
+            self, tmp_path):
+        data = make_data()
+        g, tr, jr, plan = self._kill_during_fold_run(tmp_path / "a", data)
+        assert plan.remaining() == []
+        assert (g.world_size, g.generation) == (3, 1)
+        rescale, = jr.of_kind("gang_rescale")
+        assert (rescale["old_world"], rescale["new_world"],
+                rescale["resumed_step"]) == (4, 3, 4)
+        # the folds at step 7 are origins 3 and 4 — which existed ONLY in
+        # the step-4 checkpoint when the rescale rewound to it (the
+        # replayed step 5's late gradient folds separately at step 6)
+        seq_rescale = rescale["seq"]
+        folds = [e for e in jr.of_kind("late_fold")
+                 if e["seq"] > seq_rescale]
+        assert sorted(e["origin_step"] for e in folds
+                      if e["step"] == 7) == [3, 4]
+        assert [e["origin_step"] for e in folds if e["step"] == 6] == [5]
+        assert all(np.isfinite(params_of(tr)).all() for _ in (0,))
+        # and the whole chaos run replays bitwise
+        g2, tr2, jr2, _plan2 = self._kill_during_fold_run(tmp_path / "b",
+                                                          data)
+        assert norm_events(jr) == norm_events(jr2)
+        assert g.losses_by_step == g2.losses_by_step
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+
+    def test_nan_late_fold_rolls_back_fold_not_step(self, tmp_path):
+        """grad_nan targeted at the straggler poisons its late gradient:
+        the fold is rolled back (stale_drop reason=nonfinite), the step
+        itself commits on the healthy contributions."""
+        data = make_data()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        g, tr = build_partial_gang(
+            tmp_path, data, PartialReduceConfig(deadline=0.0, tau=6))
+        plan = faults.FaultPlan([
+            (3, faults.Fault("worker_stall", worker=1, arg=2)),
+            (3, faults.Fault("grad_nan", worker=1))])
+        with obs_journal.use(jr), faults.inject(plan):
+            g.run_until(6)
+        assert plan.remaining() == []
+        drop, = jr.of_kind("stale_drop")
+        assert (drop["worker"], drop["origin_step"], drop["step"],
+                drop["reason"]) == (1, 3, 5, "nonfinite")
+        fold, = jr.of_kind("late_fold")
+        assert (fold["origin_step"], fold["age"]) == (4, 1)
+        # the step committed: loss finite, lineage unbroken, params finite
+        assert all(math.isfinite(g.losses_by_step[s]) for s in range(1, 7))
+        assert np.isfinite(params_of(tr)).all()
+        step5 = [e for e in jr.of_kind("partial_step") if e["step"] == 5]
+        assert step5 and "skipped" not in step5[-1]
+
+
+# ------------------------------------ ResilientTrainer correction state
+
+class TestResilientTrainerPartial:
+    def test_corrections_persist_through_gang_checkpoints(self, tmp_path):
+        d = str(tmp_path)
+        tr = make_trainer()
+        reducer = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        reducer.stage_late(1, 3, 5, 8.0, flat(6.0))
+        rt = ResilientTrainer(tr, d, save_every=0,
+                              gang=GangCheckpointer(d, 0, 1, keep=3),
+                              partial=reducer)
+        import jax.numpy as jnp
+        b = {k: jnp.asarray(v) for k, v in make_data(1)[0].items()}
+        rt.step(b)
+        rt.save()
+        rt.close()
+        # the reserved entries rode the shard + manifest
+        _step, _gen, sd, _extra, _rep = gang.load_gang_checkpoint(
+            d, restore_rng=False)
+        _params, entries = split_state_entries(sd)
+        assert entries.keys() == reducer.state_entries().keys()
+        # a fresh trainer + reducer restores them bitwise
+        tr2 = make_trainer()
+        red2 = PartialReducer(PartialReduceConfig(deadline=0.0, tau=8))
+        rt2 = ResilientTrainer(tr2, d, save_every=0, partial=red2)
+        assert rt2.resume() == 1
+        got = red2.state_entries()
+        want = reducer.state_entries()
+        assert got.keys() == want.keys()
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+        np.testing.assert_array_equal(params_of(tr), params_of(tr2))
+        rt2.close()
+        # and a partial-less trainer still loads the same checkpoint
+        tr3 = make_trainer()
+        rt3 = ResilientTrainer(tr3, d, save_every=0)
+        assert rt3.resume() == 1
+        np.testing.assert_array_equal(params_of(tr), params_of(tr3))
+        rt3.close()
+
+
+# --------------------------------------------------- faults satellites
+
+class TestFaultPlanWorkerEvents:
+    def test_worker_events_unifies_kills_and_stalls(self):
+        import signal as sig
+        plan = faults.FaultPlan([
+            (0, faults.Fault("worker_kill", arg=1.0)),
+            (1, faults.Fault("worker_kill", arg=2.0, sig=sig.SIGTERM)),
+            (0, faults.Fault("worker_stall", arg=0.5, duration=2.0)),
+            (1, faults.Fault("worker_stall", arg=0.5)),
+            (2, faults.Fault("worker_stall", worker=1, arg=3)),  # gang conv.
+        ])
+        kills = plan.worker_kills(2)
+        assert kills == [(0, 1.0, sig.SIGKILL), (1, 2.0, sig.SIGTERM)]
+        stalls = plan.worker_stalls(2)
+        assert stalls == [(0, 0.5, 2.0), (1, 0.5, 1.0)]
+        # the gang-convention event stays pending for its own harness
+        assert [(s, f.kind) for s, f in plan.remaining()] == \
+            [(2, "worker_stall")]
+        with pytest.raises(ValueError, match="worker_events"):
+            plan.worker_events("grad_nan")
+
+    def test_random_draws_stall_distributions(self):
+        a = faults.FaultPlan.random(7, 30, kinds=("worker_stall",),
+                                    rate=0.2, n_workers=4,
+                                    stall_steps=("pareto", 1.5, 2.0))
+        b = faults.FaultPlan.random(7, 30, kinds=("worker_stall",),
+                                    rate=0.2, n_workers=4,
+                                    stall_steps=("pareto", 1.5, 2.0))
+        ea, eb = a.remaining(), b.remaining()
+        assert [(s, f.kind, f.worker, f.arg) for s, f in ea] == \
+            [(s, f.kind, f.worker, f.arg) for s, f in eb]  # seed-pure
+        assert ea, "seeded schedule drew no stalls"
+        for _s, f in ea:
+            assert f.worker in range(4)
+            assert f.arg >= 1 and float(f.arg).is_integer()
+        # heavy tail: pareto(shape 1.5, scale 2) spreads beyond the floor
+        args = sorted(f.arg for _s, f in ea)
+        assert args[-1] > args[0]
+
+    def test_random_stall_distribution_specs(self):
+        for spec in (3, ("const", 2), ("uniform", 1, 4),
+                     ("geometric", 0.5), ("pareto", 2.0, 1.0)):
+            plan = faults.FaultPlan.random(
+                0, 20, kinds=("worker_stall",), rate=0.5, n_workers=2,
+                stall_steps=spec)
+            for _s, f in plan.remaining():
+                assert f.arg >= 1
+        with pytest.raises(ValueError, match="stall_steps"):
+            faults.FaultPlan.random(0, 5, kinds=("worker_stall",),
+                                    rate=1.0, n_workers=2,
+                                    stall_steps=("zipf", 2.0))
+
+    def test_untargeted_grad_nan_still_fires_at_executor_seam(self):
+        """The executor seam consumes only untargeted grad_nan events;
+        a gang-targeted one must survive a ResilientTrainer run."""
+        import jax.numpy as jnp
+        tr = make_trainer()
+        rt = ResilientTrainer(tr, "/tmp/_unused_partial_ckpt",
+                              save_every=0)
+        b = {k: jnp.asarray(v) for k, v in make_data(1)[0].items()}
+        plan = faults.FaultPlan([
+            (1, faults.Fault("grad_nan")),
+            (1, faults.Fault("grad_nan", worker=2))])
+        with faults.inject(plan):
+            m = rt.step(b)
+        assert m.get("skipped") is True  # the untargeted one fired
+        assert [(s, f.kind, f.worker) for s, f in plan.remaining()] == \
+            [(1, "grad_nan", 2)]
+        rt.close()
+
+
+# --------------------------------------------------- the board itself
+
+class TestGradientBoard:
+    def test_below_quorum_collect_waits_full_barrier(self, tmp_path):
+        """Review regression: a collect that is below min_arrivals at the
+        deadline degrades to the FULL barrier (mirror of cut()), not to
+        'return the moment the quorum fills in'."""
+        import threading
+        board = GradientBoard(str(tmp_path))
+        board.post(1, 0, 8.0, flat(1.0))
+        t1 = threading.Timer(0.5, board.post, (1, 1, 8.0, flat(2.0)))
+        t2 = threading.Timer(1.0, board.post, (1, 2, 8.0, flat(3.0)))
+        t1.start()
+        t2.start()
+        try:
+            got, missing, degraded = board.collect(1, [0, 1, 2],
+                                                   deadline_s=0.1,
+                                                   min_arrivals=2)
+        finally:
+            t1.cancel()
+            t2.cancel()
+        # quorum (2) filled at ~0.5s, but the degraded collect kept
+        # waiting for rank 2 as well — and reports the degrade so the
+        # caller can journal it
+        assert sorted(got) == [0, 1, 2] and missing == []
+        assert degraded is True
+
+    def test_collect_partial_cut_past_deadline(self, tmp_path):
+        board = GradientBoard(str(tmp_path))
+        board.post(1, 0, 8.0, flat(1.0))
+        got, missing, degraded = board.collect(1, [0, 1], deadline_s=0.1,
+                                               min_arrivals=1)
+        assert sorted(got) == [0] and missing == [1]
+        assert degraded is False
+
+    def test_cut_record_roundtrip(self, tmp_path):
+        board = GradientBoard(str(tmp_path))
+        board.post_cut(3, [0, 2], degraded=True)
+        rec = board.read_cut(3)
+        assert rec["contributors"] == [0, 2] and rec["degraded"] is True
+
+    def test_collect_wedged_raises(self, tmp_path):
+        board = GradientBoard(str(tmp_path))
+        with pytest.raises(TimeoutError, match="wedged"):
+            board.collect(1, [0], deadline_s=0.05, min_arrivals=1,
+                          barrier_timeout=0.2)
+
+
+# ---------------------------------------------- multi-process smoke
+
+def test_two_worker_deadline_miss_smoke(tmp_path):
+    """Tier-1 smoke of the multi-process arrival protocol (mirroring the
+    gang smoke): 2 real processes exchange gradients over a
+    GradientBoard in the shared gang dir; worker 1 misses the wall-clock
+    deadline that ``simulate_workers(partial_deadline=...)`` plumbed
+    through the environment, worker 0 reduces partially (arrivals=1) and
+    folds the late gradient as a correction on the next step."""
+    from hetu_tpu.launch import simulate_workers
+
+    gang_dir = str(tmp_path / "gang")
+    os.makedirs(gang_dir)
+    script = textwrap.dedent("""
+        import os, time
+        import numpy as np
+        from hetu_tpu.exec.partial import (GradientBoard,
+                                           PartialReduceConfig,
+                                           PartialReducer)
+
+        rank = int(os.environ["HETU_TPU_PROC_ID"])
+        gd = os.environ["HETU_TPU_GANG_DIR"]
+        cfg = PartialReduceConfig.from_env(tau=4, min_arrivals=1)
+        assert cfg is not None, "deadline env plumbing broken"
+        board = GradientBoard(gd)
+        red = PartialReducer(cfg)
+        # ready barrier: startup skew must not eat the straggler's sleep
+        open(os.path.join(gd, f"ready_{rank}"), "w").close()
+        while not all(os.path.exists(os.path.join(gd, f"ready_{r}"))
+                      for r in (0, 1)):
+            time.sleep(0.01)
+        grad = {"p.w": np.full(2, float(rank + 1), np.float32)}
+        if rank == 1:
+            time.sleep(6.0)  # the deadline miss
+        board.post(1, rank, 8.0, grad)
+        got, missing, deg = board.collect(1, [0, 1],
+                                          deadline_s=cfg.deadline,
+                                          min_arrivals=cfg.min_arrivals)
+        c1, i1 = red.reduce(1, got, degraded=deg)
+        print(f"STEP1 rank={rank} arrivals={i1['arrivals']} "
+              f"v={c1['p.w'][0]:.4f}", flush=True)
+        for w in missing:  # pick up the straggler's late post
+            while True:
+                hit = board.take(1, w)
+                if hit is not None:
+                    red.stage_late(w, 1, 2, hit[0], hit[1])
+                    break
+                time.sleep(0.05)
+        board.post(2, rank, 8.0, grad)
+        got2, _miss2, deg2 = board.collect(2, [0, 1], deadline_s=30.0,
+                                           min_arrivals=2)
+        c2, i2 = red.reduce(2, got2, degraded=deg2)
+        print(f"STEP2 rank={rank} folds={i2['late_folds']} "
+              f"v={c2['p.w'][0]:.4f}", flush=True)
+    """)
+    outs = simulate_workers(2, script, timeout=120.0, gang_dir=gang_dir,
+                            partial_deadline=1.0)
+    # worker 0: partial cut at step 1 (only itself), late fold at step 2
+    assert "STEP1 rank=0 arrivals=1 v=1.0000" in outs[0], outs[0]
+    assert "STEP2 rank=0 folds=1 v=1.6667" in outs[0], outs[0]
+    # the straggler saw both posts by the time it collected
+    assert "STEP1 rank=1 arrivals=2 v=1.5000" in outs[1], outs[1]
+    assert "STEP2 rank=1 folds=0 v=1.5000" in outs[1], outs[1]
+
+
+@pytest.mark.slow
+def test_multiprocess_straggler_gang_agrees_bitwise(tmp_path):
+    """Multi-worker chaos (slow tier): 3 real processes run 8 partial-
+    reduce steps over a GradientBoard with rank 0 as the cut decider.
+    Worker 2 sleeps through step 3's deadline (the straggler); the
+    committed cut record makes every rank — including the straggler —
+    apply the identical sequence of partial updates and late folds, so
+    all three finish with bitwise-identical reduced parameters."""
+    from hetu_tpu.launch import simulate_workers
+
+    gang_dir = str(tmp_path / "gang")
+    os.makedirs(gang_dir)
+    script = textwrap.dedent("""
+        import os, time, zlib
+        import numpy as np
+        from hetu_tpu.exec.partial import (GradientBoard,
+                                           PartialReduceConfig,
+                                           PartialReducer)
+
+        rank = int(os.environ["HETU_TPU_PROC_ID"])
+        world = 3
+        gd = os.environ["HETU_TPU_GANG_DIR"]
+        cfg = PartialReduceConfig.from_env(tau=4, min_arrivals=1)
+        board = GradientBoard(gd)
+        red = PartialReducer(cfg)
+        open(os.path.join(gd, f"ready_{rank}"), "w").close()
+        while not all(os.path.exists(os.path.join(gd, f"ready_{r}"))
+                      for r in range(world)):
+            time.sleep(0.01)
+        # a toy "model": params descend along the reduced gradient
+        params = np.zeros(4, np.float64)
+        outstanding = []  # (worker, origin) cut out at their origin step
+        missed = []
+        for s in range(1, 9):
+            if rank == 2 and s == 3:
+                time.sleep(5.0)  # the straggler
+            # deterministic per-(rank, step) gradient
+            g = {"p": np.full(4, float((rank + 1) * s), np.float64)}
+            board.post(s, rank, 8.0, g)
+            if rank == 0:
+                got, _missing, deg = board.collect(
+                    s, range(world), deadline_s=cfg.deadline,
+                    min_arrivals=cfg.min_arrivals)
+                board.post_cut(s, sorted(got), degraded=deg)
+            rec = board.read_cut(s)
+            cut = rec["contributors"]
+            if rank not in cut:
+                missed.append(s)
+            # stage every returned straggler's outstanding gradient with
+            # the deterministic arrival rule (origin + 1)
+            for w, origin in list(outstanding):
+                if w in cut:
+                    while (hit := board.take(origin, w)) is None:
+                        time.sleep(0.02)
+                    red.stage_late(w, origin, origin + 1, hit[0], hit[1])
+                    outstanding.remove((w, origin))
+            outstanding.extend((w, s) for w in range(world)
+                               if w not in cut)
+            contributions = {}
+            for w in cut:
+                while (hit := board.take(s, w)) is None:
+                    time.sleep(0.02)
+                contributions[w] = hit
+            combined, info = red.reduce(s, contributions,
+                                        degraded=rec["degraded"])
+            params = params - 0.01 * combined["p"]
+        print(f"FINAL rank={rank} missed={missed} "
+              f"crc={zlib.crc32(params.tobytes()):08x}", flush=True)
+    """)
+    outs = simulate_workers(3, script, timeout=240.0, gang_dir=gang_dir,
+                            partial_deadline=1.0)
+    crcs, misses = set(), {}
+    for r, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FINAL")][0]
+        crcs.add(line.split("crc=")[1])
+        misses[r] = line.split("missed=")[1].split(" crc=")[0]
+    assert len(crcs) == 1, outs          # every rank applied the same
+    assert "3" in misses[2], outs[2]     # the straggler really missed
