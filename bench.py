@@ -587,6 +587,113 @@ def bench_bert_headline(on_tpu, kind, peak):
                      metric="bert_large_pretrain_mfu")
 
 
+# ---------------------------------------------------------------------------
+# serve mode: seeded loadgen through the ServingEngine (paged vs gather)
+# ---------------------------------------------------------------------------
+
+def _hist_quantile(cum_before, cum_after, q: float):
+    """Quantile from the delta of two cumulative-bucket snapshots
+    (obs Histogram.cumulative(): [(le, cum_count)]).  Prometheus-style
+    linear interpolation inside the winning bucket; the +Inf bucket
+    reports its lower edge.  None when the delta is empty."""
+    delta = [(le, a - b) for (le, a), (_, b) in zip(cum_after, cum_before)]
+    total = delta[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_c = 0.0, 0
+    for le, c in delta:
+        if c >= rank:
+            if le == float("inf"):
+                return prev_le
+            if c == prev_c:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_c) / (c - prev_c)
+        prev_le, prev_c = (le if le != float("inf") else prev_le), c
+    return delta[-1][0]
+
+
+def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
+               buckets):
+    """Drive one seeded trace through a fresh engine on the real clock;
+    returns (decode tokens/s, ttft p50, ttft p99, completed)."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT
+    from hetu_tpu.obs import registry as _obs
+    from hetu_tpu.serve import ServingEngine
+
+    set_random_seed(0)
+    model = GPT(cfg)
+    eng = ServingEngine(model, num_slots=num_slots, page_size=page_size,
+                        max_seq_len=max_seq_len, prompt_buckets=buckets,
+                        queue_depth=len(trace) + 1, sampling="top_k",
+                        top_k=5, seed=11, paged_decode=paged)
+    # warmup: compile the decode program AND every prefill bucket's
+    # program outside the measured window (a serving fleet is warm; TTFT
+    # here is SLO, not compile time — a single warmup request would leave
+    # the other buckets' jit compiles inside the measured histograms)
+    for bucket in buckets:
+        eng.submit(list(range(1, bucket + 1)), 2)
+        eng.run_until_idle()
+    hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
+    cum0 = hist.cumulative()
+    handles = [eng.submit(list(it.prompt), it.max_new_tokens)
+               for it in trace]
+    t0 = time.perf_counter()
+    eng.run_until_idle(max_steps=10**7)
+    dt = time.perf_counter() - t0
+    cum1 = hist.cumulative()
+    done = [h for h in handles if h.status == "completed"]
+    # the first token of each request is prefill; the rest are decode
+    decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+    return (decode_tokens / dt if dt > 0 else 0.0,
+            _hist_quantile(cum0, cum1, 0.50),
+            _hist_quantile(cum0, cum1, 0.99), len(done))
+
+
+def bench_serve(on_tpu, kind, peak):
+    """``--mode serve``: seeded open-loop load through the ServingEngine,
+    one JSON line with decode tokens/s and TTFT p50/p99 from the serving
+    SLO histograms — paged decode measured against the gather baseline on
+    the same trace (the ROADMAP perf note's re-measure harness).  Runs
+    behind the same fast-fail device preflight as the training configs
+    (rc=3, no stdout metric on a dead tunnel)."""
+    from hetu_tpu.models import GPTConfig
+    from hetu_tpu.serve import generate_load
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        kw = dict(num_slots=8, page_size=64, max_seq_len=2048,
+                  buckets=(128, 256, 512, 1024))
+        trace = generate_load(17, 24, vocab=cfg.vocab_size,
+                              prompt_len=(64, 1024), max_new=(32, 64),
+                              mean_gap_s=0.0)
+    else:  # CI smoke: tiny shapes, still the full two-path measurement
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        kw = dict(num_slots=4, page_size=8, max_seq_len=64,
+                  buckets=(8, 16))
+        trace = generate_load(17, 8, vocab=cfg.vocab_size,
+                              prompt_len=(2, 12), max_new=(2, 6),
+                              mean_gap_s=0.0)
+    paged_tps, p50, p99, done = _serve_run(cfg, trace, paged=True, **kw)
+    gather_tps, g50, g99, gdone = _serve_run(cfg, trace, paged=False, **kw)
+    return _line(
+        "serve_decode_tokens_per_sec", paged_tps, "tokens/s",
+        paged_tps / gather_tps if gather_tps > 0 else 1.0,
+        ttft_p50_s=None if p50 is None else round(p50, 6),
+        ttft_p99_s=None if p99 is None else round(p99, 6),
+        gather_tokens_per_sec=round(gather_tps, 2),
+        gather_ttft_p50_s=None if g50 is None else round(g50, 6),
+        gather_ttft_p99_s=None if g99 is None else round(g99, 6),
+        requests=len(trace), completed=done, gather_completed=gdone,
+        slots=kw["num_slots"], max_seq_len=kw["max_seq_len"],
+        baseline_note="vs_baseline = paged/gather decode tokens/s on the "
+                      "same seeded trace (acceptance bar 1.2x on-chip)",
+        device=kind, timing="wall-trace", spread=None)
+
+
 CONFIGS = [
     ("resnet", bench_resnet),
     ("ctr", bench_ctr),
@@ -667,13 +774,35 @@ def _require_backend_alive(timeout_s: float = 240.0, probe=None,
 
 
 def main():
+    args = sys.argv[1:]
+    mode = "train"
+    if "--mode" in args:
+        i = args.index("--mode")
+        if i + 1 >= len(args):
+            sys.exit("bench: --mode needs a value (train | serve)")
+        mode = args[i + 1]
+        del args[i:i + 2]
+    if mode not in ("train", "serve"):
+        sys.exit(f"bench: unknown mode {mode!r}; one of 'train', 'serve'")
+    if mode == "serve":
+        if args:
+            sys.exit(f"bench: --mode serve takes no config names, "
+                     f"got {args}")
+        _require_backend_alive()
+        on_tpu, kind, peak = _env()
+        try:
+            bench_serve(on_tpu, kind, peak)
+        except Exception:
+            traceback.print_exc()
+            sys.exit(1)
+        return
     names = {name for name, _ in CONFIGS}
-    unknown = set(sys.argv[1:]) - names
+    unknown = set(args) - names
     if unknown:  # usage errors need no backend: fail instantly
         sys.exit(f"bench: unknown config(s) {sorted(unknown)}; "
                  f"choose from {sorted(names)}")
     _require_backend_alive()
-    only = set(sys.argv[1:]) or names
+    only = set(args) or names
     on_tpu, kind, peak = _env()
     done = set()
     for name, fn in CONFIGS:

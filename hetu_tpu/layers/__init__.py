@@ -11,6 +11,7 @@ from hetu_tpu.layers.norm import (
 )
 from hetu_tpu.layers.attention import (
     MultiHeadAttention,
+    PagedDecode,
     decode_attention,
     dot_product_attention,
     ragged_cache_update,

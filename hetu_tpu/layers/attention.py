@@ -11,7 +11,7 @@ materialized path.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,9 +21,22 @@ from hetu_tpu.core.rng import next_key
 from hetu_tpu.init import xavier_uniform, zeros
 from hetu_tpu.ops import dropout as dropout_op
 
-__all__ = ["MultiHeadAttention", "dot_product_attention",
+__all__ = ["MultiHeadAttention", "PagedDecode", "dot_product_attention",
            "dot_product_attention_bhsd", "decode_attention",
            "ragged_cache_update"]
+
+
+class PagedDecode(NamedTuple):
+    """Routing record for the paged decode path: with this passed,
+    ``decode_attention``'s ``k_cache``/``v_cache`` are the PAGED pools
+    (``(pages, page_size, H, D)``, or the stacked ``(layers, ...)`` form
+    with ``layer`` set) and attention runs the Pallas paged-decode kernel
+    (ops/pallas/paged_decode.py) — K/V pages are read in place, no
+    contiguous per-sequence view is ever materialized."""
+
+    tables: object                   # (batch, pages_per_seq) int32
+    layer: Optional[int] = None      # static layer into a stacked pool
+    interpret: Optional[bool] = None
 
 
 def _dpa_core(q, k, v, mask, scale, causal, qk_spec: str, pv_spec: str):
@@ -83,7 +96,8 @@ def ragged_cache_update(cache, new, index):
 
 
 def decode_attention(q, k_cache, v_cache, cache_index, *,
-                     scale: float | None = None, mask=None):
+                     scale: float | None = None, mask=None,
+                     paged: PagedDecode | None = None):
     """Causal attention of ``s`` new query positions against a padded KV
     cache holding each sequence's full history at a per-row offset.
 
@@ -96,7 +110,25 @@ def decode_attention(q, k_cache, v_cache, cache_index, *,
     core: with ``cache_index = 0`` and ``s = seq_len`` it is exactly
     ``dot_product_attention(..., causal=True)`` restricted to the valid
     prefix — the prefill-vs-incremental parity guarantee the serving
-    tests assert."""
+    tests assert.
+
+    With ``paged`` (a :class:`PagedDecode`), the caches are instead the
+    PAGED pools and ``s`` must be 1: the Pallas paged-decode kernel reads
+    each row's K/V pages in place via ``paged.tables``, the masking
+    contract unchanged (rows ``[0, cache_index + 1)`` valid)."""
+    if paged is not None:
+        from hetu_tpu.ops.pallas.paged_decode import paged_decode_attention
+        if q.shape[1] != 1:
+            raise ValueError(f"paged decode attends one new token per "
+                             f"sequence, got s={q.shape[1]}")
+        if mask is not None:
+            raise ValueError("paged decode does not take an extra mask; "
+                             "validity comes from cache_index")
+        out = paged_decode_attention(
+            q[:, 0], k_cache, v_cache, paged.tables,
+            cache_index + 1, layer=paged.layer, scale=scale,
+            interpret=paged.interpret)
+        return out[:, None]
     s = q.shape[1]
     max_len = k_cache.shape[1]
     jpos = jnp.arange(max_len)[None, None, :]                  # (1, 1, L)
@@ -130,8 +162,14 @@ class MultiHeadAttention(Module):
         self.attn_fn = attn_fn  # static; None -> dot_product_attention
 
     def __call__(self, x, mask=None, *, key=None, training: bool = False,
-                 kv_cache=None, cache_index=None):
+                 kv_cache=None, cache_index=None, paged=None):
         if kv_cache is not None:
+            if paged is not None:
+                if mask is not None:
+                    raise ValueError(
+                        "paged decode does not take an extra mask; "
+                        "validity comes from cache_index")
+                return self._call_paged(x, kv_cache, cache_index, paged)
             return self._call_cached(x, mask, kv_cache, cache_index)
         if getattr(self.attn_fn, "bhsd", False):
             return self._call_bhsd(x, mask, key=key, training=training)
@@ -177,6 +215,45 @@ class MultiHeadAttention(Module):
         if self.bo is not None:
             y = y + self.bo.astype(x.dtype)
         return y, (k_cache, v_cache)
+
+    def _call_paged(self, x, kv_cache, cache_index, paged: PagedDecode):
+        """Paged-decode step: project the ONE new token per row, scatter
+        its K/V into the pool at each row's (physical page, slot), and
+        attend in place over the page tables via the Pallas paged kernel
+        — no contiguous per-sequence K/V view is ever materialized.
+        ``kv_cache`` = (k_pool, v_pool), per layer or stacked with
+        ``paged.layer``; ``cache_index`` = per-row history lengths (the
+        fed token's K/V lands at that index).  Returns ``(y, (k_pool,
+        v_pool))`` with the pools updated — one small scatter each."""
+        b, s, d = x.shape
+        if s != 1:
+            raise ValueError(f"paged decode takes one new token per row, "
+                             f"got s={s}")
+        qkv = x @ self.wqkv.astype(x.dtype)
+        if self.bqkv is not None:
+            qkv = qkv + self.bqkv.astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, self.num_heads, self.head_dim)
+        v = v.reshape(b, self.num_heads, self.head_dim)
+        k_pool, v_pool = kv_cache
+        page = k_pool.shape[-3]
+        page_of = jnp.take_along_axis(
+            paged.tables, (cache_index // page)[:, None], axis=1)[:, 0]
+        slot = cache_index % page
+        if k_pool.ndim == 5:
+            k_pool = k_pool.at[paged.layer, page_of, slot].set(
+                k.astype(k_pool.dtype))
+            v_pool = v_pool.at[paged.layer, page_of, slot].set(
+                v.astype(v_pool.dtype))
+        else:
+            k_pool = k_pool.at[page_of, slot].set(k.astype(k_pool.dtype))
+            v_pool = v_pool.at[page_of, slot].set(v.astype(v_pool.dtype))
+        out = decode_attention(q, k_pool, v_pool, cache_index, paged=paged)
+        y = out.reshape(b, s, d) @ self.wo.astype(x.dtype)
+        if self.bo is not None:
+            y = y + self.bo.astype(x.dtype)
+        return y, (k_pool, v_pool)
 
     def _call_bhsd(self, x, mask=None, *, key=None, training: bool = False):
         """Native-kernel-layout path: q/k/v are PROJECTED into (B, H, S, D)

@@ -94,9 +94,10 @@ class TransformerBlock(Module):
         return out if isinstance(out, tuple) else (out, None)
 
     def __call__(self, x, mask=None, *, key=None, training: bool = False,
-                 kv_cache=None, cache_index=None):
+                 kv_cache=None, cache_index=None, paged=None):
         if kv_cache is not None:
-            return self._call_cached(x, mask, kv_cache, cache_index)
+            return self._call_cached(x, mask, kv_cache, cache_index,
+                                     paged=paged)
         ka = k1 = k2 = None
         if key is not None:
             ka, k1, k2 = jax.random.split(key, 3)
@@ -123,20 +124,22 @@ class TransformerBlock(Module):
             x = x + self._drop(y, k2, training)
         return x if aux is None else (x, aux)
 
-    def _call_cached(self, x, mask, kv_cache, cache_index):
+    def _call_cached(self, x, mask, kv_cache, cache_index, paged=None):
         """Incremental-decode step: same residual wiring as the training
         paths, attention routed through the KV cache (inference-only — no
         dropout, no fused post-LN kernel, no MoE aux loss).  Returns
-        ``(x, (k_cache, v_cache))`` with this block's caches updated."""
+        ``(x, (k_cache, v_cache))`` with this block's caches updated.
+        With ``paged`` (layers.attention.PagedDecode), the caches are the
+        paged pools and attention runs the in-place Pallas kernel."""
         if self.post_ln:
             a, kv = self.attn(x, mask, kv_cache=kv_cache,
-                              cache_index=cache_index)
+                              cache_index=cache_index, paged=paged)
             x = self.ln1(x + a)
             y, aux = self._ffn(x, training=False)
             x = self.ln2(x + y)
         else:
             a, kv = self.attn(self.ln1(x), mask, kv_cache=kv_cache,
-                              cache_index=cache_index)
+                              cache_index=cache_index, paged=paged)
             x = x + a
             y, aux = self._ffn(self.ln2(x), training=False)
             x = x + y

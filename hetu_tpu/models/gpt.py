@@ -88,7 +88,7 @@ class GPT(Module):
 
     def __call__(self, input_ids, *, key=None, training: bool = False,
                  compute_dtype=None, kv_cache=None, cache_index=None,
-                 seq_lengths=None):
+                 seq_lengths=None, paged_tables=None):
         """Logits.  Training/eval (``kv_cache=None``): full (batch, seq,
         vocab) logits, as before.
 
@@ -101,14 +101,20 @@ class GPT(Module):
         each row's LAST VALID new position (``seq_lengths``, default s —
         pass true prompt lengths when the prefill batch is right-padded
         to a bucket), so the (s, vocab) logits matrix is never
-        materialized during serving."""
+        materialized during serving.
+
+        Paged decode (``paged_tables`` set, s == 1): ``kv_cache`` is ONE
+        ``(k_pool, v_pool)`` pair of stacked ``(layers, pages, page_size,
+        H, D)`` pool arrays and attention runs the in-place Pallas
+        paged-decode kernel — no contiguous K/V view is ever built."""
         if kv_cache is None:
             x = self.hidden_states(input_ids, key=key, training=training,
                                    compute_dtype=compute_dtype)
             return x @ self._head().astype(x.dtype)
         x, new_kv = self.hidden_states(
             input_ids, training=False, compute_dtype=compute_dtype,
-            kv_cache=kv_cache, cache_index=cache_index)
+            kv_cache=kv_cache, cache_index=cache_index,
+            paged_tables=paged_tables)
         if seq_lengths is None:
             last = x[:, -1]
         else:
@@ -117,13 +123,27 @@ class GPT(Module):
         return last @ self._head().astype(last.dtype), new_kv
 
     def hidden_states(self, input_ids, *, key=None, training: bool = False,
-                      compute_dtype=None, kv_cache=None, cache_index=None):
+                      compute_dtype=None, kv_cache=None, cache_index=None,
+                      paged_tables=None):
         """Final-layer-norm hidden states (no LM-head projection).  With
         ``kv_cache``/``cache_index``, runs the incremental-decode path and
         returns ``(hidden, new_kv_cache)``; positions are each row's
         ``cache_index + arange(s)`` so ragged batches place the new
-        tokens' position embeddings correctly."""
+        tokens' position embeddings correctly.  With ``paged_tables``,
+        ``kv_cache`` is the stacked pool pair and each block attends in
+        place at its own layer index (see ``__call__``)."""
         s = input_ids.shape[-1]
+        if kv_cache is not None and paged_tables is not None:
+            from hetu_tpu.layers.attention import PagedDecode
+            positions = cache_index[:, None] + jnp.arange(s)[None, :]
+            x = self.wte(input_ids) + self.wpe(positions)
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+            k, v = kv_cache
+            for li, blk in enumerate(self.blocks):
+                x, (k, v) = blk(x, kv_cache=(k, v), cache_index=cache_index,
+                                paged=PagedDecode(paged_tables, layer=li))
+            return self.ln_f(x), (k, v)
         if kv_cache is not None:
             positions = cache_index[:, None] + jnp.arange(s)[None, :]
             x = self.wte(input_ids) + self.wpe(positions)

@@ -1,17 +1,40 @@
-"""Persistent block-size autotuner for the Pallas flash kernel.
+"""Persistent kernel-autotune DATABASE for the Pallas kernels.
 
 ``_auto_blocks`` (flash.py) is a HEURISTIC table swept by hand on a v5e at
 head_dim 64 (plus two d=128 points) — every other (seq, head_dim, device)
-combination runs on extrapolation.  This module makes the sweep a
-framework feature instead of a round-artifact: ``autotune_flash_blocks``
-measures the candidate grid fwd+bwd on the live device with a
-differenced-scan timer (the tunnel's fixed ~110 ms dispatch cost cancels
-in the difference) and persists the winner to a JSON cache keyed by
-(device kind, Sq, Sk, head_dim, causal).  ``_block_sizes`` consults the
-cache at trace time, so every later jit of the same shape on the same
-device kind picks up the measured blocks with no code change.
+combination runs on extrapolation, and the fused-LN / LM-head / paged-decode
+kernels each carried their own frozen block constants.  This module makes
+the sweep a framework feature instead of a round-artifact: one on-disk JSON
+database keyed by ``(kernel, device_kind, shape-sig)`` holds the measured
+winners for every tunable kernel, and each kernel's block-selection helper
+consults it at trace time (shapes are static under jit, so a lookup is a
+plain dict hit).  Saves are **merge-on-save under an exclusive lock** —
+the writer re-reads the disk copy, folds its new entries in, and publishes
+through ``exec/checkpoint._atomic_write_bytes`` — so a fleet of gang
+workers tuning concurrently can never torn-write or clobber each other's
+entries (the previous bare ``read_text``/``write_text`` read-modify-write
+lost the race loser's whole merge).
 
-Reference parity note: the reference has no flash kernel and no tuner;
+Covered kernels and their signatures:
+
+=============  =======================  =============================
+kernel         shape-sig                entry fields
+=============  =======================  =============================
+flash          ``{Sq}x{Sk}|d{D}|c{0/1}``  block_q, block_k
+fused_ln       ``T{T}|D{D}|s{streams}``   block_rows
+lm_head        ``N{N}|E{E}|V{V}``         block_n, block_v
+paged_decode   ``h{H}|d{D}|p{page}``      head_block
+=============  =======================  =============================
+
+Every lookup and save is counted in the ``hetu_tune_*`` obs family
+(hits/misses/retunes, labeled by kernel), so a fleet cold-start that is
+silently re-tuning shows up in /metrics instead of as mystery latency.
+
+Measurement uses the differenced-scan timer (time a scan of n1 and n2
+chained iterations and divide the delta — the tunnel's fixed ~110 ms
+dispatch cost cancels in the difference); see ``autotune_flash_blocks``.
+
+Reference parity note: the reference has no Pallas kernels and no tuner;
 the closest machinery is HetuSimulator's persistent op-time cache
 (reference python/hetu/profiler.py:609-877), whose cache-keyed-by-device
 design this follows (as does parallel/autoparallel/profiler.py).
@@ -22,6 +45,11 @@ trace time):
     from hetu_tpu.ops.pallas import autotune_flash_blocks
     autotune_flash_blocks(512, 512, 128, causal=True)   # once per shape
     # ... flash_attention / flash_attn_fn now use the measured blocks
+
+The DB location is ``HETU_TPU_TUNE_CACHE`` (default
+``~/.cache/hetu_tpu_tune_db.json``); the pre-unification name
+``HETU_TPU_FLASH_TUNE_CACHE`` is still honored with a DeprecationWarning,
+and legacy flash-only cache files are migrated key-by-key on load.
 """
 
 from __future__ import annotations
@@ -30,44 +58,193 @@ import json
 import os
 import pathlib
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["autotune_flash_blocks", "tuned_blocks", "clear_tune_cache"]
+__all__ = ["autotune_flash_blocks", "autotune_lm_head_blocks",
+           "autotune_paged_decode", "autotune_fused_ln_rows",
+           "tuned_blocks", "tuned_entry", "record_entry",
+           "clear_tune_cache"]
 
-_CACHE_ENV = "HETU_TPU_FLASH_TUNE_CACHE"
-_DEFAULT_CACHE = pathlib.Path.home() / ".cache" / "hetu_tpu_flash_blocks.json"
+_CACHE_ENV = "HETU_TPU_TUNE_CACHE"
+_LEGACY_CACHE_ENV = "HETU_TPU_FLASH_TUNE_CACHE"
+_DEFAULT_CACHE = pathlib.Path.home() / ".cache" / "hetu_tpu_tune_db.json"
+_LEGACY_DEFAULT = pathlib.Path.home() / ".cache" / "hetu_tpu_flash_blocks.json"
+_KERNELS = ("flash", "fused_ln", "lm_head", "paged_decode")
 _mem_cache: dict | None = None
+# entries recorded with save=False: an overlay re-applied after every
+# disk reload, so an ephemeral tune survives a later saving tune's cache
+# invalidation for the life of the process
+_unsaved: dict = {}
+_tune_metrics = None
+
+
+def _tune_m():
+    """Lazily-registered ``hetu_tune_*`` counter family (kernel-labeled):
+    cache hits/misses at trace-time lookups and retunes (an existing entry
+    re-measured and overwritten).  All no-ops when obs is disabled."""
+    global _tune_metrics
+    if _tune_metrics is None:
+        from hetu_tpu.obs import registry as _obs
+        reg = _obs.get_registry()
+        _tune_metrics = {
+            "hits": reg.counter(
+                "hetu_tune_hits_total",
+                "autotune DB lookups served from a measured entry",
+                ("kernel",)),
+            "misses": reg.counter(
+                "hetu_tune_misses_total",
+                "autotune DB lookups that fell through to the heuristic "
+                "(cold-start retuning territory)", ("kernel",)),
+            "retunes": reg.counter(
+                "hetu_tune_retunes_total",
+                "saves that overwrote an existing measured entry",
+                ("kernel",)),
+        }
+    return _tune_metrics
 
 
 def _cache_path() -> pathlib.Path:
-    return pathlib.Path(os.environ.get(_CACHE_ENV, _DEFAULT_CACHE))
+    new = os.environ.get(_CACHE_ENV)
+    if new is not None:
+        return pathlib.Path(new)
+    legacy = os.environ.get(_LEGACY_CACHE_ENV)
+    if legacy is not None:
+        warnings.warn(
+            f"{_LEGACY_CACHE_ENV} is deprecated now that the autotune "
+            f"cache is a shared multi-kernel database; set {_CACHE_ENV} "
+            f"instead (the old variable keeps working for now)",
+            DeprecationWarning, stacklevel=3)
+        return pathlib.Path(legacy)
+    if not _DEFAULT_CACHE.exists() and _LEGACY_DEFAULT.exists():
+        # pre-unification default file: adopt it in place (its flash-only
+        # keys are migrated on load); the first locked save republishes
+        # everything at the same path it was found
+        return _LEGACY_DEFAULT
+    return _DEFAULT_CACHE
 
 
 def _device_kind() -> str:
     return str(getattr(jax.devices()[0], "device_kind", "cpu"))
 
 
+def _full_key(kernel: str, sig: str, kind: str | None = None) -> str:
+    return f"{kernel}|{kind or _device_kind()}|{sig}"
+
+
 def _key(Sq: int, Sk: int, D: int, causal: bool, kind: str | None) -> str:
-    return f"{kind or _device_kind()}|{Sq}x{Sk}|d{D}|c{int(bool(causal))}"
+    """Flash entry key (kept for the flash tuner and its tests)."""
+    return _full_key("flash", f"{Sq}x{Sk}|d{D}|c{int(bool(causal))}", kind)
+
+
+def _migrate(raw: dict) -> dict:
+    """Rewrite legacy flash-only keys (``{kind}|{Sq}x{Sk}|d{D}|c{0/1}``)
+    into the unified ``{kernel}|{kind}|{sig}`` namespace."""
+    out = {}
+    for k, v in raw.items():
+        if k.split("|", 1)[0] not in _KERNELS:
+            k = f"flash|{k}"
+        out[k] = v
+    return out
 
 
 def _load() -> dict:
     global _mem_cache
     if _mem_cache is None:
         try:
-            _mem_cache = json.loads(_cache_path().read_text())
+            _mem_cache = _migrate(json.loads(_cache_path().read_text()))
         except (OSError, ValueError):
             _mem_cache = {}
+        _mem_cache.update(_unsaved)
     return _mem_cache
 
 
 def clear_tune_cache() -> None:
-    """Drop the in-memory cache (tests; a changed cache file re-loads)."""
+    """Drop the whole in-memory cache, unsaved entries included (tests;
+    a changed cache file re-loads)."""
     global _mem_cache
     _mem_cache = None
+    _unsaved.clear()
+
+
+def _invalidate_memo() -> None:
+    """Force the next _load() to re-read disk, KEEPING the save=False
+    overlay (the saving path's invalidation must not evict ephemeral
+    tunes)."""
+    global _mem_cache
+    _mem_cache = None
+
+
+def _locked_merge_save(updates: dict) -> None:
+    """Publish ``updates`` into the on-disk DB: take an exclusive lock on
+    a sibling ``.lock`` file, re-read the disk copy (another process — or
+    an earlier tune in this one — may have written entries since our
+    ``_load`` memoized), fold the updates in, and atomically replace via
+    the checkpoint writer's tmp-write+fsync+rename.  Concurrent tuners
+    serialize on the lock, so no merge is ever lost and no reader ever
+    sees a torn file."""
+    from hetu_tpu.exec.checkpoint import _atomic_write_bytes
+    path = _cache_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    lock = path.with_name(path.name + ".lock")
+    lf = open(lock, "a+b")
+    try:
+        try:
+            import fcntl
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            locked = True
+        except ImportError:  # non-POSIX: no advisory lock exists
+            locked = False
+        try:
+            cache = _migrate(json.loads(path.read_text()))
+        except (OSError, ValueError):
+            cache = {}
+        cache.update(updates)
+        payload = json.dumps(cache, indent=1, sort_keys=True).encode()
+        if locked:
+            _atomic_write_bytes(str(path), payload)
+        else:
+            # unlocked writers may interleave their read-modify-writes
+            # (last merge wins), but a per-PID tmp keeps every published
+            # file untorn — a SHARED tmp name would let two writers
+            # truncate each other mid-write and publish garbage
+            tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+            tmp.write_bytes(payload)
+            tmp.replace(path)
+    finally:
+        lf.close()
+    _invalidate_memo()
+
+
+def tuned_entry(kernel: str, sig: str, *, kind: str | None = None,
+                count: bool = True) -> dict | None:
+    """The measured entry for ``(kernel, device kind, sig)``, or None.
+    Consulted by each kernel's block-selection helper at trace time."""
+    hit = _load().get(_full_key(kernel, sig, kind))
+    if count:
+        m = _tune_m()
+        (m["hits"] if hit else m["misses"]).labels(kernel=kernel).inc()
+    return hit
+
+
+def record_entry(kernel: str, sig: str, entry: dict, *,
+                 kind: str | None = None, save: bool = True) -> None:
+    """Adopt a measured ``entry`` for ``(kernel, device kind, sig)`` —
+    into the in-memory cache immediately and (``save=True``) into the
+    on-disk DB under the exclusive-lock merge."""
+    full = _full_key(kernel, sig, kind)
+    if _load().get(full) is not None:
+        _tune_m()["retunes"].labels(kernel=kernel).inc()
+    if save:
+        # a newer saved entry supersedes any ephemeral one for this key
+        _unsaved.pop(full, None)
+        _locked_merge_save({full: entry})
+    else:
+        _unsaved[full] = entry
+    _load()[full] = entry
 
 
 def tuned_blocks(Sq: int, Sk: int, D: int,
@@ -85,8 +262,10 @@ def tuned_blocks(Sq: int, Sk: int, D: int,
     disk and drops the memo), a later exact-mask ``autotune_flash_blocks``
     supersedes it."""
     cache = _load()
+    m = _tune_m()
     hit = cache.get(_key(Sq, Sk, D, causal, None))
     if hit:
+        m["hits"].labels(kernel="flash").inc()
         return int(hit["block_q"]), int(hit["block_k"])
     comp = cache.get(_key(Sq, Sk, D, not causal, None))
     if comp:
@@ -94,9 +273,81 @@ def tuned_blocks(Sq: int, Sk: int, D: int,
             "block_q": int(comp["block_q"]),
             "block_k": int(comp["block_k"]),
             "complement_fallback": True}
+        m["hits"].labels(kernel="flash").inc()
         return int(comp["block_q"]), int(comp["block_k"])
+    m["misses"].labels(kernel="flash").inc()
     return None
 
+
+# ---------------------------------------------------------------------------
+# measurement machinery
+# ---------------------------------------------------------------------------
+
+def _diff_time(step_fn, carry, n1: int, n2: int) -> float:
+    """Per-iteration seconds of ``carry = step_fn(carry)`` via a
+    differenced scan: time a jitted scan of n1 and n2 chained iterations
+    and divide the delta — the fixed dispatch cost cancels.  The carry
+    must keep every output of interest live so XLA cannot dead-code-
+    eliminate the measured work."""
+    def chain(n):
+        def body(c, _):
+            return step_fn(c), ()
+        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=n)[0])
+
+    run1, run2 = chain(n1), chain(n2)
+
+    def t(run):
+        t0 = time.perf_counter()
+        out = run(carry)
+        # sync on the first leaf (block_until_ready is a tunnel no-op)
+        float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).sum())
+        return time.perf_counter() - t0
+
+    t(run1), t(run2)  # compile both
+    t(run1), t(run2)  # throwaway pair (first post-compile run skews)
+    d = [(t(run2) - t(run1)) / (n2 - n1) for _ in range(3)]
+    med = float(np.median(d))
+    if med <= 0:
+        # a latency spike on the short-chain side can make the difference
+        # negative; persisting that would let a garbage candidate win the
+        # grid and poison every later trace of this shape
+        raise RuntimeError(f"nonpositive differenced timing {d} (noise)")
+    return med
+
+
+def _sweep(candidates, measure, *, budget_s: float | None,
+           verbose: bool, tag: str) -> dict:
+    """Measure each candidate (skipping the rest once ``budget_s`` is
+    exceeded, keeping best-so-far); returns the {candidate_str: seconds |
+    'failed: ...' | 'skipped: budget'} table."""
+    table = {}
+    t_start = time.perf_counter()
+    for cand in candidates:
+        name = "x".join(str(c) for c in cand) if isinstance(
+            cand, tuple) else str(cand)
+        if (budget_s is not None and table
+                and time.perf_counter() - t_start > budget_s):
+            table[name] = "skipped: budget"
+            continue
+        try:
+            table[name] = measure(cand)
+        except Exception as e:  # candidate rejected by Mosaic/VMEM
+            table[name] = f"failed: {str(e)[:120]}"
+        if verbose:
+            print(f"autotune[{tag}]: {name} -> {table[name]}")
+    return table
+
+
+def _best(table: dict, what: str):
+    timed = {k: v for k, v in table.items() if isinstance(v, float)}
+    if not timed:
+        raise RuntimeError(f"no {what} candidate ran: {table}")
+    return min(timed, key=timed.get)
+
+
+# ---------------------------------------------------------------------------
+# flash
+# ---------------------------------------------------------------------------
 
 def _candidate_grid(Sq: int, Sk: int, D: int, interpret: bool):
     """128-aligned divisors of the (padded) sequence, VMEM-capped — the
@@ -118,11 +369,9 @@ def _candidate_grid(Sq: int, Sk: int, D: int, interpret: bool):
 
 def _time_fwd_bwd(bq: int, bk: int, q, k, v, causal: bool, interpret: bool,
                   n1: int, n2: int) -> float:
-    """Per-iteration seconds of flash fwd+bwd at (bq, bk), via a
-    differenced scan: time a scan of n1 and n2 chained iterations and
-    divide the delta — the fixed dispatch cost cancels.  ALL of dq/dk/dv
-    stay live (folded into the carry) so XLA cannot dead-code-eliminate
-    any backward matmul."""
+    """Per-iteration seconds of flash fwd+bwd at (bq, bk) via the
+    differenced scan.  ALL of dq/dk/dv stay live (folded into the carry)
+    so XLA cannot dead-code-eliminate any backward matmul."""
     from hetu_tpu.ops.pallas.flash import flash_attention_bhsd
 
     def loss(q, k, v):
@@ -132,35 +381,15 @@ def _time_fwd_bwd(bq: int, bk: int, q, k, v, causal: bool, interpret: bool,
 
     grad = jax.grad(loss, argnums=(0, 1, 2))
 
-    def chain(n):
-        def body(c, _):
-            q, k, v = c
-            dq, dk, dv = grad(q, k, v)
-            eps = jnp.asarray(1e-6, q.dtype)
-            return (q + eps * dq.astype(q.dtype),
-                    k + eps * dk.astype(k.dtype),
-                    v + eps * dv.astype(v.dtype)), ()
+    def step(c):
+        q, k, v = c
+        dq, dk, dv = grad(q, k, v)
+        eps = jnp.asarray(1e-6, q.dtype)
+        return (q + eps * dq.astype(q.dtype),
+                k + eps * dk.astype(k.dtype),
+                v + eps * dv.astype(v.dtype))
 
-        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=n)[0])
-
-    run1, run2 = chain(n1), chain(n2)
-
-    def t(run):
-        t0 = time.perf_counter()
-        out = run((q, k, v))
-        float(out[0].sum())  # sync (block_until_ready is a tunnel no-op)
-        return time.perf_counter() - t0
-
-    t(run1), t(run2)  # compile both
-    t(run1), t(run2)  # throwaway pair (first post-compile run skews)
-    d = [(t(run2) - t(run1)) / (n2 - n1) for _ in range(3)]
-    med = float(np.median(d))
-    if med <= 0:
-        # a latency spike on the short-chain side can make the difference
-        # negative; persisting that would let a garbage candidate win the
-        # grid and poison every later trace of this shape
-        raise RuntimeError(f"nonpositive differenced timing {d} (noise)")
-    return med
+    return _diff_time(step, (q, k, v), n1, n2)
 
 
 def autotune_flash_blocks(Sq: int, Sk: int, D: int, *, causal: bool = False,
@@ -196,43 +425,177 @@ def autotune_flash_blocks(Sq: int, Sk: int, D: int, *, causal: bool = False,
     k, v = (jnp.asarray(rng.standard_normal((batch, heads, Sk, D)) * 0.1,
                         dtype) for _ in range(2))
 
-    table = {}
-    t_start = time.perf_counter()
-    for bq, bk in _candidate_grid(Sq, Sk, D, interpret):
-        if (budget_s is not None and table
-                and time.perf_counter() - t_start > budget_s):
-            table[f"{bq}x{bk}"] = "skipped: budget"
-            continue
-        try:
-            table[f"{bq}x{bk}"] = _time_fwd_bwd(
-                bq, bk, q, k, v, causal, interpret, n1, n2)
-        except Exception as e:  # candidate rejected by Mosaic/VMEM
-            table[f"{bq}x{bk}"] = f"failed: {str(e)[:120]}"
-        if verbose:
-            print(f"autotune {Sq}x{Sk} d{D}: {bq}x{bk} -> "
-                  f"{table[f'{bq}x{bk}']}")
-    timed = {kk: vv for kk, vv in table.items() if isinstance(vv, float)}
-    if not timed:
-        raise RuntimeError(f"no flash block candidate ran: {table}")
-    best = min(timed, key=timed.get)
+    table = _sweep(
+        _candidate_grid(Sq, Sk, D, interpret),
+        lambda c: _time_fwd_bwd(c[0], c[1], q, k, v, causal, interpret,
+                                n1, n2),
+        budget_s=budget_s, verbose=verbose, tag=f"flash {Sq}x{Sk} d{D}")
+    best = _best(table, "flash block")
     bq, bk = (int(x) for x in best.split("x"))
     entry = {"block_q": bq, "block_k": bk, "table": table,
              "measured_at": {"batch": batch, "heads": heads,
                              "dtype": str(jnp.dtype(dtype))}}
-    if save:
-        path = _cache_path()
-        try:  # merge against DISK, not the memoized snapshot — another
-            # process (or an earlier tune in this one) may have written
-            # entries since _load() memoized
-            cache = json.loads(path.read_text())
-        except (OSError, ValueError):
-            cache = {}
-        cache[_key(Sq, Sk, D, causal, None)] = entry
-        path.parent.mkdir(parents=True, exist_ok=True)
-        # per-process tmp: a shared tmp name would let two concurrent
-        # tuners truncate each other mid-write and publish torn content
-        tmp = path.with_suffix(f".tmp.{os.getpid()}")
-        tmp.write_text(json.dumps(cache, indent=1))
-        tmp.replace(path)  # atomic per writer; last writer wins the merge
-        clear_tune_cache()
+    record_entry("flash", f"{Sq}x{Sk}|d{D}|c{int(bool(causal))}", entry,
+                 save=save)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# lm_head
+# ---------------------------------------------------------------------------
+
+def autotune_lm_head_blocks(N: int, E: int, V: int, *, dtype=jnp.bfloat16,
+                            interpret: bool | None = None,
+                            n1: int = 2, n2: int = 6, save: bool = True,
+                            budget_s: float | None = None,
+                            verbose: bool = False) -> dict:
+    """Measure (block_n, block_v) for the fused LM-head CE kernel fwd+bwd
+    at this (tokens, embed, vocab) shape and persist the winner."""
+    from hetu_tpu.ops.pallas.lm_head import lm_head_cross_entropy_pallas
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((N, E)) * 0.1, dtype)
+    w = jnp.asarray(rng.standard_normal((E, V)) * 0.1, dtype)
+    y = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+
+    if interpret:
+        cands = [(max(8, N // 2), max(128, V // 2)), (N, V)]
+    else:
+        cands = [(bn, bv) for bn in (256, 512, 1024) if N % bn == 0
+                 for bv in (512, 1024, 2048) if V % bv == 0] or [(512, 1024)]
+
+    def measure(c):
+        bn, bv = c
+
+        def loss(h, w):
+            return lm_head_cross_entropy_pallas(
+                h, w, y, block_n=bn, block_v=bv, interpret=interpret).sum()
+
+        grad = jax.grad(loss, argnums=(0, 1))
+
+        def step(carry):
+            h, w = carry
+            dh, dw = grad(h, w)
+            eps = jnp.asarray(1e-6, h.dtype)
+            return h + eps * dh.astype(h.dtype), w + eps * dw.astype(w.dtype)
+
+        return _diff_time(step, (h, w), n1, n2)
+
+    table = _sweep(cands, measure, budget_s=budget_s, verbose=verbose,
+                   tag=f"lm_head N{N} V{V}")
+    best = _best(table, "lm_head block")
+    bn, bv = (int(x) for x in best.split("x"))
+    entry = {"block_n": bn, "block_v": bv, "table": table}
+    record_entry("lm_head", f"N{N}|E{E}|V{V}", entry, save=save)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# paged_decode
+# ---------------------------------------------------------------------------
+
+def autotune_paged_decode(H: int, D: int, page_size: int, *,
+                          batch: int = 8, pages_per_seq: int = 32,
+                          dtype=jnp.bfloat16,
+                          interpret: bool | None = None,
+                          n1: int = 4, n2: int = 12, save: bool = True,
+                          budget_s: float | None = None,
+                          verbose: bool = False) -> dict:
+    """Measure the head-block size for the paged-decode attention kernel
+    (how many heads each grid step loads per page: VMEM footprint vs grid
+    parallelism) and persist the winner."""
+    from hetu_tpu.ops.pallas.paged_decode import paged_decode_attention
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    P = 1 + batch * pages_per_seq
+    q = jnp.asarray(rng.standard_normal((batch, H, D)) * 0.1, dtype)
+    k = jnp.asarray(rng.standard_normal(
+        (P, page_size, H, D)) * 0.1, dtype)
+    v = jnp.asarray(rng.standard_normal(
+        (P, page_size, H, D)) * 0.1, dtype)
+    tables = jnp.asarray(
+        1 + np.arange(batch * pages_per_seq).reshape(batch, pages_per_seq),
+        jnp.int32)
+    lengths = jnp.full((batch,), pages_per_seq * page_size, jnp.int32)
+    cands = [hb for hb in (1, 2, 4, 8, 16) if hb <= H and H % hb == 0]
+
+    def measure(hb):
+        def step(q):
+            return paged_decode_attention(
+                q, k, v, tables, lengths, head_block=hb,
+                interpret=interpret).astype(q.dtype)
+
+        return _diff_time(step, q, n1, n2)
+
+    table = _sweep(cands, measure, budget_s=budget_s, verbose=verbose,
+                   tag=f"paged_decode h{H} d{D}")
+    hb = int(_best(table, "paged_decode head-block"))
+    entry = {"head_block": hb, "table": table,
+             "measured_at": {"batch": batch, "pages_per_seq": pages_per_seq,
+                             "dtype": str(jnp.dtype(dtype))}}
+    record_entry("paged_decode", f"h{H}|d{D}|p{page_size}", entry, save=save)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# fused_ln
+# ---------------------------------------------------------------------------
+
+def autotune_fused_ln_rows(T: int, D: int, *, dtype=jnp.bfloat16,
+                           interpret: bool | None = None,
+                           n1: int = 4, n2: int = 12, save: bool = True,
+                           budget_s: float | None = None,
+                           verbose: bool = False) -> dict:
+    """Measure the rows-per-block for the fused residual+dropout+LN kernel
+    fwd+bwd at this (tokens, hidden) shape and persist the winner.  The
+    entry is recorded per backward stream count (the tighter budget), so
+    one measurement covers both directions."""
+    from hetu_tpu.ops.pallas.fused_ln import fused_residual_dropout_ln
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((T, D)) * 0.1, dtype)
+    y = jnp.asarray(rng.standard_normal((T, D)) * 0.1, dtype)
+    scale = jnp.ones((D,), jnp.float32)
+    bias = jnp.zeros((D,), jnp.float32)
+    cands = [bt for bt in (8, 16, 32, 64, 128, 256, 512)
+             if bt <= T and T % bt == 0]
+
+    def measure(bt):
+        for n in (4, 6):  # candidate-under-test visible to _pick_block:
+            # poke the memo directly — record_entry would tick the
+            # retunes counter once per candidate swap
+            _load()[_full_key("fused_ln", f"T{T}|D{D}|s{n}")] = {
+                "block_rows": int(bt)}
+
+        def loss(x, y):
+            return fused_residual_dropout_ln(
+                x, y, scale, bias, interpret=interpret
+            ).astype(jnp.float32).sum()
+
+        grad = jax.grad(loss, argnums=(0, 1))
+
+        def step(carry):
+            x, y = carry
+            dx, dy = grad(x, y)
+            eps = jnp.asarray(1e-6, x.dtype)
+            return x + eps * dx.astype(x.dtype), y + eps * dy.astype(y.dtype)
+
+        return _diff_time(step, (x, y), n1, n2)
+
+    try:
+        table = _sweep(cands, measure, budget_s=budget_s, verbose=verbose,
+                       tag=f"fused_ln T{T} D{D}")
+        bt = int(_best(table, "fused_ln row-block"))
+    finally:
+        # drop the sweep's in-memory candidate entries whatever happened
+        # — a failed sweep must not leave the LAST candidate silently
+        # steering every later _pick_block in this process (memo-only
+        # invalidation: unrelated save=False entries survive)
+        _invalidate_memo()
+    entry = {"block_rows": bt, "table": table}
+    for n in (4, 6):  # forward streams 4 row blocks, backward 6
+        record_entry("fused_ln", f"T{T}|D{D}|s{n}", dict(entry), save=save)
     return entry

@@ -58,8 +58,11 @@ _MAX_DQ_PARTIALS = 8  # fused bwd keeps nk fp32 dQ partials; beyond, two-pass
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying the varying-axes (vma) signature of
     ``like`` — required when the kernel runs inside a shard_map manual
-    region (e.g. as the Ulysses local core) under check_vma."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    region (e.g. as the Ulysses local core) under check_vma.  Older jax
+    has neither ``jax.typeof`` nor vma-typed avals — there the plain
+    struct is exactly right (no check_vma exists to satisfy)."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -70,11 +73,12 @@ def _compiler_params(n_parallel: int, arbitrary: int = 1):
     ``arbitrary`` sequential ones (0 for grids whose dims are all
     independent — Mosaic megacore partitioning can only split dims
     declared parallel)."""
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
     try:
-        return pltpu.CompilerParams(
-            dimension_semantics=("parallel",) * n_parallel
-            + ("arbitrary",) * arbitrary)
-    except TypeError:  # field renamed/absent in this jax version
+        return cls(dimension_semantics=("parallel",) * n_parallel
+                   + ("arbitrary",) * arbitrary)
+    except TypeError:  # class/field renamed or absent in this jax version
         return None
 
 

@@ -104,7 +104,13 @@ def _bwd_kernel(do_ref, x_ref, y_ref, kw_ref, s_ref, mean_ref, rstd_ref,
 def _pick_block(T: int, D: int, n_streams: int) -> int:
     """Rows per grid step, sized so n_streams double-buffered (bt, D)
     fp32 blocks stay within ~8 MB of VMEM (the backward streams 5 row
-    blocks + fp32 temps; at D=1024 this lands on bt=128)."""
+    blocks + fp32 temps; at D=1024 this lands on bt=128).  A measured
+    autotune-DB entry (ops/pallas/autotune.py ``autotune_fused_ln_rows``)
+    outranks the VMEM heuristic whenever it still divides T."""
+    from hetu_tpu.ops.pallas.autotune import tuned_entry
+    hit = tuned_entry("fused_ln", f"T{T}|D{D}|s{n_streams}")
+    if hit and T % int(hit["block_rows"]) == 0:
+        return int(hit["block_rows"])
     budget = (8 * 1024 * 1024) // (n_streams * 2 * D * 4)
     bt = max(8, min(512, budget))
     bt = 1 << (bt.bit_length() - 1)  # power of two for even division

@@ -57,7 +57,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from hetu_tpu.ops.pallas.flash import (_compiler_params, _round_up, _sds)
 
-__all__ = ["lm_head_cross_entropy_pallas"]
+__all__ = ["lm_head_cross_entropy_pallas", "lm_head_sample_pallas"]
 
 _NEG = -1e30
 
@@ -151,6 +151,19 @@ def _dw_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dw_ref, db_ref,
     def _():
         dw_ref[:, :] = dw_acc[:].astype(dw_ref.dtype)
         db_ref[:, :] = db_acc[:1, :].astype(db_ref.dtype)
+
+
+def _tuned_head_blocks(N, E, V, block_n, block_v):
+    """Resolve (block_n, block_v) for BOTH head kernels: explicit args >
+    the shared ``lm_head`` autotune-DB entry (one shape signature covers
+    the CE and sampling directions) > the swept v5e defaults."""
+    if block_n is None or block_v is None:
+        from hetu_tpu.ops.pallas.autotune import tuned_entry
+        hit = tuned_entry("lm_head", f"N{N}|E{E}|V{V}")
+        if hit:
+            block_n = block_n or int(hit["block_n"])
+            block_v = block_v or int(hit["block_v"])
+    return block_n or 512, block_v or 1024
 
 
 def _h_spec(bn, E):
@@ -267,15 +280,19 @@ _head.defvjp(_head_vjp_fwd, _head_vjp_bwd)
 
 def lm_head_cross_entropy_pallas(hidden, weight, labels, *, bias=None,
                                  ignore_index: int = -1,
-                                 block_n: int = 512, block_v: int = 1024,
+                                 block_n: int | None = None,
+                                 block_v: int | None = None,
                                  interpret: bool | None = None):
     """Per-row nll of ``softmax(hidden @ weight + bias)`` at ``labels``,
     never materializing the logits; drop-in for
-    ``ops.lm_head_cross_entropy`` (same masking contract)."""
+    ``ops.lm_head_cross_entropy`` (same masking contract).  Unset block
+    sizes consult the autotune DB (``autotune_lm_head_blocks``) before
+    falling back to the swept v5e defaults (512, 1024)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     N, E = hidden.shape
     V = weight.shape[1]
+    block_n, block_v = _tuned_head_blocks(N, E, V, block_n, block_v)
     # clamp out-of-range labels into [0, V-1] like
     # softmax_cross_entropy_sparse's gather (negatives too: a negative
     # non-ignore label would match no iota column and nll would silently
@@ -300,3 +317,181 @@ def lm_head_cross_entropy_pallas(hidden, weight, labels, *, bias=None,
 
     nll = _head(h, w, b2, y2, ignore_index, bn, bv, interpret)
     return nll[:N]
+
+
+# ---------------------------------------------------------------------------
+# fused LM-head SAMPLING (the serving decode head)
+# ---------------------------------------------------------------------------
+#
+# The decode loop's head work is logits = hidden @ W followed by a sampler
+# (ops/random.py greedy/temperature/top_k).  Fusing them streams the same
+# vocab tiles as the CE kernel but reduces each row to its top-k
+# (value, index) pairs ON THE FLY — the (N, V) logits never exist outside
+# VMEM, and the host round trip ships k scalars per row instead of V.
+#
+# Bitwise contract with the unfused samplers (given the same logits):
+# - greedy: running strictly-greater max with smallest-index tie-breaks ==
+#   jnp.argmax's first-max semantics.
+# - temperature: jax.random.categorical(key, lg) is literally
+#   argmax(gumbel(key, (V,)) + lg); the SAME per-row gumbel field is
+#   generated outside (cheap elementwise) and folded into the streamed
+#   argmax, so the draw is the sampler's draw bit for bit.
+# - top_k: the kernel's streamed selection reproduces lax.top_k's
+#   descending order with ascending-index ties; the k-way categorical over
+#   vals/temperature runs outside on k values, exactly as top_k_sample's.
+
+_IDX_PAD = 2147483647  # int32 max: init/sentinel index, loses every tie
+
+
+def _sample_kernel(h_ref, w_ref, b_ref, *refs, block_v, k, temp, use_g):
+    # refs = ([g_ref,] vals_ref, idx_ref, tv_sc, ti_sc) — the gumbel
+    # operand exists only for the temperature mode
+    g_ref = refs[0] if use_g else None
+    vals_ref, idx_ref, tv_sc, ti_sc = refs[1 if use_g else 0:]
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        tv_sc[:] = jnp.full_like(tv_sc, _NEG)
+        ti_sc[:] = jnp.full_like(ti_sc, _IDX_PAD)
+
+    lg = _tile(h_ref, w_ref, b_ref)
+    # the categorical identity: argmax(gumbel + logits/T).  Addition is
+    # bitwise commutative, so folding the gumbel here matches the
+    # sampler's gumbel(key) + lg/T exactly
+    val = g_ref[:, :] + lg / temp if use_g else lg
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, val.shape, 1)
+    # merge (running top-k | this tile) -> new running top-k: k rounds of
+    # max-with-smallest-index-tie selection.  Column indices are unique
+    # across the candidate set (running entries came from earlier tiles),
+    # so removing by index removes exactly the selected element.
+    cand_v = jnp.concatenate([tv_sc[:, :k], val], axis=1)
+    cand_i = jnp.concatenate([ti_sc[:, :k], col], axis=1)
+    for step in range(k):
+        m = jnp.max(cand_v, axis=1, keepdims=True)
+        sel = jnp.min(jnp.where(cand_v == m, cand_i, _IDX_PAD), axis=1,
+                      keepdims=True)
+        tv_sc[:, step:step + 1] = m
+        ti_sc[:, step:step + 1] = sel
+        cand_v = jnp.where(cand_i == sel, _NEG, cand_v)
+
+    @pl.when(j == nv - 1)
+    def _():
+        vals_ref[:, :] = tv_sc[:, :k]
+        idx_ref[:, :] = ti_sc[:, :k]
+
+
+def _sample_call(h, w, b2, g, temp, k, block_n, block_v, interpret):
+    N, E = h.shape
+    V = w.shape[1]
+    nn, nv = N // block_n, V // block_v
+    use_g = g is not None
+    specs = [
+        _h_spec(block_n, E),
+        pl.BlockSpec((E, block_v), lambda i, j: (0, j)),
+        pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+    ]
+    args = [h, w, b2]
+    if use_g:
+        specs.append(pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)))
+        args.append(g)
+    kernel = functools.partial(_sample_kernel, block_v=block_v, k=k,
+                               temp=temp, use_g=use_g)
+    return pl.pallas_call(
+        kernel,
+        grid=(nn, nv),
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((block_n, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, k), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            _sds((N, k), jnp.float32, h),
+            _sds((N, k), jnp.int32, h),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 128), jnp.float32),
+            pltpu.VMEM((block_n, 128), jnp.int32),
+        ],
+        compiler_params=_compiler_params(1),
+        interpret=interpret,
+    )(*args)
+
+
+def lm_head_sample_pallas(hidden, weight, *, bias=None, mode: str = "greedy",
+                          top_k: int = 5, temperature: float = 1.0,
+                          keys=None, block_n: int | None = None,
+                          block_v: int | None = None,
+                          interpret: bool | None = None):
+    """Sample next tokens straight from decode hidden states: the logits
+    ``hidden @ weight (+ bias)`` are streamed through VMEM in vocab tiles
+    and reduced to each row's sampling decision in the same pass — the
+    ``(N, V)`` logits tensor never touches HBM.
+
+    Bit-for-bit compatible with the seeded samplers in ``ops/random.py``
+    applied to the same (fp32) logits: ``mode='greedy'`` ==
+    ``greedy_sample``; ``'temperature'`` == ``temperature_sample(lg, T,
+    key)`` (the categorical's gumbel field is regenerated from the same
+    per-row key); ``'top_k'`` == ``top_k_sample(lg, k, T, key)`` (streamed
+    top-k with lax.top_k's tie order, k-way categorical outside).
+    ``keys``: per-row PRNG keys, required for the stochastic modes —
+    the serving engine derives them from (seed, request id, position), so
+    fused token streams keep the bitwise-reproducibility contract.
+
+    Traffic note: greedy/top_k stream nothing per-vocab besides the
+    weight.  Temperature mode is the exception — bitwise compatibility
+    with ``jax.random.categorical`` requires its exact (N, V) fp32
+    gumbel field, which is generated outside and streamed through the
+    kernel, so that mode trades the logits round trip for a noise round
+    trip (a wash at decode batch sizes, not a saving).
+
+    Unset block sizes consult the same autotune-DB entry as the CE kernel
+    (one ``lm_head`` shape signature covers both directions of the head).
+    Returns int32 tokens ``(N,)``.
+    """
+    if mode not in ("greedy", "temperature", "top_k"):
+        raise ValueError(f"unknown sampling mode {mode!r}; one of "
+                         f"'greedy', 'temperature', 'top_k'")
+    if mode != "greedy" and temperature <= 0.0:
+        mode = "greedy"  # the samplers' conventional T->0 collapse
+    if mode != "greedy" and keys is None:
+        raise ValueError(f"mode={mode!r} needs per-row PRNG keys")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    N, E = hidden.shape
+    V = weight.shape[1]
+    k_sel = 1 if mode != "top_k" else min(int(top_k), V)
+    if not 1 <= k_sel <= 128:
+        raise ValueError(f"top_k must be in [1, 128], got {k_sel}")
+    block_n, block_v = _tuned_head_blocks(N, E, V, block_n, block_v)
+    bn = min(block_n, _round_up(N, 8))
+    bv = min(block_v, _round_up(V, 128))
+    Np, Vp = _round_up(N, bn), _round_up(V, bv)
+
+    h = jnp.pad(hidden.astype(weight.dtype), ((0, Np - N), (0, 0))) \
+        if Np != N else hidden.astype(weight.dtype)
+    w = jnp.pad(weight, ((0, 0), (0, Vp - V))) if Vp != V else weight
+    b = (jnp.zeros((V,), jnp.float32) if bias is None
+         else bias.astype(jnp.float32))
+    # padded vocab columns get bias -1e30 (absorbed to exactly _NEG in
+    # fp32): they lose every selection to any real column
+    b2 = jnp.pad(b, (0, Vp - V), constant_values=_NEG).reshape(1, Vp)
+
+    g = None
+    if mode == "temperature":
+        # the categorical's own noise: argmax(gumbel(key, (V,)) + lg/T)
+        # IS jax.random.categorical(key, lg/T) — same keys, same field
+        gm = jax.vmap(
+            lambda kk: jax.random.gumbel(kk, (V,), jnp.float32))(keys)
+        g = jnp.pad(gm, ((0, Np - N), (0, Vp - V)))
+
+    vals, idx = _sample_call(h, w, b2, g, float(temperature), k_sel, bn, bv,
+                             interpret)
+    vals, idx = vals[:N], idx[:N]
+    if mode != "top_k":
+        return idx[:, 0].astype(jnp.int32)
+    choice = jax.vmap(
+        lambda kk, v: jax.random.categorical(kk, v / temperature))(keys, vals)
+    return jnp.take_along_axis(
+        idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)

@@ -4,12 +4,22 @@ Marries the decode seams (``models/gpt.py`` ``kv_cache=``/``cache_index=``)
 to the paged pool and the continuous batcher, and carries the two serving
 workloads the stack trains:
 
-- **Generation** — seeded greedy/top-k sampling over a GPT.  One jitted
-  step function serves both phases: prefill calls it at ``(1,
-  bucket_len)`` (one compile per prompt bucket), decode at the fixed
-  ``(num_slots, 1)`` shape (one compile, ever).  Sampling keys derive
-  from ``(seed, request.id, position)``, so a request's token stream is a
-  pure function of the seed and its own prompt — independent of which
+- **Generation** — seeded greedy/top-k sampling over a GPT.  Prefill
+  runs the bucketed gather step at ``(1, bucket_len)`` (one compile per
+  prompt bucket, once per request).  Decode — the per-token hot path —
+  defaults to the PAGED step at the fixed ``(num_slots, 1)`` shape: the
+  Pallas paged-decode kernel (ops/pallas/paged_decode.py) attends in
+  place over the pool's page tables, so the per-step contiguous
+  ``(L, batch, max_len, H, D)`` gather/scatter of the whole KV history —
+  the dominant decode HBM traffic at long context — never happens
+  (``kv_cache.gather_view_count`` proves the decode program traces zero
+  views).  Sampling fuses into the LM head
+  (ops/pallas/lm_head.py ``lm_head_sample_pallas``): the decode logits
+  never materialize in HBM for greedy/top-k (temperature mode streams
+  its bitwise-exact gumbel field instead — a wash, not a win).
+  ``paged_decode=False`` restores the gather path.  Sampling keys derive from ``(seed, request.id,
+  position)`` in both paths, so a request's token stream is a pure
+  function of the seed and its own prompt — independent of which
   neighbors shared its batch.  Two same-seed runs of the same schedule
   produce bitwise-identical streams; the acceptance test asserts it.
 
@@ -42,6 +52,7 @@ import numpy as np
 
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.obs import registry as _obs
+from hetu_tpu.ops.pallas.lm_head import lm_head_sample_pallas
 from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
                                  top_k_sample)
 from hetu_tpu.serve.batcher import (AdmissionQueueFull, ContinuousBatcher,
@@ -125,7 +136,9 @@ class ServingEngine:
                  sampling: str = "greedy", top_k: int = 5,
                  temperature: float = 1.0, eos_id: Optional[int] = None,
                  seed: int = 0, clock=time.monotonic,
-                 defrag_every: int = 0, ctr_model=None):
+                 defrag_every: int = 0, ctr_model=None,
+                 paged_decode: bool = True,
+                 fused_sampling: Optional[bool] = None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -164,6 +177,15 @@ class ServingEngine:
         self._stop = threading.Event()
         self._step_fn = jax.jit(self._step_impl)
         self._sample_fn = jax.jit(self._sample_impl)
+        self.paged_decode = bool(paged_decode)
+        if fused_sampling is None:
+            # the fused sampler's streamed top-k holds at most 128
+            # candidates in its lane-wide scratch; wider top-k falls back
+            # to XLA logits + the row sampler (still paged attention)
+            fused_sampling = (sampling != "top_k"
+                              or min(top_k, cfg.vocab_size) <= 128)
+        self._fused_sampling = bool(fused_sampling)
+        self._paged_step_fn = jax.jit(self._paged_decode_impl)
         self.ctr_model = ctr_model
         if ctr_model is not None:
             _mark_stores_read_only(ctr_model)
@@ -183,6 +205,32 @@ class ServingEngine:
         v_upd = jnp.stack([kv_l[1] for kv_l in new_kv])
         k, v = scatter_views(k, v, page_idx, k_upd, v_upd)
         return logits, k, v
+
+    def _paged_decode_impl(self, model, k, v, page_tables, lengths, tokens,
+                           request_ids, positions):
+        """The paged decode step: attention reads K/V pages IN PLACE via
+        the page tables (Pallas paged-decode kernel), each layer's new
+        K/V lands with one small scatter, and sampling fuses into the
+        LM-head kernel — neither the contiguous KV views nor the (slots,
+        vocab) logits ever materialize.  Same key derivation as
+        :meth:`_sample_impl`, so streams stay bitwise-reproducible."""
+        x, (k, v) = model.hidden_states(
+            tokens, kv_cache=(k, v), cache_index=lengths,
+            paged_tables=page_tables)
+        last = x[:, -1]
+        head = model._head().astype(last.dtype)
+        if self._fused_sampling:
+            keys = None
+            if self.sampling != "greedy":
+                keys = jax.vmap(lambda r, p: jax.random.fold_in(
+                    jax.random.fold_in(self._base_key, r), p))(
+                    request_ids, positions)
+            toks = lm_head_sample_pallas(
+                last, head, mode=self.sampling, top_k=self.top_k,
+                temperature=self.temperature, keys=keys)
+        else:
+            toks = self._sample_impl(last @ head, request_ids, positions)
+        return toks, k, v
 
     def _sample_impl(self, logits, request_ids, positions):
         """Per-row seeded sampling (vmapped: one dispatch per step).  Keys
@@ -381,13 +429,22 @@ class ServingEngine:
                   if r.slot is not None]  # drop the evicted
         if not active:
             return 0
-        logits, k, v = self._step_fn(
-            self.model, self.pool.k, self.pool.v,
-            self.pool.gather_indices(seq_ids),
-            jnp.asarray(index), jnp.asarray(tokens), None)
-        self.pool.commit(k, v)
-        toks = np.asarray(self._sample_fn(logits, jnp.asarray(rids),
-                                          jnp.asarray(positions)))
+        if self.paged_decode:
+            toks_dev, k, v = self._paged_step_fn(
+                self.model, self.pool.k, self.pool.v,
+                self.pool.gather_indices(seq_ids),
+                jnp.asarray(index), jnp.asarray(tokens),
+                jnp.asarray(rids), jnp.asarray(positions))
+            self.pool.commit(k, v)
+            toks = np.asarray(toks_dev)
+        else:
+            logits, k, v = self._step_fn(
+                self.model, self.pool.k, self.pool.v,
+                self.pool.gather_indices(seq_ids),
+                jnp.asarray(index), jnp.asarray(tokens), None)
+            self.pool.commit(k, v)
+            toks = np.asarray(self._sample_fn(logits, jnp.asarray(rids),
+                                              jnp.asarray(positions)))
         now = self.clock()
         for slot, req in active:
             self.pool.table(req.id).length += 1  # fed token's K/V written
@@ -472,6 +529,8 @@ class ServingEngine:
                 "pool": self.pool.utilization(),
                 "max_seq_len": self.max_seq_len,
                 "sampling": self.sampling,
+                "paged_decode": self.paged_decode,
+                "fused_sampling": self._fused_sampling,
                 "metrics": snap,
             }
 

@@ -39,7 +39,25 @@ import dataclasses
 
 import jax.numpy as jnp
 
-__all__ = ["KVCachePool", "PageTable", "OutOfPages", "SCRATCH_PAGE"]
+__all__ = ["KVCachePool", "PageTable", "OutOfPages", "SCRATCH_PAGE",
+           "gather_view_count", "reset_gather_view_count"]
+
+# Counting seam for the no-materialization acceptance test: gather_views
+# is THE place a contiguous (L, batch, max_len, H, D) view of the pool is
+# built, and it runs at trace time (inside jit), so counting its calls
+# proves which jitted programs gather.  The paged decode step must trace
+# to zero gathers; prefill (bucketed, once per request) still gathers.
+_gather_view_calls = 0
+
+
+def gather_view_count() -> int:
+    """How many times :func:`gather_views` has traced a contiguous view."""
+    return _gather_view_calls
+
+
+def reset_gather_view_count() -> None:
+    global _gather_view_calls
+    _gather_view_calls = 0
 
 # Physical page 0 is reserved: page-table padding points at it, and the
 # scatter of a padded decode batch dumps dead rows into it.  Never
@@ -205,7 +223,11 @@ class KVCachePool:
 
 def gather_views(k, v, page_idx):
     """Inside-jit helper: materialize the bucket-padded contiguous views
-    ``(L, batch, max_len, H, D)`` from the page arrays — one gather each."""
+    ``(L, batch, max_len, H, D)`` from the page arrays — one gather each.
+    Counted (at trace time) so the paged-decode acceptance test can prove
+    the decode program never builds a view."""
+    global _gather_view_calls
+    _gather_view_calls += 1
     L, _, page, H, D = k.shape
     b, P = page_idx.shape
     kv_shape = (L, b, P * page, H, D)

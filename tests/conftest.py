@@ -73,6 +73,11 @@ def pytest_configure(config):
                    "are additionally marked slow — a fast 2-worker smoke "
                    "stays in tier-1")
     config.addinivalue_line(
+        "markers", "pallas: Pallas kernel tests (ops/pallas paged-decode / "
+                   "fused-sampling / autotune-DB and their serving seams); "
+                   "interpret-mode parity suites are tier-1, on-device "
+                   "measurement/tuning runs are additionally marked slow")
+    config.addinivalue_line(
         "markers", "partial: straggler-tolerant partial-reduce tests "
                    "(exec.partial deadline cut / bounded-staleness folds / "
                    "correction-term persistence); multi-worker chaos runs "

@@ -191,3 +191,66 @@ class TestPreflight:
     def test_healthy_backend_passes_silently(self, capsys):
         bench._require_backend_alive(timeout_s=30.0)
         assert capsys.readouterr().out == ""
+
+
+class TestServeMode:
+    """--mode serve machinery that must not first run on a live chip:
+    histogram quantiles and the CLI mode gate."""
+
+    def test_hist_quantile_interpolates(self):
+        before = [(0.1, 0), (0.5, 0), (1.0, 0), (float("inf"), 0)]
+        after = [(0.1, 2), (0.5, 6), (1.0, 10), (float("inf"), 10)]
+        # p50: rank 5 lands in the (0.1, 0.5] bucket (2 -> 6): linear
+        assert bench._hist_quantile(before, after, 0.5) == pytest.approx(
+            0.1 + 0.4 * (5 - 2) / 4)
+        # p99 lands in the (0.5, 1.0] bucket
+        assert 0.5 < bench._hist_quantile(before, after, 0.99) <= 1.0
+
+    def test_hist_quantile_inf_bucket_reports_lower_edge(self):
+        before = [(0.1, 0), (float("inf"), 0)]
+        after = [(0.1, 0), (float("inf"), 4)]
+        assert bench._hist_quantile(before, after, 0.5) == 0.1
+
+    def test_hist_quantile_empty_delta_is_none(self):
+        cum = [(0.1, 3), (float("inf"), 7)]
+        assert bench._hist_quantile(cum, cum, 0.5) is None
+
+    def test_unknown_mode_exits_before_preflight(self, monkeypatch):
+        probed = []
+        monkeypatch.setattr(bench, "_require_backend_alive",
+                            lambda *a, **k: probed.append(1))
+        monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--mode", "fly"])
+        with pytest.raises(SystemExit, match="unknown mode"):
+            bench.main()
+        monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--mode"])
+        with pytest.raises(SystemExit, match="--mode needs"):
+            bench.main()
+        monkeypatch.setattr(bench.sys, "argv",
+                            ["bench.py", "--mode", "serve", "resnet"])
+        with pytest.raises(SystemExit, match="takes no config"):
+            bench.main()
+        assert probed == []  # usage errors never touch the backend
+
+    def test_serve_mode_runs_behind_preflight(self, monkeypatch, capture):
+        """--mode serve goes through the SAME fast-fail preflight as the
+        training configs: a dead tunnel means rc=3 and NO stdout metric."""
+        order = []
+        monkeypatch.setattr(
+            bench, "_require_backend_alive",
+            lambda *a, **k: order.append("preflight"))
+        monkeypatch.setattr(
+            bench, "bench_serve",
+            lambda on_tpu, kind, peak: order.append("serve"))
+        monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--mode",
+                                                "serve"])
+        bench.main()
+        assert order == ["preflight", "serve"]
+
+        def dead(*a, **k):
+            raise SystemExit(bench.PREFLIGHT_RC)
+
+        monkeypatch.setattr(bench, "_require_backend_alive", dead)
+        order.clear()
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == bench.PREFLIGHT_RC and order == []

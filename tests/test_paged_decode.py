@@ -1,0 +1,252 @@
+"""Paged-decode Pallas kernel: interpret-mode parity vs the XLA decode
+path on ragged seq_lengths (ulp-tight), scratch-page poisoning immunity,
+layered-pool indexing, head-block tiling invariance, and the engine-level
+no-materialization acceptance (zero ``gather_views`` traces in the paged
+decode program, counted at the seam).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.layers.attention import (MultiHeadAttention, PagedDecode,
+                                       decode_attention)
+from hetu_tpu.models.gpt import GPT, GPTConfig
+from hetu_tpu.ops.pallas.paged_decode import paged_decode_attention
+from hetu_tpu.serve import ServingEngine
+from hetu_tpu.serve.kv_cache import gather_view_count
+
+pytestmark = pytest.mark.pallas
+
+
+def _paged_setup(lens, *, H=2, D=8, page=4, n_pages=None, P=None, seed=0):
+    """Pools + page tables for ragged ``lens``; pages handed out low-first
+    from 1 (page 0 reserved scratch), mirroring KVCachePool placement."""
+    rng = np.random.default_rng(seed)
+    B = len(lens)
+    n_pages = n_pages or max(-(-int(n) // page) for n in lens)
+    P = P or 1 + sum(-(-int(n) // page) for n in lens)
+    tables = np.zeros((B, n_pages), np.int32)
+    nxt = 1
+    for i, n in enumerate(lens):
+        for j in range(-(-int(n) // page)):
+            tables[i, j] = nxt
+            nxt += 1
+    k_pool = rng.standard_normal((P, page, H, D)).astype(np.float32)
+    v_pool = rng.standard_normal((P, page, H, D)).astype(np.float32)
+    q = rng.standard_normal((B, H, D)).astype(np.float32)
+    return q, k_pool, v_pool, tables, np.asarray(lens, np.int32)
+
+
+def _reference(q, k_pool, v_pool, tables, lens):
+    """The XLA path the kernel replaces: gather the contiguous caches,
+    run ``decode_attention`` (cache_index = len - 1 for one new token)."""
+    B, n_pages = tables.shape
+    page = k_pool.shape[1]
+    k_cache = k_pool[tables].reshape(B, n_pages * page, *k_pool.shape[2:])
+    v_cache = v_pool[tables].reshape(B, n_pages * page, *v_pool.shape[2:])
+    out = decode_attention(jnp.asarray(q)[:, None], jnp.asarray(k_cache),
+                           jnp.asarray(v_cache), jnp.asarray(lens - 1))
+    return np.asarray(out)[:, 0]
+
+
+@pytest.mark.parametrize("lens", [[5, 16, 1], [4, 4], [13, 2, 7, 9]])
+def test_paged_matches_decode_attention_ragged(lens):
+    """Parity vs the gather + decode_attention path is ulp-tight on
+    ragged batches (fp32 online softmax vs fp32 full softmax)."""
+    q, k_pool, v_pool, tables, lens = _paged_setup(lens)
+    out = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    ref = _reference(q, k_pool, v_pool, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-6, atol=2e-7)
+
+
+def test_scratch_page_poisoning_bitwise_immune():
+    """Fill the reserved scratch page 0 with NaN: every output must be
+    BITWISE unchanged — padded page-table entries and positions at/past
+    seq_lengths are never read into the math (a single leaked NaN would
+    infect the whole row through the softmax)."""
+    q, k_pool, v_pool, tables, lens = _paged_setup([5, 16, 1])
+    clean = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    k_poison, v_poison = k_pool.copy(), v_pool.copy()
+    k_poison[0] = np.nan
+    v_poison[0] = np.nan
+    poisoned = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_poison), jnp.asarray(v_poison),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+
+def test_tail_of_last_page_masked():
+    """Garbage (NaN) in the allocated-but-unwritten tail of a row's LAST
+    page must not contribute either — the in-page position mask, not just
+    the whole-page skip, carries the seq_lengths contract."""
+    q, k_pool, v_pool, tables, lens = _paged_setup([5, 9])
+    clean = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    page = k_pool.shape[1]
+    k_poison, v_poison = k_pool.copy(), v_pool.copy()
+    for i, n in enumerate(lens):
+        last_pg = tables[i, (int(n) - 1) // page]
+        k_poison[last_pg, int(n) % page or page:] = np.nan
+        v_poison[last_pg, int(n) % page or page:] = np.nan
+    poisoned = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_poison), jnp.asarray(v_poison),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+
+def test_layered_pool_and_head_block_invariance():
+    """The stacked (layers, pages, ...) form with a static layer index
+    reads exactly its layer; head_block tilings are bitwise-equivalent
+    (the autotune knob cannot change results)."""
+    q, k_pool, v_pool, tables, lens = _paged_setup([5, 16, 1], H=4)
+    base = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    k5 = np.stack([k_pool * 3, k_pool])
+    v5 = np.stack([v_pool * 3, v_pool])
+    layered = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k5), jnp.asarray(v5),
+        jnp.asarray(tables), jnp.asarray(lens), layer=1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(layered))
+    with pytest.raises(ValueError, match="layer"):
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k5), jnp.asarray(v5),
+            jnp.asarray(tables), jnp.asarray(lens), interpret=True)
+    for hb in (1, 2):
+        tiled = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(lens), head_block=hb,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(tiled))
+    with pytest.raises(ValueError, match="head_block"):
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(lens), head_block=3,
+            interpret=True)
+
+
+def test_mha_paged_step_matches_cached_step():
+    """One MultiHeadAttention paged decode step == the contiguous-cache
+    ``_call_cached`` step: same output, and the scattered K/V rows land
+    exactly where the gathered view would have written them."""
+    set_random_seed(3)
+    H, D, page, n_pages = 2, 8, 4, 3
+    mha = MultiHeadAttention(H * D, H)
+    rng = np.random.default_rng(1)
+    lens = np.asarray([5, 9], np.int32)  # history BEFORE the new token
+    B = len(lens)
+    q, k_pool, v_pool, tables, _ = _paged_setup(
+        list(lens + 1), H=H, D=D, page=page, n_pages=n_pages, seed=1)
+    x = jnp.asarray(rng.standard_normal((B, 1, H * D)), jnp.float32)
+
+    # contiguous reference caches mirroring the pool's current content
+    max_len = n_pages * page
+    k_cache = jnp.asarray(k_pool[tables].reshape(B, max_len, H, D))
+    v_cache = jnp.asarray(v_pool[tables].reshape(B, max_len, H, D))
+    y_ref, (k_ref, v_ref) = mha(x, kv_cache=(k_cache, v_cache),
+                                cache_index=jnp.asarray(lens))
+    y_paged, (k_new, v_new) = mha(
+        x, kv_cache=(jnp.asarray(k_pool), jnp.asarray(v_pool)),
+        cache_index=jnp.asarray(lens),
+        paged=PagedDecode(jnp.asarray(tables)))
+    np.testing.assert_allclose(np.asarray(y_paged), np.asarray(y_ref),
+                               rtol=2e-6, atol=2e-7)
+    # the scatter wrote each row's new K/V at (page, slot) == position len
+    k_new, v_new = np.asarray(k_new), np.asarray(v_new)
+    for i, n in enumerate(lens):
+        pg, slot = tables[i, int(n) // page], int(n) % page
+        np.testing.assert_array_equal(
+            k_new[pg, slot], np.asarray(k_ref)[i, int(n)])
+        np.testing.assert_array_equal(
+            v_new[pg, slot], np.asarray(v_ref)[i, int(n)])
+
+
+def tiny_gpt(seed=0, **kw):
+    set_random_seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, **kw)
+    return GPT(cfg)
+
+
+def test_gpt_paged_decode_matches_gather_decode():
+    """A full GPT paged decode step (stacked pools threaded through every
+    block) produces the same next-token logits as the gather-view decode
+    path, on a ragged batch."""
+    m = tiny_gpt()
+    cfg = m.config
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    page, n_pages = 8, 4
+    lens = np.asarray([5, 9, 2], np.int32)
+    B = len(lens)
+    rng = np.random.default_rng(2)
+    P = 1 + B * n_pages
+    tables = np.zeros((B, n_pages), np.int32)
+    nxt = 1
+    for i, n in enumerate(lens):
+        for j in range(-(-(int(n) + 1) // page)):
+            tables[i, j] = nxt
+            nxt += 1
+    k_pool = rng.standard_normal(
+        (cfg.num_layers, P, page, H, D)).astype(np.float32)
+    v_pool = rng.standard_normal(
+        (cfg.num_layers, P, page, H, D)).astype(np.float32)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 1)), jnp.int32)
+
+    max_len = n_pages * page
+    kv = [(jnp.asarray(k_pool[li][tables].reshape(B, max_len, H, D)),
+           jnp.asarray(v_pool[li][tables].reshape(B, max_len, H, D)))
+          for li in range(cfg.num_layers)]
+    logits_ref, _ = m(toks, kv_cache=kv, cache_index=jnp.asarray(lens))
+    logits_paged, (k2, v2) = m(
+        toks, kv_cache=(jnp.asarray(k_pool), jnp.asarray(v_pool)),
+        cache_index=jnp.asarray(lens), paged_tables=jnp.asarray(tables))
+    np.testing.assert_allclose(np.asarray(logits_paged),
+                               np.asarray(logits_ref),
+                               rtol=2e-5, atol=2e-6)
+    assert k2.shape == k_pool.shape and v2.shape == v_pool.shape
+
+
+@pytest.mark.serve
+def test_engine_paged_decode_zero_gather_materialization():
+    """Acceptance: the paged engine's decode program traces ZERO
+    ``gather_views`` calls (the counting seam in serve/kv_cache.py) —
+    only the per-bucket prefill program gathers — and its token streams
+    are bitwise-identical to the gather engine's on the same requests."""
+    m = tiny_gpt()
+
+    def run(paged):
+        eng = ServingEngine(m, num_slots=2, page_size=8, max_seq_len=64,
+                            prompt_buckets=(8,), sampling="top_k", top_k=3,
+                            temperature=1.5, seed=0, paged_decode=paged)
+        before = gather_view_count()
+        hs = [eng.submit([i + 1, i + 2, i + 3], 6) for i in range(4)]
+        eng.run_until_idle()
+        assert all(h.status == "completed" for h in hs)
+        return [tuple(h.tokens) for h in hs], gather_view_count() - before
+
+    paged_streams, paged_traces = run(True)
+    gather_streams, gather_traces = run(False)
+    # paged: exactly the one prefill bucket program gathered; gather
+    # baseline additionally traces its decode program's gather
+    assert paged_traces == 1
+    assert gather_traces == 2
+    assert paged_streams == gather_streams
+    # and directly: tracing the paged decode impl touches the seam 0 times
+    eng = ServingEngine(m, num_slots=2, page_size=8, max_seq_len=64,
+                        prompt_buckets=(8,), seed=0, paged_decode=True)
+    before = gather_view_count()
+    jax.eval_shape(
+        eng._paged_decode_impl, m, eng.pool.k, eng.pool.v,
+        jnp.zeros((2, 8), jnp.int32), jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2, 1), jnp.int32), jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2,), jnp.int32))
+    assert gather_view_count() == before
