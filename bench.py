@@ -49,28 +49,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-PEAK_BF16 = {
-    # chip kind (jax.devices()[0].device_kind) -> peak bf16 FLOP/s
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-}
-
-
-def transformer_train_flops(L, h, V, batch, seq, ratio=4):
-    """Forward+backward matmul FLOPs per step (2 flops per MAC, bwd = 2x fwd)."""
-    per_layer_fwd = (
-        6 * seq * h * h      # qkv projection
-        + 2 * seq * h * h    # attention out projection
-        + 4 * seq * seq * h  # QK^T and PV
-        + 4 * ratio * seq * h * h  # MLP in+out
-    )
-    heads_fwd = 2 * seq * (h * h + h * V)  # mlm transform + tied decoder
-    fwd = L * per_layer_fwd + heads_fwd
-    return 3 * fwd * batch
+# The per-config flops model and peak-FLOP/s table live in
+# hetu_tpu.obs.goodput now, so the online MFU gauge and this benchmark
+# report are the same arithmetic; re-exported here for callers/tests
+# that import them from bench.
+from hetu_tpu.obs.goodput import PEAK_BF16, transformer_train_flops  # noqa: E402,F401
 
 
 def _env():
@@ -592,25 +575,13 @@ def bench_bert_headline(on_tpu, kind, peak):
 # ---------------------------------------------------------------------------
 
 def _hist_quantile(cum_before, cum_after, q: float):
-    """Quantile from the delta of two cumulative-bucket snapshots
-    (obs Histogram.cumulative(): [(le, cum_count)]).  Prometheus-style
-    linear interpolation inside the winning bucket; the +Inf bucket
-    reports its lower edge.  None when the delta is empty."""
-    delta = [(le, a - b) for (le, a), (_, b) in zip(cum_after, cum_before)]
-    total = delta[-1][1]
-    if total <= 0:
-        return None
-    rank = q * total
-    prev_le, prev_c = 0.0, 0
-    for le, c in delta:
-        if c >= rank:
-            if le == float("inf"):
-                return prev_le
-            if c == prev_c:
-                return le
-            return prev_le + (le - prev_le) * (rank - prev_c) / (c - prev_c)
-        prev_le, prev_c = (le if le != float("inf") else prev_le), c
-    return delta[-1][0]
+    """Quantile from the delta of two cumulative-bucket snapshots —
+    promoted into ``obs.registry.Histogram.quantile_from_cumulative``
+    (the one quantile implementation in the tree; ``serve/engine.py``'s
+    ``/stats`` summary uses the same code).  Kept as a thin alias for
+    bench-internal callers and tests."""
+    from hetu_tpu.obs.registry import Histogram
+    return Histogram.quantile_from_cumulative(cum_before, cum_after, q)
 
 
 def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module, trainable_mask
 from hetu_tpu.core.rng import next_key
+from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _obs_tracing
 from hetu_tpu.optim.optimizers import Optimizer
@@ -257,6 +258,10 @@ class Trainer:
         skipped = bool(metrics.get("skipped"))
         m["steps"].labels(outcome="skipped" if skipped else "ok").inc()
         m["latency"].observe(dt)
+        # online goodput accounting: one global load + branch when no
+        # meter is installed (obs.goodput.install_meter), same contract
+        # as the rest of this seam
+        _obs_goodput.record_step(dt, skipped=skipped)
         if not skipped:
             n = _batch_examples(batch)
             if n:

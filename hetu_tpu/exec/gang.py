@@ -75,6 +75,8 @@ from hetu_tpu.exec import partial as _partial
 from hetu_tpu.exec.checkpoint import (CheckpointError, _atomic_write_bytes,
                                       load_checkpoint, load_state_dict,
                                       read_footer_crc, save_checkpoint)
+from hetu_tpu.obs import fleet as _obs_fleet
+from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import journal as _obs_journal
 from hetu_tpu.obs import registry as _obs
 
@@ -545,15 +547,30 @@ class GangMembership:
         self._beat_n += 1
         rec = {"rank": self.rank, "generation": self.generation,
                "beat": self._beat_n, "ts": self.clock()}
-        tmp = self._lease_path(self.rank) + f".tmp.{os.getpid()}"
+        # tmp is per-thread: the beat daemon and direct heartbeat() calls
+        # (worker step loops, rescale) may renew concurrently
+        tmp = (self._lease_path(self.rank)
+               + f".tmp.{os.getpid()}.{threading.get_ident()}")
         with open(tmp, "w") as f:
             f.write(json.dumps(rec))
         os.replace(tmp, self._lease_path(self.rank))
         if _obs.enabled():
             _gang_m()["alive"].labels(worker=str(self.rank)).set(1.0)
+        # fleet-telemetry publication rides the heartbeat cadence: with no
+        # publisher installed (or HETU_OBS=0) this is one global load and
+        # a branch
+        _obs_fleet.maybe_publish()
 
     def start(self) -> None:
-        """Heartbeat now and keep renewing on a daemon thread."""
+        """Heartbeat now and keep renewing on a daemon thread.  When the
+        launcher exported a snapshot interval
+        (:data:`~hetu_tpu.obs.fleet.ENV_OBS_SNAPSHOT`) and no publisher is
+        installed yet, this worker starts publishing fleet-telemetry
+        snapshots into the gang dir on the heartbeat cadence."""
+        if _obs_fleet.get_publisher() is None:
+            pub = _obs_fleet.publisher_from_env(self.gang_dir, self.rank)
+            if pub is not None:
+                _obs_fleet.install_publisher(pub)
         self.heartbeat()
         self._stop.clear()
 
@@ -575,6 +592,16 @@ class GangMembership:
         """Clean departure: stop heartbeating and remove the lease so
         peers see an intentional exit, not a lost worker."""
         self.stop()
+        pub = _obs_fleet.get_publisher()
+        if pub is not None and pub.rank == self.rank \
+                and pub.gang_dir == self.gang_dir:
+            # force one last snapshot so the fleet surface keeps this
+            # worker's final counters/journal after the process exits —
+            # then uninstall, so a process that later joins another gang
+            # (or rejoins under a new rank) doesn't keep publishing into
+            # this gang's dir under the stale rank
+            pub.publish()
+            _obs_fleet.install_publisher(None)
         try:
             os.remove(self._lease_path(self.rank))
         except OSError:
@@ -669,6 +696,7 @@ class GangMembership:
             survivors = sorted(set(survivors) | {self.rank})
         self.generation += 1
         self.heartbeat()  # lease now carries the new generation
+        barrier_t0 = time.monotonic()
         try:
             self.barrier(self.generation, survivors, timeout=timeout)
         except TimeoutError as e:
@@ -679,6 +707,8 @@ class GangMembership:
                 waiting_on=getattr(e, "stragglers", None),
                 timeout_s=float(timeout))
             raise
+        # barrier wall time is lost time: bill the goodput rescale bucket
+        _obs_goodput.record_event("rescale", time.monotonic() - barrier_t0)
         # every survivor acked the new generation, so all of them have
         # observed the eviction — the stale leases can go (otherwise the
         # dead worker would be re-"detected" forever).  Best-effort and
@@ -768,7 +798,8 @@ class ElasticGang:
                  data_fn: Callable[[int], dict], global_batch_size: int,
                  seed: int = 0, save_every: int = 2, keep: int = 4,
                  lease_steps: int = 1,
-                 partial: Optional["_partial.PartialReduceConfig"] = None):
+                 partial: Optional["_partial.PartialReduceConfig"] = None,
+                 goodput=None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -793,6 +824,11 @@ class ElasticGang:
         self._dead: set = set()
         self._stalled_until: dict = {}
         self._last_beat = {w: 0 for w in range(self.world_size)}
+        # a dedicated obs.goodput.GoodputMeter the gang bills in SIM-TIME
+        # units (1 + wait per step): pass one explicitly rather than
+        # installing a process-wide meter, which would double-count —
+        # Trainer.step's seam bills the installed meter in WALL time
+        self.goodput = goodput
         self.partial = partial
         self.reducer: Optional[_partial.PartialReducer] = None
         if partial is not None:
@@ -989,6 +1025,11 @@ class ElasticGang:
             metrics = self.trainer.step(batch, next_key())
             metrics["arrivals"] = self.world_size
             self.sim_time += 1.0
+            if self.goodput is not None:
+                # replayed step ids after a rescale rewind land in the
+                # "rescale" bucket via the meter's step high-water mark
+                self.goodput.record_step(
+                    1.0, step=s, skipped=bool(metrics.get("skipped")))
         self.step_count = s
         loss = float(metrics["loss"])
         self.history.append((s, loss))
@@ -1028,6 +1069,9 @@ class ElasticGang:
                                - self.sim_time))
                   for w in range(self.world_size)}
         ontime, wait, degraded = self.partial.cut(delays)
+        # straggler attribution: fold this cut's per-worker delays into
+        # the arrival-lag EWMAs (hetu_partial_worker_lag_seconds{worker=})
+        self.reducer.lags.observe(delays)
         self.sim_time += 1.0 + wait
         key = next_key()  # ONE global draw per step, like the sync path
         model = self.trainer.state.model
@@ -1097,6 +1141,18 @@ class ElasticGang:
                     sm["examples"].inc(committed)
                     if dt > 0:
                         sm["eps"].set(committed / dt)
+        if self.goodput is not None:
+            # sim-time accounting: the step cost 1 + wait units, the wait
+            # attributed to the slowest CONTRIBUTOR at the cut — cut()
+            # computes wait over the on-time set (everyone on a degraded
+            # step), so a dropped worker past the deadline never gets
+            # billed for wait it did not cause (lowest rank wins ties,
+            # so seeded replays attribute identically)
+            straggler = (max(sorted(ontime), key=lambda w: delays[w])
+                         if wait > 0 and ontime else None)
+            self.goodput.record_step(1.0 + wait, step=s, waited=wait,
+                                     straggler=straggler,
+                                     skipped=combined is None)
         return {"loss": loss, "arrivals": info["arrivals"],
                 "late_folds": info["late_folds"],
                 "dropped": info["dropped"], "degraded": info["degraded"],

@@ -62,7 +62,8 @@ from hetu_tpu.obs import journal as _obs_journal
 from hetu_tpu.obs import registry as _obs
 
 __all__ = ["PartialReduceConfig", "PartialReducer", "GradientBoard",
-           "grad_apply_fns", "split_state_entries", "STATE_PREFIX"]
+           "WorkerLagEWMA", "grad_apply_fns", "split_state_entries",
+           "STATE_PREFIX"]
 
 # Reserved dotted-path prefix for pending-correction checkpoint entries.
 # shard_owner() hashes these names like any parameter, so corrections are
@@ -168,8 +169,61 @@ def _partial_m() -> dict:
                 "hetu_partial_staleness_age_steps",
                 "staleness age (steps) of late contributions at fold or "
                 "drop time", buckets=_AGE_BUCKETS),
+            "lag": reg.gauge(
+                "hetu_partial_worker_lag_seconds",
+                "EWMA of each worker's gradient arrival lag at the "
+                "partial-reduce cut (step-clock units in the in-process "
+                "gang, wall seconds over a GradientBoard) — the "
+                "straggler-attribution signal /fleet/stragglers ranks "
+                "and the future adaptive deadline consumes", ("worker",)),
         }
     return _partial_metrics
+
+
+class WorkerLagEWMA:
+    """Per-worker arrival-lag EWMA — the straggler attribution state.
+
+    ``observe(delays)`` folds one cut's per-worker arrival delays into
+    exponentially-weighted means (iteration in sorted rank order, plain
+    float arithmetic: two same-schedule runs produce bitwise-identical
+    EWMAs) and mirrors them to
+    ``hetu_partial_worker_lag_seconds{worker=}``.  ``remap`` re-keys
+    survivors through a rescale's rank map and removes evicted workers'
+    gauge series (the elastic-membership convention: departed workers
+    disappear from scrapes instead of freezing)."""
+
+    def __init__(self, alpha: float = 0.25):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.lag: Dict[int, float] = {}
+
+    def observe(self, delays: Dict[int, float]) -> None:
+        for w in sorted(delays):
+            d = float(delays[w])
+            prev = self.lag.get(w)
+            cur = d if prev is None else \
+                (1.0 - self.alpha) * prev + self.alpha * d
+            self.lag[int(w)] = cur
+            if _obs.enabled():
+                _partial_m()["lag"].labels(worker=str(w)).set(cur)
+
+    def remap(self, rank_map: Dict[int, int]) -> None:
+        old = self.lag
+        self.lag = {}
+        for w in sorted(old):
+            if _obs.enabled():
+                _partial_m()["lag"].remove(worker=str(w))
+            if w in rank_map:
+                self.lag[int(rank_map[w])] = old[w]
+        for w, v in sorted(self.lag.items()):
+            if _obs.enabled():
+                _partial_m()["lag"].labels(worker=str(w)).set(v)
+
+    def top(self, k: int = 5) -> list:
+        """Worst-first ``[(worker, ewma_lag)]`` — the local form of the
+        ``/fleet/stragglers`` report."""
+        return sorted(self.lag.items(), key=lambda e: (-e[1], e[0]))[:k]
 
 
 def _is_finite(flat: dict) -> bool:
@@ -200,6 +254,9 @@ class PartialReducer:
         # origin — each entry is one late gradient awaiting its owner's
         # next on-time step
         self.pending: Dict[int, list] = {}
+        # straggler attribution: the harness feeds each cut's delays in
+        # (ElasticGang on the step clock, GradientBoard on wall time)
+        self.lags = WorkerLagEWMA()
 
     # -- staging ------------------------------------------------------------
 
@@ -397,6 +454,9 @@ class PartialReducer:
             n = struct.unpack(">d", bytes.fromhex(m.group(4)))[0]
             groups.setdefault((w, t, a, n), {})[name] = np.asarray(val)
         self.pending = {}
+        if rank_map is not None:
+            # the lag EWMAs follow the same re-ranking the corrections do
+            self.lags.remap(rank_map)
         for (w, t, a, n), grads in sorted(groups.items()):
             if rank_map is not None:
                 if w not in rank_map:
@@ -492,6 +552,9 @@ class GradientBoard:
 
     def __init__(self, gang_dir: str):
         self.dir = os.path.join(gang_dir, "partial")
+        # wall-clock straggler attribution on the multi-process path:
+        # collect() feeds each rank's observed arrival lag per step
+        self.lags = WorkerLagEWMA()
 
     def _path(self, step: int, rank: int) -> str:
         return os.path.join(self.dir, f"step_{int(step):08d}",
@@ -532,8 +595,10 @@ class GradientBoard:
         ``barrier_timeout`` (a wedged gang, not a straggler)."""
         want = [int(r) for r in ranks]
         got: dict = {}
-        deadline = time.monotonic() + float(deadline_s)
-        hard = time.monotonic() + float(barrier_timeout)
+        arrived: dict = {}
+        t0 = time.monotonic()
+        deadline = t0 + float(deadline_s)
+        hard = t0 + float(barrier_timeout)
         required = min(int(min_arrivals), len(want))
         degraded = False
         while True:
@@ -542,6 +607,7 @@ class GradientBoard:
                     hit = self.take(step, r)
                     if hit is not None:
                         got[r] = hit
+                        arrived[r] = time.monotonic() - t0
             if len(got) == len(want):
                 break
             now = time.monotonic()
@@ -558,6 +624,14 @@ class GradientBoard:
                     f"{sorted(got)} of {want} posted within "
                     f"{barrier_timeout}s")
             time.sleep(poll)
+        # ranks that never posted are the REAL stragglers: attribute the
+        # full time we waited as their lag floor (they took at least that
+        # long), matching the in-process path which observes every rank
+        elapsed = time.monotonic() - t0
+        for r in want:
+            if r not in arrived:
+                arrived[r] = elapsed
+        self.lags.observe(arrived)
         return got, [r for r in want if r not in got], degraded
 
     # The cut record: one worker (rank 0 by convention) runs the wall-

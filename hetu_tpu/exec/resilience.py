@@ -38,6 +38,7 @@ import os
 import re
 import signal
 import threading
+import time
 from typing import Any, Optional
 
 import jax.numpy as jnp
@@ -51,6 +52,7 @@ from hetu_tpu.exec.checkpoint import (AsyncCheckpointer, CheckpointError,
                                       load_checkpoint, load_state_dict,
                                       save_checkpoint)
 from hetu_tpu.exec.partial import split_state_entries as _split_partial
+from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import journal as _obs_journal
 from hetu_tpu.obs import registry as _obs
 
@@ -448,6 +450,7 @@ class ResilientTrainer:
         # the in-flight async save (if any) holds a pre-anomaly snapshot;
         # make it durable before scanning so we roll back as little as
         # possible
+        t0 = time.perf_counter()
         self._ck.wait()
         gstep, gsd, gextra, greport = self._latest_gang_state()
         if gstep is not None:
@@ -466,6 +469,10 @@ class ResilientTrainer:
             _res_m()["rollbacks"].inc()
             _obs_journal.record("rollback", at_step=self._step,
                                 to_step=int(extra.get("step", step)))
+        # the restore itself is lost time: bill it to the goodput
+        # "rollback" bucket (the rejected steps were billed there by the
+        # Trainer.step seam as they happened)
+        _obs_goodput.record_event("rollback", time.perf_counter() - t0)
         self._step = int(extra.get("step", step))
         return self._step
 

@@ -24,6 +24,7 @@ import sys
 from typing import Optional, Sequence
 
 from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs.fleet import ENV_OBS_SNAPSHOT
 
 __all__ = ["DistConfig", "HostSpec", "initialize", "launch", "simulate_workers",
            "worker_env", "embed_server_addresses", "main"]
@@ -177,8 +178,9 @@ def launch(cfg: DistConfig, argv: Sequence[str],
     ``"server:<addr>"``."""
     procs = []
     carry = [ENV_COORD, ENV_NPROC, ENV_PROC_ID, ENV_EMBED_SERVERS,
-             ENV_GANG_DIR, ENV_PARTIAL_DEADLINE, "JAX_PLATFORMS",
-             "XLA_FLAGS", "PYTHONPATH"] + sorted(extra_env or ())
+             ENV_GANG_DIR, ENV_PARTIAL_DEADLINE, ENV_OBS_SNAPSHOT,
+             "JAX_PLATFORMS", "XLA_FLAGS",
+             "PYTHONPATH"] + sorted(extra_env or ())
     for host, port in cfg.server_table():
         srv_argv = [sys.executable, "-m", "hetu_tpu.embed.net",
                     "--port", str(port)]
@@ -211,7 +213,8 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
                      timeout: float = 120.0, port: int = 0, faults=None,
                      restart_once: bool = False, gang_dir: Optional[str] = None,
                      allow_failures: bool = False,
-                     partial_deadline: Optional[float] = None) -> list:
+                     partial_deadline: Optional[float] = None,
+                     obs_snapshot: Optional[float] = None) -> list:
     """Run ``script`` in ``n`` local CPU processes joined into one jax
     distributed world.  Returns each process's stdout.  The CPU analogue of
     the reference's mpirun-on-localhost test pattern (tests/test_comm.py).
@@ -239,6 +242,13 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
     ``exec.partial.PartialReduceConfig.from_env()`` picks up for
     straggler-tolerant partial gradient reduction over the shared
     ``gang_dir`` (``exec.partial.GradientBoard``).
+
+    ``obs_snapshot``: exported as ``HETU_TPU_OBS_SNAPSHOT`` (requires
+    ``gang_dir``) — the fleet-telemetry publish interval in seconds.
+    Worker scripts that start a ``GangMembership`` then publish atomic
+    per-rank telemetry snapshots into ``<gang_dir>/obs/`` on the
+    heartbeat cadence, which ``obs.fleet.FleetAggregator`` (rank 0 or an
+    external observer) merges and serves on ``/fleet/*``.
 
     ``allow_failures``: a worker that still exits non-zero (after any
     ``restart_once`` retry) is recorded — its output gains a trailing
@@ -281,6 +291,12 @@ def simulate_workers(n: int, script: str, *, cpu_devices_per_proc: int = 1,
             env[ENV_GANG_DIR] = gang_dir
         if partial_deadline is not None:
             env[ENV_PARTIAL_DEADLINE] = str(float(partial_deadline))
+        if obs_snapshot is not None:
+            if gang_dir is None:
+                raise ValueError(
+                    "obs_snapshot needs gang_dir: fleet-telemetry "
+                    "snapshots are published into <gang_dir>/obs/")
+            env[ENV_OBS_SNAPSHOT] = str(float(obs_snapshot))
         env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU jax (sitecustomize)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +

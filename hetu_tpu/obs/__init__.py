@@ -16,7 +16,14 @@ production seams write to:
 - :mod:`~hetu_tpu.obs.journal` — append-only JSONL resilience event
   journal with monotonic sequence numbers;
 - :mod:`~hetu_tpu.obs.server` — stdlib-HTTP ``/metrics`` / ``/healthz``
-  endpoint (the ``exec/graphboard.py`` server pattern).
+  endpoint (the ``exec/graphboard.py`` server pattern);
+- :mod:`~hetu_tpu.obs.fleet` — the cross-worker plane: per-worker atomic
+  snapshot publication into the gang dir, rank-0 aggregation under a
+  ``worker`` label, merged journals, stitched traces, and the
+  ``/fleet/*`` endpoints;
+- :mod:`~hetu_tpu.obs.goodput` — online goodput buckets (useful /
+  straggler-wait / rollback / rescale / checkpoint / retune) and a
+  rolling MFU gauge from the bench's own flops model.
 
 Instrumented seams: ``embed.net.RemoteEmbeddingTable._rpc`` (latency,
 bytes, redials, errors), the HET caches (hit/miss), ``Trainer.step``
@@ -27,6 +34,9 @@ is disabled in one switch — ``obs.disable()`` or ``HETU_OBS=0`` — and
 the disabled path is a single global load + branch per seam.
 """
 
+from hetu_tpu.obs.fleet import (FleetAggregator, SnapshotPublisher,
+                                fleet_routes, serve_fleet)
+from hetu_tpu.obs.goodput import GoodputMeter
 from hetu_tpu.obs.journal import (EventJournal, get_journal, record,
                                   set_journal, use)
 from hetu_tpu.obs.registry import (DEFAULT_BUCKETS, Counter, Gauge,
@@ -44,4 +54,6 @@ __all__ = [
     "EventJournal", "get_journal", "set_journal", "use", "record",
     "TelemetryServer", "serve", "Routes", "RoutedHTTPServer",
     "telemetry_routes",
+    "SnapshotPublisher", "FleetAggregator", "fleet_routes", "serve_fleet",
+    "GoodputMeter",
 ]

@@ -1,0 +1,538 @@
+"""Fleet observability plane: cross-worker telemetry aggregation.
+
+Everything in ``obs/`` below this module is per-process — one registry,
+one journal, one tracer — while the runtime became a multi-process fleet
+(gang workers with heartbeat leases, ``GradientBoard`` arrival boards,
+serving replicas).  This module closes the gap with the same substrate
+the gang itself coordinates over — the shared directory — and the same
+atomic-write conventions as ``exec/gang.py`` leases and
+``GradientBoard`` posts (tmp + ``os.replace``; a reader sees the old
+snapshot or the new one, never a torn file):
+
+1. **Publication** — :class:`SnapshotPublisher`: each worker
+   periodically writes ``<gang_dir>/obs/worker_RRRR.snapshot.json``
+   containing its registry :meth:`~hetu_tpu.obs.registry.MetricsRegistry.
+   dump`, its journal events, and its finished spans
+   (:meth:`~hetu_tpu.obs.tracing.Tracer.span_dicts`).  The process-wide
+   hook (:func:`install_publisher` + :func:`maybe_publish`) is wired
+   into ``GangMembership.heartbeat`` — with ``HETU_OBS=0`` or no
+   publisher installed it is a single global load + branch, the
+   ``Trainer.step`` overhead contract.
+
+2. **Aggregation** — :class:`FleetAggregator` (rank 0, or any
+   observer): merges every worker's counters/gauges/histograms under a
+   ``worker`` label (histograms additionally merge bucket-wise via
+   :meth:`merged`; a family that already carries a ``worker`` label
+   keeps it and the publishing rank lands under ``publisher`` instead —
+   the Prometheus-federation clash rule), merges journals into one
+   globally-ordered stream (``(seq, worker)`` order, per-worker
+   gaplessness verified), and stitches Chrome traces with pid =
+   ``SPAN_PID + rank`` so worker 3's overrunning step span is visible
+   against everyone else's.
+
+3. **Endpoints** — :func:`fleet_routes` on the existing ``Routes``
+   table (one port can serve both ``/metrics`` and the fleet surface):
+
+   - ``/fleet/metrics``     aggregated Prometheus text exposition
+   - ``/fleet/healthz``     per-worker snapshot age, stale workers flagged
+   - ``/fleet/journal``     merged stream (``?since=<index>`` / ``?n=``)
+   - ``/fleet/stragglers``  top-k worker arrival-lag report
+     (``hetu_partial_worker_lag_seconds`` EWMAs — the future adaptive
+     deadline's input)
+   - ``/fleet/trace``       stitched Chrome trace JSON
+   - ``/fleet/goodput``     the installed goodput meter's snapshot
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _registry
+from hetu_tpu.obs import tracing as _tracing
+from hetu_tpu.obs.registry import _fmt, _sample_key
+from hetu_tpu.obs.server import PROM_CONTENT_TYPE, RoutedHTTPServer, Routes
+
+__all__ = ["SnapshotPublisher", "FleetAggregator", "fleet_routes",
+           "serve_fleet", "snapshot_path", "install_publisher",
+           "get_publisher", "maybe_publish", "publisher_from_env",
+           "SNAPSHOT_FORMAT", "ENV_OBS_SNAPSHOT"]
+
+SNAPSHOT_FORMAT = "hetu-fleet-snapshot-v1"
+
+# Exported by ``launch.simulate_workers`` (value = publish interval in
+# seconds); ``GangMembership.start`` builds a publisher from it.
+ENV_OBS_SNAPSHOT = "HETU_TPU_OBS_SNAPSHOT"
+
+_SNAP_RE = re.compile(r"^worker_(\d+)\.snapshot\.json$")
+
+
+def snapshot_dir(gang_dir: str) -> str:
+    return os.path.join(gang_dir, "obs")
+
+
+def snapshot_path(gang_dir: str, rank: int) -> str:
+    return os.path.join(snapshot_dir(gang_dir),
+                        f"worker_{int(rank):04d}.snapshot.json")
+
+
+class SnapshotPublisher:
+    """One worker's telemetry publication handle.
+
+    ``publish()`` atomically replaces this rank's snapshot file with the
+    current registry dump + journal events + finished spans.  The
+    injectable ``clock`` only throttles the ``force=False`` cadence
+    (heartbeat-driven publication); the snapshot's ``ts`` uses it too,
+    so deterministic tests control staleness exactly.  ``journal_tail``
+    and ``span_tail`` cap how many trailing journal events / finished
+    spans ride each snapshot (None = all; long runs should cap — the
+    merged stream is for operations, the full history is on each
+    worker's own journal file, and publish cost must stay O(tail), not
+    O(run length): the heartbeat seam serializes inline)."""
+
+    def __init__(self, gang_dir: str, rank: int, *, interval: float = 0.5,
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 journal: Optional[_journal.EventJournal] = None,
+                 tracer: Optional[_tracing.Tracer] = None,
+                 clock: Callable[[], float] = time.time,
+                 journal_tail: Optional[int] = None,
+                 span_tail: Optional[int] = None):
+        self.gang_dir = gang_dir
+        self.rank = int(rank)
+        self.interval = float(interval)
+        self.registry = registry
+        self.journal = journal
+        self.tracer = tracer
+        self.clock = clock
+        self.journal_tail = journal_tail
+        self.span_tail = span_tail
+        self.published = 0          # publication sequence number
+        self._last: Optional[float] = None
+        # publication happens from both the gang heartbeat daemon thread
+        # and direct heartbeat()/leave() calls on the main thread — the
+        # lock keeps seq/interval state consistent and the thread ident
+        # in the tmp name keeps concurrent writers off each other's file
+        self._lock = threading.Lock()
+
+    def publish(self, force: bool = True) -> Optional[str]:
+        """Write the snapshot; returns its path, or None when telemetry
+        is disabled or (``force=False``) the interval has not elapsed."""
+        if not _registry.enabled():
+            return None
+        with self._lock:
+            now = self.clock()
+            if not force and self._last is not None \
+                    and now - self._last < self.interval:
+                return None
+            reg = self.registry if self.registry is not None \
+                else _registry.get_registry()
+            j = self.journal if self.journal is not None \
+                else _journal.get_journal()
+            events = list(j.events) if j is not None else []
+            if self.journal_tail is not None:
+                events = events[-int(self.journal_tail):]
+            tr = self.tracer if self.tracer is not None \
+                else _tracing.get_tracer()
+            spans = tr.span_dicts()
+            if self.span_tail is not None:
+                spans = spans[-int(self.span_tail):]
+            self.published += 1
+            body = {"format": SNAPSHOT_FORMAT, "worker": self.rank,
+                    "seq": self.published, "ts": now,
+                    "registry": reg.dump(), "journal": events,
+                    "spans": spans}
+            path = snapshot_path(self.gang_dir, self.rank)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            # GradientBoard/lease convention: tmp + replace, never torn
+            tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(body))
+            os.replace(tmp, path)
+            self._last = now
+            return path
+
+
+# ---------------------------------------------- process-wide publication
+
+_publisher: Optional[SnapshotPublisher] = None
+
+
+def install_publisher(pub: Optional[SnapshotPublisher]
+                      ) -> Optional[SnapshotPublisher]:
+    """Install ``pub`` as the process-wide publisher :func:`maybe_publish`
+    drives (None uninstalls).  Returns the publisher."""
+    global _publisher
+    _publisher = pub
+    return pub
+
+
+def get_publisher() -> Optional[SnapshotPublisher]:
+    return _publisher
+
+
+def maybe_publish() -> bool:
+    """Interval-throttled publication on the installed publisher — the
+    seam ``GangMembership.heartbeat`` calls.  With no publisher installed
+    (or ``HETU_OBS=0``) this is a single global load + branch."""
+    p = _publisher
+    if p is None:
+        return False
+    return p.publish(force=False) is not None
+
+
+def publisher_from_env(gang_dir: str, rank: int
+                       ) -> Optional[SnapshotPublisher]:
+    """Build a publisher from the launcher's environment
+    (:data:`ENV_OBS_SNAPSHOT` = publish interval, exported by
+    ``launch.simulate_workers`` when a gang dir is in play); None when
+    unset or telemetry is disabled.  The env path is the long-running
+    production wiring, so it caps the journal/span tails: publication
+    rides the heartbeat inline and must stay O(tail) per publish, not
+    O(run length)."""
+    raw = os.environ.get(ENV_OBS_SNAPSHOT)
+    if raw is None or not _registry.enabled():
+        return None
+    return SnapshotPublisher(gang_dir, rank, interval=float(raw),
+                             journal_tail=512, span_tail=1024)
+
+
+# ------------------------------------------------------------ aggregation
+
+class FleetAggregator:
+    """Rank-0 (or external observer) merge over the workers' published
+    snapshots.  ``refresh()`` re-reads the snapshot directory; every
+    read-side method works off the last refresh, so one scrape is one
+    directory read however many series it renders.
+
+    Schema conflicts (the same family name published with a different
+    kind, label schema, or bucket bounds by different workers) keep the
+    first worker's schema; the conflicting worker's family is dropped
+    from that merge and reported in :meth:`healthz` — a conflict is an
+    instrumentation bug to surface, not to silently sum over."""
+
+    def __init__(self, gang_dir: str, *, stale_after: float = 5.0,
+                 clock: Callable[[], float] = time.time):
+        self.gang_dir = gang_dir
+        self.stale_after = float(stale_after)
+        self.clock = clock
+        self.snapshots: dict = {}      # rank -> parsed snapshot body
+        self.conflicts: list = []      # [(family, worker, diagnosis)]
+
+    def refresh(self) -> dict:
+        """Re-read every ``worker_*.snapshot.json``; unparseable or
+        alien-format files are skipped (atomic replace means they should
+        not exist; a partially-copied dir might).  Returns the snapshot
+        map ``{rank: body}``."""
+        out: dict = {}
+        d = snapshot_dir(self.gang_dir)
+        try:
+            names = os.listdir(d)
+        except (FileNotFoundError, NotADirectoryError):
+            names = []
+        for name in sorted(names):
+            m = _SNAP_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    body = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(body, dict) \
+                    or body.get("format") != SNAPSHOT_FORMAT:
+                continue
+            out[int(m.group(1))] = body
+        self.snapshots = out
+        return out
+
+    # -- metric merge -------------------------------------------------------
+
+    def _families(self) -> dict:
+        """``{name: (schema, [(worker, family_entry)])}`` across workers,
+        first-schema-wins; conflicting entries recorded and dropped."""
+        self.conflicts = []
+        fams: dict = {}
+        for rank in sorted(self.snapshots):
+            for ent in self.snapshots[rank].get(
+                    "registry", {}).get("families", []):
+                name = ent["name"]
+                if name not in fams:
+                    fams[name] = (ent, [(rank, ent)])
+                    continue
+                schema, members = fams[name]
+                if (ent["kind"] != schema["kind"]
+                        or ent["labelnames"] != schema["labelnames"]
+                        or ent.get("buckets") != schema.get("buckets")):
+                    self.conflicts.append(
+                        (name, rank,
+                         f"kind/labels/buckets disagree with worker "
+                         f"{members[0][0]}'s registration"))
+                    continue
+                members.append((rank, ent))
+        return fams
+
+    def render_prometheus(self) -> str:
+        """Aggregated text exposition: every worker's series under a
+        ``worker`` label, plus the fleet meta-series
+        (``hetu_fleet_workers``, ``hetu_fleet_snapshot_age_seconds``)."""
+        now = self.clock()
+        lines = [
+            "# HELP hetu_fleet_workers workers with a published "
+            "telemetry snapshot",
+            "# TYPE hetu_fleet_workers gauge",
+            f"hetu_fleet_workers {len(self.snapshots)}",
+            "# HELP hetu_fleet_snapshot_age_seconds seconds since each "
+            "worker's last telemetry snapshot",
+            "# TYPE hetu_fleet_snapshot_age_seconds gauge",
+        ]
+        for rank in sorted(self.snapshots):
+            age = max(now - float(self.snapshots[rank].get("ts", 0.0)), 0.0)
+            lines.append(_sample_key("hetu_fleet_snapshot_age_seconds",
+                                     ("worker",), (str(rank),))
+                         + f" {_fmt(age)}")
+        fams = self._families()
+        for name in sorted(fams):
+            schema, members = fams[name]
+            if schema["help"]:
+                help_text = schema["help"].replace("\\", "\\\\").replace(
+                    "\n", "\\n")
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {schema['kind']}")
+            labelnames = tuple(schema["labelnames"])
+            # a family that already carries a `worker` label (per-rank
+            # gauges like hetu_gang_worker_alive) keeps it — the
+            # publishing rank then lands under `publisher` instead, the
+            # Prometheus-federation clash rule (duplicate label names are
+            # invalid exposition)
+            wlabel = "publisher" if "worker" in labelnames else "worker"
+            for rank, ent in members:
+                w = str(rank)
+                for child in ent["children"]:
+                    values = tuple(str(v) for v in child["labels"])
+                    if schema["kind"] == "histogram":
+                        bounds = list(schema["buckets"]) + [float("inf")]
+                        acc = 0
+                        for b, c in zip(bounds, child["counts"]):
+                            acc += c
+                            lines.append(_sample_key(
+                                name + "_bucket",
+                                labelnames + (wlabel, "le"),
+                                values + (w, _fmt(b))) + f" {acc}")
+                        lines.append(_sample_key(
+                            name + "_sum", labelnames + (wlabel,),
+                            values + (w,)) + f" {_fmt(child['sum'])}")
+                        lines.append(_sample_key(
+                            name + "_count", labelnames + (wlabel,),
+                            values + (w,)) + f" {child['count']}")
+                    else:
+                        lines.append(_sample_key(
+                            name, labelnames + (wlabel,),
+                            values + (w,)) + f" {_fmt(child['value'])}")
+        return "\n".join(lines) + "\n"
+
+    def merged(self, name: str, agg: str = "sum") -> Optional[dict]:
+        """Fleet-wide merge of one family across workers, keyed by the
+        family's own label values (the ``worker`` dimension folded away):
+
+        - counters/gauges → ``{labels_tuple: float}`` (``agg``: ``sum``
+          or ``max`` — ``max`` is right for per-worker gauges every
+          publisher mirrors, like the straggler-lag EWMA);
+        - histograms → ``{labels_tuple: {"counts", "sum", "count"}}``
+          merged **bucket-wise** (bounds are schema-checked, so counts
+          add index by index).
+
+        Returns ``{"kind", "labelnames", "buckets"?, "children"}`` or
+        None when no worker published the family."""
+        fams = self._families()
+        if name not in fams:
+            return None
+        schema, members = fams[name]
+        out: dict = {"kind": schema["kind"],
+                     "labelnames": tuple(schema["labelnames"]),
+                     "children": {}}
+        if schema["kind"] == "histogram":
+            out["buckets"] = tuple(schema["buckets"])
+        kids = out["children"]
+        for _rank, ent in members:
+            for child in ent["children"]:
+                key = tuple(str(v) for v in child["labels"])
+                if schema["kind"] == "histogram":
+                    cur = kids.setdefault(
+                        key, {"counts": [0] * len(child["counts"]),
+                              "sum": 0.0, "count": 0})
+                    cur["counts"] = [a + b for a, b in
+                                     zip(cur["counts"], child["counts"])]
+                    cur["sum"] += float(child["sum"])
+                    cur["count"] += int(child["count"])
+                elif agg == "max":
+                    kids[key] = max(kids.get(key, float("-inf")),
+                                    float(child["value"]))
+                else:
+                    kids[key] = kids.get(key, 0.0) + float(child["value"])
+        return out
+
+    # -- journal merge ------------------------------------------------------
+
+    def merged_journal(self, strict: bool = True) -> list:
+        """Every worker's journal events in one globally-ordered stream:
+        sorted by ``(seq, worker)``, each event tagged with its
+        ``worker`` rank.  ``strict`` verifies each worker's sequence is
+        gapless (raises ``ValueError`` naming the worker — a gap means a
+        lost write, exactly like ``EventJournal.read``)."""
+        merged = []
+        for rank in sorted(self.snapshots):
+            events = self.snapshots[rank].get("journal", [])
+            if strict and events:
+                first = int(events[0].get("seq", 0))
+                for i, e in enumerate(events):
+                    if int(e.get("seq", -1)) != first + i:
+                        raise ValueError(
+                            f"fleet journal: worker {rank} has a "
+                            f"sequence gap at local index {i} (expected "
+                            f"seq {first + i}, found {e.get('seq')})")
+            merged.extend({**e, "worker": rank} for e in events)
+        merged.sort(key=lambda e: (e.get("seq", 0), e["worker"]))
+        return merged
+
+    # -- health / stragglers / traces ---------------------------------------
+
+    def healthz(self) -> dict:
+        """Per-worker snapshot freshness: age, publication seq, journal
+        length; workers whose snapshot is older than ``stale_after`` are
+        flagged and flip the status to ``degraded`` (so a wedged worker
+        is one scrape away from being named, not inferred)."""
+        self._families()  # (re)compute schema conflicts for this report
+        now = self.clock()
+        workers, stale = {}, []
+        for rank in sorted(self.snapshots):
+            body = self.snapshots[rank]
+            age = max(now - float(body.get("ts", 0.0)), 0.0)
+            is_stale = age > self.stale_after
+            if is_stale:
+                stale.append(rank)
+            workers[str(rank)] = {
+                "age_s": round(age, 3), "seq": body.get("seq"),
+                "journal_events": len(body.get("journal", [])),
+                "spans": len(body.get("spans", [])),
+                "stale": is_stale}
+        return {"status": "degraded" if stale or self.conflicts else "ok",
+                "workers": workers, "stale_workers": stale,
+                "stale_after_s": self.stale_after,
+                "schema_conflicts": [
+                    {"family": f, "worker": w, "diagnosis": d}
+                    for f, w, d in self.conflicts]}
+
+    def stragglers(self, k: int = 5) -> list:
+        """Top-``k`` stragglers by arrival-lag EWMA
+        (``hetu_partial_worker_lag_seconds{worker=}``, max across
+        publishers — every observer of the cut publishes its view of the
+        same lag).  Each entry: ``{"worker", "lag", "snapshot_age_s"}``,
+        sorted worst-first — the adaptive deadline's input."""
+        lag = self.merged("hetu_partial_worker_lag_seconds", agg="max")
+        if lag is None:
+            return []
+        now = self.clock()
+        out = []
+        for labels, value in lag["children"].items():
+            w = int(dict(zip(lag["labelnames"], labels))["worker"])
+            body = self.snapshots.get(w, {})
+            out.append({"worker": w, "lag": value,
+                        "snapshot_age_s": round(
+                            max(now - float(body.get("ts", now)), 0.0), 3)})
+        out.sort(key=lambda e: (-e["lag"], e["worker"]))
+        return out[:max(int(k), 0)]
+
+    def stitched_trace_events(self) -> list:
+        """Every worker's spans as one Chrome timeline, pid =
+        ``SPAN_PID + rank`` (``tracing.span_pid``) — concatenable with an
+        XProf capture exactly like the single-process export."""
+        events = []
+        for rank in sorted(self.snapshots):
+            spans = self.snapshots[rank].get("spans", [])
+            events.extend(
+                _tracing.spans_to_chrome_events(spans, worker=rank))
+        return events
+
+
+# -------------------------------------------------------------- endpoints
+
+def fleet_routes(aggregator: FleetAggregator,
+                 routes: Optional[Routes] = None) -> Routes:
+    """Register the fleet surface on ``routes`` (default: a fresh table —
+    pass ``telemetry_routes()`` to serve ``/metrics`` and ``/fleet/*``
+    from one port).  Every handler refreshes the aggregator, so a scrape
+    always reflects the snapshots on disk."""
+    routes = routes if routes is not None else Routes()
+
+    def metrics(q, b):
+        aggregator.refresh()
+        return aggregator.render_prometheus().encode(), PROM_CONTENT_TYPE
+
+    def healthz(q, b):
+        aggregator.refresh()
+        return json.dumps(aggregator.healthz()).encode(), "application/json"
+
+    def journal(q, b):
+        # NOTE: unlike the per-process /journal?since=<seq> (a stable,
+        # gapless per-journal sequence number), the fleet form's cursor
+        # is a POSITION in the current (seq, worker)-ordered merge — it
+        # is stable while the worker set is, but a restarted worker's
+        # journal re-seeds seq at 1 and its new events sort before an
+        # old cursor.  Collectors that must survive restarts should
+        # track (worker, seq) pairs from the events themselves.
+        aggregator.refresh()
+        merged = aggregator.merged_journal(strict=False)
+        if "since" in q:
+            since = int(q["since"][0])
+            merged = merged[since:]
+            if "n" in q:
+                merged = merged[:int(q["n"][0])]
+        else:
+            merged = merged[-int(q.get("n", ["100"])[0]):]
+        return json.dumps(merged).encode(), "application/json"
+
+    def stragglers(q, b):
+        aggregator.refresh()
+        k = int(q.get("k", ["5"])[0])
+        return (json.dumps(aggregator.stragglers(k)).encode(),
+                "application/json")
+
+    def trace(q, b):
+        aggregator.refresh()
+        return (json.dumps(
+            {"traceEvents": aggregator.stitched_trace_events()}).encode(),
+            "application/json")
+
+    def goodput(q, b):
+        from hetu_tpu.obs import goodput as _goodput
+        m = _goodput.get_meter()
+        body = m.snapshot() if m is not None else {}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/fleet/metrics", metrics)
+    routes.add("GET", "/fleet/healthz", healthz)
+    routes.add("GET", "/fleet/journal", journal)
+    routes.add("GET", "/fleet/stragglers", stragglers)
+    routes.add("GET", "/fleet/trace", trace)
+    routes.add("GET", "/fleet/goodput", goodput)
+    return routes
+
+
+def serve_fleet(gang_dir: str, port: int = 0, host: str = "127.0.0.1", *,
+                stale_after: float = 5.0,
+                with_telemetry: bool = True) -> RoutedHTTPServer:
+    """Start the rank-0 fleet scrape server: ``/fleet/*`` over
+    ``gang_dir``'s snapshots, plus (``with_telemetry``) this process's
+    own ``/metrics``/``/healthz``/``/journal`` on the same port."""
+    from hetu_tpu.obs.server import telemetry_routes
+    agg = FleetAggregator(gang_dir, stale_after=stale_after)
+    routes = telemetry_routes() if with_telemetry else Routes()
+    fleet_routes(agg, routes)
+    srv = RoutedHTTPServer(routes, port, host, thread_name="hetu-fleet-http")
+    srv.aggregator = agg
+    return srv.start()

@@ -109,6 +109,14 @@ class EventJournal:
     def of_kind(self, *kinds: str) -> list:
         return [e for e in self.events if e["kind"] in kinds]
 
+    def events_since(self, seq: int) -> list:
+        """Events with ``seq`` strictly greater than the cursor — the
+        incremental-poll form ``/journal?since=`` and the fleet
+        aggregator use.  ``seq`` numbers are gapless and 1-based, so the
+        slice is O(returned), not a scan."""
+        with self._lock:
+            return self.events[max(int(seq), 0):]
+
     def close(self) -> None:
         with self._lock:
             if self._f is not None:

@@ -211,6 +211,40 @@ class Histogram(_Child):
             out.append((math.inf, acc + self._counts[-1]))
         return out
 
+    @staticmethod
+    def quantile_from_cumulative(cum_before, cum_after, q: float):
+        """Quantile from the delta of two :meth:`cumulative` snapshots.
+        Prometheus-style linear interpolation inside the winning bucket;
+        the +Inf bucket reports its lower edge.  None when the delta is
+        empty.  The single quantile implementation in the tree —
+        ``bench.py --mode serve`` and the serving ``/stats`` summary both
+        call through here."""
+        delta = [(le, a - b)
+                 for (le, a), (_, b) in zip(cum_after, cum_before)]
+        total = delta[-1][1]
+        if total <= 0:
+            return None
+        rank = q * total
+        prev_le, prev_c = 0.0, 0
+        for le, c in delta:
+            if c >= rank:
+                if le == math.inf:
+                    return prev_le
+                if c == prev_c:
+                    return le
+                return prev_le + (le - prev_le) * (rank - prev_c) / (c - prev_c)
+            prev_le, prev_c = (le if le != math.inf else prev_le), c
+        return delta[-1][0]
+
+    def quantile(self, q: float, since=None):
+        """Quantile over everything observed since ``since`` (a
+        :meth:`cumulative` snapshot taken earlier; default: since the
+        histogram was created)."""
+        cum = self.cumulative()
+        if since is None:
+            since = [(le, 0) for le, _c in cum]
+        return self.quantile_from_cumulative(since, cum, q)
+
 
 _CHILD_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
@@ -378,6 +412,37 @@ class MetricsRegistry:
                     out[_sample_key(fam.name, fam.labelnames,
                                     values)] = child.value
         return out
+
+    def dump(self) -> dict:
+        """Structured, JSON-serializable export of every family — schema
+        (kind, help, label names, histogram bucket bounds) plus raw child
+        state (per-bucket counts, not cumulative).  This is the form one
+        process can hand another for re-aggregation: ``obs.fleet``
+        publishes it in worker snapshots and merges it back under a
+        ``worker`` label, which the flat :meth:`snapshot` sample keys
+        could only support by re-parsing."""
+        fams = []
+        for fam in list(self._families.values()):
+            with fam._lock:  # vs. concurrent labels() child creation
+                children = sorted(fam._children.items())
+            ent = {"name": fam.name, "kind": fam.kind, "help": fam.help,
+                   "labelnames": list(fam.labelnames)}
+            if fam.kind == "histogram":
+                ent["buckets"] = list(fam.buckets)
+            kids = []
+            for values, child in children:
+                if fam.kind == "histogram":
+                    with child._lock:
+                        kids.append({"labels": list(values),
+                                     "counts": list(child._counts),
+                                     "sum": child._sum,
+                                     "count": child._count})
+                else:
+                    kids.append({"labels": list(values),
+                                 "value": child.value})
+            ent["children"] = kids
+            fams.append(ent)
+        return {"families": fams}
 
     def delta(self, new: dict, old: dict) -> dict:
         """Difference of two :meth:`snapshot` dicts: monotonic samples

@@ -16,7 +16,8 @@ register handlers instead of copy-pasting the HTTP plumbing:
   - ``/metrics``       Prometheus text exposition 0.0.4 of the registry
   - ``/metrics.json``  the same samples as a JSON snapshot
   - ``/healthz``       liveness JSON: status, uptime, last journal seq
-  - ``/journal``       tail of the installed event journal (``?n=100``)
+  - ``/journal``       installed event journal: tail (``?n=100``) or
+    cursor pagination (``?since=<seq>``, incremental polls)
 
 ``serve()`` returns a started :class:`TelemetryServer` whose daemon
 thread renders each scrape on demand — a training loop needs no extra
@@ -180,9 +181,20 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
     routes.add("GET", "/healthz", healthz)
 
     def journal_tail(q, b):
+        """Tail form (``?n=100``, newest suffix) or cursor form
+        (``?since=<seq>``, everything after the gapless sequence number,
+        oldest first, optionally capped by ``?n=``) — the incremental
+        poll the fleet aggregator and external collectors use instead of
+        re-reading the whole stream every scrape."""
         j = _journal.get_journal()
-        n = int(q.get("n", ["100"])[0])
-        events = j.events[-n:] if j is not None else []
+        if j is None:
+            events = []
+        elif "since" in q:
+            events = j.events_since(int(q["since"][0]))
+            if "n" in q:
+                events = events[:int(q["n"][0])]
+        else:
+            events = j.events[-int(q.get("n", ["100"])[0]):]
         return json.dumps(events).encode(), "application/json"
 
     routes.add("GET", "/journal", journal_tail)

@@ -32,11 +32,49 @@ from typing import Callable, Optional
 
 from hetu_tpu.obs import registry as _registry
 
-__all__ = ["Span", "Tracer", "get_tracer", "span", "current_span"]
+__all__ = ["Span", "Tracer", "get_tracer", "span", "current_span",
+           "span_pid", "spans_to_chrome_events"]
 
 # Chrome trace-event pid reserved for runtime spans: far away from XProf's
 # device/host pids so a merged trace shows them as their own process row.
+# In a stitched FLEET trace (obs.fleet) each worker's spans render at
+# pid = SPAN_PID + rank — the same offset scheme generalized, so worker 3
+# overrunning everyone else's step span is one glance at four rows.
 SPAN_PID = 88888
+
+
+def span_pid(worker=None) -> int:
+    """Chrome-trace pid for one process's runtime spans: the reserved
+    base for a standalone process, ``SPAN_PID + rank`` for gang worker
+    ``rank`` in a stitched fleet timeline."""
+    return SPAN_PID if worker is None else SPAN_PID + int(worker)
+
+
+def spans_to_chrome_events(span_dicts, *, worker=None,
+                           label: Optional[str] = None) -> list:
+    """Serialized span dicts (see :meth:`Tracer.span_dicts`) → complete
+    (``ph: X``) Chrome trace events plus a process_name metadata event.
+    Lives here — not in the aggregator — so the pid-offset scheme has
+    one owner; ``obs.fleet`` calls this per worker and concatenates."""
+    pid = span_pid(worker)
+    if label is None:
+        label = ("hetu-tpu runtime spans" if worker is None
+                 else f"hetu-tpu runtime spans (worker {worker})")
+    events = [{"ph": "M", "name": "process_name", "pid": pid,
+               "args": {"name": label}}]
+    for sp in span_dicts:
+        start = sp["start"]
+        end = sp.get("end")
+        events.append({
+            "ph": "X", "name": sp["name"], "pid": pid,
+            "tid": 1 if sp.get("parent_id") is None else 2,
+            "ts": start * 1e6,
+            "dur": ((end - start) if end is not None else 0.0) * 1e6,
+            "args": {"trace_id": sp["trace_id"], "span_id": sp["span_id"],
+                     "parent_id": sp.get("parent_id"),
+                     **{k: str(v) for k, v in sp.get("attrs", {}).items()}},
+        })
+    return events
 
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "hetu_obs_span", default=None)
@@ -154,23 +192,22 @@ class Tracer:
 
     # -- export -------------------------------------------------------------
 
-    def to_chrome_events(self) -> list:
+    def span_dicts(self) -> list:
+        """Finished spans as plain JSON-serializable dicts — the form a
+        fleet telemetry snapshot publishes so rank 0 can stitch every
+        worker's timeline (:func:`spans_to_chrome_events`)."""
+        return [{"name": sp.name, "trace_id": sp.trace_id,
+                 "span_id": sp.span_id, "parent_id": sp.parent_id,
+                 "start": sp.start, "end": sp.end_time,
+                 "attrs": {k: str(v) for k, v in sp.attrs.items()}}
+                for sp in self.spans]
+
+    def to_chrome_events(self, worker=None) -> list:
         """Complete (``ph: X``) trace events plus a process_name metadata
         event, timestamps in microseconds — the traceEvents schema XProf
-        emits, so the two merge by list concatenation."""
-        events = [{"ph": "M", "name": "process_name", "pid": SPAN_PID,
-                   "args": {"name": "hetu-tpu runtime spans"}}]
-        for sp in self.spans:
-            events.append({
-                "ph": "X", "name": sp.name, "pid": SPAN_PID,
-                "tid": 1 if sp.parent_id is None else 2,
-                "ts": sp.start * 1e6,
-                "dur": (sp.duration or 0.0) * 1e6,
-                "args": {"trace_id": sp.trace_id, "span_id": sp.span_id,
-                         "parent_id": sp.parent_id,
-                         **{k: str(v) for k, v in sp.attrs.items()}},
-            })
-        return events
+        emits, so the two merge by list concatenation.  ``worker`` offsets
+        the pid (``SPAN_PID + rank``) for stitched fleet timelines."""
+        return spans_to_chrome_events(self.span_dicts(), worker=worker)
 
     def export_chrome(self, path: str) -> str:
         """Write ``{"traceEvents": [...]}`` (gzipped when the path ends in

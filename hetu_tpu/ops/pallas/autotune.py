@@ -335,6 +335,16 @@ def _sweep(candidates, measure, *, budget_s: float | None,
             table[name] = f"failed: {str(e)[:120]}"
         if verbose:
             print(f"autotune[{tag}]: {name} -> {table[name]}")
+    # the sweep's wall cost is lost training time: journal it (kind
+    # "retune", duration_s) — a no-op when no journal is installed.  The
+    # goodput "retune" bucket is billed from this event alone, via
+    # GoodputMeter.ingest, exactly like checkpoint_saved: one billing
+    # path, so a driver that polls the journal into its meter never
+    # double-counts a sweep
+    dt = time.perf_counter() - t_start
+    from hetu_tpu.obs import journal as _journal
+    _journal.record("retune", kernel=tag.split()[0], candidates=len(table),
+                    duration_s=round(dt, 6))
     return table
 
 

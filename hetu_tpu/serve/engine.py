@@ -516,13 +516,26 @@ class ServingEngine:
     # -- introspection ------------------------------------------------------
 
     def stats(self) -> dict:
-        """The ``/stats`` payload: scheduler + pool occupancy and the
-        serving counters' current values."""
+        """The ``/stats`` payload: scheduler + pool occupancy, the
+        serving counters' current values, and an SLO quantile summary
+        (TTFT / per-token latency p50+p99 from the serving histograms,
+        via ``Histogram.quantile`` — the same quantile implementation
+        ``bench.py --mode serve`` reports)."""
         with self._lock:
             reg = _obs.get_registry()
             snap = {k: v for k, v in reg.snapshot().items()
                     if k.startswith("hetu_serve_") and "_bucket" not in k}
+            m = _serve_m()
+            slo = {}
+            for short, hist in (("ttft", m["ttft"]),
+                                ("token_latency", m["tok_latency"])):
+                h = hist.labels()
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    v = h.quantile(q)
+                    slo[f"{short}_{tag}_s"] = (None if v is None
+                                               else round(v, 6))
             return {
+                "slo": slo,
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,
                 "num_slots": self.batcher.num_slots,

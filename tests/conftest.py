@@ -56,7 +56,10 @@ def pytest_configure(config):
                    "processes are additionally marked slow")
     config.addinivalue_line(
         "markers", "obs: runtime telemetry tests (hetu_tpu.obs registry/"
-                   "tracing/journal/endpoint and the instrumented seams)")
+                   "tracing/journal/endpoint, the instrumented seams, and "
+                   "the fleet plane: snapshot publication, cross-worker "
+                   "aggregation, goodput/MFU accounting — a 2-worker "
+                   "fleet-scrape smoke stays in tier-1)")
     config.addinivalue_line(
         "markers", "serve: online-inference tests (hetu_tpu.serve KV-cache "
                    "pool / continuous batcher / engine / endpoint and the "

@@ -157,6 +157,62 @@ class TestRegistry:
         c.set_total(12)
         assert c.value == 12
 
+    def test_histogram_quantile(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("q_seconds", buckets=(0.1, 1.0)).labels()
+        assert h.quantile(0.5) is None  # nothing observed yet
+        for _ in range(4):
+            h.observe(0.05)
+        # all mass in the first bucket: linear interpolation inside it
+        assert 0.0 < h.quantile(0.5) <= 0.1
+        assert h.quantile(1.0) == pytest.approx(0.1)
+        since = h.cumulative()
+        for _ in range(10):
+            h.observe(0.5)
+        # windowed form: only the post-snapshot observations count
+        assert 0.1 < h.quantile(0.5, since=since) <= 1.0
+        # +Inf bucket reports its lower (finite) edge
+        h2 = reg.histogram("q2_seconds", buckets=(0.1,)).labels()
+        h2.observe(5.0)
+        assert h2.quantile(0.5) == 0.1
+
+    def test_bench_quantile_is_the_registry_implementation(self):
+        """Satellite: bench._hist_quantile delegates to
+        Histogram.quantile_from_cumulative — one quantile implementation
+        in the tree, not two."""
+        import math
+
+        import bench
+        before = [(0.1, 0), (1.0, 0), (math.inf, 0)]
+        after = [(0.1, 3), (1.0, 9), (math.inf, 10)]
+        for q in (0.1, 0.5, 0.9, 0.99):
+            assert bench._hist_quantile(before, after, q) == \
+                obs.Histogram.quantile_from_cumulative(before, after, q)
+        assert bench._hist_quantile(after, after, 0.5) is None
+
+    def test_dump_roundtrips_schema_and_state(self):
+        """registry.dump() is the re-aggregatable export the fleet plane
+        publishes: schema (kind/help/labels/buckets) + raw bucket counts
+        (NOT cumulative), JSON-serializable."""
+        reg = obs.MetricsRegistry()
+        reg.counter("dmp_total", "ct", ("op",)).labels(op="a").inc(3)
+        reg.gauge("dmp_gauge", "gg").set(2.5)
+        h = reg.histogram("dmp_seconds", "hh", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        d = json.loads(json.dumps(reg.dump()))  # JSON-serializable
+        fams = {f["name"]: f for f in d["families"]}
+        assert fams["dmp_total"]["kind"] == "counter"
+        assert fams["dmp_total"]["labelnames"] == ["op"]
+        assert fams["dmp_total"]["children"][0] == {"labels": ["a"],
+                                                    "value": 3.0}
+        assert fams["dmp_gauge"]["children"][0]["value"] == 2.5
+        hist = fams["dmp_seconds"]
+        assert hist["buckets"] == [0.1, 1.0]
+        child = hist["children"][0]
+        assert child["counts"] == [1, 2, 1]  # per-bucket, not cumulative
+        assert child["count"] == 4 and child["sum"] == pytest.approx(6.05)
+
 
 # ----------------------------------------------------------------- tracing
 
@@ -205,6 +261,57 @@ class TestTracing:
             obs.enable()
             tr.stop()
         assert tr.spans == []
+
+    def test_span_parentage_across_worker_threads(self):
+        """Satellite: the module docstring's ``contextvars.copy_context()``
+        recipe — a worker thread run under the copied context parents its
+        spans to the span current at copy time; a plain thread starts a
+        fresh trace."""
+        import contextvars
+        clock = iter(range(100))
+        tr = obs.Tracer(clock=lambda: next(clock))
+        with tr.collect():
+            with tr.span("driver"):
+                ctx = contextvars.copy_context()
+
+                def inherited():
+                    with tr.span("worker.pull"):
+                        pass
+
+                def orphan():
+                    with tr.span("worker.orphan"):
+                        pass
+
+                t1 = threading.Thread(target=lambda: ctx.run(inherited))
+                t2 = threading.Thread(target=orphan)
+                t1.start(); t1.join()
+                t2.start(); t2.join()
+        spans = {s.name: s for s in tr.spans}
+        driver = spans["driver"]
+        assert spans["worker.pull"].parent_id == driver.span_id
+        assert spans["worker.pull"].trace_id == driver.trace_id
+        # no copied context -> no inherited parentage (fresh trace root)
+        assert spans["worker.orphan"].parent_id is None
+        assert spans["worker.orphan"].trace_id != driver.trace_id
+
+    def test_stitched_pid_offset(self):
+        """span_pid / spans_to_chrome_events: worker rank offsets the
+        reserved pid so a stitched fleet trace shows one row per worker."""
+        from hetu_tpu.obs.tracing import (SPAN_PID, span_pid,
+                                          spans_to_chrome_events)
+        assert span_pid() == SPAN_PID
+        assert span_pid(3) == SPAN_PID + 3
+        clock = iter(range(10))
+        tr = obs.Tracer(clock=lambda: next(clock))
+        with tr.collect():
+            with tr.span("step"):
+                pass
+        ev = spans_to_chrome_events(tr.span_dicts(), worker=3)
+        assert all(e["pid"] == SPAN_PID + 3 for e in ev)
+        meta = [e for e in ev if e["ph"] == "M"][0]
+        assert "worker 3" in meta["args"]["name"]
+        # default export is unchanged (worker=None -> base pid)
+        assert all(e["pid"] == SPAN_PID for e in tr.to_chrome_events())
 
     def test_chrome_export_and_xprof_merge(self, tmp_path):
         clock = iter(range(10))
@@ -298,6 +405,15 @@ class TestJournal:
             t.join()
         assert [e["seq"] for e in j.events] == list(range(1, 401))
 
+    def test_events_since_cursor(self):
+        j = obs.EventJournal()
+        for kind in "abcde":
+            j.record(kind)
+        assert [e["kind"] for e in j.events_since(2)] == ["c", "d", "e"]
+        assert [e["kind"] for e in j.events_since(0)] == list("abcde")
+        assert j.events_since(-3) == j.events_since(0)
+        assert j.events_since(5) == [] and j.events_since(99) == []
+
 
 # ------------------------------------------------- /metrics endpoint smoke
 
@@ -355,6 +471,102 @@ def test_metrics_endpoint_live_training(tmp_path):
                                     timeout=10) as r:
             snap = json.loads(r.read())
         assert any(k.startswith("hetu_train_steps_total") for k in snap)
+
+
+def test_journal_endpoint_since_cursor():
+    """Satellite: /journal?since=<seq> cursor pagination — incremental
+    polls (the fleet aggregator's form) alongside the tail ?n= form."""
+    j = obs.EventJournal()
+    with obs.use(j), obs.serve() as srv:
+        for i in range(1, 6):
+            j.record("evt", i=i)
+
+        def get(qs):
+            with urllib.request.urlopen(srv.url + "/journal" + qs,
+                                        timeout=10) as r:
+                return [e["seq"] for e in json.loads(r.read())]
+
+        assert get("?since=3") == [4, 5]
+        assert get("?since=0") == [1, 2, 3, 4, 5]
+        assert get("?since=99") == []
+        assert get("?since=1&n=2") == [2, 3]  # cursor + cap composes
+        assert get("?n=2") == [4, 5]          # tail form unchanged
+        # incremental poll picks up exactly the new events
+        j.record("evt", i=6)
+        assert get("?since=5") == [6]
+
+
+def test_metric_naming_conventions():
+    """Satellite lint: every reg.counter/gauge/histogram registration in
+    the tree follows Prometheus conventions — hetu_ prefix, _total suffix
+    on counters (and never on gauges), unit suffixes on histograms — and
+    no two sites register the same name with a different kind, label
+    schema, or help text."""
+    import ast
+    import pathlib
+
+    import hetu_tpu
+    root = pathlib.Path(hetu_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + [root.parent / "bench.py"]
+    sites = {}  # name -> [(kind, labels_or_None, help_or_None, where)]
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge", "histogram")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            kind = node.func.attr
+            help_text = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                help_text = node.args[1].value
+            labels = None
+            label_node = node.args[2] if len(node.args) > 2 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "labelnames"), None)
+            if isinstance(label_node, (ast.Tuple, ast.List)):
+                labels = tuple(e.value for e in label_node.elts
+                               if isinstance(e, ast.Constant))
+            where = f"{path.relative_to(root.parent)}:{node.lineno}"
+            sites.setdefault(name, []).append(
+                (kind, labels, help_text, where))
+    assert len(sites) > 30, "scanner found suspiciously few registrations"
+    problems = []
+    for name, regs in sorted(sites.items()):
+        kinds = {k for k, _l, _h, _w in regs}
+        if len(kinds) > 1:
+            problems.append(f"{name}: registered as {sorted(kinds)} "
+                            f"at {[w for *_x, w in regs]}")
+            continue
+        kind = kinds.pop()
+        if not re.match(r"^hetu_[a-z0-9_]+$", name):
+            problems.append(f"{name}: not hetu_-prefixed lowercase "
+                            f"({regs[0][3]})")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(f"{name}: counter without _total ({regs[0][3]})")
+        if kind == "gauge" and name.endswith("_total"):
+            problems.append(f"{name}: gauge must not claim _total "
+                            f"({regs[0][3]})")
+        if kind == "histogram" and not name.endswith(
+                ("_seconds", "_bytes", "_steps")):
+            problems.append(f"{name}: histogram without a unit suffix "
+                            f"({regs[0][3]})")
+        # conflicting re-registration: among sites that state a schema
+        # (a help text or labels — a bare name is a family lookup, not a
+        # registration), everyone must agree
+        helps = {h for _k, _l, h, _w in regs if h is not None}
+        labels = {l for _k, l, _h, _w in regs if l is not None}
+        if len(helps) > 1:
+            problems.append(f"{name}: conflicting help texts at "
+                            f"{[w for *_x, w in regs]}")
+        if len(labels) > 1:
+            problems.append(f"{name}: conflicting label schemas "
+                            f"{sorted(labels)} at {[w for *_x, w in regs]}")
+    assert not problems, "\n".join(problems)
 
 
 def test_metrics_endpoint_404():

@@ -566,6 +566,13 @@ def test_infer_endpoint_live_engine():
         assert stats["pool"]["pages_used"] == 0
         assert any(k.startswith("hetu_serve_requests_total")
                    for k in stats["metrics"])
+        # SLO summary: TTFT quantiles through Histogram.quantile — the
+        # request above observed at least one TTFT, so p50 <= p99
+        slo = stats["slo"]
+        assert set(slo) == {"ttft_p50_s", "ttft_p99_s",
+                            "token_latency_p50_s", "token_latency_p99_s"}
+        assert slo["ttft_p50_s"] is not None
+        assert slo["ttft_p50_s"] <= slo["ttft_p99_s"]
         with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
             text = r.read().decode()
         for line in text.splitlines():
