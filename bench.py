@@ -579,15 +579,26 @@ def _hist_quantile(cum_before, cum_after, q: float):
     promoted into ``obs.registry.Histogram.quantile_from_cumulative``
     (the one quantile implementation in the tree; ``serve/engine.py``'s
     ``/stats`` summary uses the same code).  Kept as a thin alias for
-    bench-internal callers and tests."""
+    bench-internal callers and tests.  An empty delta reads ``nan``
+    (deterministic — see the registry docstring); :func:`_q_or_none`
+    maps that to a JSON-safe null for the metric line."""
     from hetu_tpu.obs.registry import Histogram
     return Histogram.quantile_from_cumulative(cum_before, cum_after, q)
+
+
+def _q_or_none(v, digits: int = 6):
+    """JSON has no NaN: empty-histogram quantiles become null."""
+    return None if v is None or v != v else round(v, digits)
 
 
 def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
                buckets):
     """Drive one seeded trace through a fresh engine on the real clock;
-    returns (decode tokens/s, ttft p50, ttft p99, completed)."""
+    returns (decode tokens/s, ttft p50, ttft p99, completed,
+    stage_decomposition) — the last is the SLO engine's per-stage
+    summary over the measured window, so a regression names the stage
+    that moved (queue vs prefill vs decode vs emit), not just a
+    ratio."""
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.models import GPT
     from hetu_tpu.obs import registry as _obs
@@ -608,6 +619,10 @@ def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
         eng.run_until_idle()
     hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
     cum0 = hist.cumulative()
+    # the warmup requests were graded too; summarize only the measured
+    # window by differencing the SLO engine's per-stage totals
+    stages0 = {s: v["total_s"] for s, v in eng.slo.stage_summary().items()}
+    n0 = eng.slo.requests
     handles = [eng.submit(list(it.prompt), it.max_new_tokens)
                for it in trace]
     t0 = time.perf_counter()
@@ -615,11 +630,20 @@ def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
     dt = time.perf_counter() - t0
     cum1 = hist.cumulative()
     done = [h for h in handles if h.status == "completed"]
+    stages1 = eng.slo.stage_summary()
+    n = max(eng.slo.requests - n0, 1)
+    totals = {s: stages1[s]["total_s"] - stages0[s] for s in stages1}
+    wall = sum(totals.values())
+    decomposition = {s: {"total_s": round(totals[s], 6),
+                         "mean_s": round(totals[s] / n, 6),
+                         "fraction": round(totals[s] / wall, 6)
+                         if wall > 0 else 0.0}
+                     for s in totals}
     # the first token of each request is prefill; the rest are decode
     decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
     return (decode_tokens / dt if dt > 0 else 0.0,
             _hist_quantile(cum0, cum1, 0.50),
-            _hist_quantile(cum0, cum1, 0.99), len(done))
+            _hist_quantile(cum0, cum1, 0.99), len(done), decomposition)
 
 
 def bench_serve(on_tpu, kind, peak):
@@ -648,16 +672,20 @@ def bench_serve(on_tpu, kind, peak):
         trace = generate_load(17, 8, vocab=cfg.vocab_size,
                               prompt_len=(2, 12), max_new=(2, 6),
                               mean_gap_s=0.0)
-    paged_tps, p50, p99, done = _serve_run(cfg, trace, paged=True, **kw)
-    gather_tps, g50, g99, gdone = _serve_run(cfg, trace, paged=False, **kw)
+    paged_tps, p50, p99, done, stages = _serve_run(
+        cfg, trace, paged=True, **kw)
+    gather_tps, g50, g99, gdone, gstages = _serve_run(
+        cfg, trace, paged=False, **kw)
     return _line(
         "serve_decode_tokens_per_sec", paged_tps, "tokens/s",
         paged_tps / gather_tps if gather_tps > 0 else 1.0,
-        ttft_p50_s=None if p50 is None else round(p50, 6),
-        ttft_p99_s=None if p99 is None else round(p99, 6),
+        ttft_p50_s=_q_or_none(p50),
+        ttft_p99_s=_q_or_none(p99),
+        stage_decomposition=stages,
         gather_tokens_per_sec=round(gather_tps, 2),
-        gather_ttft_p50_s=None if g50 is None else round(g50, 6),
-        gather_ttft_p99_s=None if g99 is None else round(g99, 6),
+        gather_ttft_p50_s=_q_or_none(g50),
+        gather_ttft_p99_s=_q_or_none(g99),
+        gather_stage_decomposition=gstages,
         requests=len(trace), completed=done, gather_completed=gdone,
         slots=kw["num_slots"], max_seq_len=kw["max_seq_len"],
         baseline_note="vs_baseline = paged/gather decode tokens/s on the "

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from hetu_tpu.core.module import Module, trainable_mask
 from hetu_tpu.core.rng import next_key
+from hetu_tpu.obs import compile as _obs_compile
 from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _obs_tracing
@@ -215,8 +216,12 @@ class Trainer:
             donate_args = (0,) if donate else ()
             train_step = jax.jit(train_step, donate_argnums=donate_args)
             eval_step = jax.jit(eval_step)
-        self._train_step = train_step
-        self._eval_step = eval_step
+        # compile-counting seams (obs.compile watch mode: the wrapped jit
+        # keeps dispatching — donation/sharding strategies unchanged — and
+        # the disabled path stays one global load + branch).  A recompile
+        # here is a shape-signature change the journal names.
+        self._train_step = _obs_compile.watch(train_step, site="train.step")
+        self._eval_step = _obs_compile.watch(eval_step, site="train.eval")
 
     @property
     def state(self) -> TrainState:
@@ -351,7 +356,10 @@ class Trainer:
                 body, (state, key), None, length=n_steps)
             return state, jax.tree_util.tree_map(lambda x: x[-1], stacked)
 
-        return jax.jit(run, donate_argnums=(0,))
+        # the step watcher passes tracer-stage calls through (the scan's
+        # program owns the compile), so the scan gets its own counted site
+        return _obs_compile.watch(jax.jit(run, donate_argnums=(0,)),
+                                  site="train.scan")
 
     def profile(self, batch, key=None, iters: int = 10) -> dict:
         """Wall-time + cost profile of one train step on the given batch

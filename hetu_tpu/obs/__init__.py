@@ -22,8 +22,21 @@ production seams write to:
   ``worker`` label, merged journals, stitched traces, and the
   ``/fleet/*`` endpoints;
 - :mod:`~hetu_tpu.obs.goodput` — online goodput buckets (useful /
-  straggler-wait / rollback / rescale / checkpoint / retune) and a
-  rolling MFU gauge from the bench's own flops model.
+  straggler-wait / rollback / rescale / checkpoint / retune / compile)
+  and a rolling MFU gauge from the bench's own flops model;
+- :mod:`~hetu_tpu.obs.reqtrace` — request-scope serving timelines: one
+  exact stage decomposition + span tree per request, kept in a bounded
+  ring with slowest-N exemplar retention, queryable via
+  ``/trace/<request_id>`` and stitchable with the fleet traces;
+- :mod:`~hetu_tpu.obs.slo` — the serving SLO engine: per-request
+  TTFT/TPOT/queue-age grading against env-configurable targets,
+  short+long-window burn rates, and the ``/slo`` shed-pressure gauge
+  (``/fleet/slo`` aggregates it);
+- :mod:`~hetu_tpu.obs.compile` — XLA compilation telemetry: exact
+  compile counting at the jit seams (serving step fns AOT,
+  ``Trainer.step`` watch-only), per-shape-signature compile cost and
+  ``memory_analysis`` bytes, ``recompile`` journal events carrying the
+  triggering shape delta, and a recompile-storm gauge.
 
 Instrumented seams: ``embed.net.RemoteEmbeddingTable._rpc`` (latency,
 bytes, redials, errors), the HET caches (hit/miss), ``Trainer.step``
@@ -34,9 +47,13 @@ is disabled in one switch — ``obs.disable()`` or ``HETU_OBS=0`` — and
 the disabled path is a single global load + branch per seam.
 """
 
+from hetu_tpu.obs.compile import (InstrumentedJit, StormDetector,
+                                  compile_report, instrument, watch)
 from hetu_tpu.obs.fleet import (FleetAggregator, SnapshotPublisher,
                                 fleet_routes, serve_fleet)
 from hetu_tpu.obs.goodput import GoodputMeter
+from hetu_tpu.obs.reqtrace import ReqTraceBuffer, RequestTimeline
+from hetu_tpu.obs.slo import SLOEngine, SLOTargets
 from hetu_tpu.obs.journal import (EventJournal, get_journal, record,
                                   set_journal, use)
 from hetu_tpu.obs.registry import (DEFAULT_BUCKETS, Counter, Gauge,
@@ -56,4 +73,8 @@ __all__ = [
     "telemetry_routes",
     "SnapshotPublisher", "FleetAggregator", "fleet_routes", "serve_fleet",
     "GoodputMeter",
+    "RequestTimeline", "ReqTraceBuffer",
+    "SLOEngine", "SLOTargets",
+    "InstrumentedJit", "StormDetector", "instrument", "watch",
+    "compile_report",
 ]

@@ -41,6 +41,9 @@ snapshot or the new one, never a torn file):
      deadline's input)
    - ``/fleet/trace``       stitched Chrome trace JSON
    - ``/fleet/goodput``     the installed goodput meter's snapshot
+   - ``/fleet/slo``         serving-SLO merge: summed stage seconds /
+     request verdicts / violations, worst-of-fleet burn rates and shed
+     pressure (max across workers — the router's placement input)
 """
 
 from __future__ import annotations
@@ -447,6 +450,40 @@ class FleetAggregator:
         out.sort(key=lambda e: (-e["lag"], e["worker"]))
         return out[:max(int(k), 0)]
 
+    def slo(self) -> dict:
+        """Fleet-wide serving-SLO merge over the workers' published
+        ``hetu_slo_*`` families: stage seconds / request verdicts /
+        per-target violations SUM across workers (they are counters of
+        disjoint requests), while burn rates and shed pressure take the
+        fleet MAX — the router must react to the worst replica, not the
+        average.  Empty dict values when no worker serves."""
+        out: dict = {"workers": len(self.snapshots)}
+        for key, family in (("stage_seconds", "hetu_slo_stage_seconds_total"),
+                            ("requests", "hetu_slo_requests_total"),
+                            ("violations", "hetu_slo_violations_total")):
+            m = self.merged(family)
+            out[key] = ({k[0]: v for k, v in m["children"].items()}
+                        if m is not None else {})
+        burn = self.merged("hetu_slo_burn_rate", agg="max")
+        rates: dict = {}
+        if burn is not None:
+            for labels, v in burn["children"].items():
+                d = dict(zip(burn["labelnames"], labels))
+                rates.setdefault(d["target"], {})[d["window"]] = v
+        out["burn_rates_max"] = rates
+        by_worker = {}
+        for rank in sorted(self.snapshots):
+            for ent in self.snapshots[rank].get(
+                    "registry", {}).get("families", []):
+                if ent["name"] == "hetu_slo_shed_pressure" \
+                        and ent["children"]:
+                    by_worker[str(rank)] = float(
+                        ent["children"][0]["value"])
+        out["shed_pressure"] = {
+            "max": max(by_worker.values(), default=0.0),
+            "by_worker": by_worker}
+        return out
+
     def stitched_trace_events(self) -> list:
         """Every worker's spans as one Chrome timeline, pid =
         ``SPAN_PID + rank`` (``tracing.span_pid``) — concatenable with an
@@ -514,6 +551,11 @@ def fleet_routes(aggregator: FleetAggregator,
         body = m.snapshot() if m is not None else {}
         return json.dumps(body).encode(), "application/json"
 
+    def slo(q, b):
+        aggregator.refresh()
+        return json.dumps(aggregator.slo()).encode(), "application/json"
+
+    routes.add("GET", "/fleet/slo", slo)
     routes.add("GET", "/fleet/metrics", metrics)
     routes.add("GET", "/fleet/healthz", healthz)
     routes.add("GET", "/fleet/journal", journal)

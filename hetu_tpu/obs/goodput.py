@@ -25,6 +25,12 @@ Buckets (``hetu_goodput_seconds_total{bucket=...}``):
                     under ``useful`` and are journaled, not re-billed)
 ``retune``          kernel autotune sweeps (``hetu_tune_retunes_total``'s
                     wall cost, when the tuner reports it)
+``compile``         XLA program compilation wall time, billed from the
+                    ``obs.compile`` seam's AOT journal events (kinds
+                    ``compile``/``recompile`` with ``aot: true``)
+                    exactly like ``checkpoint_saved``/``retune`` — one
+                    billing path; watch-mode events are not billed
+                    (their wall is inside a step already billed useful)
 ==================  ====================================================
 
 Classification inputs are the things the runtime already records:
@@ -54,7 +60,7 @@ __all__ = ["BUCKETS", "GoodputMeter", "install_meter", "get_meter",
            "PEAK_BF16", "peak_flops"]
 
 BUCKETS = ("useful", "straggler_wait", "rollback", "rescale",
-           "checkpoint", "retune")
+           "checkpoint", "retune", "compile")
 
 # ------------------------------------------------------------ flops model
 # Factored out of bench.py so the online MFU gauge and the benchmark
@@ -148,8 +154,8 @@ class GoodputMeter:
                     "hetu_goodput_seconds_total",
                     "accounted step/driver time by goodput bucket "
                     "(useful, straggler_wait, rollback, rescale, "
-                    "checkpoint, retune); buckets partition the total "
-                    "exactly", ("bucket",)),
+                    "checkpoint, retune, compile); buckets partition the "
+                    "total exactly", ("bucket",)),
                 "fraction": reg.gauge(
                     "hetu_goodput_fraction",
                     "share of accounted time per goodput bucket "
@@ -229,21 +235,30 @@ class GoodputMeter:
             self._publish_gauges(enabled)
 
     def ingest(self, events, since_seq: int = 0) -> int:
-        """Fold journal events into the buckets — currently
-        ``checkpoint_saved`` (its ``duration_s`` bills ``checkpoint``) and
-        ``retune``-shaped records carrying ``duration_s``.  Returns the
-        new cursor (max seq seen), for incremental polls against
-        ``/journal?since=``."""
+        """Fold journal events into the buckets — ``checkpoint_saved``
+        (its ``duration_s`` bills ``checkpoint``), ``retune``, and the
+        ``obs.compile`` seam's AOT ``compile``/``recompile`` records
+        (billing ``compile``), each carrying ``duration_s``.  Watch-mode
+        compile events (``aot: false``) are deliberately NOT billed:
+        their first-call wall includes the step's execution, and the
+        step's own ``record_step`` already billed that second as
+        ``useful`` — billing it again would break the exact-partition
+        invariant (the same never-double-bill rule the autotune sweep
+        follows).  Returns the new cursor (max seq seen), for
+        incremental polls against ``/journal?since=``."""
         last = int(since_seq)
+        billed = {"checkpoint_saved": "checkpoint", "retune": "retune",
+                  "compile": "compile", "recompile": "compile"}
         for e in events:
             seq = int(e.get("seq", 0))
             if seq <= since_seq:
                 continue
             last = max(last, seq)
-            if e.get("kind") == "checkpoint_saved":
-                self.record_event("checkpoint", float(e.get("duration_s", 0.0)))
-            elif e.get("kind") == "retune":
-                self.record_event("retune", float(e.get("duration_s", 0.0)))
+            bucket = billed.get(e.get("kind"))
+            if bucket == "compile" and not e.get("aot"):
+                continue
+            if bucket is not None:
+                self.record_event(bucket, float(e.get("duration_s", 0.0)))
         return last
 
     # -- read side ----------------------------------------------------------

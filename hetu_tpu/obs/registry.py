@@ -214,16 +214,27 @@ class Histogram(_Child):
     @staticmethod
     def quantile_from_cumulative(cum_before, cum_after, q: float):
         """Quantile from the delta of two :meth:`cumulative` snapshots.
-        Prometheus-style linear interpolation inside the winning bucket;
-        the +Inf bucket reports its lower edge.  None when the delta is
-        empty.  The single quantile implementation in the tree —
-        ``bench.py --mode serve`` and the serving ``/stats`` summary both
-        call through here."""
+        Prometheus-style linear interpolation inside the winning bucket.
+        Edge semantics are pinned down (this now backs both the bench
+        and the serve ``/stats`` SLO summary, so "whatever falls out"
+        is not acceptable):
+
+        - an EMPTY delta (nothing observed) returns ``nan`` — never a
+          number a dashboard could mistake for a latency;
+        - the +Inf bucket reports its lower edge (the largest finite
+          bound, or 0.0 for a bucketless histogram) — deterministic,
+          never +Inf itself;
+        - a single-bucket histogram degenerates to interpolation inside
+          that one bucket, its upper bound at q=1.
+
+        The single quantile implementation in the tree — ``bench.py
+        --mode serve`` and the serving ``/stats`` summary both call
+        through here."""
         delta = [(le, a - b)
                  for (le, a), (_, b) in zip(cum_after, cum_before)]
         total = delta[-1][1]
         if total <= 0:
-            return None
+            return math.nan
         rank = q * total
         prev_le, prev_c = 0.0, 0
         for le, c in delta:

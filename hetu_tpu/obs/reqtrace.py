@@ -1,0 +1,285 @@
+"""Request-scope tracing: one timeline per serving request.
+
+The serving subsystem's telemetry so far is *aggregate* — histograms and
+counters answer "how is the fleet doing" but not "where did THIS slow
+request's time go".  The reference answers the per-walk question with
+per-op instrumentation hooks on the executor's topological walk; the
+TPU-native equivalent for an Orca-style continuous batcher is a
+per-request timeline: every request admitted to the batcher carries a
+:class:`RequestTimeline` whose spans cover queue wait, admission,
+prefill, every decode iteration (batch composition rides the span
+attributes), sampling, and emit.
+
+Two invariants make the timelines assertable, not just plottable:
+
+- **Exact decomposition** — :meth:`RequestTimeline.stage_seconds`
+  returns the per-stage wall split (``queue``/``prefill``/``decode``/
+  ``emit``) computed from the recorded boundary timestamps, and
+  :attr:`RequestTimeline.wall_s` is *defined* as their sum — the
+  goodput-bucket discipline of ``obs.goodput`` applied per request, so
+  the stages partition the total exactly by construction (the chaos
+  acceptance asserts it for 100% of completed requests).
+- **One decode span per token** — every generated token (the
+  prefill-sampled first token included) records exactly one
+  ``serve.decode`` span, so ``len(spans named serve.decode) ==
+  len(tokens)`` for every request, gapless.
+
+Completed timelines land in a :class:`ReqTraceBuffer`: a bounded ring
+(operational memory stays O(capacity) however long the engine runs)
+plus **exemplar retention** — the slowest N requests of each
+fixed-size completion window survive eviction from the ring, so the
+p99.9 offender from an hour ago is still queryable via
+``/trace/<request_id>`` after a million fast requests displaced it.
+
+Export is the span-dict schema of :mod:`~hetu_tpu.obs.tracing`
+(:func:`~hetu_tpu.obs.tracing.spans_to_chrome_events` renders it), so
+request timelines stitch into the PR-8 fleet traces: when the process
+tracer is recording, finished timelines are folded into it
+(``Tracer.record_external``) and ride the worker snapshot like every
+other span.
+
+Everything is driven by the engine's injectable clock and the engine's
+own request ids, so two same-seed runs produce bitwise-identical
+timelines — trace ids derive from request ids alone.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+__all__ = ["RequestTimeline", "ReqTraceBuffer", "STAGES"]
+
+# The per-request stage partition, in boundary order.  ``queue`` is
+# arrival -> admission (or expiry), ``prefill`` admission -> first
+# token, ``decode`` first token -> last token, ``emit`` last token ->
+# handle resolution.  Consecutive boundaries, so the stages partition
+# the request's wall time with no gaps and no overlap.
+STAGES = ("queue", "prefill", "decode", "emit")
+
+
+class RequestTimeline:
+    """The trace context one serving request carries from submission to
+    handle resolution.  Boundary timestamps come from the engine's
+    injectable clock; span ids are drawn from a per-request counter, so
+    the whole timeline is a pure function of the request's schedule."""
+
+    __slots__ = ("request_id", "trace_id", "arrival", "admitted_at",
+                 "first_token_at", "last_token_at", "finished_at",
+                 "outcome", "attrs", "spans", "_ids", "_decodes")
+
+    def __init__(self, request_id: int, arrival: float, **attrs):
+        self.request_id = int(request_id)
+        # derived from the request id alone: two same-seed runs of the
+        # same schedule produce identical trace ids
+        self.trace_id = f"req-{self.request_id}"
+        self.arrival = float(arrival)
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.outcome: Optional[str] = None
+        self.attrs = dict(attrs)
+        self.spans: list = []   # span dicts, tracing.span_dicts schema
+        self._ids = 0
+        self._decodes = 0       # serve.decode spans recorded, O(1) read
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, start: float, end: float, **attrs) -> dict:
+        """Record one completed span on this request's trace.  The root
+        ``serve.request`` span is synthesized at :meth:`close`; every
+        span recorded here becomes its child."""
+        self._ids += 1
+        sp = {"name": name, "trace_id": self.trace_id,
+              "span_id": f"{self.trace_id}.{self._ids}",
+              "parent_id": f"{self.trace_id}.0",
+              "start": float(start), "end": float(end),
+              "attrs": {k: str(v) for k, v in attrs.items()}}
+        self.spans.append(sp)
+        return sp
+
+    def admit(self, now: float, **attrs) -> None:
+        """Close the queue stage: the request left the admission queue
+        for a slot at ``now``."""
+        self.admitted_at = float(now)
+        self.span("serve.queue", self.arrival, now)
+        self.span("serve.admit", now, now, **attrs)
+
+    def prefill(self, start: float, end: float, **attrs) -> None:
+        """The bucketed prefill step, admission -> first sampled token."""
+        self.first_token_at = float(end)
+        self.last_token_at = float(end)
+        self.span("serve.prefill", start, end, **attrs)
+
+    def decode(self, end: float, **attrs) -> None:
+        """One token-production span — called once per generated token
+        (the prefill-sampled first token included), so the count of
+        ``serve.decode`` spans always equals the tokens generated."""
+        start = self.last_token_at if self.last_token_at is not None \
+            else (self.admitted_at if self.admitted_at is not None
+                  else self.arrival)
+        self._decodes += 1
+        self.span("serve.decode", start, end,
+                  iteration=self._decodes, **attrs)
+        if self.first_token_at is None:
+            self.first_token_at = float(end)
+        self.last_token_at = float(end)
+
+    def close(self, outcome: str, now: float, **attrs) -> None:
+        """Resolve the timeline: record the emit span (last token ->
+        handle resolution) and the root ``serve.request`` span."""
+        self.finished_at = float(now)
+        self.outcome = outcome
+        self.attrs.update({k: v for k, v in attrs.items()})
+        if self.last_token_at is not None:
+            self.span("serve.emit", self.last_token_at, now)
+        self.spans.append({
+            "name": "serve.request", "trace_id": self.trace_id,
+            "span_id": f"{self.trace_id}.0", "parent_id": None,
+            "start": self.arrival, "end": now,
+            "attrs": {"request_id": str(self.request_id),
+                      "outcome": str(outcome),
+                      **{k: str(v) for k, v in self.attrs.items()}}})
+
+    # -- read side ----------------------------------------------------------
+
+    def decode_count(self) -> int:
+        # a counter, not a span scan: this runs once per generated token
+        # on the serving hot path (and the engine holds its lock there)
+        return self._decodes
+
+    def stage_seconds(self) -> dict:
+        """The per-stage wall split from the boundary timestamps —
+        consecutive differences, so the stages partition the request's
+        accounted time with no gap and no overlap.  Stages the request
+        never reached (an expiry in the queue has no prefill) are 0."""
+        t0 = self.arrival
+        t1 = self.admitted_at if self.admitted_at is not None else None
+        t2 = self.first_token_at
+        t3 = self.last_token_at
+        t4 = self.finished_at if self.finished_at is not None else t0
+        out = dict.fromkeys(STAGES, 0.0)
+        if t1 is None:                       # never admitted: all queue
+            out["queue"] = t4 - t0
+            return out
+        out["queue"] = t1 - t0
+        if t2 is None:                       # admitted, no token (cannot
+            out["prefill"] = t4 - t1         # happen today: prefill
+            return out                       # samples at admission)
+        out["prefill"] = t2 - t1
+        out["decode"] = t3 - t2
+        out["emit"] = t4 - t3
+        return out
+
+    @property
+    def wall_s(self) -> float:
+        """The request's accounted wall time — DEFINED as the sum of its
+        stage decomposition (the goodput-bucket discipline per request),
+        so ``sum(stage_seconds().values()) == wall_s`` holds exactly, in
+        float, for every request."""
+        return sum(self.stage_seconds().values())
+
+    def summary(self) -> dict:
+        """The ``/trace/<request_id>`` payload: outcome, exact stage
+        decomposition, token/span counts, and the full span list."""
+        stages = self.stage_seconds()
+        return {"request_id": self.request_id, "trace_id": self.trace_id,
+                "outcome": self.outcome, "arrival": self.arrival,
+                "finished_at": self.finished_at,
+                "stages_s": stages, "wall_s": sum(stages.values()),
+                "decode_spans": self.decode_count(),
+                "attrs": dict(self.attrs), "spans": list(self.spans)}
+
+
+class ReqTraceBuffer:
+    """Completed request timelines: a bounded ring + slowest-N-per-window
+    exemplars.
+
+    The ring (``capacity``) is the operational view — the last requests,
+    whatever they were.  Exemplars are the forensic view: completions
+    are grouped into fixed-size windows of ``window`` requests, and at
+    each window close the retained set is refreshed to the ``slow_n``
+    slowest timelines seen so far (by accounted wall time; ties break
+    toward the lower request id, so retention is deterministic) — so a
+    slow offender survives eviction however many fast windows follow.  ``get()`` serves
+    ``/trace/<request_id>`` from both.  Thread-safe; memory is
+    O(capacity + slow_n) however long the engine runs."""
+
+    def __init__(self, capacity: int = 256, *, slow_n: int = 8,
+                 window: int = 128):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.slow_n = max(int(slow_n), 0)
+        self.window = max(int(window), 1)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._window_cur: list = []     # current window's timelines
+        self._exemplars: list = []      # previous window's slowest N
+        self.completed = 0
+        self._lock = threading.Lock()
+
+    def add(self, tl: RequestTimeline) -> None:
+        with self._lock:
+            self._ring.append(tl)
+            self.completed += 1
+            if self.slow_n:
+                self._window_cur.append(tl)
+                if len(self._window_cur) >= self.window:
+                    self._exemplars = self._slowest(
+                        self._exemplars + self._window_cur)
+                    self._window_cur = []
+
+    def _slowest(self, tls: list) -> list:
+        return sorted(tls, key=lambda t: (-t.wall_s, t.request_id)
+                      )[: self.slow_n]
+
+    # -- read side ----------------------------------------------------------
+
+    def get(self, request_id: int) -> Optional[RequestTimeline]:
+        """Timeline by request id, from the ring or the exemplar set."""
+        rid = int(request_id)
+        with self._lock:
+            for tl in reversed(self._ring):
+                if tl.request_id == rid:
+                    return tl
+            for tl in self._exemplars + self._window_cur:
+                if tl.request_id == rid:
+                    return tl
+        return None
+
+    def timelines(self) -> list:
+        """Ring contents, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def exemplars(self) -> list:
+        """Retained slowest timelines: the last finalized window's
+        slowest N plus the current partial window's, slowest first."""
+        with self._lock:
+            return self._slowest(self._exemplars + self._window_cur)
+
+    def request_ids(self) -> list:
+        """Request ids currently in the ring, completion order — the
+        gapless-id invariant of a fully-completed run is asserted on
+        this."""
+        with self._lock:
+            return [tl.request_id for tl in self._ring]
+
+    def span_dicts(self) -> list:
+        """Every ring timeline's spans, completion order — the tracing
+        span-dict schema, renderable by ``spans_to_chrome_events`` and
+        stitchable with the fleet traces."""
+        with self._lock:
+            return [sp for tl in self._ring for sp in tl.spans]
+
+    def to_chrome_events(self, worker=None) -> list:
+        """Chrome trace events for the ring's timelines (pid offset by
+        ``worker`` rank in a stitched fleet view, like the runtime
+        spans)."""
+        from hetu_tpu.obs.tracing import spans_to_chrome_events
+        label = ("hetu-tpu request timelines" if worker is None
+                 else f"hetu-tpu request timelines (worker {worker})")
+        return spans_to_chrome_events(self.span_dicts(), worker=worker,
+                                      label=label)

@@ -55,20 +55,39 @@ class Routes:
 
     def __init__(self):
         self._routes: dict = {}
+        self._prefixes: dict = {}
 
     def add(self, method: str, path: str, handler: Callable) -> "Routes":
         """Register (and return self, so registrations chain)."""
         self._routes[(method.upper(), path)] = handler
         return self
 
+    def add_prefix(self, method: str, prefix: str,
+                   handler: Callable) -> "Routes":
+        """Register a path-parameter route: any request whose path starts
+        with ``prefix`` (and matched no exact route) dispatches to
+        ``handler(rest, query, body)`` where ``rest`` is the path tail —
+        the ``/trace/<request_id>`` form.  Longest prefix wins."""
+        self._prefixes[(method.upper(), prefix)] = handler
+        return self
+
     def paths(self) -> list:
-        return sorted({p for _, p in self._routes})
+        return sorted({p for _, p in self._routes}
+                      | {p + "*" for _, p in self._prefixes})
 
     def dispatch(self, method: str, path: str, query: dict,
                  body: bytes) -> tuple:
         """Resolve + invoke; always returns ``(payload_bytes, content_type,
         status)``."""
         handler = self._routes.get((method.upper(), path))
+        if handler is None:
+            for (m, pre), h in sorted(self._prefixes.items(),
+                                      key=lambda kv: -len(kv[0][1])):
+                if m == method.upper() and path.startswith(pre):
+                    rest = path[len(pre):]
+                    handler = (lambda h, rest: lambda q, b: h(rest, q, b)
+                               )(h, rest)
+                    break
         if handler is None:
             if any(p == path for _, p in self._routes):
                 return (json.dumps({"error": "method not allowed"}).encode(),

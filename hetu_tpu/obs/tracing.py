@@ -131,6 +131,7 @@ class Tracer:
         self.clock = clock if clock is not None else time.perf_counter
         self.recording = False
         self._spans: list = []
+        self._external: list = []   # pre-built span dicts (reqtrace folds)
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
 
@@ -145,6 +146,7 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._spans = []
+            self._external = []
         self._ids = itertools.count(1)
 
     @contextlib.contextmanager
@@ -184,6 +186,20 @@ class Tracer:
         with self._lock:
             self._spans.append(sp)
 
+    def record_external(self, span_dicts) -> int:
+        """Fold externally-built, already-complete span dicts (the
+        :meth:`span_dicts` schema) into this tracer — the seam the
+        serving request timelines (``obs.reqtrace``) use so finished
+        request traces ride the fleet snapshot and every export path
+        exactly like runtime spans.  Only records while the tracer is
+        recording (and telemetry enabled); returns the number folded."""
+        if not (self.recording and _registry.enabled()):
+            return 0
+        folded = [dict(sp) for sp in span_dicts]
+        with self._lock:
+            self._external.extend(folded)
+        return len(folded)
+
     @property
     def spans(self) -> list:
         """Finished spans in end order."""
@@ -196,11 +212,13 @@ class Tracer:
         """Finished spans as plain JSON-serializable dicts — the form a
         fleet telemetry snapshot publishes so rank 0 can stitch every
         worker's timeline (:func:`spans_to_chrome_events`)."""
-        return [{"name": sp.name, "trace_id": sp.trace_id,
-                 "span_id": sp.span_id, "parent_id": sp.parent_id,
-                 "start": sp.start, "end": sp.end_time,
-                 "attrs": {k: str(v) for k, v in sp.attrs.items()}}
-                for sp in self.spans]
+        own = [{"name": sp.name, "trace_id": sp.trace_id,
+                "span_id": sp.span_id, "parent_id": sp.parent_id,
+                "start": sp.start, "end": sp.end_time,
+                "attrs": {k: str(v) for k, v in sp.attrs.items()}}
+               for sp in self.spans]
+        with self._lock:
+            return own + list(self._external)
 
     def to_chrome_events(self, worker=None) -> list:
         """Complete (``ph: X``) trace events plus a process_name metadata

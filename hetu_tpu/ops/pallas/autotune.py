@@ -340,11 +340,23 @@ def _sweep(candidates, measure, *, budget_s: float | None,
     # goodput "retune" bucket is billed from this event alone, via
     # GoodputMeter.ingest, exactly like checkpoint_saved: one billing
     # path, so a driver that polls the journal into its meter never
-    # double-counts a sweep
+    # double-counts a sweep.  Each measured candidate compiled two
+    # differenced-scan programs (_measure_differenced's run1/run2); the
+    # retune record reports that under `compiles` and the count lands in
+    # hetu_compile_total{site="tune.<kernel>"} — NOT as per-compile
+    # journal events, whose duration_s would double-bill the goodput
+    # compile bucket on top of retune.
     dt = time.perf_counter() - t_start
+    kernel = tag.split()[0]
+    measured = sum(1 for v in table.values() if isinstance(v, float))
     from hetu_tpu.obs import journal as _journal
-    _journal.record("retune", kernel=tag.split()[0], candidates=len(table),
-                    duration_s=round(dt, 6))
+    from hetu_tpu.obs import registry as _registry
+    _journal.record("retune", kernel=kernel, candidates=len(table),
+                    compiles=2 * measured, duration_s=round(dt, 6))
+    if measured and _registry.enabled():
+        from hetu_tpu.obs import compile as _ocompile
+        _ocompile._compile_m()["compiles"].labels(
+            site=f"tune.{kernel}").inc(2 * measured)
     return table
 
 

@@ -35,9 +35,29 @@ workloads the stack trains:
 Telemetry (lazily registered, all no-ops when obs is disabled): queue
 depth and active-slot gauges, TTFT and per-token latency histograms,
 token/request counters by outcome, tokens/s gauge; admission rejections
-and deadline expiries are journaled (``serve_reject`` /
-``serve_deadline``).  The clock is injectable — the deterministic tests
-drive a virtual clock, production defaults to ``time.monotonic``.
+are journaled (``serve_reject``) and deadline expiries are counted by
+stage (``hetu_serve_deadline_expired_total{stage=queued|running}``) and
+journaled (``request_expired``).  **Request-scope observability**: every
+request carries a :class:`~hetu_tpu.obs.reqtrace.RequestTimeline` —
+spans for queue wait, admission, prefill, each decode iteration (batch
+composition in the attrs), and emit, with the stage decomposition
+summing to wall time exactly — finished timelines land in
+``self.trace_buffer`` (ring + slowest-N exemplars, ``/trace/<id>``) and
+are graded by ``self.slo`` (:class:`~hetu_tpu.obs.slo.SLOEngine`:
+TTFT/TPOT/queue-age targets, burn rates, shed pressure on ``/slo``).
+The three jitted step functions are compile-counting seams
+(:func:`obs.compile.instrument`, AOT): ``serve.prefill_step`` /
+``serve.paged_decode`` / ``serve.sample`` own their program caches, so
+``hetu_compile_total`` is exact and a recompile storm is a gauge.  The
+clock is injectable — the deterministic tests drive a virtual clock,
+production defaults to ``time.monotonic``.
+
+Deadlines: ``deadline_s`` bounds a request's total age.  A request past
+its deadline while still *queued* is dropped before admission (stage
+``queued``); one that exceeds it while *running* is retired at the next
+scheduler tick with the tokens generated so far (stage ``running``) —
+serving it further would be serving it late.  Both resolve the handle
+with status ``expired`` and a human-readable ``error``.
 """
 
 from __future__ import annotations
@@ -50,8 +70,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hetu_tpu.obs import compile as _compile
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs import tracing as _tracing
+from hetu_tpu.obs.reqtrace import ReqTraceBuffer, RequestTimeline
+from hetu_tpu.obs.slo import SLOEngine
 from hetu_tpu.ops.pallas.lm_head import lm_head_sample_pallas
 from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
                                  top_k_sample)
@@ -94,6 +118,12 @@ def _serve_m() -> dict:
                 "decode throughput over the last step"),
             "ctr": reg.counter(
                 "hetu_serve_ctr_requests_total", "CTR inference batches"),
+            "deadline": reg.counter(
+                "hetu_serve_deadline_expired_total",
+                "requests dropped at their deadline, by the stage they "
+                "were in (queued: expired waiting for a slot; running: "
+                "cut off mid-decode, keeping the tokens generated)",
+                ("stage",)),
         }
     return _serve_metrics
 
@@ -103,18 +133,23 @@ class RequestHandle:
 
     def __init__(self, request_id: int):
         self.request_id = request_id
+        self.trace_id = f"req-{request_id}"   # reqtrace derivation: the
+        # handle can name its /trace/<id> timeline before resolving
         self._done = threading.Event()
         # completed | rejected | expired | evicted (overcommitted pool only)
         self.status: Optional[str] = None
         self.tokens: list = []
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
+        self.error: Optional[str] = None   # human-readable failure reason
 
-    def _finish(self, status: str, tokens=(), ttft_s=None, latency_s=None):
+    def _finish(self, status: str, tokens=(), ttft_s=None, latency_s=None,
+                error=None):
         self.status = status
         self.tokens = list(tokens)
         self.ttft_s = ttft_s
         self.latency_s = latency_s
+        self.error = error
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -138,7 +173,9 @@ class ServingEngine:
                  seed: int = 0, clock=time.monotonic,
                  defrag_every: int = 0, ctr_model=None,
                  paged_decode: bool = True,
-                 fused_sampling: Optional[bool] = None):
+                 fused_sampling: Optional[bool] = None,
+                 slo_targets=None, trace_capacity: int = 256,
+                 trace_slow_n: int = 8, trace_window: int = 128):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -175,8 +212,22 @@ class ServingEngine:
         self._recycled = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._step_fn = jax.jit(self._step_impl)
-        self._sample_fn = jax.jit(self._sample_impl)
+        # request-scope observability: one timeline per in-flight request,
+        # finished timelines into the ring/exemplar buffer and the SLO
+        # engine (both driven by the engine's own injectable clock, so
+        # same-seed runs produce bitwise-identical timelines)
+        self.trace_buffer = ReqTraceBuffer(capacity=trace_capacity,
+                                           slow_n=trace_slow_n,
+                                           window=trace_window)
+        self.slo = SLOEngine(slo_targets, clock=clock)
+        self._timelines: dict = {}
+        # the jit seams are compile-counting (obs.compile AOT: the
+        # instrumented cache IS the program cache, so hetu_compile_total
+        # is exact and a recompile storm is a gauge, not a bench round)
+        self._step_fn = _compile.instrument(jax.jit(self._step_impl),
+                                            site="serve.prefill_step")
+        self._sample_fn = _compile.instrument(jax.jit(self._sample_impl),
+                                              site="serve.sample")
         self.paged_decode = bool(paged_decode)
         if fused_sampling is None:
             # the fused sampler's streamed top-k holds at most 128
@@ -185,7 +236,8 @@ class ServingEngine:
             fused_sampling = (sampling != "top_k"
                               or min(top_k, cfg.vocab_size) <= 128)
         self._fused_sampling = bool(fused_sampling)
-        self._paged_step_fn = jax.jit(self._paged_decode_impl)
+        self._paged_step_fn = _compile.instrument(
+            jax.jit(self._paged_decode_impl), site="serve.paged_decode")
         self.ctr_model = ctr_model
         if ctr_model is not None:
             _mark_stores_read_only(ctr_model)
@@ -264,6 +316,8 @@ class ServingEngine:
             req = Request(id=rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
                           arrival=self.clock(), deadline_s=deadline_s)
+            tl = RequestTimeline(rid, req.arrival, prompt_len=len(prompt),
+                                 max_new_tokens=req.max_new_tokens)
             reason = None
             max_bucket = self.batcher.prompt_buckets[-1]
             if not prompt:
@@ -287,9 +341,16 @@ class ServingEngine:
                 _journal.record("serve_reject", request_id=rid,
                                 reason=reason,
                                 queue_depth=self.batcher.queue_len)
-                handle._finish("rejected")
+                # a zero-length timeline still lands in the trace buffer
+                # (a rejection is queryable forensics too), but it is NOT
+                # graded: it never entered the serving pipeline, so it
+                # must not consume SLO error budget
+                tl.close("rejected", req.arrival, reason=reason)
+                self._finalize_timeline(tl, grade=False)
+                handle._finish("rejected", error=reason)
                 return handle
             self._handles[rid] = handle
+            self._timelines[rid] = tl
             _serve_m()["queue"].set(self.batcher.queue_len)
         return handle
 
@@ -316,13 +377,28 @@ class ServingEngine:
 
             tick = self.batcher.poll(now, can_admit=gate)
             for req in tick.expired:
-                _journal.record("serve_deadline", request_id=req.id,
-                                waited_s=round(now - req.arrival, 6))
+                waited = now - req.arrival
+                _journal.record("request_expired", request_id=req.id,
+                                stage="queued", waited_s=round(waited, 6))
                 m["requests"].labels(outcome="expired").inc()
-                self._handles.pop(req.id)._finish("expired")
+                m["deadline"].labels(stage="queued").inc()
+                tl = self._timelines.pop(req.id)
+                tl.close("expired", now, stage="queued")
+                self._finalize_timeline(tl)
+                self._handles.pop(req.id)._finish(
+                    "expired",
+                    error=f"deadline of {req.deadline_s}s expired after "
+                          f"{waited:.6g}s in the admission queue")
             for req in tick.admitted:
                 m["requests"].labels(outcome="admitted").inc()
+                self._timelines[req.id].admit(
+                    now, slot=req.slot, queue_depth=self.batcher.queue_len)
                 self._prefill(req, now)
+            # a running request past its deadline is cut off here, with
+            # the tokens it has — serving it further is serving it late
+            for _slot, req in self.batcher.active():
+                if req.expired(now):
+                    self._retire(req, "expired", now)
             produced = self._decode()
             m["queue"].set(self.batcher.queue_len)
             m["slots"].set(self.batcher.active_slots)
@@ -389,8 +465,16 @@ class ServingEngine:
         tok = int(self._sample_fn(
             logits, jnp.asarray([req.id], jnp.int32),
             jnp.asarray([plen], jnp.int32))[0])
-        req.prefill_at = now
-        self._append_token(req, tok, now, ttft=now - req.arrival)
+        # re-read the clock so the prefill stage absorbs the prefill
+        # compute on the real clock (the virtual test clock returns the
+        # same instant, keeping the decomposition deterministic) — the
+        # same convention _decode uses for its post-compute timestamp
+        done_at = self.clock()
+        req.prefill_at = done_at
+        tl = self._timelines[req.id]
+        tl.prefill(tl.admitted_at, done_at, bucket=bucket, prompt_len=plen)
+        self._append_token(req, tok, done_at, ttft=done_at - req.arrival,
+                           batch=1)
 
     def _decode(self) -> int:
         """One fixed-shape (num_slots, 1) decode step over every active
@@ -398,7 +482,7 @@ class ServingEngine:
         active = self.batcher.active()
         if not active:
             return 0
-        t0 = time.perf_counter()
+        t0 = self.clock()
         seq_ids = [None] * self.batcher.num_slots
         tokens = np.zeros((self.batcher.num_slots, 1), np.int32)
         index = np.zeros(self.batcher.num_slots, np.int32)
@@ -446,22 +530,30 @@ class ServingEngine:
             toks = np.asarray(self._sample_fn(logits, jnp.asarray(rids),
                                               jnp.asarray(positions)))
         now = self.clock()
+        nactive = len(active)
         for slot, req in active:
             self.pool.table(req.id).length += 1  # fed token's K/V written
-            self._append_token(req, int(toks[slot]), now)
-        dt = time.perf_counter() - t0
+            self._append_token(req, int(toks[slot]), now, batch=nactive)
+        # the injected clock times the step (production: time.monotonic
+        # measures the real compute; the virtual test clock keeps the
+        # latency histogram deterministic — the _prefill convention)
+        dt = now - t0
         m = _serve_m()
         m["tok_latency"].observe(dt / max(len(active), 1))
         m["tps"].set(len(active) / dt if dt > 0 else 0.0)
         return len(active)
 
     def _append_token(self, req: Request, tok: int, now: float,
-                      ttft: Optional[float] = None) -> None:
+                      ttft: Optional[float] = None, batch: int = 1) -> None:
         """Account one generated token (its own K/V is written by the NEXT
         decode step, at index ``pool.table(id).length``); retire the
-        request on EOS, budget exhaustion, or context exhaustion."""
+        request on EOS, budget exhaustion, or context exhaustion.
+        ``batch`` is the decode step's batch composition (active slots),
+        recorded on the token's ``serve.decode`` span — one span per
+        generated token, the prefill-sampled first token included."""
         pt = self.pool.table(req.id)
         req.tokens.append(tok)
+        self._timelines[req.id].decode(now, batch=batch, slot=req.slot)
         m = _serve_m()
         m["tokens"].inc()
         if ttft is not None:
@@ -473,23 +565,51 @@ class ServingEngine:
             self._retire(req, "completed", now)
 
     def _retire(self, req: Request, outcome: str, now: float) -> None:
-        """Recycle the slot and pages, close the handle.  ``outcome`` is
-        ``completed`` or — only under an overcommitted pool — ``evicted``
-        (the request keeps the tokens generated so far)."""
+        """Recycle the slot and pages, close the handle and timeline.
+        ``outcome`` is ``completed``, ``expired`` (running deadline cut),
+        or — only under an overcommitted pool — ``evicted``; the last two
+        keep the tokens generated so far."""
         self.batcher.finish(req.slot)
         self.pool.free(req.id)
         self._recycled += 1
         if self.defrag_every and self._recycled % self.defrag_every == 0:
             self.pool.defrag()
+        m = _serve_m()
+        error = None
         if outcome == "evicted":
             _journal.record("serve_evict", request_id=req.id,
                             tokens_generated=len(req.tokens))
-        _serve_m()["requests"].labels(outcome=outcome).inc()
+            error = "evicted: KV pool exhausted (overcommitted num_pages)"
+        elif outcome == "expired":
+            age = now - req.arrival
+            _journal.record("request_expired", request_id=req.id,
+                            stage="running", age_s=round(age, 6),
+                            tokens_generated=len(req.tokens))
+            m["deadline"].labels(stage="running").inc()
+            error = (f"deadline of {req.deadline_s}s expired after "
+                     f"{age:.6g}s while decoding "
+                     f"({len(req.tokens)} tokens generated)")
+        m["requests"].labels(outcome=outcome).inc()
+        tl = self._timelines.pop(req.id)
+        tl.close(outcome, now, tokens=len(req.tokens))
+        self._finalize_timeline(tl)
         self._handles.pop(req.id)._finish(
             outcome, req.tokens,
             ttft_s=(None if req.prefill_at is None
                     else req.prefill_at - req.arrival),
-            latency_s=now - req.arrival)
+            latency_s=now - req.arrival, error=error)
+
+    def _finalize_timeline(self, tl: RequestTimeline,
+                           grade: bool = True) -> None:
+        """Resolved timeline -> trace buffer (+ SLO grading, + the process
+        tracer when it is recording, so request traces stitch into the
+        fleet timeline like any runtime span)."""
+        self.trace_buffer.add(tl)
+        if grade:
+            self.slo.observe(tl)
+        tracer = _tracing.get_tracer()
+        if tracer.recording:
+            tracer.record_external(tl.spans)
 
     # -- CTR inference ------------------------------------------------------
 
@@ -532,10 +652,13 @@ class ServingEngine:
                 h = hist.labels()
                 for q, tag in ((0.5, "p50"), (0.99, "p99")):
                     v = h.quantile(q)
-                    slo[f"{short}_{tag}_s"] = (None if v is None
+                    # empty histogram -> nan (deterministic); JSON has no
+                    # NaN, so the payload carries null
+                    slo[f"{short}_{tag}_s"] = (None if v is None or v != v
                                                else round(v, 6))
             return {
                 "slo": slo,
+                "shed_pressure": self.slo.shed_pressure(),
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,
                 "num_slots": self.batcher.num_slots,
@@ -544,6 +667,8 @@ class ServingEngine:
                 "sampling": self.sampling,
                 "paged_decode": self.paged_decode,
                 "fused_sampling": self._fused_sampling,
+                "compile": _compile.compile_report(
+                    self._step_fn, self._paged_step_fn, self._sample_fn),
                 "metrics": snap,
             }
 

@@ -9,12 +9,21 @@ they describe.
 
 - ``POST /infer`` with ``{"prompt": [ids...], "max_new_tokens": n,
   "deadline_s": s?, "timeout_s": s?}`` blocks until the request resolves
-  and returns ``{"request_id", "status", "tokens", "ttft_s",
+  and returns ``{"request_id", "trace_id", "status", "tokens", "ttft_s",
   "latency_s"}`` — 200 on completion, 429 on admission rejection, 504 on
-  deadline expiry.
+  deadline expiry.  Non-completed responses carry a human-readable
+  ``error`` naming what happened (rejection reason; deadline stage and
+  age), and ``trace_id`` keys the request's full timeline at
+  ``/trace/<request_id>``.
 - ``POST /infer`` with ``{"dense": [[...]], "sparse": [[...]]}`` runs
   the read-only CTR path and returns ``{"pred": [...]}``.
 - ``GET /stats`` returns the engine's scheduler/pool/counter snapshot.
+- ``GET /slo`` returns the SLO engine's summary: targets, per-stage
+  request-seconds, burn rates (short+long window per target), and the
+  shed-pressure gauge.
+- ``GET /trace`` lists the trace buffer (ring ids + exemplar ids);
+  ``GET /trace/<request_id>`` returns one request's timeline — outcome,
+  exact stage decomposition, span list (Chrome-stitchable schema).
 """
 
 from __future__ import annotations
@@ -45,21 +54,54 @@ def serving_routes(engine) -> Routes:
         # and hang this handler thread forever
         if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
             return (json.dumps({"request_id": handle.request_id,
+                                "trace_id": handle.trace_id,
                                 "status": "pending"}).encode(),
                     "application/json", 504)
         status = {"completed": 200, "rejected": 429,
                   "expired": 504, "evicted": 503}[handle.status]
-        return (json.dumps({
+        body = {
             "request_id": handle.request_id,
+            "trace_id": handle.trace_id,
             "status": handle.status,
             "tokens": handle.tokens,
             "ttft_s": handle.ttft_s,
             "latency_s": handle.latency_s,
-        }).encode(), "application/json", status)
+        }
+        if handle.error is not None:
+            # the distinguishable-error contract: a shed/expired request
+            # says WHY, not just a status code
+            body["error"] = handle.error
+        return json.dumps(body).encode(), "application/json", status
+
+    def trace_index(query, body):
+        buf = engine.trace_buffer
+        return json.dumps({
+            "completed": buf.completed,
+            "ring": buf.request_ids(),
+            "exemplars": [t.request_id for t in buf.exemplars()],
+        }).encode()
+
+    def trace_one(rest, query, body):
+        try:
+            rid = int(rest)
+        except ValueError:
+            return (json.dumps({"error": f"bad request id {rest!r}"}
+                               ).encode(), "application/json", 400)
+        tl = engine.trace_buffer.get(rid)
+        if tl is None:
+            return (json.dumps({"error": f"no timeline for request {rid} "
+                                "(evicted from the ring and not an "
+                                "exemplar, or never submitted)"}).encode(),
+                    "application/json", 404)
+        return json.dumps(tl.summary()).encode()
 
     routes.add("POST", "/infer", infer)
     routes.add("GET", "/stats",
                lambda q, b: json.dumps(engine.stats()).encode())
+    routes.add("GET", "/slo",
+               lambda q, b: json.dumps(engine.slo.summary()).encode())
+    routes.add("GET", "/trace", trace_index)
+    routes.add_prefix("GET", "/trace/", trace_one)
     return routes
 
 

@@ -211,9 +211,11 @@ class TestServeMode:
         after = [(0.1, 0), (float("inf"), 4)]
         assert bench._hist_quantile(before, after, 0.5) == 0.1
 
-    def test_hist_quantile_empty_delta_is_none(self):
+    def test_hist_quantile_empty_delta_is_nan(self):
         cum = [(0.1, 3), (float("inf"), 7)]
-        assert bench._hist_quantile(cum, cum, 0.5) is None
+        v = bench._hist_quantile(cum, cum, 0.5)
+        assert v != v  # nan, deterministically — never a fake latency
+        assert bench._q_or_none(v) is None  # and null on the JSON line
 
     def test_unknown_mode_exits_before_preflight(self, monkeypatch):
         probed = []

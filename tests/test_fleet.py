@@ -324,7 +324,8 @@ class TestGoodputMeter:
         m.record_event("rescale", 0.25)
         assert m.totals == {"useful": 2.0, "straggler_wait": 2.0,
                             "rollback": 1.0, "rescale": 1.25,
-                            "checkpoint": 0.5, "retune": 0.0}
+                            "checkpoint": 0.5, "retune": 0.0,
+                            "compile": 0.0}
         assert m.total() == sum(m.totals.values()) == 6.75
         assert m.by_worker == {3: 2.0}
         fr = m.fractions()
@@ -368,14 +369,21 @@ class TestGoodputMeter:
             {"seq": 1, "kind": "checkpoint_saved", "duration_s": 0.5},
             {"seq": 2, "kind": "nan_skip"},
             {"seq": 3, "kind": "retune", "duration_s": 2.0},
+            # AOT compile wall is pure lower+compile -> billed; a
+            # watch-mode first-call wall includes the step's execution,
+            # already billed useful by record_step -> NOT billed again
+            {"seq": 4, "kind": "compile", "aot": True, "duration_s": 0.25},
+            {"seq": 5, "kind": "recompile", "aot": False,
+             "duration_s": 9.0},
         ]
         cursor = m.ingest(events)
-        assert cursor == 3
+        assert cursor == 5
         assert m.totals["checkpoint"] == 0.5 and m.totals["retune"] == 2.0
+        assert m.totals["compile"] == 0.25
         # incremental: an already-consumed prefix is not re-billed
-        events.append({"seq": 4, "kind": "checkpoint_saved",
+        events.append({"seq": 6, "kind": "checkpoint_saved",
                        "duration_s": 0.25})
-        assert m.ingest(events, since_seq=cursor) == 4
+        assert m.ingest(events, since_seq=cursor) == 6
         assert m.totals["checkpoint"] == 0.75
 
     def test_flops_model_matches_bench(self):

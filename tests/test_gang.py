@@ -67,14 +67,18 @@ def params_of(tr):
 
 def norm_events(jr):
     """Journal events with wall-clock noise stripped: ``ts`` always,
-    write duration, and the tmp-dir prefix of checkpoint paths (the last
-    two path components — worker_RRRR/shard.step_N — stay)."""
+    write/compile durations, and the tmp-dir prefix of checkpoint paths
+    (the last two path components — worker_RRRR/shard.step_N — stay)."""
     out = []
     for e in jr.events:
         e = {k: v for k, v in e.items() if k != "ts"}
         if e["kind"] == "checkpoint_saved":
             e.pop("duration_s", None)
             e["path"] = "/".join(e["path"].split(os.sep)[-2:])
+        elif e["kind"] in ("compile", "recompile"):
+            # the Trainer.step watch seam journals real compile wall
+            # time — the one nondeterministic field on a bitwise replay
+            e.pop("duration_s", None)
         out.append(e)
     return out
 

@@ -5,6 +5,7 @@ disabled-overhead guard, and the exact-telemetry chaos acceptance test.
 
 import gzip
 import json
+import math
 import re
 import threading
 import time
@@ -160,7 +161,7 @@ class TestRegistry:
     def test_histogram_quantile(self):
         reg = obs.MetricsRegistry()
         h = reg.histogram("q_seconds", buckets=(0.1, 1.0)).labels()
-        assert h.quantile(0.5) is None  # nothing observed yet
+        assert math.isnan(h.quantile(0.5))  # nothing observed yet
         for _ in range(4):
             h.observe(0.05)
         # all mass in the first bucket: linear interpolation inside it
@@ -176,6 +177,39 @@ class TestRegistry:
         h2.observe(5.0)
         assert h2.quantile(0.5) == 0.1
 
+    def test_histogram_quantile_edge_semantics(self):
+        """Satellite: empty and single-bucket histograms answer
+        deterministically — an empty delta is nan (never a plausible
+        latency), the +Inf bucket reports its finite lower edge (0.0
+        for a bucketless histogram), and a single-bucket histogram
+        interpolates inside its one bucket up to its bound at q=1."""
+        reg = obs.MetricsRegistry()
+        # empty: nan on every quantile, fresh or windowed
+        h = reg.histogram("qe_seconds", buckets=(0.1,)).labels()
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert math.isnan(h.quantile(q))
+        snap = h.cumulative()
+        assert math.isnan(h.quantile(0.5, since=snap))
+        # single bucket, all mass inside it: interpolation + exact edge
+        h.observe(0.05)
+        h.observe(0.05)
+        assert 0.0 < h.quantile(0.5) <= 0.1
+        assert h.quantile(1.0) == pytest.approx(0.1)
+        # single bucket, all mass ABOVE it: the +Inf bucket's lower edge
+        h1 = reg.histogram("qo_seconds", buckets=(0.1,)).labels()
+        h1.observe(7.0)
+        assert h1.quantile(0.5) == 0.1
+        assert h1.quantile(0.99) == 0.1
+        # bucketless histogram: +Inf is the only bucket; lower edge is 0.0
+        h0 = reg.histogram("qz_seconds", buckets=()).labels()
+        assert math.isnan(h0.quantile(0.5))
+        h0.observe(3.0)
+        assert h0.quantile(0.5) == 0.0
+        # static form mirrors the instance form
+        empty = [(0.1, 0), (math.inf, 0)]
+        assert math.isnan(
+            obs.Histogram.quantile_from_cumulative(empty, empty, 0.5))
+
     def test_bench_quantile_is_the_registry_implementation(self):
         """Satellite: bench._hist_quantile delegates to
         Histogram.quantile_from_cumulative — one quantile implementation
@@ -188,7 +222,9 @@ class TestRegistry:
         for q in (0.1, 0.5, 0.9, 0.99):
             assert bench._hist_quantile(before, after, q) == \
                 obs.Histogram.quantile_from_cumulative(before, after, q)
-        assert bench._hist_quantile(after, after, 0.5) is None
+        assert math.isnan(bench._hist_quantile(after, after, 0.5))
+        assert bench._q_or_none(bench._hist_quantile(after, after, 0.5)) \
+            is None  # the JSON line carries null, never NaN
 
     def test_dump_roundtrips_schema_and_state(self):
         """registry.dump() is the re-aggregatable export the fleet plane
@@ -567,6 +603,56 @@ def test_metric_naming_conventions():
             problems.append(f"{name}: conflicting label schemas "
                             f"{sorted(labels)} at {[w for *_x, w in regs]}")
     assert not problems, "\n".join(problems)
+
+
+def test_span_naming_conventions():
+    """Satellite lint: the PR-8 metric-naming AST lint extended to span
+    names — every span opened in the tree uses a dotted lowercase
+    namespace (``serve.*`` / ``compile.*`` / ``train.*`` / ``ps.*``)
+    given as a string LITERAL.  Dynamic span-name construction is banned:
+    a name built from runtime values is unbounded-cardinality and breaks
+    the stitched-trace grouping the fleet plane relies on."""
+    import ast
+    import pathlib
+
+    import hetu_tpu
+    root = pathlib.Path(hetu_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + [root.parent / "bench.py"]
+    # obs/tracing.py is the framework itself: its module-level span()
+    # forwarder passes its `name` parameter through by definition
+    skip = {root / "obs" / "tracing.py"}
+    pat = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+    names, problems = set(), []
+    for path in files:
+        if path in skip:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f = node.func
+            # every tracing span is opened through an attribute call
+            # (tracer.span / tl.span / obs.span); a bare name is some
+            # local helper, not the tracing API
+            if not (isinstance(f, ast.Attribute) and f.attr == "span"):
+                continue
+            where = f"{path.relative_to(root.parent)}:{node.lineno}"
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                problems.append(
+                    f"{where}: span name is not a string literal "
+                    f"(dynamic construction is banned)")
+                continue
+            if not pat.match(arg.value):
+                problems.append(
+                    f"{where}: span name {arg.value!r} is not a dotted "
+                    f"lowercase namespace (like serve.decode)")
+            names.add(arg.value)
+    assert not problems, "\n".join(problems)
+    # the namespaces the obs plane documents must actually be in use
+    roots = {n.split(".", 1)[0] for n in names}
+    assert {"serve", "compile", "train", "ps"} <= roots, roots
 
 
 def test_metrics_endpoint_404():

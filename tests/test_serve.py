@@ -441,10 +441,16 @@ class TestEngineScheduling:
         assert d['hetu_serve_requests_total{outcome="admitted"}'] == 1
         assert d['hetu_serve_requests_total{outcome="completed"}'] == 1
         kinds = [e["kind"] for e in journal.events]
-        assert "serve_reject" in kinds and "serve_deadline" in kinds
+        assert "serve_reject" in kinds and "request_expired" in kinds
         rej = journal.of_kind("serve_reject")[0]
         assert rej["request_id"] == overflow.request_id
-        assert journal.of_kind("serve_deadline")[0]["waited_s"] >= 0.5
+        exp = journal.of_kind("request_expired")[0]
+        assert exp["stage"] == "queued" and exp["waited_s"] >= 0.5
+        # the deadline satellite: expiries are counted by stage, not
+        # silently dropped, and the handle names why it failed
+        assert d['hetu_serve_deadline_expired_total{stage="queued"}'] == 1
+        assert waiting.error is not None and "deadline" in waiting.error
+        assert overflow.error is not None  # rejection reason rides too
 
     def test_eos_recycles_slot_early(self):
         m = tiny_gpt()

@@ -482,11 +482,12 @@ def check_paged_decode():
     trace = generate_load(17, 24, vocab=cfg.vocab_size,
                           prompt_len=(64, 1024), max_new=(32, 64),
                           mean_gap_s=0.0)
-    paged_tps, p50, p99, _ = _serve_run(cfg, trace, paged=True, **kw)
-    gather_tps, _, _, _ = _serve_run(cfg, trace, paged=False, **kw)
+    paged_tps, p50, p99, _, stages = _serve_run(cfg, trace, paged=True, **kw)
+    gather_tps, _, _, _, _ = _serve_run(cfg, trace, paged=False, **kw)
     print(f"  decode tokens/s: paged {paged_tps:.1f} vs gather "
           f"{gather_tps:.1f} ({paged_tps / gather_tps:.2f}x); "
-          f"ttft p50 {p50} p99 {p99}")
+          f"ttft p50 {p50} p99 {p99}; stage fractions "
+          f"{ {s: v['fraction'] for s, v in stages.items()} }")
     assert paged_tps >= 1.2 * gather_tps, (
         "paged decode under the 1.2x acceptance bar", paged_tps,
         gather_tps)
