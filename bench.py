@@ -149,6 +149,23 @@ def _tinfo(t):
     return out
 
 
+def _numerics_fields(trainer, batch, key=None):
+    """Grad-norm / nonfinite health summary for a train metric line
+    (obs.numerics.grad_health): a perf regression that is really a
+    numerics regression — exploding group, NaN factory — names the
+    unhealthy layer in the same JSON artifact.  Costs one extra gradient
+    compile on the measured config; HETU_TPU_BENCH_NUMERICS=0 skips."""
+    if os.environ.get("HETU_TPU_BENCH_NUMERICS", "1") in ("0", "false"):
+        return {}
+    try:
+        from hetu_tpu.obs.numerics import grad_health
+        return {"numerics": grad_health(trainer.loss_fn,
+                                        trainer.state.model, batch,
+                                        key)}
+    except Exception as e:  # a health probe must never kill the line
+        return {"numerics": {"error": str(e)[:120]}}
+
+
 def _line(metric, value, unit, vs_baseline, **extra):
     rec = {"metric": metric, "value": round(float(value), 4), "unit": unit,
            "vs_baseline": round(float(vs_baseline), 4), **extra}
@@ -190,7 +207,8 @@ def bench_resnet(on_tpu, kind, peak):
         baseline_note="device time (differenced scan); r03 wall numbers "
                       "(42-83 steps/s) measured tunnel dispatch, not the "
                       "framework — this line is the regression baseline",
-        device=kind, batch=batch, **_tinfo(t))
+        device=kind, batch=batch, **_numerics_fields(trainer, b),
+        **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -442,6 +460,10 @@ def _bert_time(on_tpu, kind, peak, *, seq, batch, k, attn, fused_ln,
         cfg.num_layers, cfg.hidden_size, cfg.vocab_size, batch, seq,
         cfg.intermediate_ratio)
     t["batch"], t["seq"] = batch, seq
+    # handed back (and stripped before the JSON line) so the winning
+    # variant's metric line can carry the grad-health summary without a
+    # second trainer build
+    t["_trainer"], t["_batch"] = trainer, b
     return t
 
 
@@ -508,6 +530,8 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric,
         t = _bert_time(on_tpu, kind, peak, seq=seq, batch=batch, k=k,
                        attn=attn, fused_ln=fused_ln, remat=remat)
     mfu = t["flops"] / t["median_s"] / peak
+    trainer, b = t.pop("_trainer", None), t.pop("_batch", None)
+    numerics = _numerics_fields(trainer, b) if trainer is not None else {}
     return _line(
         metric if on_tpu else "bert_smoke_mfu", mfu, "MFU", mfu / 0.45,
         samples_per_sec_per_chip=round(t["batch"] / t["median_s"], 2),
@@ -515,7 +539,7 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric,
         best_mfu=round(t["flops"] / t["min_s"] / peak, 4),
         dropout=True, flash_attention=(attn == "flash" and on_tpu),
         fused_ln=bool(fused_ln and on_tpu), remat=bool(remat),
-        **({"ab_probe_ms": ab} if ab else {}),
+        **({"ab_probe_ms": ab} if ab else {}), **numerics,
         device=kind, batch=t["batch"], seq=t["seq"], **_tinfo(t))
 
 

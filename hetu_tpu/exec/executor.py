@@ -23,6 +23,7 @@ from hetu_tpu.core.module import Module, trainable_mask
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.obs import compile as _obs_compile
 from hetu_tpu.obs import goodput as _obs_goodput
+from hetu_tpu.obs import numerics as _obs_numerics
 from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _obs_tracing
 from hetu_tpu.optim.optimizers import Optimizer
@@ -162,6 +163,9 @@ class Trainer:
         # cannot be rolled back.  Attach BEFORE the first step: the guard's
         # ``grad_norm`` metric is added at trace time.
         self.grad_guard: Optional[Callable[[dict], bool]] = None
+        # (batch, key) of the last step that carried numerics stats —
+        # the NaN-provenance post-mortem replays these exact inputs
+        self._last_step_inputs: Optional[tuple] = None
         self._state = TrainState(model, optimizer.init(model))
         # Non-trainable state (BatchNorm statistics) must not see weight decay
         # or moment updates; the mask is static model structure, closed over.
@@ -198,6 +202,19 @@ class Trainer:
             # benchmarked scan_steps path — is unchanged
             if self.grad_guard is not None:
                 metrics["grad_norm"] = _global_grad_norm(grads)
+            # trace-time check, same rule as grad_guard: only trainers
+            # built while a flight recorder is installed
+            # (obs.numerics.install) trace the tensor stats — per-group
+            # grad norms/max-abs/nonfinite/zero-fraction plus the
+            # deterministic bitcast-uint32 fingerprints of the UPDATED
+            # params — into the step program.  They ride the step's
+            # outputs as device scalars, so recording adds no host sync;
+            # a plain Trainer's program is unchanged.
+            if _obs_numerics.recording():
+                metrics["_numerics"] = {
+                    "grad": _obs_numerics.group_stats(grads),
+                    "param_fp": _obs_numerics.tree_fingerprints(params),
+                }
             if self._has_staged:
                 metrics["_staged_rows_grads"] = [
                     m.rows for m in _find_staged(grads)]
@@ -298,6 +315,17 @@ class Trainer:
                         "stage(ids) on every module from staged_modules() "
                         "before each training step")
         new_state, metrics = self._train_step(self._state, batch, key)
+        ns = metrics.pop("_numerics", None)
+        if ns is not None:
+            # ring the device scalars as-is (no fetch, no sync)
+            _obs_numerics.observe(ns)
+        if ns is not None or self.grad_guard is not None:
+            # post-fault-hook batch/key stashed so the resilience layer's
+            # NaN-provenance post-mortem replays the EXACT inputs —
+            # including a fault-hook-poisoned batch.  Guarded trainers
+            # stash with or without a flight recorder: provenance is
+            # default-on and must not silently replay a clean batch.
+            self._last_step_inputs = (batch, key)
         if self.grad_guard is not None and not self.grad_guard(metrics):
             # rejected update: keep the pre-step state, drop the staged
             # grads (never push an anomalous gradient to the host/PS

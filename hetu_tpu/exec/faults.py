@@ -48,6 +48,15 @@ fault kind         hook site (module seam)               effect
                                                          deleted — recovery
                                                          must ride the ring
                                                          replica
+``bit_flip``       ``exec.gang.ElasticGang``             one bit of rank
+                   (divergence check)                    ``worker``'s
+                                                         post-update replica
+                                                         is flipped (``arg``
+                                                         indexes the bit) —
+                                                         the divergence
+                                                         detector must name
+                                                         the step/worker/
+                                                         shard
 =================  ====================================  ===================
 
 Two scheduling conventions coexist for the worker-targeted kinds: in
@@ -86,7 +95,7 @@ __all__ = ["Fault", "FaultPlan", "install", "uninstall", "inject", "fire",
            "active_plan", "KINDS"]
 
 KINDS = ("ps_socket_kill", "ckpt_truncate", "ckpt_corrupt", "grad_nan",
-         "hang", "worker_kill", "worker_stall", "shard_loss")
+         "hang", "worker_kill", "worker_stall", "shard_loss", "bit_flip")
 
 # C-client dead-socket status (net.RemoteEmbeddingTable._NET_ERRS)
 _DEAD_SOCKET = -10

@@ -75,9 +75,11 @@ from hetu_tpu.exec import partial as _partial
 from hetu_tpu.exec.checkpoint import (CheckpointError, _atomic_write_bytes,
                                       load_checkpoint, load_state_dict,
                                       read_footer_crc, save_checkpoint)
+from hetu_tpu.obs import divergence as _obs_divergence
 from hetu_tpu.obs import fleet as _obs_fleet
 from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import numerics as _obs_numerics
 from hetu_tpu.obs import registry as _obs
 
 __all__ = ["GangError", "GangManifestError", "shard_owner", "ring_neighbor",
@@ -202,6 +204,18 @@ def save_shard(gang_dir: str, rank: int, world_size: int, step: int,
     p = shard_path(gang_dir, rank, step)
     os.makedirs(os.path.dirname(p), exist_ok=True)
     save_checkpoint(p, own, extra=meta)
+    # content fingerprint sidecar: the deterministic uint32 fingerprint of
+    # the shard's floating entries (obs.numerics host mirror — bitwise
+    # the device fingerprint), recorded by the manifest beside the CRC.
+    # The CRC proves the BYTES survived; the fingerprint identifies the
+    # NUMBERS, so a divergent replica's shard is nameable from manifests
+    # alone.  Partial-reduce correction entries (``partialreduce.*``) are
+    # in ``sd`` like any parameter, so they are fingerprinted for free.
+    fp_body = {"fingerprint": _obs_numerics.host_state_fingerprint(own),
+               "groups": _obs_numerics.host_tree_fingerprints(own)}
+    _atomic_write_bytes(p + ".fp.json",
+                        (json.dumps(fp_body, sort_keys=True) + "\n"
+                         ).encode())
     nbr = ring_neighbor(rank, world_size)
     if nbr != rank:
         rep = {k: v for k, v in sd.items()
@@ -241,8 +255,19 @@ def write_manifest(gang_dir: str, step: int, generation: int,
                 f"cannot write gang manifest for step {step}: shard for "
                 f"rank {r} never appeared at {p} (worker crashed before "
                 f"its save, or wait_timeout={wait_timeout}s too short)")
-        shards[str(r)] = {"crc32": crc,
-                          "relpath": os.path.relpath(p, gang_dir)}
+        ent = {"crc32": crc, "relpath": os.path.relpath(p, gang_dir)}
+        # content fingerprint beside the CRC (from save_shard's sidecar):
+        # absent for shards written by an older build — manifests carry it
+        # best-effort and loaders never require it (MIGRATING note)
+        try:
+            with open(p + ".fp.json") as f:
+                fp_body = json.load(f)
+            ent["fingerprint"] = int(fp_body["fingerprint"])
+            ent["fingerprint_groups"] = {
+                g: int(v) for g, v in fp_body.get("groups", {}).items()}
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        shards[str(r)] = ent
     body = {"format": MANIFEST_FORMAT, "step": int(step),
             "generation": int(generation), "world_size": int(world_size),
             "rng": list(rng if rng is not None else get_seed_status()),
@@ -373,7 +398,7 @@ def load_gang_checkpoint(gang_dir: str, restore_rng: bool = True) -> tuple:
     return None, None, None, None, report
 
 
-_STEP_SUFFIX_RE = re.compile(r"\.step_(\d+)$")
+_STEP_SUFFIX_RE = re.compile(r"\.step_(\d+)(?:\.fp\.json)?$")
 
 
 def prune_gang(gang_dir: str, keep: int) -> None:
@@ -799,7 +824,7 @@ class ElasticGang:
                  seed: int = 0, save_every: int = 2, keep: int = 4,
                  lease_steps: int = 1,
                  partial: Optional["_partial.PartialReduceConfig"] = None,
-                 goodput=None):
+                 goodput=None, numerics=None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -829,6 +854,22 @@ class ElasticGang:
         # installing a process-wide meter, which would double-count —
         # Trainer.step's seam bills the installed meter in WALL time
         self.goodput = goodput
+        # numerics observability (obs.numerics/obs.divergence): True (or a
+        # DivergenceDetector) turns on the per-step cross-replica
+        # fingerprint check — every live worker's post-update parameter
+        # fingerprints (per group, partial-reduce correction entries
+        # included) are compared each committed step and a mismatch
+        # journals ``replica_divergence`` naming the step/worker/shard —
+        # plus NaN provenance on the first poisoned partial-reduce
+        # contribution and per-step gradient stats into the installed
+        # flight recorder.  Default off: the sim costs nothing new.
+        if numerics is True:
+            numerics = _obs_divergence.DivergenceDetector()
+        self.divergence: Optional[_obs_divergence.DivergenceDetector] = \
+            numerics if numerics else None
+        self._pending_flips: dict = {}
+        self._provenanced_steps: set = set()
+        self._last_grad_stats: Optional[dict] = None
         self.partial = partial
         self.reducer: Optional[_partial.PartialReducer] = None
         if partial is not None:
@@ -903,13 +944,18 @@ class ElasticGang:
             # (worker=None, step-as-worker-index) stays PENDING for its
             # own harness instead of being popped here
             f = plan.take("worker_kill", "worker_stall", "shard_loss",
-                          require_worker=True)
+                          "bit_flip", require_worker=True)
             if f is None:
                 return
             w = int(f.worker)
             if w >= self.world_size:
                 continue  # target already gone at fire time
-            if f.kind == "shard_loss":
+            if f.kind == "bit_flip":
+                # post-reduce corruption: rank w's replica of the updated
+                # parameters differs by one bit — consumed by the
+                # divergence check after this step commits
+                self._pending_flips.setdefault(w, []).append(f)
+            elif f.kind == "shard_loss":
                 # the STORAGE dies; orthogonal to process liveness (a
                 # killed worker's disk is usually the one that vanishes)
                 shutil.rmtree(worker_dir(self.gang_dir, w),
@@ -954,6 +1000,8 @@ class ElasticGang:
         self._dead = set()
         self._stalled_until = {remap[o]: v for o, v in
                                self._stalled_until.items() if o in remap}
+        self._pending_flips = {remap[o]: v for o, v in
+                               self._pending_flips.items() if o in remap}
         resumed = self._restore(rank_map=remap)
         self._last_beat = {w: resumed for w in range(self.world_size)}
         _obs_journal.record("gang_rescale", generation=self.generation,
@@ -1034,9 +1082,73 @@ class ElasticGang:
         loss = float(metrics["loss"])
         self.history.append((s, loss))
         self.losses_by_step[s] = loss
+        if self.divergence is not None:
+            self._check_divergence(s)
         if self.save_every > 0 and s % self.save_every == 0:
             self.save()
         return metrics
+
+    # -- numerics observability ---------------------------------------------
+
+    def _replica_state(self) -> dict:
+        """Host flat view of the post-update parameters every replica
+        must hold bitwise — pending partial-reduce corrections included
+        (they persist as ``partialreduce.*`` entries, so a diverged
+        correction term is nameable like any parameter shard)."""
+        import jax
+        sd = {k: np.asarray(jax.device_get(v)) for k, v in
+              named_parameters(self.trainer.state.model)}
+        if self.reducer is not None:
+            sd.update(self.reducer.state_entries())
+        return sd
+
+    def _check_divergence(self, s: int) -> None:
+        """Compare every live worker's post-update parameter fingerprints
+        for step ``s``.  The lock-step simulation holds ONE set of
+        parameters, so healthy replicas agree by construction; an
+        injected ``bit_flip`` fault perturbs the target rank's replica
+        view by one bit, and the detector must name it."""
+        sd = self._replica_state()
+        fps = _obs_numerics.host_tree_fingerprints(sd)
+        per_worker = {}
+        for w in range(self.world_size):
+            flips = self._pending_flips.pop(w, None)
+            per_worker[w] = (fps if not flips
+                             else _flipped_fingerprints(sd, fps, flips))
+        if self.partial is not None:
+            # ring the step's numbers (partial mode bypasses the
+            # Trainer.step seam, so the gang feeds the recorder itself);
+            # the post-update fingerprints ride along for the snapshot-
+            # cadence gauge publication
+            stats: dict = {"param_fp": fps}
+            if self._last_grad_stats is not None:
+                stats["grad"] = self._last_grad_stats
+                self._last_grad_stats = None
+            _obs_numerics.observe(stats, step=s)
+        self.divergence.check(s, per_worker)
+
+    def _maybe_provenance(self, s: int, model, shard: dict, key) -> None:
+        """NaN provenance for one poisoned partial-reduce contribution:
+        interpret the grad jaxpr on the exact (model, shard, key) and
+        journal the first non-finite producer — once per step, post-
+        mortem path only."""
+        if self.divergence is None or s in self._provenanced_steps:
+            return
+        self._provenanced_steps.add(s)
+        try:
+            rep = _obs_numerics.loss_provenance(
+                self.trainer.loss_fn, model,
+                {k: v for k, v in shard.items()}, key)
+        except Exception as e:
+            _obs_journal.record("nan_provenance", step=s,
+                                op="provenance_error", origin="error",
+                                error=str(e))
+            return
+        if rep is not None:
+            _obs_journal.record(
+                "nan_provenance", step=s, op=rep["op"],
+                origin=rep["origin"], site=rep.get("site"),
+                **({"leaf": rep["leaf"]} if "leaf" in rep else {}))
 
     def _partial_step(self, s: int, shards: list, parts: list) -> dict:
         """The arrival-collection phase: stage every live worker's shard
@@ -1078,6 +1190,7 @@ class ElasticGang:
         contributions: dict = {}
         losses: dict = {}
         template = None
+        nonfinite_seen = False
         for w in range(self.world_size):
             n = float(len(parts[w]))
             if w not in ontime:
@@ -1101,6 +1214,19 @@ class ElasticGang:
                 if np.issubdtype(a.dtype, np.floating):
                     flat[name] = a
             losses[w] = (n, float(loss))
+            if self.divergence is not None and (
+                    not np.isfinite(losses[w][1])
+                    or not _partial._is_finite(flat)):
+                # numerics post-mortem on the poisoned contribution: the
+                # provenance interpreter sees the exact (model, shard,
+                # key) that went non-finite, so it names where the NaN
+                # entered (the poisoned input leaf, or the op that bore
+                # it); once per step, cold path only
+                nonfinite_seen = True
+                _obs_numerics.note_outcome(False, step=s,
+                                           signal="contribution")
+                self._maybe_provenance(s, model, shard,
+                                       jax.random.fold_in(key, w))
             if w in ontime:
                 if template is None:
                     template = grads
@@ -1110,6 +1236,16 @@ class ElasticGang:
                                         n, flat)
         combined, info = self.reducer.reduce(s, contributions,
                                              degraded=degraded, waited=wait)
+        if self.divergence is not None:
+            if not nonfinite_seen:
+                _obs_numerics.note_outcome(True, step=s,
+                                           signal="contribution")
+            if combined is not None:
+                # the reduced gradient's per-group stats ride the flight
+                # recorder ring (host numpy — the gradients are already
+                # on host in this harness, no device sync added)
+                self._last_grad_stats = _obs_numerics.host_group_stats(
+                    combined)
         if combined is not None:
             gtree = load_state_dict(template, combined)
             self.trainer.state = self._apply_fn(self.trainer.state, gtree)
@@ -1179,3 +1315,39 @@ def _to_device(tree):
     import jax.tree_util as jtu
     return jtu.tree_map(
         lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def _flipped_fingerprints(sd: dict, fps: dict, flips: list) -> dict:
+    """Fingerprints of a replica whose state differs from ``sd`` by the
+    injected bit flips: honestly re-fingerprint a perturbed copy of the
+    target array (never fake the fingerprint directly) so the detector
+    is exercised end to end.  Each fault's ``arg`` indexes the flipped
+    bit; the target is the first floating entry in sorted-name order —
+    deterministic, so seeded replays diverge identically."""
+    names = sorted(n for n in sd
+                   if np.issubdtype(np.asarray(sd[n]).dtype, np.floating)
+                   and np.asarray(sd[n]).size > 0)
+    if not names:
+        return fps
+    out = dict(fps)
+    target = names[0]
+    a = np.asarray(sd[target]).copy()
+    for f in flips:
+        bit = int(f.arg or 0)
+        if a.dtype.itemsize == 8:
+            u = a.reshape(-1).view(np.uint64)
+        elif a.dtype.itemsize == 4:
+            u = a.reshape(-1).view(np.uint32)
+        elif a.dtype.itemsize == 2:
+            u = a.reshape(-1).view(np.uint16)
+        else:
+            u = a.reshape(-1).view(np.uint8)
+        width = u.dtype.itemsize * 8
+        u[(bit // width) % u.size] ^= np.asarray(
+            1 << (bit % width), u.dtype)
+    from hetu_tpu.obs.numerics import _group_of, host_tree_fingerprints
+    group = _group_of(target, 2)
+    members = {n: (a if n == target else sd[n]) for n in sd
+               if _group_of(n, 2) == group}
+    out.update(host_tree_fingerprints(members))
+    return out

@@ -54,6 +54,7 @@ from hetu_tpu.exec.checkpoint import (AsyncCheckpointer, CheckpointError,
 from hetu_tpu.exec.partial import split_state_entries as _split_partial
 from hetu_tpu.obs import goodput as _obs_goodput
 from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import numerics as _obs_numerics
 from hetu_tpu.obs import registry as _obs
 
 __all__ = ["ResilientTrainer", "BackendUnresponsive", "Preempted",
@@ -246,7 +247,8 @@ class ResilientTrainer:
                  keep: int = 3, anomaly_policy: str = "skip",
                  max_consecutive_anomalies: int = 3,
                  step_timeout: Optional[float] = None,
-                 handle_signals: bool = False, gang=None, partial=None):
+                 handle_signals: bool = False, gang=None, partial=None,
+                 nan_provenance: bool = True):
         if anomaly_policy not in ("skip", "raise", "off"):
             raise ValueError(
                 f"anomaly_policy must be 'skip', 'raise' or 'off', "
@@ -266,6 +268,11 @@ class ResilientTrainer:
         self.step_timeout = step_timeout
         self.gang = gang
         self.partial = partial
+        # numerics post-mortem: on the FIRST anomaly of a streak, dump
+        # the flight-recorder ring (obs.numerics.install) and interpret
+        # the step's jaxpr to name the first non-finite producer.  Cold
+        # path only — a healthy run never pays for it.
+        self.nan_provenance = bool(nan_provenance)
         if gang is not None and (os.path.normpath(gang.gang_dir)
                                  != os.path.normpath(ckpt_dir)):
             # save() writes where the gang points but resume()/rollback
@@ -469,6 +476,10 @@ class ResilientTrainer:
             _res_m()["rollbacks"].inc()
             _obs_journal.record("rollback", at_step=self._step,
                                 to_step=int(extra.get("step", step)))
+        # the flight recorder's ring holds the steps that led here — dump
+        # it before the restore makes them unreconstructable (no-op with
+        # no recorder installed)
+        _obs_numerics.dump("rollback", step=self._step)
         # the restore itself is lost time: bill it to the goodput
         # "rollback" bucket (the rejected steps were billed there by the
         # Trainer.step seam as they happened)
@@ -512,7 +523,12 @@ class ResilientTrainer:
                 "gradient detection.", RuntimeWarning, stacklevel=2)
         loss = float(metrics.get("loss", 0.0))
         gnorm = float(metrics.get("grad_norm", 0.0))
-        if np.isfinite(loss) and np.isfinite(gnorm):
+        finite = bool(np.isfinite(loss) and np.isfinite(gnorm))
+        # streak accounting from values already fetched to host — the
+        # hetu_numerics_nonfinite_streak gauge costs no extra sync (and
+        # is one global load + branch with no recorder installed)
+        _obs_numerics.note_outcome(finite, step=self._step)
+        if finite:
             return True
         if self.anomaly_policy == "raise":
             raise TrainingDiverged(
@@ -620,6 +636,11 @@ class ResilientTrainer:
             reset_seed_seqnum(*rng0)
             self._step -= 1
             self._consec += 1
+            if self._consec == 1:
+                # first anomaly of a streak: numerics post-mortem (flight
+                # dump + jaxpr provenance) before any rollback mutates
+                # the state the NaN was born under
+                self._numerics_postmortem(self._step + 1, batch, key)
             if self._consec >= self.max_consecutive_anomalies:
                 metrics["rolled_back_to"] = self._rollback()
                 self._consec = 0
@@ -629,6 +650,34 @@ class ResilientTrainer:
                 self.save()
         self._maybe_preempt()
         return metrics
+
+    def _numerics_postmortem(self, step: int, batch, key) -> None:
+        """First-anomaly-of-a-streak forensics: dump the flight-recorder
+        ring (``flight_dump``, no-op without an installed recorder) and
+        interpret the step's ``value_and_grad`` jaxpr to journal
+        ``nan_provenance`` naming the first non-finite producer.  The
+        trainer's stashed post-fault-hook inputs are preferred so an
+        injected poison is replayed exactly."""
+        _obs_numerics.dump("nan_skip", step=step)
+        if not (self.nan_provenance and _obs.enabled()):
+            return
+        stashed = getattr(self.trainer, "_last_step_inputs", None)
+        if stashed is not None:
+            batch, key = stashed
+        try:
+            rep = _obs_numerics.loss_provenance(
+                self.trainer.loss_fn, self.trainer.state.model, batch,
+                key)
+        except Exception as e:
+            _obs_journal.record("nan_provenance", step=step,
+                                op="provenance_error", origin="error",
+                                error=str(e))
+            return
+        if rep is not None:
+            _obs_journal.record(
+                "nan_provenance", step=step, op=rep["op"],
+                origin=rep["origin"], site=rep.get("site"),
+                **({"leaf": rep["leaf"]} if "leaf" in rep else {}))
 
     def _maybe_preempt(self):
         if self._preempt_signum is None:

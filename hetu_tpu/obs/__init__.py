@@ -49,6 +49,13 @@ the disabled path is a single global load + branch per seam.
 
 from hetu_tpu.obs.compile import (InstrumentedJit, StormDetector,
                                   compile_report, instrument, watch)
+from hetu_tpu.obs.divergence import (DivergenceDetector, FingerprintBoard,
+                                     compare_fleet)
+from hetu_tpu.obs.numerics import (FlightRecorder, first_nonfinite,
+                                   fingerprint, group_stats,
+                                   host_fingerprint, host_fingerprint_ints,
+                                   host_group_stats, install_recorder,
+                                   loss_provenance, tree_fingerprints)
 from hetu_tpu.obs.fleet import (FleetAggregator, SnapshotPublisher,
                                 fleet_routes, serve_fleet)
 from hetu_tpu.obs.goodput import GoodputMeter
@@ -77,4 +84,8 @@ __all__ = [
     "SLOEngine", "SLOTargets",
     "InstrumentedJit", "StormDetector", "instrument", "watch",
     "compile_report",
+    "FlightRecorder", "install_recorder", "fingerprint", "group_stats",
+    "tree_fingerprints", "host_fingerprint", "host_fingerprint_ints",
+    "host_group_stats", "first_nonfinite", "loss_provenance",
+    "DivergenceDetector", "FingerprintBoard", "compare_fleet",
 ]

@@ -1,0 +1,326 @@
+"""Cross-replica divergence detection over parameter fingerprints.
+
+Under data parallelism every replica's post-update parameters must agree
+**bitwise** after the reduce — that is the invariant every bitwise-replay
+acceptance test in this repo rests on — yet a flipped bit in one
+worker's optimizer state, a torn shard restore, or a non-deterministic
+collective silently violates it until the loss curves drift apart hours
+later.  This module turns the invariant into a per-step check built on
+the :mod:`~hetu_tpu.obs.numerics` fingerprints:
+
+- :class:`DivergenceDetector` — rank-0 comparison: given every worker's
+  per-group post-update fingerprints for one step, the majority value
+  per group is the reference and any disagreeing worker is journaled as
+  ``replica_divergence`` naming the first divergent **step**, **worker**,
+  and **parameter shard** (group).  Partial-reduce correction terms are
+  covered for free: they persist as ``partialreduce.*`` entries in the
+  same flat state dicts the fingerprints (and the gang's manifest
+  fingerprints) are computed over.
+- :class:`FingerprintBoard` — the multi-process substrate: per-step
+  atomic fingerprint posts into ``<gang_dir>/numerics/`` (the
+  ``GradientBoard`` tmp+replace convention), collected and compared by
+  rank 0.
+- The **fleet path**: workers publish their latest fingerprints as
+  ``hetu_numerics_param_fingerprint{group}`` gauges (flushed at the
+  heartbeat-snapshot cadence by
+  :func:`~hetu_tpu.obs.numerics.flush_fingerprints`), so they ride the
+  PR-8 snapshots; :func:`compare_fleet` gives the aggregator's
+  ``/fleet/divergence`` report — workers are only compared when their
+  ``hetu_numerics_fingerprint_step`` gauges match, so a slow publisher
+  is reported as unsynchronized, never as divergent.
+
+A detected divergence flips a process-wide flag (:func:`detected`) that
+``/healthz`` surfaces as a red flag, increments
+``hetu_numerics_divergence_total``, and sets
+``hetu_numerics_divergence_detected`` — a run that is dying stops
+reporting "ok".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import numerics as _numerics
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["DivergenceDetector", "FingerprintBoard", "compare_fleet",
+           "detected", "reset_detected"]
+
+_div_metrics = None
+
+
+def _div_m() -> dict:
+    global _div_metrics
+    if _div_metrics is None:
+        reg = _obs.get_registry()
+        _div_metrics = {
+            "divergences": reg.counter(
+                "hetu_numerics_divergence_total",
+                "replica-divergence findings (one per divergent (step, "
+                "worker, parameter-group) triple)"),
+            "detected": reg.gauge(
+                "hetu_numerics_divergence_detected",
+                "1 once any replica divergence has been detected this "
+                "process lifetime (the /healthz red flag), else 0"),
+            "checks": reg.counter(
+                "hetu_numerics_divergence_checks_total",
+                "cross-replica fingerprint comparisons performed"),
+        }
+    return _div_metrics
+
+
+# Process-wide red flag: set on first finding, read by /healthz.
+_detected = False
+_detected_lock = threading.Lock()
+
+
+def detected() -> bool:
+    return _detected
+
+
+def reset_detected() -> None:
+    """Test hook: clear the process-wide divergence flag."""
+    global _detected
+    with _detected_lock:
+        _detected = False
+        if _obs.enabled():
+            _div_m()["detected"].set(0.0)
+
+
+def _flag() -> None:
+    global _detected
+    with _detected_lock:
+        _detected = True
+        if _obs.enabled():
+            _div_m()["detected"].set(1.0)
+
+
+class DivergenceDetector:
+    """Rank-0 per-step comparison of every replica's parameter
+    fingerprints.
+
+    ``check(step, {worker: {group: fp}})`` elects the majority
+    fingerprint per group as the reference (ties break toward the lowest
+    rank's value, so seeded replays report identically) and journals one
+    ``replica_divergence`` per disagreeing (worker, group).  Findings
+    accumulate on ``.events`` — ``first`` names the first divergent
+    step/worker/shard, the post-mortem headline."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = int(depth)
+        self.events: list = []     # [{step, worker, shard, ...}]
+        self.checks = 0
+        # a corrupted replica stays divergent on EVERY later step; the
+        # journal entry, the stored event, and the flight-recorder dump
+        # fire once per (worker, shard) pair — repeats only tick the
+        # counter, so a long divergent run cannot flood the journal
+        # (which rides every fleet snapshot) or grow events unboundedly
+        self._seen: set = set()
+
+    @property
+    def first(self) -> Optional[dict]:
+        return self.events[0] if self.events else None
+
+    def check(self, step: int,
+              fingerprints: Dict[int, Dict[str, int]]) -> list:
+        """Compare one step's per-worker fingerprint maps; returns (and
+        records) the divergence findings."""
+        self.checks += 1
+        if _obs.enabled():
+            _div_m()["checks"].inc()
+        if len(fingerprints) < 2:
+            return []
+        groups = sorted({g for fps in fingerprints.values() for g in fps})
+        findings = []
+        fresh = False
+        for g in groups:
+            votes: dict = {}
+            for w in sorted(fingerprints):
+                fp = fingerprints[w].get(g)
+                if fp is not None:
+                    votes.setdefault(int(fp), []).append(w)
+            if len(votes) <= 1:
+                continue
+            # majority value; ties break toward the one the lowest rank
+            # holds, so two same-seed replays elect the same reference
+            ref = max(votes, key=lambda v: (len(votes[v]), -min(votes[v])))
+            for fp, workers in sorted(votes.items()):
+                if fp == ref:
+                    continue
+                for w in workers:
+                    finding = {"step": int(step), "worker": int(w),
+                               "shard": g, "fingerprint": int(fp),
+                               "expected": int(ref)}
+                    findings.append(finding)
+                    if _obs.enabled():
+                        _div_m()["divergences"].inc()
+                    if (int(w), g) in self._seen:
+                        continue   # still-divergent repeat: counter only
+                    self._seen.add((int(w), g))
+                    fresh = True
+                    self.events.append(finding)
+                    _journal.record("replica_divergence", step=int(step),
+                                    worker=int(w), shard=g,
+                                    fingerprint=int(fp),
+                                    expected=int(ref))
+        if findings:
+            _flag()
+        if fresh:
+            # the post-mortem needs the surrounding numbers too: dump the
+            # installed flight recorder's ring (no-op without one) — once
+            # per newly-divergent (worker, shard), not per lingering step
+            _numerics.dump("divergence", step=int(step),
+                           workers=sorted(int(w) for w in fingerprints))
+        return findings
+
+    def snapshot(self) -> dict:
+        """The ``/fleet/divergence`` per-detector body."""
+        return {"checks": self.checks, "divergent": bool(self.events),
+                "first": self.first, "events": list(self.events)}
+
+
+class FingerprintBoard:
+    """File-based per-step fingerprint exchange for multi-process gangs —
+    the ``GradientBoard`` conventions (atomic tmp+replace posts under the
+    shared gang dir) applied to the divergence check.  Every worker
+    ``post``s its post-update fingerprints after the step commits; the
+    decider rank ``collect``s and feeds a :class:`DivergenceDetector`."""
+
+    def __init__(self, gang_dir: str):
+        self.dir = os.path.join(gang_dir, "numerics")
+
+    def _path(self, step: int, rank: int) -> str:
+        return os.path.join(self.dir, f"step_{int(step):08d}",
+                            f"fp_{int(rank):04d}.json")
+
+    def post(self, step: int, rank: int,
+             fingerprints: Dict[str, int]) -> str:
+        path = self._path(step, rank)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "rank": int(rank),
+                       "fingerprints": {g: int(v) for g, v in
+                                        sorted(fingerprints.items())}}, f)
+        os.replace(tmp, path)
+        return path
+
+    def take(self, step: int, rank: int) -> Optional[Dict[str, int]]:
+        try:
+            with open(self._path(step, rank)) as f:
+                body = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return {g: int(v) for g, v in body.get("fingerprints", {}).items()}
+
+    def collect(self, step: int, ranks: Sequence[int], *,
+                timeout_s: float = 30.0,
+                poll: float = 0.01) -> Dict[int, Dict[str, int]]:
+        """Wait for every rank's post for ``step``; raises TimeoutError
+        naming the missing ranks (a worker that cannot even post its
+        fingerprint is a membership problem, not a numerics one)."""
+        want = [int(r) for r in ranks]
+        got: dict = {}
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            for r in want:
+                if r not in got:
+                    fps = self.take(step, r)
+                    if fps is not None:
+                        got[r] = fps
+            if len(got) == len(want):
+                return got
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fingerprint board for step {step}: only "
+                    f"{sorted(got)} of {want} posted within {timeout_s}s")
+            time.sleep(poll)
+
+    def compare(self, step: int, ranks: Sequence[int],
+                detector: Optional[DivergenceDetector] = None, *,
+                timeout_s: float = 30.0) -> list:
+        """Collect + check in one call (the decider rank's per-step
+        form).  Returns the findings."""
+        det = detector if detector is not None else DivergenceDetector()
+        return det.check(step, self.collect(step, ranks,
+                                            timeout_s=timeout_s))
+
+    def prune(self, keep_after: int) -> None:
+        """Drop step directories at or below ``keep_after`` (best-effort,
+        the retention idiom of the gradient board)."""
+        import re
+        import shutil
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in names:
+            m = re.match(r"^step_(\d+)$", name)
+            if m and int(m.group(1)) <= int(keep_after):
+                shutil.rmtree(os.path.join(self.dir, name),
+                              ignore_errors=True)
+
+
+def compare_fleet(snapshots: dict) -> dict:
+    """The aggregator-side comparison over published worker snapshots
+    (the ``/fleet/divergence`` payload): read each worker's
+    ``hetu_numerics_param_fingerprint{group}`` gauges plus its
+    ``hetu_numerics_fingerprint_step``, compare only workers whose
+    fingerprint steps MATCH (the snapshot cadence means they can lag a
+    step — lag is "unsynchronized", not divergence), and name any group
+    whose fingerprints disagree within a matched-step cohort.
+
+    Returns ``{"workers", "by_step", "divergent", "findings",
+    "unsynchronized"}``; does NOT journal — the per-step detectors own
+    the journal, this is the scrape view."""
+    per_worker: dict = {}   # rank -> (step, {group: fp})
+    for rank in sorted(snapshots):
+        fams = {ent["name"]: ent for ent in
+                snapshots[rank].get("registry", {}).get("families", [])}
+        fp_fam = fams.get("hetu_numerics_param_fingerprint")
+        step_fam = fams.get("hetu_numerics_fingerprint_step")
+        if fp_fam is None or step_fam is None \
+                or not step_fam.get("children"):
+            continue
+        step = int(float(step_fam["children"][0]["value"]))
+        fps = {}
+        labelnames = tuple(fp_fam.get("labelnames", ()))
+        for child in fp_fam.get("children", []):
+            labels = dict(zip(labelnames, child["labels"]))
+            fps[labels.get("group", "")] = int(float(child["value"]))
+        per_worker[int(rank)] = (step, fps)
+    by_step: dict = {}
+    for rank, (step, fps) in per_worker.items():
+        by_step.setdefault(step, {})[rank] = fps
+    findings = []
+    for step in sorted(by_step):
+        cohort = by_step[step]
+        if len(cohort) < 2:
+            continue
+        groups = sorted({g for fps in cohort.values() for g in fps})
+        for g in groups:
+            votes: dict = {}
+            for w in sorted(cohort):
+                fp = cohort[w].get(g)
+                if fp is not None:
+                    votes.setdefault(fp, []).append(w)
+            if len(votes) <= 1:
+                continue
+            ref = max(votes, key=lambda v: (len(votes[v]),
+                                            -min(votes[v])))
+            for fp, workers in sorted(votes.items()):
+                if fp != ref:
+                    findings.extend(
+                        {"step": step, "worker": w, "shard": g,
+                         "fingerprint": fp, "expected": ref}
+                        for w in workers)
+    steps = {s for s, _f in per_worker.values()}
+    return {"workers": len(per_worker),
+            "by_step": {str(s): sorted(c) for s, c in by_step.items()},
+            "divergent": bool(findings), "findings": findings,
+            "unsynchronized": len(steps) > 1}

@@ -41,6 +41,9 @@ snapshot or the new one, never a torn file):
      deadline's input)
    - ``/fleet/trace``       stitched Chrome trace JSON
    - ``/fleet/goodput``     the installed goodput meter's snapshot
+   - ``/fleet/divergence``  cross-replica parameter-fingerprint
+     comparison (matched-step cohorts only; lagging publishers are
+     ``unsynchronized``, not divergent)
    - ``/fleet/slo``         serving-SLO merge: summed stage seconds /
      request verdicts / violations, worst-of-fleet burn rates and shed
      pressure (max across workers — the router's placement input)
@@ -132,6 +135,13 @@ class SnapshotPublisher:
             if not force and self._last is not None \
                     and now - self._last < self.interval:
                 return None
+            # post-update parameter fingerprints ride the snapshot: the
+            # flight recorder publishes its latest device fingerprints as
+            # gauges here — ONE host fetch per publication (heartbeat
+            # cadence), never per step; one global load + branch with no
+            # recorder installed
+            from hetu_tpu.obs import numerics as _numerics
+            _numerics.flush_fingerprints()
             reg = self.registry if self.registry is not None \
                 else _registry.get_registry()
             j = self.journal if self.journal is not None \
@@ -408,7 +418,11 @@ class FleetAggregator:
         """Per-worker snapshot freshness: age, publication seq, journal
         length; workers whose snapshot is older than ``stale_after`` are
         flagged and flip the status to ``degraded`` (so a wedged worker
-        is one scrape away from being named, not inferred)."""
+        is one scrape away from being named, not inferred).  Fleet-wide
+        red flags ride along: an active non-finite streak on any worker,
+        a replica divergence across published fingerprints, a compile
+        storm anywhere — /fleet/healthz must not say "ok" while one
+        replica is dying."""
         self._families()  # (re)compute schema conflicts for this report
         now = self.clock()
         workers, stale = {}, []
@@ -423,12 +437,46 @@ class FleetAggregator:
                 "journal_events": len(body.get("journal", [])),
                 "spans": len(body.get("spans", [])),
                 "stale": is_stale}
-        return {"status": "degraded" if stale or self.conflicts else "ok",
+        flags = self._red_flags()
+        return {"status": ("degraded" if stale or self.conflicts or flags
+                           else "ok"),
                 "workers": workers, "stale_workers": stale,
                 "stale_after_s": self.stale_after,
+                "flags": flags,
                 "schema_conflicts": [
                     {"family": f, "worker": w, "diagnosis": d}
                     for f, w, d in self.conflicts]}
+
+    def _red_flags(self) -> list:
+        """Fleet-wide numerics/compile red flags over the published
+        families (max across workers: any one replica in trouble flags
+        the fleet)."""
+        flags = []
+        streak = self.merged("hetu_numerics_nonfinite_streak", agg="max")
+        if streak is not None:
+            worst = max(streak["children"].values(), default=0.0)
+            if worst > 0:
+                flags.append({"flag": "nonfinite_streak",
+                              "streak": int(worst)})
+        div = self.divergence()
+        if div["divergent"]:
+            flags.append({"flag": "replica_divergence",
+                          "findings": len(div["findings"]),
+                          "first": div["findings"][0]})
+        storm = self.merged("hetu_compile_storm", agg="max")
+        if storm is not None and max(storm["children"].values(),
+                                     default=0.0) > 0:
+            flags.append({"flag": "compile_storm"})
+        return flags
+
+    def divergence(self) -> dict:
+        """Cross-replica fingerprint comparison over the published
+        snapshots — the ``/fleet/divergence`` payload.  Workers are only
+        compared when their ``hetu_numerics_fingerprint_step`` gauges
+        match (snapshot cadence can lag a step: lag is reported as
+        ``unsynchronized``, never as divergence)."""
+        from hetu_tpu.obs import divergence as _divergence
+        return _divergence.compare_fleet(self.snapshots)
 
     def stragglers(self, k: int = 5) -> list:
         """Top-``k`` stragglers by arrival-lag EWMA
@@ -555,6 +603,12 @@ def fleet_routes(aggregator: FleetAggregator,
         aggregator.refresh()
         return json.dumps(aggregator.slo()).encode(), "application/json"
 
+    def divergence(q, b):
+        aggregator.refresh()
+        return (json.dumps(aggregator.divergence()).encode(),
+                "application/json")
+
+    routes.add("GET", "/fleet/divergence", divergence)
     routes.add("GET", "/fleet/slo", slo)
     routes.add("GET", "/fleet/metrics", metrics)
     routes.add("GET", "/fleet/healthz", healthz)

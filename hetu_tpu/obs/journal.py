@@ -46,7 +46,30 @@ kind                fields (beyond ``seq``/``ts``)
                       ``nonfinite_contribution`` = the step's own
                       on-time gradient was NaN, ``worker_lost`` = owner
                       evicted before folding)
+``replica_divergence``  ``step``, ``worker``, ``shard``,
+                      ``fingerprint``, ``expected`` (a data-parallel
+                      replica's post-update parameter fingerprint
+                      disagrees with the majority — the first divergent
+                      step/worker/shard, bitwise)
+``nan_provenance``    ``step``, ``op``, ``origin`` (``op`` = born at
+                      that primitive with finite inputs, ``input`` = an
+                      argument arrived poisoned, naming the leaf) +
+                      ``site`` when the traceback resolves
+``flight_dump``       ``reason`` (``nan_skip``/``rollback``/
+                      ``divergence``), ``step``, ``records`` (the
+                      flight recorder's ring: per-step per-group tensor
+                      stats, fetched to host on the cold path only)
 ==================  =====================================================
+
+Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
+every kind the production seams may emit, each with the set of fields
+that must always be present.  New seams register their kinds here (or
+via :func:`register_kind`); the AST lint in ``tests/test_obs.py``
+rejects any ``record("...")`` call in the tree whose kind is
+unregistered or whose statically-visible keyword arguments miss a
+required field.  Ad-hoc kinds on a *direct* ``EventJournal.record``
+call remain legal (tests and probes use them); the registry governs the
+process-wide :func:`record` seam the production code emits through.
 
 A journal is installed process-wide with :func:`set_journal` (or the
 :func:`use` context manager); the seams emit through :func:`record`,
@@ -69,7 +92,68 @@ from typing import Callable, Optional
 
 from hetu_tpu.obs import registry as _registry
 
-__all__ = ["EventJournal", "get_journal", "set_journal", "use", "record"]
+__all__ = ["EventJournal", "get_journal", "set_journal", "use", "record",
+           "EVENT_KINDS", "register_kind"]
+
+# The registry of journal event kinds: kind -> the fields every record of
+# that kind must carry (beyond the automatic ``seq``/``ts``).  The
+# REQUIRED set is the intersection across emit sites — optional fields
+# (``resume``'s ``path`` vs ``format``, ``worker_lost``'s ``step`` vs
+# ``age_s``) are legal extras, not listed here.  tests/test_obs.py walks
+# the tree's ``record(...)`` calls against this table.
+EVENT_KINDS = {
+    # resilience (PR 1/2)
+    "checkpoint_saved": frozenset(
+        {"path", "step", "bytes", "crc32", "duration_s"}),
+    "rollback": frozenset({"at_step", "to_step"}),
+    "nan_skip": frozenset({"step", "loss", "grad_norm"}),
+    "watchdog_fired": frozenset({"step", "timeout_s", "committing"}),
+    "preemption": frozenset({"step", "signum"}),
+    "ps_redial": frozenset(
+        {"address", "table_id", "attempt", "table_created"}),
+    "resume": frozenset({"step"}),
+    # elastic gang (PR 5)
+    "worker_lost": frozenset({"rank", "generation", "reason"}),
+    "gang_rescale": frozenset({"generation", "old_world", "new_world"}),
+    "shard_restore": frozenset({"rank", "from_rank", "step", "generation"}),
+    "manifest_skipped": frozenset({"step", "generation", "reason"}),
+    "rescale_timeout": frozenset({"generation", "waiting_on", "timeout_s"}),
+    # partial reduce (PR 6)
+    "partial_step": frozenset(
+        {"step", "arrivals", "late_folds", "dropped", "degraded",
+         "waited"}),
+    "late_fold": frozenset({"step", "worker", "origin_step", "age"}),
+    "stale_drop": frozenset(
+        {"step", "worker", "origin_step", "age", "reason"}),
+    # kernels / autotune (PR 7)
+    "retune": frozenset({"kernel", "candidates", "compiles", "duration_s"}),
+    # serving (PR 3/9)
+    "serve_reject": frozenset({"request_id", "reason", "queue_depth"}),
+    "serve_evict": frozenset({"request_id", "tokens_generated"}),
+    "request_expired": frozenset({"request_id", "stage"}),
+    # compile telemetry (PR 9)
+    "compile": frozenset({"site", "programs", "sig", "duration_s", "aot"}),
+    "recompile": frozenset(
+        {"site", "programs", "sig", "duration_s", "aot"}),
+    "compile_storm": frozenset({"site", "recent", "threshold", "window_s"}),
+    # numerics observability (PR 10)
+    "replica_divergence": frozenset(
+        {"step", "worker", "shard", "fingerprint", "expected"}),
+    "nan_provenance": frozenset({"step", "op", "origin"}),
+    "flight_dump": frozenset({"reason", "step", "records"}),
+}
+
+
+def register_kind(kind: str, *required: str) -> None:
+    """Register an event kind (idempotent for an identical required set;
+    raises on a conflicting re-registration — one kind, one schema)."""
+    req = frozenset(required)
+    prev = EVENT_KINDS.get(kind)
+    if prev is not None and prev != req:
+        raise ValueError(
+            f"journal kind {kind!r} already registered with required "
+            f"fields {sorted(prev)}; refusing conflicting {sorted(req)}")
+    EVENT_KINDS[kind] = req
 
 
 class EventJournal:

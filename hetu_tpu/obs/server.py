@@ -15,7 +15,12 @@ register handlers instead of copy-pasting the HTTP plumbing:
 
   - ``/metrics``       Prometheus text exposition 0.0.4 of the registry
   - ``/metrics.json``  the same samples as a JSON snapshot
-  - ``/healthz``       liveness JSON: status, uptime, last journal seq
+  - ``/healthz``       liveness JSON: status, uptime, last journal seq,
+    plus red flags (active non-finite streak, detected replica
+    divergence, compile storm) — flags flip the status to
+    ``unhealthy``, so a dying run stops scraping "ok"
+  - ``/numerics``      flight-recorder ring tail, non-finite streak,
+    last dump, latest parameter fingerprints
   - ``/journal``       installed event journal: tail (``?n=100``) or
     cursor pagination (``?since=<seq>``, incremental polls)
 
@@ -191,13 +196,47 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
 
     def healthz(q, b):
         j = _journal.get_journal()
-        body = {"status": "ok",
+        # red flags: healthz must stop saying "ok" while a run is dying.
+        # Lazy imports keep the scrape path's module graph minimal; each
+        # check is a read of state the hot paths already maintain.
+        from hetu_tpu.obs import compile as _compile
+        from hetu_tpu.obs import divergence as _divergence
+        from hetu_tpu.obs import numerics as _numerics
+        flags = []
+        rec = _numerics.get_recorder()
+        if rec is not None and rec.nonfinite_streak > 0:
+            flags.append({"flag": "nonfinite_streak",
+                          "streak": rec.nonfinite_streak})
+        if _divergence.detected():
+            flags.append({"flag": "replica_divergence"})
+        storm = _compile.get_storm()
+        recent = storm.recent()
+        if recent > storm.threshold:
+            flags.append({"flag": "compile_storm", "recent": recent,
+                          "threshold": storm.threshold})
+        body = {"status": "unhealthy" if flags else "ok",
+                "flags": flags,
                 "uptime_s": round(time.time() - started, 3),
                 "telemetry_enabled": _registry.enabled(),
                 "journal_seq": j._seq if j is not None else None}
         return json.dumps(body).encode(), "application/json"
 
     routes.add("GET", "/healthz", healthz)
+
+    def numerics_view(q, b):
+        """``/numerics``: the flight recorder's ring tail, non-finite
+        streak, last dump, and the latest published parameter
+        fingerprints — the process-scope numerics surface (the fleet
+        comparison lives at ``/fleet/divergence``)."""
+        from hetu_tpu.obs import divergence as _divergence
+        from hetu_tpu.obs import numerics as _numerics
+        rec = _numerics.get_recorder()
+        body = {"recorder": rec.snapshot() if rec is not None else None,
+                "divergence_detected": _divergence.detected(),
+                "param_fingerprints": _numerics.flush_fingerprints()}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/numerics", numerics_view)
 
     def journal_tail(q, b):
         """Tail form (``?n=100``, newest suffix) or cursor form

@@ -72,6 +72,7 @@ import numpy as np
 
 from hetu_tpu.obs import compile as _compile
 from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import numerics as _numerics
 from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _tracing
 from hetu_tpu.obs.reqtrace import ReqTraceBuffer, RequestTimeline
@@ -142,14 +143,20 @@ class RequestHandle:
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
         self.error: Optional[str] = None   # human-readable failure reason
+        # deterministic uint32 fingerprint of the token stream
+        # (obs.numerics.host_fingerprint_ints): two same-seed runs of the
+        # same schedule must agree — a mismatch in prod IS sampler
+        # nondeterminism, detectable from the /infer response alone
+        self.stream_fingerprint: Optional[int] = None
 
     def _finish(self, status: str, tokens=(), ttft_s=None, latency_s=None,
-                error=None):
+                error=None, stream_fingerprint=None):
         self.status = status
         self.tokens = list(tokens)
         self.ttft_s = ttft_s
         self.latency_s = latency_s
         self.error = error
+        self.stream_fingerprint = stream_fingerprint
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -590,14 +597,22 @@ class ServingEngine:
                      f"{age:.6g}s while decoding "
                      f"({len(req.tokens)} tokens generated)")
         m["requests"].labels(outcome=outcome).inc()
+        # per-request token-stream fingerprint: O(tokens) host numpy, so
+        # sampler nondeterminism is a field comparison in prod, not a
+        # token-by-token diff (rides the handle, the /infer response, and
+        # the request timeline)
+        sfp = (_numerics.host_fingerprint_ints(req.tokens)
+               if req.tokens else None)
         tl = self._timelines.pop(req.id)
-        tl.close(outcome, now, tokens=len(req.tokens))
+        tl.close(outcome, now, tokens=len(req.tokens),
+                 **({"stream_fp": sfp} if sfp is not None else {}))
         self._finalize_timeline(tl)
         self._handles.pop(req.id)._finish(
             outcome, req.tokens,
             ttft_s=(None if req.prefill_at is None
                     else req.prefill_at - req.arrival),
-            latency_s=now - req.arrival, error=error)
+            latency_s=now - req.arrival, error=error,
+            stream_fingerprint=sfp)
 
     def _finalize_timeline(self, tl: RequestTimeline,
                            grade: bool = True) -> None:

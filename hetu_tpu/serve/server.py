@@ -64,6 +64,11 @@ def serving_routes(engine) -> Routes:
             "trace_id": handle.trace_id,
             "status": handle.status,
             "tokens": handle.tokens,
+            # deterministic token-stream fingerprint: same seed + same
+            # prompt must return the same value however the batch was
+            # composed — compare across replicas/replays to catch
+            # sampler nondeterminism in prod (null until a token lands)
+            "stream_fingerprint": handle.stream_fingerprint,
             "ttft_s": handle.ttft_s,
             "latency_s": handle.latency_s,
         }

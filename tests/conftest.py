@@ -81,11 +81,32 @@ def pytest_configure(config):
                    "interpret-mode parity suites are tier-1, on-device "
                    "measurement/tuning runs are additionally marked slow")
     config.addinivalue_line(
+        "markers", "numerics: numerics-observability tests (obs.numerics "
+                   "flight recorder / deterministic fingerprints / NaN "
+                   "provenance, obs.divergence cross-replica detection, "
+                   "and their trainer/gang/serving seams); the 2-worker "
+                   "divergence smoke and the in-process 4-worker chaos "
+                   "acceptance stay in tier-1")
+    config.addinivalue_line(
         "markers", "partial: straggler-tolerant partial-reduce tests "
                    "(exec.partial deadline cut / bounded-staleness folds / "
                    "correction-term persistence); multi-worker chaos runs "
                    "ride the slow tier — a 2-worker deadline-miss smoke "
                    "stays in tier-1, mirroring the gang convention")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_storm():
+    """The compile StormDetector is process-global with a real-time
+    window: left shared, a compile-heavy test flips the storm gauge (and
+    now the /healthz ``compile_storm`` red flag) for every test that
+    follows within the window.  Reset it per test so healthz/journal
+    assertions are deterministic; tests that exercise storms install
+    their own detector via ``configure_storm`` as before."""
+    from hetu_tpu.obs import compile as _obs_compile
+    _obs_compile.configure_storm(None)
+    yield
+    _obs_compile.configure_storm(None)
 
 
 @pytest.fixture

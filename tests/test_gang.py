@@ -514,8 +514,10 @@ class TestReviewRegressions:
                 write_manifest(d, step, 0, 2)
         gang.prune_gang(d, keep=2)
         assert [s for s, _p in gang.list_manifests(d)] == [6, 8]
-        leftover = sorted({int(p.rsplit("_", 1)[1]) for p in
-                           __import__("glob").glob(
+        # parse with the pruner's own step-suffix rule: worker dirs hold
+        # shards, replicas, AND the numerics fingerprint sidecars
+        leftover = sorted({int(gang._STEP_SUFFIX_RE.search(p).group(1))
+                           for p in __import__("glob").glob(
                                os.path.join(d, "worker_*", "*.step_*"))})
         assert leftover == [6, 8]  # 2 AND the orphaned 4 are gone
 

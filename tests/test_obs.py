@@ -655,6 +655,70 @@ def test_span_naming_conventions():
     assert {"serve", "compile", "train", "ps"} <= roots, roots
 
 
+def test_journal_event_kinds_registered():
+    """Satellite lint: every ``record("kind", ...)`` call in the tree
+    (the process-wide ``obs.journal.record`` seam) must name a kind
+    registered in ``journal.EVENT_KINDS`` — with its kind as a string
+    literal (an IfExp over literals is the one allowed dynamic form,
+    the compile/recompile site) — and its statically-visible keyword
+    arguments must cover the kind's required fields.  Unregistered
+    kinds and silently-missing fields are exactly how a journal schema
+    rots; direct ``EventJournal.record`` calls in tests stay free-form."""
+    import ast
+    import pathlib
+
+    import hetu_tpu
+    from hetu_tpu.obs.journal import EVENT_KINDS
+    root = pathlib.Path(hetu_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + [root.parent / "bench.py"]
+    # the journal module itself forwards record(kind, **fields) by design
+    skip = {root / "obs" / "journal.py"}
+    problems, seen_kinds = [], set()
+    for path in files:
+        if path in skip:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record"):
+                continue
+            where = f"{path.relative_to(root.parent)}:{node.lineno}"
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                kinds = [arg.value]
+            elif (isinstance(arg, ast.IfExp)
+                  and isinstance(arg.body, ast.Constant)
+                  and isinstance(arg.orelse, ast.Constant)):
+                kinds = [arg.body.value, arg.orelse.value]
+            else:
+                problems.append(
+                    f"{where}: journal kind is not a string literal "
+                    f"(dynamic kind construction defeats the registry)")
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+            has_splat = any(kw.arg is None for kw in node.keywords)
+            for kind in kinds:
+                if kind not in EVENT_KINDS:
+                    problems.append(
+                        f"{where}: unregistered journal kind {kind!r} — "
+                        f"add it to obs.journal.EVENT_KINDS with its "
+                        f"required fields")
+                    continue
+                seen_kinds.add(kind)
+                missing = EVENT_KINDS[kind] - kwargs
+                if missing and not has_splat:
+                    problems.append(
+                        f"{where}: kind {kind!r} missing required "
+                        f"fields {sorted(missing)}")
+    assert not problems, "\n".join(problems)
+    # the registry must describe reality: the new numerics kinds (and a
+    # spread of the old ones) are actually emitted somewhere in the tree
+    assert {"replica_divergence", "nan_provenance", "flight_dump",
+            "nan_skip", "rollback", "partial_step"} <= seen_kinds, \
+        sorted(seen_kinds)
+
+
 def test_metrics_endpoint_404():
     import urllib.error
     with obs.serve() as srv:
