@@ -166,6 +166,30 @@ def _numerics_fields(trainer, batch, key=None):
         return {"numerics": {"error": str(e)[:120]}}
 
 
+_CONTROLLER_SUMMARY = None
+
+
+def _controller_fields():
+    """Closed-loop remediation summary for train lines
+    (exec.controller.controller_smoke): a seeded 2-worker in-process
+    deadline-retune smoke — actions taken and the final tuned deadline
+    prove the telemetry->actuator loop is live on this build, in the
+    same JSON artifact as the perf number.  Deterministic, memoized
+    (one run per bench process), and — like every bench config — only
+    reached past the rc=3 device preflight.
+    HETU_TPU_BENCH_CONTROLLER=0 skips."""
+    global _CONTROLLER_SUMMARY
+    if os.environ.get("HETU_TPU_BENCH_CONTROLLER", "1") in ("0", "false"):
+        return {}
+    if _CONTROLLER_SUMMARY is None:
+        try:
+            from hetu_tpu.exec.controller import controller_smoke
+            _CONTROLLER_SUMMARY = {"controller": controller_smoke()}
+        except Exception as e:  # the smoke must never kill the line
+            _CONTROLLER_SUMMARY = {"controller": {"error": str(e)[:120]}}
+    return _CONTROLLER_SUMMARY
+
+
 def _line(metric, value, unit, vs_baseline, **extra):
     rec = {"metric": metric, "value": round(float(value), 4), "unit": unit,
            "vs_baseline": round(float(vs_baseline), 4), **extra}
@@ -208,7 +232,7 @@ def bench_resnet(on_tpu, kind, peak):
                       "(42-83 steps/s) measured tunnel dispatch, not the "
                       "framework — this line is the regression baseline",
         device=kind, batch=batch, **_numerics_fields(trainer, b),
-        **_tinfo(t))
+        **_controller_fields(), **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -270,7 +294,7 @@ def bench_ctr(on_tpu, kind, peak):
                       "published reference number, this round's value sets "
                       "the baseline",
         device=kind, batch=batch, embedding="host+lfuopt-cache",
-        **_tinfo(t))
+        **_controller_fields(), **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -320,7 +344,7 @@ def bench_moe(on_tpu, kind, peak):
         baseline_note="reference run_top1.sh ships no table; this round's "
                       "value sets the baseline",
         device=kind, batch=batch, seq=seq, experts=cfg.num_experts,
-        top_k=cfg.top_k, **stats, **_tinfo(t))
+        top_k=cfg.top_k, **stats, **_controller_fields(), **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -382,7 +406,8 @@ def bench_autogpt(on_tpu, kind, peak):
         "samples/s", mfu / 0.45 if on_tpu else 1.0,
         mfu=round(float(mfu), 4), plan=plan.describe(),
         best_samples_per_sec=round(batch / t["min_s"], 1),
-        device=kind, batch=batch, seq=seq, **_tinfo(t))
+        device=kind, batch=batch, seq=seq, **_controller_fields(),
+        **_tinfo(t))
 
 
 # ---------------------------------------------------------------------------
@@ -540,6 +565,7 @@ def _bert_mfu(on_tpu, kind, peak, *, seq, batch, k, variants, metric,
         dropout=True, flash_attention=(attn == "flash" and on_tpu),
         fused_ln=bool(fused_ln and on_tpu), remat=bool(remat),
         **({"ab_probe_ms": ab} if ab else {}), **numerics,
+        **_controller_fields(),
         device=kind, batch=t["batch"], seq=t["seq"], **_tinfo(t))
 
 

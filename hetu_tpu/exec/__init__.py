@@ -33,4 +33,5 @@ from hetu_tpu.exec.partial import (
     PartialReduceConfig,
     PartialReducer,
 )
-from hetu_tpu.exec import faults, gang, metrics, partial
+from hetu_tpu.exec.controller import ControllerConfig, RuntimeController
+from hetu_tpu.exec import controller, faults, gang, metrics, partial

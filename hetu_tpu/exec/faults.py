@@ -57,6 +57,16 @@ fault kind         hook site (module seam)               effect
                                                          detector must name
                                                          the step/worker/
                                                          shard
+``compile_storm``  ``serve.engine.ServingEngine.step``   ``arg`` synthetic
+                                                         distinct-shape
+                                                         compiles are noted
+                                                         into the process
+                                                         StormDetector at the
+                                                         scheduled scheduler
+                                                         tick (default:
+                                                         threshold+1) — the
+                                                         controller must
+                                                         freeze bucket growth
 =================  ====================================  ===================
 
 Two scheduling conventions coexist for the worker-targeted kinds: in
@@ -95,7 +105,8 @@ __all__ = ["Fault", "FaultPlan", "install", "uninstall", "inject", "fire",
            "active_plan", "KINDS"]
 
 KINDS = ("ps_socket_kill", "ckpt_truncate", "ckpt_corrupt", "grad_nan",
-         "hang", "worker_kill", "worker_stall", "shard_loss", "bit_flip")
+         "hang", "worker_kill", "worker_stall", "shard_loss", "bit_flip",
+         "compile_storm")
 
 # C-client dead-socket status (net.RemoteEmbeddingTable._NET_ERRS)
 _DEAD_SOCKET = -10

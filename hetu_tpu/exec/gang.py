@@ -54,6 +54,7 @@ through ``obs.journal``.
 
 from __future__ import annotations
 
+import dataclasses
 import glob
 import hashlib
 import json
@@ -69,6 +70,7 @@ import numpy as np
 
 from hetu_tpu.core import get_seed_status, next_key, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
+from hetu_tpu.exec import controller as _controller
 from hetu_tpu.exec import executor as _executor
 from hetu_tpu.exec import faults as _faults
 from hetu_tpu.exec import partial as _partial
@@ -824,7 +826,7 @@ class ElasticGang:
                  seed: int = 0, save_every: int = 2, keep: int = 4,
                  lease_steps: int = 1,
                  partial: Optional["_partial.PartialReduceConfig"] = None,
-                 goodput=None, numerics=None):
+                 goodput=None, numerics=None, controller=None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -870,6 +872,14 @@ class ElasticGang:
         self._pending_flips: dict = {}
         self._provenanced_steps: set = set()
         self._last_grad_stats: Optional[dict] = None
+        # closed-loop remediation (exec.controller): an attached
+        # RuntimeController consumes this gang's signals (lag EWMAs,
+        # divergence verdicts) after every committed step and drives the
+        # actuators below (set_partial_deadline, quarantine).  None falls
+        # back to the process-wide installed controller; with neither,
+        # the post-commit seam is one attribute + one global load and a
+        # branch.
+        self.controller = controller
         self.partial = partial
         self.reducer: Optional[_partial.PartialReducer] = None
         if partial is not None:
@@ -1002,6 +1012,8 @@ class ElasticGang:
                                self._stalled_until.items() if o in remap}
         self._pending_flips = {remap[o]: v for o, v in
                                self._pending_flips.items() if o in remap}
+        if self.divergence is not None:
+            self.divergence.rescaled()
         resumed = self._restore(rank_map=remap)
         self._last_beat = {w: resumed for w in range(self.world_size)}
         _obs_journal.record("gang_rescale", generation=self.generation,
@@ -1014,6 +1026,64 @@ class ElasticGang:
             m["rescales"].inc()
             for w in range(self.world_size):
                 m["alive"].labels(worker=str(w)).set(1.0)
+
+    # -- controller actuators -----------------------------------------------
+
+    def set_partial_deadline(self, deadline: float, *,
+                             source: str = "controller"
+                             ) -> "_partial.PartialReduceConfig":
+        """Swap in a retuned partial-reduce deadline (clamped by the
+        policy's own rails) — the controller's deadline actuator.  Both
+        the gang's cut policy and the reducer's journal view move
+        together, so the very next ``partial_step`` event carries the
+        new ``deadline_source``."""
+        if self.partial is None:
+            raise ValueError("gang runs the synchronous barrier: there "
+                             "is no partial-reduce deadline to tune")
+        cfg = dataclasses.replace(self.partial,
+                                  deadline=self.partial.clamp(deadline),
+                                  deadline_source=source)
+        self.partial = cfg
+        self.reducer.config = cfg
+        return cfg
+
+    @property
+    def live_world(self) -> int:
+        """Workers currently live: in the membership and not killed or
+        quarantined (the lease check has not necessarily evicted the
+        dead ones yet)."""
+        return self.world_size - len(self._dead)
+
+    def can_quarantine(self, worker: int) -> bool:
+        """Whether evicting ``worker`` is safe: it must be a live rank
+        and not the LAST live one — remediation must never turn a
+        divergent run into a dead one (with another worker already down,
+        quarantining the sole survivor would leave nothing to rescale).
+        The controller consults this before deciding, so dry-run
+        decisions match what an active controller would actually do."""
+        w = int(worker)
+        return (0 <= w < self.world_size and w not in self._dead
+                and self.live_world >= 2)
+
+    def quarantine(self, worker: int) -> bool:
+        """Evict ``worker`` from the gang — the controller's divergence
+        actuator.  Its lease is revoked (the next step's liveness check
+        sees it lost and rescales) and its shard *storage* is dropped:
+        a replica whose post-update state diverged cannot be trusted to
+        have written honest bytes either, so the rescale's restore
+        recovers its shard from the ring predecessor's replica
+        (``shard_restore``) instead.  Returns False — acting nothing —
+        when the worker is already gone or is the last live one
+        (:meth:`can_quarantine`)."""
+        w = int(worker)
+        if not self.can_quarantine(w):
+            return False
+        self._dead.add(w)
+        # revoke the lease outright: eviction at the NEXT step, not after
+        # lease_steps of silence — quarantine is a decision, not a timeout
+        self._last_beat[w] = -(10 ** 9)
+        shutil.rmtree(worker_dir(self.gang_dir, w), ignore_errors=True)
+        return True
 
     def rejoin(self, n: int = 1) -> None:
         """Grow the gang by ``n`` workers (preempted capacity coming
@@ -1086,6 +1156,10 @@ class ElasticGang:
             self._check_divergence(s)
         if self.save_every > 0 and s % self.save_every == 0:
             self.save()
+        # closed-loop remediation rides the committed step, AFTER the
+        # save: a quarantine's storage drop must outlive this step's
+        # shard writes so the rescale restore exercises the ring replica
+        _controller.maybe_gang_step(self, s, metrics)
         return metrics
 
     # -- numerics observability ---------------------------------------------

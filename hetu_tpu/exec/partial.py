@@ -87,11 +87,20 @@ class PartialReduceConfig:
     ``tau``: staleness bound in steps for late-gradient folds.
     ``min_arrivals``: quorum floor below which the step degrades to the
     full barrier instead of trusting a tiny contributor set.
+    ``min_deadline``/``max_deadline``: the :meth:`clamp` bounds an
+    online tuner (``exec.controller``) must stay inside — the operator's
+    hard rails around any automated policy.
+    ``deadline_source``: ``"static"`` (configured) or ``"controller"``
+    (auto-tuned); surfaced on every ``partial_step`` journal event so
+    replays distinguish tuned from configured cuts.
     """
 
     deadline: float = 0.0
     tau: int = 4
     min_arrivals: int = 1
+    min_deadline: float = 0.0
+    max_deadline: float = float("inf")
+    deadline_source: str = "static"
 
     def __post_init__(self):
         if self.deadline < 0:
@@ -101,6 +110,24 @@ class PartialReduceConfig:
         if self.min_arrivals < 1:
             raise ValueError(
                 f"min_arrivals must be >= 1, got {self.min_arrivals}")
+        if self.min_deadline < 0:
+            raise ValueError(
+                f"min_deadline must be >= 0, got {self.min_deadline}")
+        if self.max_deadline < self.min_deadline:
+            raise ValueError(
+                f"max_deadline {self.max_deadline} < min_deadline "
+                f"{self.min_deadline}")
+        if self.deadline_source not in ("static", "controller"):
+            raise ValueError(
+                f"deadline_source must be 'static' or 'controller', got "
+                f"{self.deadline_source!r}")
+
+    def clamp(self, deadline: float) -> float:
+        """Pin a proposed deadline inside ``[min_deadline,
+        max_deadline]`` — the rails the controller's auto-tuning may
+        never leave."""
+        return min(max(float(deadline), self.min_deadline),
+                   self.max_deadline)
 
     @classmethod
     def from_env(cls, **kw) -> Optional["PartialReduceConfig"]:
@@ -358,7 +385,9 @@ class PartialReducer:
             _obs_journal.record("partial_step", step=step,
                                 arrivals=len(contributions), late_folds=folds,
                                 dropped=drops, degraded=bool(degraded),
-                                waited=float(waited), skipped=True)
+                                waited=float(waited),
+                                deadline_source=self.config.deadline_source,
+                                skipped=True)
             return None, info
         total = sum(wt for wt, _g in used_terms)
         keys = sorted(used_terms[0][1])
@@ -373,7 +402,8 @@ class PartialReducer:
         _obs_journal.record("partial_step", step=step,
                             arrivals=len(contributions), late_folds=folds,
                             dropped=drops, degraded=bool(degraded),
-                            waited=float(waited))
+                            waited=float(waited),
+                            deadline_source=self.config.deadline_source)
         return combined, info
 
     def _fold_for(self, worker: int, step: int, used_terms: list) -> tuple:

@@ -47,6 +47,7 @@ import numpy as np
 
 from hetu_tpu.core import get_seed_status, next_key, reset_seed_seqnum
 from hetu_tpu.core.module import named_parameters
+from hetu_tpu.exec import controller as _controller
 from hetu_tpu.exec import faults as _faults
 from hetu_tpu.exec.checkpoint import (AsyncCheckpointer, CheckpointError,
                                       load_checkpoint, load_state_dict,
@@ -648,6 +649,11 @@ class ResilientTrainer:
             self._consec = 0
             if self.save_every > 0 and self._step % self.save_every == 0:
                 self.save()
+        # closed-loop remediation (exec.controller): an installed
+        # controller re-evaluates the partial-reduce deadline from this
+        # trainer's reducer lag EWMAs — one global load + branch when
+        # none is installed (the obs seam contract)
+        _controller.maybe_after_train_step(self, self._step, metrics)
         self._maybe_preempt()
         return metrics
 

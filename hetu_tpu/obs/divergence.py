@@ -115,6 +115,12 @@ class DivergenceDetector:
         self.depth = int(depth)
         self.events: list = []     # [{step, worker, shard, ...}]
         self.checks = 0
+        # index into `events` of the first finding recorded under the
+        # CURRENT membership generation (advanced by `rescaled()`):
+        # earlier findings carry pre-rescale rank numbering, so a
+        # consumer acting on ranks (the remediation controller) must not
+        # apply them to the renumbered gang
+        self.generation_cursor = 0
         # a corrupted replica stays divergent on EVERY later step; the
         # journal entry, the stored event, and the flight-recorder dump
         # fire once per (worker, shard) pair — repeats only tick the
@@ -125,6 +131,18 @@ class DivergenceDetector:
     @property
     def first(self) -> Optional[dict]:
         return self.events[0] if self.events else None
+
+    def rescaled(self) -> None:
+        """Membership changed (survivor ranks renumbered densely): the
+        per-(worker, shard) dedupe keys no longer name the same physical
+        replicas, so clear them — a fresh divergence on a reused rank
+        index must journal anew, not be mistaken for the old replica's
+        lingering one.  Recorded findings stay on ``events`` (the audit
+        record keeps the pre-rescale rank numbering it was made under),
+        and ``generation_cursor`` marks where the current generation's
+        findings begin."""
+        self._seen.clear()
+        self.generation_cursor = len(self.events)
 
     def check(self, step: int,
               fingerprints: Dict[int, Dict[str, int]]) -> list:

@@ -47,6 +47,10 @@ snapshot or the new one, never a torn file):
    - ``/fleet/slo``         serving-SLO merge: summed stage seconds /
      request verdicts / violations, worst-of-fleet burn rates and shed
      pressure (max across workers — the router's placement input)
+   - ``/fleet/controller``  closed-loop remediation merge: summed
+     ``hetu_ctrl_*`` action counters, per-worker tuned deadlines and
+     shed/freeze latches, and the fleet's ``remediation`` journal tail
+     — the audit surface for the PR-11 controller
 """
 
 from __future__ import annotations
@@ -532,6 +536,46 @@ class FleetAggregator:
             "by_worker": by_worker}
         return out
 
+    def controller(self, tail: int = 50) -> dict:
+        """Fleet-wide remediation merge — the ``/fleet/controller``
+        payload: action counters SUM across workers (each decision is a
+        disjoint event), the shed/freeze latches take the fleet MAX (any
+        one controller acting flags the fleet), tuned deadlines report
+        per worker, and the trailing ``remediation`` events ride along.
+        Each event keeps its OWN fields (a quarantine's ``worker`` is
+        the quarantined rank) and the publishing rank lands under
+        ``publisher`` — the same clash rule the metric merge uses."""
+        out: dict = {"workers": len(self.snapshots)}
+        for key, family in (("actions", "hetu_ctrl_actions_total"),
+                            ("would_act", "hetu_ctrl_would_act_total")):
+            m = self.merged(family)
+            out[key] = ({k[0]: v for k, v in m["children"].items()}
+                        if m is not None else {})
+        by_worker = {}
+        for rank in sorted(self.snapshots):
+            for ent in self.snapshots[rank].get(
+                    "registry", {}).get("families", []):
+                if ent["name"] == "hetu_ctrl_deadline_seconds" \
+                        and ent["children"]:
+                    by_worker[str(rank)] = float(
+                        ent["children"][0]["value"])
+        out["deadline_by_worker"] = by_worker
+        for key, family in (("shed_active", "hetu_ctrl_shed_active"),
+                            ("freeze_active", "hetu_ctrl_freeze_active")):
+            m = self.merged(family, agg="max")
+            out[key] = bool(m is not None
+                            and max(m["children"].values(),
+                                    default=0.0) > 0)
+        events = []
+        for rank in sorted(self.snapshots):
+            events.extend({**e, "publisher": rank}
+                          for e in self.snapshots[rank].get("journal", [])
+                          if e.get("kind") == "remediation")
+        events.sort(key=lambda e: (e.get("seq", 0), e["publisher"]))
+        tail = max(int(tail), 0)
+        out["remediation"] = events[-tail:] if tail else []
+        return out
+
     def stitched_trace_events(self) -> list:
         """Every worker's spans as one Chrome timeline, pid =
         ``SPAN_PID + rank`` (``tracing.span_pid``) — concatenable with an
@@ -608,6 +652,13 @@ def fleet_routes(aggregator: FleetAggregator,
         return (json.dumps(aggregator.divergence()).encode(),
                 "application/json")
 
+    def controller(q, b):
+        aggregator.refresh()
+        tail = int(q.get("n", ["50"])[0])
+        return (json.dumps(aggregator.controller(tail)).encode(),
+                "application/json")
+
+    routes.add("GET", "/fleet/controller", controller)
     routes.add("GET", "/fleet/divergence", divergence)
     routes.add("GET", "/fleet/slo", slo)
     routes.add("GET", "/fleet/metrics", metrics)

@@ -59,6 +59,18 @@ kind                fields (beyond ``seq``/``ts``)
                       ``divergence``), ``step``, ``records`` (the
                       flight recorder's ring: per-step per-group tensor
                       stats, fetched to host on the cold path only)
+``remediation``       ``action`` (``deadline_retune``/``quarantine``/
+                      ``admission_shed``/``admission_release``/
+                      ``bucket_freeze``/``bucket_unfreeze``),
+                      ``signal`` (the telemetry that triggered it),
+                      ``dry_run`` (True = a ``would_act`` decision that
+                      actuated nothing) + action-specific numbers
+                      (``old``/``new`` deadline, ``worker``/``shard``,
+                      ``pressure``, ``recent``/``threshold``)
+``shed``              ``request_id``, ``reason``
+                      (``controller``/``queue_full``/``bucket_freeze``)
+                      — an admission rejection that was load shedding,
+                      distinguishable by cause
 ==================  =====================================================
 
 Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
@@ -118,10 +130,11 @@ EVENT_KINDS = {
     "shard_restore": frozenset({"rank", "from_rank", "step", "generation"}),
     "manifest_skipped": frozenset({"step", "generation", "reason"}),
     "rescale_timeout": frozenset({"generation", "waiting_on", "timeout_s"}),
-    # partial reduce (PR 6)
+    # partial reduce (PR 6; deadline_source since PR 11 — "static" vs
+    # "controller", so replays distinguish tuned from configured cuts)
     "partial_step": frozenset(
         {"step", "arrivals", "late_folds", "dropped", "degraded",
-         "waited"}),
+         "waited", "deadline_source"}),
     "late_fold": frozenset({"step", "worker", "origin_step", "age"}),
     "stale_drop": frozenset(
         {"step", "worker", "origin_step", "age", "reason"}),
@@ -141,6 +154,9 @@ EVENT_KINDS = {
         {"step", "worker", "shard", "fingerprint", "expected"}),
     "nan_provenance": frozenset({"step", "op", "origin"}),
     "flight_dump": frozenset({"reason", "step", "records"}),
+    # closed-loop remediation (PR 11)
+    "remediation": frozenset({"action", "signal", "dry_run"}),
+    "shed": frozenset({"request_id", "reason"}),
 }
 
 

@@ -238,6 +238,19 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
 
     routes.add("GET", "/numerics", numerics_view)
 
+    def controller_view(q, b):
+        """``/controller``: the process-wide installed
+        :class:`~hetu_tpu.exec.controller.RuntimeController`'s policy,
+        live latches (shed / bucket freeze), tuned deadline, and full
+        decision list — the remediation audit surface.  Lazy import:
+        the scrape path must not pull the exec stack until asked."""
+        from hetu_tpu.exec.controller import get_controller
+        c = get_controller()
+        body = c.summary() if c is not None else {"installed": False}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/controller", controller_view)
+
     def journal_tail(q, b):
         """Tail form (``?n=100``, newest suffix) or cursor form
         (``?since=<seq>``, everything after the gapless sequence number,

@@ -29,8 +29,8 @@ tokens, bit-for-bit — the serving counterpart of the training stack's
 chaos-lineage guarantee.
 """
 
-from hetu_tpu.serve.batcher import (AdmissionQueueFull, ContinuousBatcher,
-                                    Request)
+from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
+                                    ContinuousBatcher, Request)
 from hetu_tpu.serve.engine import RequestHandle, ServingEngine
 from hetu_tpu.serve.kv_cache import KVCachePool, OutOfPages, PageTable
 from hetu_tpu.serve.loadgen import LoadItem, generate_load
@@ -38,7 +38,7 @@ from hetu_tpu.serve.server import ServingServer, serve_engine
 
 __all__ = [
     "KVCachePool", "PageTable", "OutOfPages",
-    "ContinuousBatcher", "Request", "AdmissionQueueFull",
+    "ContinuousBatcher", "Request", "AdmissionQueueFull", "AdmissionShed",
     "ServingEngine", "RequestHandle",
     "ServingServer", "serve_engine",
     "generate_load", "LoadItem",

@@ -29,11 +29,21 @@ import dataclasses
 from typing import Optional
 
 __all__ = ["Request", "ContinuousBatcher", "AdmissionQueueFull",
-           "SchedulerTick"]
+           "AdmissionShed", "SchedulerTick"]
 
 
 class AdmissionQueueFull(RuntimeError):
     """The waiting queue is at its depth limit — shed load upstream."""
+
+
+class AdmissionShed(AdmissionQueueFull):
+    """Admission shedding engaged upstream (the runtime controller,
+    under sustained SLO burn): the queue may have room, but admitting
+    more work means serving it late.  Subclasses
+    :class:`AdmissionQueueFull` so existing catch sites keep working,
+    while the engine can tell a controller shed from a full queue —
+    they are counted (``hetu_serve_shed_total{reason=}``), journaled
+    (kind ``shed``), and surfaced on ``/infer`` distinguishably."""
 
 
 @dataclasses.dataclass
@@ -80,12 +90,32 @@ class ContinuousBatcher:
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self._waiting: list = []
         self._slots: list = [None] * num_slots
+        # controller shed latch: while set, submit rejects with
+        # AdmissionShed naming the reason (released by clear_shed)
+        self.shed_reason: Optional[str] = None
 
     # -- admission ----------------------------------------------------------
 
+    def set_shed(self, reason: str) -> None:
+        """Engage admission shedding: every :meth:`submit` until
+        :meth:`clear_shed` raises :exc:`AdmissionShed` carrying
+        ``reason`` — the controller's sustained-SLO-burn actuator."""
+        self.shed_reason = str(reason)
+
+    def clear_shed(self) -> None:
+        self.shed_reason = None
+
+    @property
+    def shedding(self) -> bool:
+        return self.shed_reason is not None
+
     def submit(self, request: Request) -> None:
-        """Queue a request; raises :exc:`AdmissionQueueFull` at the depth
-        limit (the engine counts the rejection and journals it)."""
+        """Queue a request; raises :exc:`AdmissionShed` while the
+        controller's shed latch is engaged, :exc:`AdmissionQueueFull` at
+        the depth limit (the engine counts and journals both,
+        distinguishably)."""
+        if self.shed_reason is not None:
+            raise AdmissionShed(self.shed_reason)
         if len(self._waiting) >= self.queue_depth:
             raise AdmissionQueueFull(
                 f"admission queue at depth limit {self.queue_depth}")

@@ -70,6 +70,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hetu_tpu.exec import controller as _controller
+from hetu_tpu.exec import faults as _faults
 from hetu_tpu.obs import compile as _compile
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.obs import numerics as _numerics
@@ -80,8 +82,8 @@ from hetu_tpu.obs.slo import SLOEngine
 from hetu_tpu.ops.pallas.lm_head import lm_head_sample_pallas
 from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
                                  top_k_sample)
-from hetu_tpu.serve.batcher import (AdmissionQueueFull, ContinuousBatcher,
-                                    Request)
+from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
+                                    ContinuousBatcher, Request)
 from hetu_tpu.serve.kv_cache import (KVCachePool, OutOfPages, gather_views,
                                      scatter_views)
 
@@ -125,6 +127,13 @@ def _serve_m() -> dict:
                 "were in (queued: expired waiting for a slot; running: "
                 "cut off mid-decode, keeping the tokens generated)",
                 ("stage",)),
+            "shed": reg.counter(
+                "hetu_serve_shed_total",
+                "admission rejections that were load shedding, by cause "
+                "(controller: the runtime controller's sustained-SLO-"
+                "burn latch; queue_full: the depth limit; bucket_freeze: "
+                "prompt-bucket growth frozen during a compile storm)",
+                ("reason",)),
         }
     return _serve_metrics
 
@@ -182,7 +191,8 @@ class ServingEngine:
                  paged_decode: bool = True,
                  fused_sampling: Optional[bool] = None,
                  slo_targets=None, trace_capacity: int = 256,
-                 trace_slow_n: int = 8, trace_window: int = 128):
+                 trace_slow_n: int = 8, trace_window: int = 128,
+                 controller=None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -248,6 +258,17 @@ class ServingEngine:
         self.ctr_model = ctr_model
         if ctr_model is not None:
             _mark_stores_read_only(ctr_model)
+        # closed-loop remediation (exec.controller): the attached (or
+        # process-wide installed) RuntimeController runs once per
+        # scheduler tick — shed latch on sustained SLO burn, bucket
+        # freeze under a compile storm.  With neither, the tick seam is
+        # one attribute + one global load and a branch.
+        self.controller = controller
+        # while frozen, prompts needing a prefill bucket that has not
+        # compiled yet are rejected instead of feeding the storm
+        self.freeze_bucket_growth = False
+        self._prefill_buckets: set = set()
+        self._tick = 0
 
     # -- jitted compute -----------------------------------------------------
 
@@ -326,6 +347,7 @@ class ServingEngine:
             tl = RequestTimeline(rid, req.arrival, prompt_len=len(prompt),
                                  max_new_tokens=req.max_new_tokens)
             reason = None
+            shed_reason = None  # set when the rejection is LOAD SHEDDING
             max_bucket = self.batcher.prompt_buckets[-1]
             if not prompt:
                 reason = "empty prompt"
@@ -338,13 +360,30 @@ class ServingEngine:
             elif len(prompt) > max_bucket:
                 reason = (f"prompt of {len(prompt)} tokens exceeds the "
                           f"largest prefill bucket {max_bucket}")
+            elif self.freeze_bucket_growth and \
+                    self.batcher.bucket_for(len(prompt)) \
+                    not in self._prefill_buckets:
+                reason = (
+                    f"prompt bucket "
+                    f"{self.batcher.bucket_for(len(prompt))} not yet "
+                    f"compiled and bucket growth is frozen (compile "
+                    f"storm); warm buckets: "
+                    f"{sorted(self._prefill_buckets)}")
+                shed_reason = "bucket_freeze"
             if reason is None:
                 try:
                     self.batcher.submit(req)
+                except AdmissionShed as e:
+                    reason, shed_reason = str(e), "controller"
                 except AdmissionQueueFull as e:
-                    reason = str(e)
+                    reason, shed_reason = str(e), "queue_full"
             if reason is not None:
                 _serve_m()["requests"].labels(outcome="rejected").inc()
+                if shed_reason is not None:
+                    _serve_m()["shed"].labels(reason=shed_reason).inc()
+                    _journal.record("shed", request_id=rid,
+                                    reason=shed_reason,
+                                    queue_depth=self.batcher.queue_len)
                 _journal.record("serve_reject", request_id=rid,
                                 reason=reason,
                                 queue_depth=self.batcher.queue_len)
@@ -367,6 +406,21 @@ class ServingEngine:
         """One scheduler tick: expire, admit+prefill, one decode step.
         Returns the number of tokens produced (0 when idle)."""
         with self._lock:
+            self._tick += 1
+            plan = _faults.active_plan()
+            if plan is not None:
+                # chaos seam: a scheduled compile_storm fault notes `arg`
+                # synthetic distinct-shape compiles (default: enough to
+                # cross the threshold) into the process storm detector —
+                # the deterministic stand-in for an unbucketed-shape
+                # flood.  Only this kind is consumed here; the training
+                # harnesses keep their own conventions.
+                f = plan.take("compile_storm", late_ok=True, now=self._tick)
+                if f is not None:
+                    storm = _compile.get_storm()
+                    for _ in range(int(f.arg or storm.threshold + 1)):
+                        storm.note("fault_injection")
+            _controller.maybe_serve_tick(self)
             now = self.clock()
             m = _serve_m()
             # reserving gate: poll admits several requests before any of
@@ -457,6 +511,7 @@ class ServingEngine:
         sample the first token at the prompt's true last position."""
         plen = len(req.prompt)
         bucket = self.batcher.bucket_for(plen)
+        self._prefill_buckets.add(bucket)  # warm: survives a freeze
         self.pool.alloc(req.id, plen)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt
@@ -674,6 +729,11 @@ class ServingEngine:
             return {
                 "slo": slo,
                 "shed_pressure": self.slo.shed_pressure(),
+                "controller": {
+                    "shedding": self.batcher.shed_reason,
+                    "freeze_bucket_growth": self.freeze_bucket_growth,
+                    "warm_buckets": sorted(self._prefill_buckets),
+                },
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,
                 "num_slots": self.batcher.num_slots,

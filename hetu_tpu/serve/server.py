@@ -14,7 +14,15 @@ they describe.
   deadline expiry.  Non-completed responses carry a human-readable
   ``error`` naming what happened (rejection reason; deadline stage and
   age), and ``trace_id`` keys the request's full timeline at
-  ``/trace/<request_id>``.
+  ``/trace/<request_id>``.  Rejections that are *load shedding* are
+  distinguishable by their ``error`` text: a controller shed under
+  sustained SLO burn says so ("controller shed: ..."), a compile-storm
+  bucket freeze names the frozen bucket, and a full queue names the
+  depth limit — each also counted under
+  ``hetu_serve_shed_total{reason=}`` and journaled (kind ``shed``).
+- ``GET /controller`` (via the telemetry routes) reports the installed
+  runtime controller's policy, latches, and decision list — README
+  "Self-driving runtime".
 - ``POST /infer`` with ``{"dense": [[...]], "sparse": [[...]]}`` runs
   the read-only CTR path and returns ``{"pred": [...]}``.
 - ``GET /stats`` returns the engine's scheduler/pool/counter snapshot.

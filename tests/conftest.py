@@ -93,6 +93,14 @@ def pytest_configure(config):
                    "correction-term persistence); multi-worker chaos runs "
                    "ride the slow tier — a 2-worker deadline-miss smoke "
                    "stays in tier-1, mirroring the gang convention")
+    config.addinivalue_line(
+        "markers", "controller: closed-loop remediation tests "
+                   "(exec.controller deadline auto-tuning / divergence "
+                   "quarantine / SLO-burn shedding / compile-storm bucket "
+                   "freeze and their journal/endpoint surfaces); the "
+                   "4-worker chaos acceptance rides the slow tier — the "
+                   "in-process 2-worker deadline-retune smoke, the serve "
+                   "latches, and the overhead guard stay in tier-1")
 
 
 @pytest.fixture(autouse=True)
