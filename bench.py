@@ -53,14 +53,17 @@ import numpy as np
 # hetu_tpu.obs.goodput now, so the online MFU gauge and this benchmark
 # report are the same arithmetic; re-exported here for callers/tests
 # that import them from bench.
-from hetu_tpu.obs.goodput import PEAK_BF16, transformer_train_flops  # noqa: E402,F401
+from hetu_tpu.obs.goodput import (PEAK_BF16, peak_flops,  # noqa: E402,F401
+                                  transformer_train_flops)
 
 
 def _env():
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu")
     on_tpu = "TPU" in str(kind).upper() or dev.platform in ("tpu", "axon")
-    peak = PEAK_BF16.get(kind, 197e12 if on_tpu else 1e12)
+    # peak_flops warns ONCE when an unknown TPU kind falls back to the
+    # v5e figure — an MFU against a guessed peak must not be silent
+    peak = peak_flops()
     return on_tpu, str(kind), peak
 
 
@@ -190,11 +193,53 @@ def _controller_fields():
     return _CONTROLLER_SUMMARY
 
 
+_CALIB_STORE = None
+
+
+def _calib_record(rec):
+    """Append one calibration record per emitted result line — the
+    measure side of the calibration plane (obs.calibration): the round's
+    numbers land in the versioned profile store, where the sentinel
+    grades them against the stored baseline and journals
+    ``perf_regression`` on a >10% throughput/MFU drop — the alarm rounds
+    4-5 (backend_unreachable) never had.  Uses the installed process
+    store when one is, else the env-pathed on-disk store
+    (HETU_TPU_CALIB_STORE).  HETU_TPU_BENCH_CALIB=0 skips; like every
+    metric line, this only runs past the rc=3 device preflight, so a
+    dead tunnel can never write a bogus baseline."""
+    global _CALIB_STORE
+    if os.environ.get("HETU_TPU_BENCH_CALIB", "1") in ("0", "false"):
+        return
+    try:
+        from hetu_tpu.obs import calibration as _calibration
+        store = _calibration.get_store()
+        if store is None:
+            if _CALIB_STORE is None:
+                # LOAD, not construct: each bench run is a fresh process,
+                # and the sentinel grades against the key's version-1
+                # baseline — an empty store would re-baseline every round
+                # and the cross-round alarm would never fire.  A damaged
+                # store file must not kill the line: start fresh at the
+                # same path (the damage is diagnosed on any explicit load).
+                path = _calibration.default_store_path()
+                try:
+                    _CALIB_STORE = _calibration.ProfileStore.load(path)
+                except _calibration.CalibrationStoreError as e:
+                    print(f"bench: calibration store unreadable "
+                          f"({e}); starting fresh", file=sys.stderr)
+                    _CALIB_STORE = _calibration.ProfileStore(path)
+            store = _CALIB_STORE
+        store.ingest_bench_line(rec)
+    except Exception as e:  # a calibration hiccup must never kill the line
+        print(f"bench: calibration record skipped: {e}", file=sys.stderr)
+
+
 def _line(metric, value, unit, vs_baseline, **extra):
     rec = {"metric": metric, "value": round(float(value), 4), "unit": unit,
            "vs_baseline": round(float(vs_baseline), 4), **extra}
     print(json.dumps(rec))
     sys.stdout.flush()
+    _calib_record(rec)
     return rec
 
 

@@ -406,8 +406,21 @@ class Trainer:
             prof["predicted_peak_bytes"] = \
                 self.memory_plan.predicted_peak_bytes
         if _obs.enabled() and prof.get("temp_bytes") is not None:
-            from hetu_tpu.mem.estimator import record_memory_gauges
+            from hetu_tpu.mem.estimator import (reconcile,
+                                                record_memory_gauges)
             record_memory_gauges(xla=prof)
+            # reconcile the planner's predicted device peak against the
+            # compiled step's own memory_analysis bytes: publishes the
+            # hetu_mem_estimator_error_ratio gauge, journals
+            # mem_estimate_drift outside the 25% band, and feeds the
+            # installed calibration store (the measured correction
+            # plan_memory(calibration=) later divides by)
+            if self.memory_plan is not None:
+                xla_peak = (float(prof.get("argument_bytes") or 0.0)
+                            + float(prof.get("temp_bytes") or 0.0))
+                r = reconcile(self.memory_plan.predicted_peak_bytes,
+                              xla_peak, model_sig="train.step")
+                prof["estimator_error_ratio"] = r["ratio"]
         return prof
 
 

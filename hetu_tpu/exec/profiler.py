@@ -287,4 +287,9 @@ def device_op_breakdown(logdir: str, *, steps: int = 1, top: int = 0):
     ranked = dict(sorted(per.items(), key=lambda kv: -kv[1]))
     if top:
         ranked = dict(list(ranked.items())[:top])
+    # calibration seam: the parsed per-op device table is a measured
+    # signal — fold it into the installed profile store (one global
+    # load + branch when none is installed)
+    from hetu_tpu.obs.calibration import note_op_breakdown
+    note_op_breakdown(per, totals)
     return ranked, totals

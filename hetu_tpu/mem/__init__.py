@@ -24,14 +24,15 @@ Predicted and XLA-reported peak bytes are published as ``hetu_mem_*``
 gauges on ``/metrics`` (``obs``).
 """
 
-from hetu_tpu.mem.estimator import (MemoryEstimate, cross_check,
+from hetu_tpu.mem.estimator import (ERROR_BAND, MemoryEstimate, cross_check,
                                     estimate_peak_bytes,
-                                    estimate_train_peak,
+                                    estimate_train_peak, reconcile,
                                     record_memory_gauges)
 from hetu_tpu.mem.offload import (host_memory_kind, offload_optimizer_state,
                                   offload_to_host, restore_to_device,
                                   supports_host_offload)
-from hetu_tpu.mem.planner import CandidateEval, MemoryPlan, plan_memory
+from hetu_tpu.mem.planner import (CandidateEval, MemoryPlan, MemoryPlanner,
+                                  plan_memory)
 from hetu_tpu.mem.policy import (RematPolicy, apply_policy,
                                  available_policies, get_policy,
                                  normalize_remat, normalize_remat_field,
@@ -39,11 +40,11 @@ from hetu_tpu.mem.policy import (RematPolicy, apply_policy,
 
 __all__ = [
     "MemoryEstimate", "estimate_peak_bytes", "estimate_train_peak",
-    "cross_check", "record_memory_gauges",
+    "cross_check", "record_memory_gauges", "reconcile", "ERROR_BAND",
     "RematPolicy", "register_policy", "get_policy", "policy_names",
     "available_policies", "normalize_remat", "normalize_remat_field",
     "apply_policy",
-    "MemoryPlan", "CandidateEval", "plan_memory",
+    "MemoryPlan", "MemoryPlanner", "CandidateEval", "plan_memory",
     "supports_host_offload", "host_memory_kind", "offload_to_host",
     "restore_to_device", "offload_optimizer_state",
 ]

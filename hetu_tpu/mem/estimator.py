@@ -36,7 +36,15 @@ from typing import Any, Callable
 import numpy as np
 
 __all__ = ["MemoryEstimate", "estimate_peak_bytes", "estimate_train_peak",
-           "cross_check", "record_memory_gauges"]
+           "cross_check", "record_memory_gauges", "reconcile",
+           "ERROR_BAND"]
+
+#: The estimator's documented accuracy band vs ``memory_analysis()``:
+#: the tests assert predictions land within 25% of XLA's number, and
+#: :func:`reconcile` journals ``mem_estimate_drift`` when a production
+#: cross-check leaves it — the band is a runtime contract now, not just
+#: a test constant.
+ERROR_BAND = 0.25
 
 
 # Elementwise primitives XLA freely duplicates into consumers: with one
@@ -211,9 +219,48 @@ def cross_check(fn: Callable, *example_args) -> dict:
         out["xla_argument_bytes"] = stats.get("argument_bytes", 0.0)
         out["xla_output_bytes"] = stats.get("output_bytes", 0.0)
         if out["xla_temp_bytes"]:
-            out["ratio"] = out["predicted_temp_bytes"] / out["xla_temp_bytes"]
+            out["ratio"] = reconcile(out["predicted_temp_bytes"],
+                                     out["xla_temp_bytes"])["ratio"]
     record_memory_gauges(predicted=est.temp_peak_bytes, xla=out)
     return out
+
+
+def reconcile(predicted_bytes: float, xla_bytes: float, *,
+              band: float = ERROR_BAND, model_sig: str = "") -> dict:
+    """Reconcile an estimator prediction against XLA's own
+    ``memory_analysis`` bytes — the measure→calibrate closing move for
+    the memory model:
+
+    - publishes the ``hetu_mem_estimator_error_ratio`` gauge
+      (predicted / XLA-reported; 1.0 = perfect);
+    - journals ``mem_estimate_drift`` when the ratio leaves the
+      ``band`` (default the tests' 25% cross-check band — until now
+      that band only existed inside tests);
+    - feeds the installed calibration
+      :class:`~hetu_tpu.obs.calibration.ProfileStore` a ``mem`` record,
+      which ``fit_calibration`` turns into the ``mem_error_ratio``
+      constant ``plan_memory(calibration=...)`` corrects by.
+
+    Returns ``{"ratio", "within_band"}``; a non-positive ``xla_bytes``
+    yields ratio 0.0 (absent, not infinite) and no drift event."""
+    predicted_bytes = float(predicted_bytes)
+    xla_bytes = float(xla_bytes)
+    if xla_bytes <= 0.0:
+        return {"ratio": 0.0, "within_band": True}
+    ratio = predicted_bytes / xla_bytes
+    within = abs(ratio - 1.0) <= float(band)
+    from hetu_tpu.obs import registry as _obs
+    if _obs.enabled():
+        _mem_gauges()["error_ratio"].set(ratio)
+    if not within:
+        from hetu_tpu.obs import journal as _obs_journal
+        _obs_journal.record(
+            "mem_estimate_drift", predicted_bytes=predicted_bytes,
+            xla_bytes=xla_bytes, ratio=round(ratio, 6),
+            band=float(band))
+    from hetu_tpu.obs.calibration import note_mem
+    note_mem(predicted_bytes, xla_bytes, ratio, model_sig=model_sig)
+    return {"ratio": ratio, "within_band": within}
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +292,11 @@ def _mem_gauges():
                 "hetu_mem_xla_output_bytes",
                 "XLA-reported output bytes of the last profiled "
                 "executable"),
+            "error_ratio": reg.gauge(
+                "hetu_mem_estimator_error_ratio",
+                "estimator-predicted / XLA-reported bytes of the last "
+                "reconciled program (1.0 = perfect; leaving the 25% "
+                "band journals mem_estimate_drift)"),
         }
     return _gauges
 

@@ -26,7 +26,7 @@ from typing import Callable, Optional, Sequence
 from hetu_tpu.mem.estimator import estimate_train_peak, record_memory_gauges
 from hetu_tpu.mem.policy import get_policy, policy_names
 
-__all__ = ["CandidateEval", "MemoryPlan", "plan_memory"]
+__all__ = ["CandidateEval", "MemoryPlan", "MemoryPlanner", "plan_memory"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,7 +68,7 @@ def plan_memory(loss_fn: Callable, model_builder: Callable,
                 batch_builder: Callable, budget_bytes: float, *,
                 policies: Optional[Sequence[str]] = None,
                 microbatch_options: Sequence[int] = (1,),
-                ) -> MemoryPlan:
+                calibration=None) -> MemoryPlan:
     """Search (policy, microbatch) for the cheapest pair under budget.
 
     ``model_builder(policy_name) -> model`` builds the model with that
@@ -84,7 +84,21 @@ def plan_memory(loss_fn: Callable, model_builder: Callable,
     wins whenever it fits, and heavier recompute is bought only when the
     budget demands it.  Returns the minimum-memory candidate flagged
     ``fits=False`` when nothing fits.
+
+    ``calibration`` (a fitted
+    :class:`~hetu_tpu.obs.calibration.Calibration`) corrects every
+    prediction by the estimator's MEASURED error ratio
+    (``mem_error_ratio`` = predicted / XLA-reported bytes, fitted from
+    the ``mem.estimator.reconcile`` records): a systematically
+    over-predicting estimator stops rejecting configs that actually
+    fit, and an under-predicting one stops approving OOMs.  The
+    correction is a deterministic scalar divide, so plans stay
+    byte-identical for identical (inputs, calibration).
     """
+    ratio = None
+    if calibration is not None:
+        r = calibration.mem_error_ratio
+        ratio = float(r) if r is not None and r > 0 else None
     names = list(policies) if policies is not None else list(policy_names())
     for n in names:
         get_policy(n)  # validate up front, with the registered names
@@ -104,6 +118,8 @@ def plan_memory(loss_fn: Callable, model_builder: Callable,
         for mb in micros:
             est = estimate_train_peak(loss_fn, model, batches[mb])
             peak = est.device_peak_bytes
+            if ratio is not None:
+                peak = int(round(peak / ratio))
             evals.append(CandidateEval(policy, mb, int(peak), rc,
                                        peak <= budget_bytes))
 
@@ -120,3 +136,32 @@ def plan_memory(loss_fn: Callable, model_builder: Callable,
                           evals, key=lambda e: (e.policy, e.microbatch))))
     record_memory_gauges(predicted=plan.predicted_peak_bytes)
     return plan
+
+
+class MemoryPlanner:
+    """Reusable planner handle: the (budget, policies, microbatches,
+    calibration) configuration held once, :meth:`plan` run per model —
+    the form the unified deployment planner (ROADMAP item 4) composes,
+    and the ``MemoryPlanner(calibration=...)`` consumption surface of
+    the calibration plane.
+
+    >>> planner = MemoryPlanner(budget_bytes=16e9,
+    ...                         calibration=fit_calibration(store, ...))
+    >>> plan = planner.plan(loss_fn, model_builder, batch_builder)
+    """
+
+    def __init__(self, budget_bytes: float, *,
+                 policies: Optional[Sequence[str]] = None,
+                 microbatch_options: Sequence[int] = (1,),
+                 calibration=None):
+        self.budget_bytes = float(budget_bytes)
+        self.policies = list(policies) if policies is not None else None
+        self.microbatch_options = tuple(microbatch_options)
+        self.calibration = calibration
+
+    def plan(self, loss_fn: Callable, model_builder: Callable,
+             batch_builder: Callable) -> MemoryPlan:
+        return plan_memory(loss_fn, model_builder, batch_builder,
+                           self.budget_bytes, policies=self.policies,
+                           microbatch_options=self.microbatch_options,
+                           calibration=self.calibration)

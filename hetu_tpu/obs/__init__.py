@@ -36,7 +36,15 @@ production seams write to:
   compile counting at the jit seams (serving step fns AOT,
   ``Trainer.step`` watch-only), per-shape-signature compile cost and
   ``memory_analysis`` bytes, ``recompile`` journal events carrying the
-  triggering shape delta, and a recompile-storm gauge.
+  triggering shape delta, and a recompile-storm gauge;
+- :mod:`~hetu_tpu.obs.calibration` — the performance calibration
+  plane: a versioned CRC+signed ``ProfileStore`` of calibration
+  records ingested from the signals above, a fit layer emitting
+  measured ``TimeCostModel``/``MemoryCostModel`` constants (consumed
+  via ``dp_search(calibration=)`` / ``plan_memory(calibration=)``),
+  and a perf-regression sentinel journaling ``perf_regression`` and
+  flipping a ``/healthz`` red flag (``/calibration`` +
+  ``/fleet/calibration``).
 
 Instrumented seams: ``embed.net.RemoteEmbeddingTable._rpc`` (latency,
 bytes, redials, errors), the HET caches (hit/miss), ``Trainer.step``
@@ -47,6 +55,10 @@ is disabled in one switch — ``obs.disable()`` or ``HETU_OBS=0`` — and
 the disabled path is a single global load + branch per seam.
 """
 
+from hetu_tpu.obs.calibration import (Calibration, CalibrationKey,
+                                      FittedConstant, ProfileStore,
+                                      RegressionSentinel, fit_calibration,
+                                      get_store, install_store)
 from hetu_tpu.obs.compile import (InstrumentedJit, StormDetector,
                                   compile_report, instrument, watch)
 from hetu_tpu.obs.divergence import (DivergenceDetector, FingerprintBoard,
@@ -88,4 +100,6 @@ __all__ = [
     "tree_fingerprints", "host_fingerprint", "host_fingerprint_ints",
     "host_group_stats", "first_nonfinite", "loss_provenance",
     "DivergenceDetector", "FingerprintBoard", "compare_fleet",
+    "ProfileStore", "CalibrationKey", "Calibration", "FittedConstant",
+    "RegressionSentinel", "fit_calibration", "install_store", "get_store",
 ]

@@ -51,6 +51,10 @@ snapshot or the new one, never a torn file):
      ``hetu_ctrl_*`` action counters, per-worker tuned deadlines and
      shed/freeze latches, and the fleet's ``remediation`` journal tail
      — the audit surface for the PR-11 controller
+   - ``/fleet/calibration``  rank-0 calibration merge: the shared
+     profile store under the gang dir (workers merge-save into it
+     through the exclusive-lock path) plus the fleet's
+     ``perf_regression`` journal tail
 """
 
 from __future__ import annotations
@@ -471,6 +475,10 @@ class FleetAggregator:
         if storm is not None and max(storm["children"].values(),
                                      default=0.0) > 0:
             flags.append({"flag": "compile_storm"})
+        regressed = self.merged("hetu_calib_regressed", agg="max")
+        if regressed is not None and max(regressed["children"].values(),
+                                         default=0.0) > 0:
+            flags.append({"flag": "perf_regression"})
         return flags
 
     def divergence(self) -> dict:
@@ -576,6 +584,34 @@ class FleetAggregator:
         out["remediation"] = events[-tail:] if tail else []
         return out
 
+    def calibration(self, tail: int = 50) -> dict:
+        """Fleet-wide calibration merge — the ``/fleet/calibration``
+        payload: the SHARED profile store under the gang dir (every
+        worker merge-saves into it through the exclusive-lock path, so
+        rank 0 reads one already-merged file) plus the trailing
+        ``perf_regression`` journal events across the workers'
+        snapshots.  Each event keeps its own fields and the publishing
+        rank lands under ``publisher`` — the controller-merge
+        convention."""
+        from hetu_tpu.obs import calibration as _calibration
+        path = _calibration.store_path(self.gang_dir)
+        try:
+            store = _calibration.ProfileStore.load(path)
+            body = store.summary()
+            body["installed"] = os.path.exists(path)
+        except _calibration.CalibrationStoreError as e:
+            body = {"installed": False, "error": str(e), "path": path}
+        body["workers"] = len(self.snapshots)
+        events = []
+        for rank in sorted(self.snapshots):
+            events.extend({**e, "publisher": rank}
+                          for e in self.snapshots[rank].get("journal", [])
+                          if e.get("kind") == "perf_regression")
+        events.sort(key=lambda e: (e.get("seq", 0), e["publisher"]))
+        tail = max(int(tail), 0)
+        body["perf_regressions"] = events[-tail:] if tail else []
+        return body
+
     def stitched_trace_events(self) -> list:
         """Every worker's spans as one Chrome timeline, pid =
         ``SPAN_PID + rank`` (``tracing.span_pid``) — concatenable with an
@@ -658,6 +694,13 @@ def fleet_routes(aggregator: FleetAggregator,
         return (json.dumps(aggregator.controller(tail)).encode(),
                 "application/json")
 
+    def calibration(q, b):
+        aggregator.refresh()
+        tail = int(q.get("n", ["50"])[0])
+        return (json.dumps(aggregator.calibration(tail)).encode(),
+                "application/json")
+
+    routes.add("GET", "/fleet/calibration", calibration)
     routes.add("GET", "/fleet/controller", controller)
     routes.add("GET", "/fleet/divergence", divergence)
     routes.add("GET", "/fleet/slo", slo)

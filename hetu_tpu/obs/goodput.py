@@ -90,11 +90,17 @@ def transformer_train_flops(L, h, V, batch, seq, ratio=4):
     return 3 * fwd * batch
 
 
+# TPU kinds we already warned about falling back to the v5e figure —
+# once per kind per process, not once per MFU sample
+_warned_kinds: set = set()
+
+
 def peak_flops(device_kind: Optional[str] = None) -> float:
     """Peak bf16 FLOP/s for ``device_kind`` (default: the first visible
     jax device), with bench.py's fallbacks: unknown TPU kinds assume the
-    v5e figure, non-TPU hosts 1e12 — the CI-smoke convention where MFU
-    is a smoke signal, not a perf claim."""
+    v5e figure (warned ONCE per kind — an MFU computed against a guessed
+    peak is not silently a perf claim), non-TPU hosts 1e12 — the
+    CI-smoke convention where MFU is a smoke signal."""
     if device_kind is None:
         import jax
         dev = jax.devices()[0]
@@ -103,6 +109,16 @@ def peak_flops(device_kind: Optional[str] = None) -> float:
                   or dev.platform in ("tpu", "axon"))
     else:
         on_tpu = "TPU" in str(device_kind).upper()
+    if on_tpu and device_kind not in PEAK_BF16 \
+            and device_kind not in _warned_kinds:
+        import warnings
+        _warned_kinds.add(device_kind)
+        warnings.warn(
+            f"unknown TPU device kind {device_kind!r}: falling back to "
+            f"the v5e peak (197 TFLOP/s bf16) — MFU figures for this "
+            f"chip are normalized against a GUESS; add the kind to "
+            f"hetu_tpu.obs.goodput.PEAK_BF16 (or pass peak= explicitly) "
+            f"for honest numbers", stacklevel=2)
     return PEAK_BF16.get(device_kind, 197e12 if on_tpu else 1e12)
 
 

@@ -71,6 +71,15 @@ kind                fields (beyond ``seq``/``ts``)
                       (``controller``/``queue_full``/``bucket_freeze``)
                       — an admission rejection that was load shedding,
                       distinguishable by cause
+``calibration_update``  ``record_kind``, ``key``, ``version`` (one
+                      calibration record appended to the profile store)
+``perf_regression``   ``metric``, ``baseline``, ``observed``, ``ratio``
+                      (the calibration sentinel graded a new record as
+                      regressed against its stored baseline)
+``mem_estimate_drift``  ``predicted_bytes``, ``xla_bytes``, ``ratio``,
+                      ``band`` (the memory estimator's prediction left
+                      its cross-check band against XLA's own
+                      ``memory_analysis`` bytes)
 ==================  =====================================================
 
 Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
@@ -157,6 +166,12 @@ EVENT_KINDS = {
     # closed-loop remediation (PR 11)
     "remediation": frozenset({"action", "signal", "dry_run"}),
     "shed": frozenset({"request_id", "reason"}),
+    # performance calibration plane (PR 12)
+    "calibration_update": frozenset({"record_kind", "key", "version"}),
+    "perf_regression": frozenset(
+        {"metric", "baseline", "observed", "ratio"}),
+    "mem_estimate_drift": frozenset(
+        {"predicted_bytes", "xla_bytes", "ratio", "band"}),
 }
 
 

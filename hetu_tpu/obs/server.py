@@ -17,10 +17,12 @@ register handlers instead of copy-pasting the HTTP plumbing:
   - ``/metrics.json``  the same samples as a JSON snapshot
   - ``/healthz``       liveness JSON: status, uptime, last journal seq,
     plus red flags (active non-finite streak, detected replica
-    divergence, compile storm) — flags flip the status to
-    ``unhealthy``, so a dying run stops scraping "ok"
+    divergence, compile storm, active perf regression) — flags flip
+    the status to ``unhealthy``, so a dying run stops scraping "ok"
   - ``/numerics``      flight-recorder ring tail, non-finite streak,
     last dump, latest parameter fingerprints
+  - ``/calibration``   the installed calibration profile store: latest
+    records per key, active perf regressions
   - ``/journal``       installed event journal: tail (``?n=100``) or
     cursor pagination (``?since=<seq>``, incremental polls)
 
@@ -199,6 +201,7 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
         # red flags: healthz must stop saying "ok" while a run is dying.
         # Lazy imports keep the scrape path's module graph minimal; each
         # check is a read of state the hot paths already maintain.
+        from hetu_tpu.obs import calibration as _calibration
         from hetu_tpu.obs import compile as _compile
         from hetu_tpu.obs import divergence as _divergence
         from hetu_tpu.obs import numerics as _numerics
@@ -214,6 +217,11 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
         if recent > storm.threshold:
             flags.append({"flag": "compile_storm", "recent": recent,
                           "threshold": storm.threshold})
+        regs = _calibration.active_regressions()
+        if regs:
+            flags.append({"flag": "perf_regression", "count": len(regs),
+                          "worst": regs[0]["metric"],
+                          "ratio": regs[0]["ratio"]})
         body = {"status": "unhealthy" if flags else "ok",
                 "flags": flags,
                 "uptime_s": round(time.time() - started, 3),
@@ -250,6 +258,20 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
         return json.dumps(body).encode(), "application/json"
 
     routes.add("GET", "/controller", controller_view)
+
+    def calibration_view(q, b):
+        """``/calibration``: the process-wide installed
+        :class:`~hetu_tpu.obs.calibration.ProfileStore`'s summary —
+        per-kind key counts, each key's latest record, and the active
+        perf regressions (the rank-0 fleet merge lives at
+        ``/fleet/calibration``).  Lazy import: the scrape path must not
+        pull the calibration stack until asked."""
+        from hetu_tpu.obs import calibration as _calibration
+        s = _calibration.get_store()
+        body = s.summary() if s is not None else {"installed": False}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/calibration", calibration_view)
 
     def journal_tail(q, b):
         """Tail form (``?n=100``, newest suffix) or cursor form

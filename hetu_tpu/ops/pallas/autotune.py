@@ -245,6 +245,12 @@ def record_entry(kernel: str, sig: str, entry: dict, *,
     else:
         _unsaved[full] = entry
     _load()[full] = entry
+    # calibration seam: a tuned entry is a measured kernel timing — fold
+    # it into the installed profile store (one global load + branch when
+    # none is; the sentinel then catches a retune landing >15% slower
+    # than the stored baseline)
+    from hetu_tpu.obs.calibration import note_tune
+    note_tune(kernel, sig, entry, device_kind=kind or _device_kind())
 
 
 def tuned_blocks(Sq: int, Sk: int, D: int,

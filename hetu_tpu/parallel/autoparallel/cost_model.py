@@ -106,28 +106,59 @@ class MemoryCostModel:
     bf16 weights (2B) + f32 master copy (4B) + Adam m/v (8B): weights split
     by tp; master+moments+grads additionally split by dp under ZeRO.
     Activations split by dp (batch) and tp (hidden), x pp microbatching.
+
+    The byte constants are overridable directly (``bytes_weight=`` /
+    ``bytes_state=`` / ``bytes_grad=`` / ``activation_scale=`` — no
+    profile store required), or pulled from a fitted
+    :class:`~hetu_tpu.obs.calibration.Calibration` carrying constants of
+    those names; explicit keyword overrides win over the calibration.
     """
 
     BYTES_WEIGHT = 2.0
     BYTES_STATE = 12.0  # master + adam moments
     BYTES_GRAD = 2.0
 
-    def __init__(self, cluster: ClusterSpec):
+    def __init__(self, cluster: ClusterSpec, *,
+                 bytes_weight: float | None = None,
+                 bytes_state: float | None = None,
+                 bytes_grad: float | None = None,
+                 activation_scale: float | None = None,
+                 calibration=None):
         self.cluster = cluster
+
+        def pick(explicit, name, default):
+            if explicit is not None:
+                return float(explicit)
+            if calibration is not None:
+                v = calibration.get(name)
+                if v is not None and v > 0:
+                    return float(v)
+            return float(default)
+
+        self.bytes_weight = pick(bytes_weight, "bytes_weight",
+                                 self.BYTES_WEIGHT)
+        self.bytes_state = pick(bytes_state, "bytes_state",
+                                self.BYTES_STATE)
+        self.bytes_grad = pick(bytes_grad, "bytes_grad", self.BYTES_GRAD)
+        # measured-over-modeled activation correction (a calibration fit
+        # against recorded memory_analysis bytes lands here)
+        self.activation_scale = pick(activation_scale, "activation_scale",
+                                     1.0)
 
     def layer_bytes(self, layer: LayerSpec, choice: ParallelChoice,
                     batch_per_replica: int, n_microbatches: int = 1,
                     remat_policy: str = "none") -> float:
         tp_split = choice.tp * layer.tp_shardable + (1 - layer.tp_shardable)
         p = layer.params / tp_split
-        weights = p * self.BYTES_WEIGHT
-        state = p * self.BYTES_STATE
-        grads = p * self.BYTES_GRAD
+        weights = p * self.bytes_weight
+        state = p * self.bytes_state
+        grads = p * self.bytes_grad
         if choice.zero:
             state /= choice.dp
             grads /= choice.dp
         micro_batch = math.ceil(batch_per_replica / n_microbatches)
-        acts = (layer.activation_per_sample * micro_batch / choice.tp)
+        acts = (layer.activation_per_sample * micro_batch / choice.tp
+                * self.activation_scale)
         # cost_knobs, not the raw fields: offload policies degrade to
         # their on-device fallback (and its residency) on backends
         # without host offload
@@ -138,13 +169,32 @@ class MemoryCostModel:
 class TimeCostModel:
     """Per-layer step time under a choice (cost_model.py:38 semantics):
     compute + TP collectives on the critical path + DP gradient allreduce
-    discounted by overlap."""
+    discounted by overlap.
 
-    def __init__(self, cluster: ClusterSpec, *, mfu: float = 0.4,
-                 dp_overlap: float = 0.7):
+    ``mfu`` and ``dp_overlap`` default to the historical guesses (0.4 /
+    0.7) but are overridable directly, or pulled from a fitted
+    :class:`~hetu_tpu.obs.calibration.Calibration` (measured MFU from
+    the goodput records, measured overlap from the compute/communication
+    partition) — explicit keyword overrides win over the calibration,
+    so ``dp_search(calibration=...)`` ranks plans by MEASURED constants
+    while a caller can still pin either knob."""
+
+    def __init__(self, cluster: ClusterSpec, *, mfu: float | None = None,
+                 dp_overlap: float | None = None, calibration=None):
         self.cluster = cluster
-        self.mfu = mfu
-        self.dp_overlap = dp_overlap
+
+        def pick(explicit, name, default, lo, hi):
+            if explicit is not None:
+                return float(explicit)
+            if calibration is not None:
+                v = calibration.get(name)
+                if v is not None and lo < v <= hi:
+                    return float(v)
+            return float(default)
+
+        # mfu must stay positive (it divides); dp_overlap lives in [0, 1]
+        self.mfu = pick(mfu, "mfu", 0.4, 0.0, 1.0)
+        self.dp_overlap = pick(dp_overlap, "dp_overlap", 0.7, -1.0, 1.0)
 
     def layer_time(self, layer: LayerSpec, choice: ParallelChoice,
                    batch_per_replica: int, remat_policy: str = "none") -> float:

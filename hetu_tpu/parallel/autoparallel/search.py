@@ -121,7 +121,8 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
               time_model: TimeCostModel | None = None,
               microbatch_options: Sequence[int] = (1, 2, 4, 8),
               uniform: bool = False, max_pp: int | None = None,
-              remat_policies: Sequence[str] = ("none",)) -> Plan:
+              remat_policies: Sequence[str] = ("none",),
+              calibration=None) -> Plan:
     """Search pp_deg x per-layer choices; returns the fastest feasible plan.
 
     With ``uniform=False`` a dynamic program picks each layer's choice
@@ -138,11 +139,23 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     being discarded — the searcher then weighs the recompute slowdown
     against alternative parallelism.  Default ('none',) keeps the legacy
     behavior.
+
+    ``calibration`` (a :class:`~hetu_tpu.obs.calibration.Calibration`,
+    fitted via ``fit_calibration`` or built with ``Calibration.of``)
+    builds the default cost models from MEASURED constants —
+    goodput-measured MFU and dp_overlap instead of the 0.4/0.7 guesses
+    — so two plans are ranked by what the chip actually did.  A
+    calibration carrying ``bytes_weight``/``bytes_state``/
+    ``bytes_grad``/``activation_scale`` constants (manual overrides;
+    the fit layer does not emit these yet) feeds the memory model too.
+    Explicit ``time_model=`` / ``mem_model=`` win over it.
     """
     if not remat_policies:
         raise ValueError("remat_policies must name at least one policy")
-    mem_model = mem_model or MemoryCostModel(cluster)
-    time_model = time_model or TimeCostModel(cluster)
+    mem_model = mem_model or MemoryCostModel(cluster,
+                                             calibration=calibration)
+    time_model = time_model or TimeCostModel(cluster,
+                                             calibration=calibration)
     best: Optional[Plan] = None
     pp = 1
     # max_pp caps the pipeline search space (e.g. a runtime without a

@@ -94,6 +94,14 @@ def pytest_configure(config):
                    "ride the slow tier — a 2-worker deadline-miss smoke "
                    "stays in tier-1, mirroring the gang convention")
     config.addinivalue_line(
+        "markers", "calib: performance-calibration tests (obs.calibration "
+                   "profile store / fit layer / regression sentinel, the "
+                   "dp_search/plan_memory calibrated-constant consumers, "
+                   "and the /calibration endpoints); the two-process "
+                   "concurrent-writer merge rides the slow tier — the "
+                   "store-determinism, sentinel, and /calibration scrape "
+                   "smokes stay in tier-1")
+    config.addinivalue_line(
         "markers", "controller: closed-loop remediation tests "
                    "(exec.controller deadline auto-tuning / divergence "
                    "quarantine / SLO-burn shedding / compile-storm bucket "
