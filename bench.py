@@ -788,6 +788,97 @@ def bench_serve(on_tpu, kind, peak):
         device=kind, timing="wall-trace", spread=None)
 
 
+def bench_serve_fleet(on_tpu, kind, peak, *, replicas: int,
+                      prefix_share: bool):
+    """``--mode serve --replicas N [--prefix-share]``: the seeded
+    SHARED-PREFIX trace (template pool × suffixes, loadgen satellite)
+    through an N-replica FleetRouter — affinity placement, optional
+    copy-on-write prefix sharing — against the same trace through a
+    single replica.  One JSON line; ``vs_baseline`` = fleet / single
+    decode tokens/s.  Rides the same rc=3 preflight as every serve
+    round."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.obs import registry as _obs
+    from hetu_tpu.serve import (FleetRouter, ServingEngine,
+                                generate_shared_prefix_load)
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        kw = dict(num_slots=8, page_size=64, max_seq_len=2048,
+                  prompt_buckets=(128, 256, 512, 1024))
+        trace = generate_shared_prefix_load(
+            17, 24, vocab=cfg.vocab_size, n_templates=4, prefix_len=256,
+            suffix_len=(16, 128), max_new=(32, 64), shared_fraction=0.7,
+            unique_len=(64, 512), mean_gap_s=0.0)
+    else:  # CI smoke: tiny shapes, still the full fleet-vs-single A/B
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        kw = dict(num_slots=4, page_size=8, max_seq_len=64,
+                  prompt_buckets=(8, 16, 32))
+        trace = generate_shared_prefix_load(
+            17, 12, vocab=cfg.vocab_size, n_templates=2, prefix_len=16,
+            suffix_len=(2, 6), max_new=(2, 6), shared_fraction=0.7,
+            unique_len=(4, 12), mean_gap_s=0.0)
+
+    set_random_seed(0)
+    model = GPT(cfg)
+    hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
+
+    def drive(n):
+        engines = [ServingEngine(model, queue_depth=len(trace) + 8,
+                                 sampling="top_k", top_k=5, seed=11,
+                                 prefix_sharing=prefix_share, **kw)
+                   for _ in range(n)]
+        router = FleetRouter(engines)
+        # warmup: compile every prefill bucket on every replica outside
+        # the measured window (the _serve_run convention)
+        for eng in engines:
+            for bucket in kw["prompt_buckets"]:
+                eng.submit(list(range(1, bucket + 1)), 2)
+            eng.run_until_idle()
+        cum0 = hist.cumulative()
+        # open-loop-ish: one fleet tick between arrivals, so published
+        # prefixes exist by the time their siblings route (a burst would
+        # race every template request past the trie it feeds)
+        t0 = time.perf_counter()
+        handles = []
+        for it in trace:
+            handles.append(router.submit(list(it.prompt),
+                                         it.max_new_tokens))
+            router.step()
+        router.run_until_idle(max_steps=10**7)
+        dt = time.perf_counter() - t0
+        done = [h for h in handles if h.status == "completed"]
+        decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        return (decode_tokens / dt if dt > 0 else 0.0,
+                _hist_quantile(cum0, hist.cumulative(), 0.50),
+                _hist_quantile(cum0, hist.cumulative(), 0.99),
+                len(done), router.stats())
+
+    fleet_tps, p50, p99, done, fstats = drive(replicas)
+    single_tps, s50, s99, sdone, _ = drive(1)
+    return _line(
+        "serve_fleet_decode_tokens_per_sec", fleet_tps, "tokens/s",
+        fleet_tps / single_tps if single_tps > 0 else 1.0,
+        replicas=replicas, prefix_share=prefix_share,
+        ttft_p50_s=_q_or_none(p50), ttft_p99_s=_q_or_none(p99),
+        single_tokens_per_sec=round(single_tps, 2),
+        single_ttft_p50_s=_q_or_none(s50),
+        single_ttft_p99_s=_q_or_none(s99),
+        requests=len(trace), completed=done, single_completed=sdone,
+        placements_by_reason=fstats["placements_by_reason"],
+        pages_shared=fstats["pages_shared"],
+        baseline_note="vs_baseline = fleet/single decode tokens/s on the "
+                      "same seeded shared-prefix trace; in-process "
+                      "replicas TIMESHARE this one device, so the ratio "
+                      "isolates scheduling + prefix-sharing effects — "
+                      "an N-chip deployment multiplies it by its "
+                      "parallelism",
+        device=kind, timing="wall-trace", spread=None)
+
+
 CONFIGS = [
     ("resnet", bench_resnet),
     ("ctr", bench_ctr),
@@ -879,13 +970,35 @@ def main():
     if mode not in ("train", "serve"):
         sys.exit(f"bench: unknown mode {mode!r}; one of 'train', 'serve'")
     if mode == "serve":
+        replicas = None
+        if "--replicas" in args:
+            i = args.index("--replicas")
+            if i + 1 >= len(args):
+                sys.exit("bench: --replicas needs a count")
+            try:
+                replicas = int(args[i + 1])
+            except ValueError:
+                sys.exit(f"bench: --replicas needs an integer, "
+                         f"got {args[i + 1]!r}")
+            if replicas < 1:
+                sys.exit(f"bench: --replicas must be >= 1, got {replicas}")
+            del args[i:i + 2]
+        prefix_share = "--prefix-share" in args
+        if prefix_share:
+            args.remove("--prefix-share")
+        if prefix_share and replicas is None:
+            replicas = 2  # sharing is a fleet feature; A/B needs a fleet
         if args:
             sys.exit(f"bench: --mode serve takes no config names, "
                      f"got {args}")
         _require_backend_alive()
         on_tpu, kind, peak = _env()
         try:
-            bench_serve(on_tpu, kind, peak)
+            if replicas is not None:
+                bench_serve_fleet(on_tpu, kind, peak, replicas=replicas,
+                                  prefix_share=prefix_share)
+            else:
+                bench_serve(on_tpu, kind, peak)
         except Exception:
             traceback.print_exc()
             sys.exit(1)

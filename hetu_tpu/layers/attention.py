@@ -23,7 +23,7 @@ from hetu_tpu.ops import dropout as dropout_op
 
 __all__ = ["MultiHeadAttention", "PagedDecode", "dot_product_attention",
            "dot_product_attention_bhsd", "decode_attention",
-           "ragged_cache_update"]
+           "ragged_cache_update", "paged_write_slots"]
 
 
 class PagedDecode(NamedTuple):
@@ -93,6 +93,26 @@ def ragged_cache_update(cache, new, index):
     return jax.vmap(
         lambda c, n, i: jax.lax.dynamic_update_slice(
             c, n.astype(c.dtype), (i, 0, 0)))(cache, new, index)
+
+
+def paged_write_slots(tables, cache_index, page_size: int):
+    """Physical (page, slot) each batch row's new K/V lands at: row
+    ``b`` writes into ``tables[b, cache_index[b] // page_size]`` at slot
+    ``cache_index[b] % page_size``.
+
+    This is the speculative-decode seam: several rows may share ONE page
+    table at consecutive ``cache_index`` values (a verify chain), and
+    because these writes are element-level scatters into the pool —
+    distinct (page, slot) per chain row — they compose within a single
+    step, with each row's attention then reading its predecessors'
+    fresh K/V (writes precede the kernel).  Rollback is the host's move:
+    a rejected chain suffix simply never advances ``PageTable.length``,
+    leaving its K/V as dead bytes beyond every future step's validity
+    mask until overwritten — the same contract bucket-pad garbage
+    already relies on."""
+    page_of = jnp.take_along_axis(
+        tables, (cache_index // page_size)[:, None], axis=1)[:, 0]
+    return page_of, cache_index % page_size
 
 
 def decode_attention(q, k_cache, v_cache, cache_index, *,
@@ -237,10 +257,8 @@ class MultiHeadAttention(Module):
         k = k.reshape(b, self.num_heads, self.head_dim)
         v = v.reshape(b, self.num_heads, self.head_dim)
         k_pool, v_pool = kv_cache
-        page = k_pool.shape[-3]
-        page_of = jnp.take_along_axis(
-            paged.tables, (cache_index // page)[:, None], axis=1)[:, 0]
-        slot = cache_index % page
+        page_of, slot = paged_write_slots(paged.tables, cache_index,
+                                          k_pool.shape[-3])
         if k_pool.ndim == 5:
             k_pool = k_pool.at[paged.layer, page_of, slot].set(
                 k.astype(k_pool.dtype))

@@ -166,6 +166,12 @@ EVENT_KINDS = {
     # closed-loop remediation (PR 11)
     "remediation": frozenset({"action", "signal", "dry_run"}),
     "shed": frozenset({"request_id", "reason"}),
+    # serving fleet tier (PR 13): prefix sharing / speculative decoding /
+    # cache-affinity routing
+    "prefix_share": frozenset({"request_id", "shared_tokens",
+                               "prompt_len"}),
+    "spec_verify": frozenset({"proposed", "accepted"}),
+    "router_place": frozenset({"request_id", "replica", "reason"}),
     # performance calibration plane (PR 12)
     "calibration_update": frozenset({"record_kind", "key", "version"}),
     "perf_regression": frozenset(

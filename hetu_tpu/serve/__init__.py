@@ -22,7 +22,13 @@ one.  This package is the inference path the training stack feeds:
   endpoint registered on the ``obs.server`` route table, sharing a port
   with ``/metrics``;
 - :mod:`~hetu_tpu.serve.loadgen` — seeded deterministic load generator
-  (the acceptance tests replay identical request schedules).
+  (the acceptance tests replay identical request schedules), including
+  template-heavy shared-prefix traces;
+- :mod:`~hetu_tpu.serve.fleet` — the multi-replica tier: copy-on-write
+  prefix sharing over the paged pool, speculative decoding with a draft
+  GPT (accepted streams bitwise identical to non-speculative runs), and
+  :class:`~hetu_tpu.serve.fleet.FleetRouter` placing requests across N
+  replicas by prefix-cache affinity and shed pressure.
 
 Everything is deterministic under a fixed seed: same schedule, same
 tokens, bit-for-bit — the serving counterpart of the training stack's
@@ -32,14 +38,21 @@ chaos-lineage guarantee.
 from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
                                     ContinuousBatcher, Request)
 from hetu_tpu.serve.engine import RequestHandle, ServingEngine
-from hetu_tpu.serve.kv_cache import KVCachePool, OutOfPages, PageTable
-from hetu_tpu.serve.loadgen import LoadItem, generate_load
-from hetu_tpu.serve.server import ServingServer, serve_engine
+from hetu_tpu.serve.kv_cache import (DoubleFree, KVCachePool, OutOfPages,
+                                     PageTable)
+from hetu_tpu.serve.loadgen import (LoadItem, generate_load,
+                                    generate_shared_prefix_load)
+from hetu_tpu.serve.server import (FleetServingServer, ServingServer,
+                                   serve_engine, serve_fleet_router)
+from hetu_tpu.serve.fleet import (FleetRouter, PrefixSharer, PrefixTrie,
+                                  SpeculativeDecoder)
 
 __all__ = [
-    "KVCachePool", "PageTable", "OutOfPages",
+    "KVCachePool", "PageTable", "OutOfPages", "DoubleFree",
     "ContinuousBatcher", "Request", "AdmissionQueueFull", "AdmissionShed",
     "ServingEngine", "RequestHandle",
     "ServingServer", "serve_engine",
-    "generate_load", "LoadItem",
+    "FleetServingServer", "serve_fleet_router",
+    "generate_load", "generate_shared_prefix_load", "LoadItem",
+    "PrefixTrie", "PrefixSharer", "SpeculativeDecoder", "FleetRouter",
 ]

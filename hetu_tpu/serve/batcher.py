@@ -167,6 +167,14 @@ class ContinuousBatcher:
         r.slot = None
         return r
 
+    def load_factor(self) -> float:
+        """Occupancy in [0, 1]: (waiting + decoding) over total capacity
+        (queue depth + slots).  The fleet router's cold-start tie-breaker:
+        before any SLO burn exists, shed-pressure gauges tie at 0.0 on
+        every replica, and occupancy is the honest load signal."""
+        return ((len(self._waiting) + self.active_slots)
+                / max(self.queue_depth + self.num_slots, 1))
+
     @property
     def queue_len(self) -> int:
         return len(self._waiting)

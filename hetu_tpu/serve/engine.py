@@ -52,6 +52,17 @@ The three jitted step functions are compile-counting seams
 clock is injectable — the deterministic tests drive a virtual clock,
 production defaults to ``time.monotonic``.
 
+**Fleet tier** (serve/fleet): ``prefix_sharing=True`` attaches a
+per-engine :class:`~hetu_tpu.serve.fleet.prefix.PrefixSharer` — prompt
+prefixes alias shared refcounted KV pages and prefill computes only the
+unshared suffix; ``draft_model=`` swaps the decode step for
+propose-and-verify speculation
+(:class:`~hetu_tpu.serve.fleet.spec.SpeculativeDecoder`, paged path
+only) with accepted streams bitwise identical to the non-speculative
+run; a :class:`~hetu_tpu.serve.fleet.router.FleetRouter` places
+requests across N engines by trie affinity and shed pressure
+(``RequestHandle.shed_reason`` marks re-routable rejections).
+
 Deadlines: ``deadline_s`` bounds a request's total age.  A request past
 its deadline while still *queued* is dropped before admission (stage
 ``queued``); one that exceeds it while *running* is retired at the next
@@ -62,6 +73,7 @@ with status ``expired`` and a human-readable ``error``.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -84,6 +96,7 @@ from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
                                  top_k_sample)
 from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
                                     ContinuousBatcher, Request)
+from hetu_tpu.serve import kv_cache as _kv
 from hetu_tpu.serve.kv_cache import (KVCachePool, OutOfPages, gather_views,
                                      scatter_views)
 
@@ -152,6 +165,11 @@ class RequestHandle:
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
         self.error: Optional[str] = None   # human-readable failure reason
+        # set on LOAD-SHEDDING rejections only ("controller" |
+        # "queue_full" | "bucket_freeze"): the fleet router re-routes
+        # these to another replica; validation rejections (None) would
+        # fail identically everywhere and are returned as-is
+        self.shed_reason: Optional[str] = None
         # deterministic uint32 fingerprint of the token stream
         # (obs.numerics.host_fingerprint_ints): two same-seed runs of the
         # same schedule must agree — a mismatch in prod IS sampler
@@ -192,7 +210,8 @@ class ServingEngine:
                  fused_sampling: Optional[bool] = None,
                  slo_targets=None, trace_capacity: int = 256,
                  trace_slow_n: int = 8, trace_window: int = 128,
-                 controller=None):
+                 controller=None, prefix_sharing: Optional[bool] = None,
+                 draft_model=None, spec_k: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -269,6 +288,36 @@ class ServingEngine:
         self.freeze_bucket_growth = False
         self._prefill_buckets: set = set()
         self._tick = 0
+        # fleet tier (serve/fleet): copy-on-write prefix sharing maps
+        # identical prompt prefixes to shared refcounted KV pages, and a
+        # draft model turns decode into propose-and-verify (bitwise
+        # identical streams).  Lazy imports: serve.fleet imports this
+        # module's types back.
+        # HETU_TPU_FLEET_* env knobs back the explicit kwargs (the fleet
+        # deployment story: one env block configures every replica)
+        if prefix_sharing is None:
+            prefix_sharing = os.environ.get(
+                "HETU_TPU_FLEET_PREFIX_SHARE", "0") not in ("0", "", "false")
+        if spec_k is None:
+            spec_k = int(os.environ.get("HETU_TPU_FLEET_SPEC_K", "4"))
+        self.sharer = None
+        if prefix_sharing:
+            from hetu_tpu.serve.fleet.prefix import PrefixSharer
+            self.sharer = PrefixSharer(self.pool)
+        self.spec = None
+        if draft_model is not None:
+            if not self.paged_decode:
+                raise ValueError(
+                    "speculative decoding requires paged_decode=True: "
+                    "chained verify rows share one page table, which "
+                    "only element-scattered paged K/V writes compose "
+                    "(the gather path scatters whole per-row page "
+                    "copies back — chained rows would clobber each "
+                    "other)")
+            from hetu_tpu.serve.fleet.spec import SpeculativeDecoder
+            self.spec = SpeculativeDecoder(
+                draft_model, spec_k, num_slots=num_slots,
+                max_len=self.max_seq_len)
 
     # -- jitted compute -----------------------------------------------------
 
@@ -393,6 +442,7 @@ class ServingEngine:
                 # must not consume SLO error budget
                 tl.close("rejected", req.arrival, reason=reason)
                 self._finalize_timeline(tl, grade=False)
+                handle.shed_reason = shed_reason
                 handle._finish("rejected", error=reason)
                 return handle
             self._handles[rid] = handle
@@ -431,6 +481,10 @@ class ServingEngine:
             def gate(r):
                 nonlocal budget
                 need = self.pool.pages_needed(len(r.prompt))
+                if need > budget and self.sharer is not None:
+                    # cached prefixes are a loan: evict trie-only pages
+                    # (least-recently-matched first) to admit real work
+                    budget += self.sharer.reclaim(need - budget)
                 if need > budget:
                     return False
                 budget -= need
@@ -507,23 +561,59 @@ class ServingEngine:
     # -- phases -------------------------------------------------------------
 
     def _prefill(self, req: Request, now: float) -> None:
-        """Right-pad the prompt to its bucket, run one (1, bucket) step,
-        sample the first token at the prompt's true last position."""
+        """Right-pad the prompt (or, under prefix sharing, just its
+        unshared suffix) to its bucket, run one (1, bucket) step at
+        ``cache_index = shared_tokens``, sample the first token at the
+        prompt's true last position.
+
+        With a trie hit, the table's leading entries alias the shared
+        pages — their K/V is already written, so the step computes and
+        writes ONLY the suffix pages (the ``pages_written`` seam counts
+        them: an identical-prefix request writes zero duplicate prefix
+        pages).  The sampled position and its key are the same either
+        way, shared or not."""
         plen = len(req.prompt)
-        bucket = self.batcher.bucket_for(plen)
+        shared_pages, shared_len = (), 0
+        if self.sharer is not None:
+            # trim the share so shared + suffix-bucket FITS the serving
+            # window: the gathered view is max_seq_len tokens, and a
+            # ragged write past it would be clamp-shifted back INTO the
+            # shared prefix pages (dynamic_update_slice clamps), then
+            # scattered back — corrupting the cached K/V for every alias
+            m = self.sharer.match_tokens(req.prompt)
+            while m and m + self.batcher.bucket_for(plen - m) \
+                    > self.max_seq_len:
+                m -= self.pool.page_size
+            # under a compile-storm freeze, a COLD suffix bucket must not
+            # slip past the admission gate (which checked the full-prompt
+            # bucket): drop sharing, the full-prompt bucket is warm
+            if m and self.freeze_bucket_growth and \
+                    self.batcher.bucket_for(plen - m) \
+                    not in self._prefill_buckets:
+                m = 0
+            shared_pages, shared_len = self.sharer.lookup(req.prompt, m)
+        suffix = req.prompt[shared_len:]
+        bucket = self.batcher.bucket_for(len(suffix))
         self._prefill_buckets.add(bucket)  # warm: survives a freeze
-        self.pool.alloc(req.id, plen)
+        self.pool.alloc(req.id, plen, shared_pages=shared_pages)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :plen] = req.prompt
+        tokens[0, :len(suffix)] = suffix
         logits, k, v = self._step_fn(
             self.model, self.pool.k, self.pool.v,
             self.pool.gather_indices([req.id]),
-            jnp.zeros((1,), jnp.int32), jnp.asarray(tokens),
-            jnp.asarray([plen], jnp.int32))
+            jnp.asarray([shared_len], jnp.int32), jnp.asarray(tokens),
+            jnp.asarray([len(suffix)], jnp.int32))
         self.pool.commit(k, v)
         # the bucket's pad positions wrote garbage K/V beyond plen; the
         # table's length stays plen, so decode overwrites them in turn
         self.pool.table(req.id).length = plen
+        _kv.note_pages_written(
+            self.pool.pages_needed(plen) - len(shared_pages))
+        if self.sharer is not None:
+            if shared_len:
+                _journal.record("prefix_share", request_id=req.id,
+                                shared_tokens=shared_len, prompt_len=plen)
+            self.sharer.publish(req.prompt, self.pool.table(req.id))
         tok = int(self._sample_fn(
             logits, jnp.asarray([req.id], jnp.int32),
             jnp.asarray([plen], jnp.int32))[0])
@@ -534,13 +624,30 @@ class ServingEngine:
         done_at = self.clock()
         req.prefill_at = done_at
         tl = self._timelines[req.id]
-        tl.prefill(tl.admitted_at, done_at, bucket=bucket, prompt_len=plen)
+        tl.prefill(tl.admitted_at, done_at, bucket=bucket, prompt_len=plen,
+                   **({"shared_tokens": shared_len} if shared_len else {}))
         self._append_token(req, tok, done_at, ttft=done_at - req.arrival,
                            batch=1)
 
+    def _ensure_pages(self, req_id: int, n_tokens: int) -> None:
+        """Grow a sequence's allocation, evicting trie-only cached
+        prefixes first when the free list is short — cached prefixes are
+        a loan, never a reason to evict live work.  Raises
+        :exc:`OutOfPages` only when the pool is genuinely full."""
+        need = self.pool.pages_needed(n_tokens) - \
+            len(self.pool.table(req_id).pages)
+        if need > self.pool.free_pages and self.sharer is not None:
+            self.sharer.reclaim(need - self.pool.free_pages)
+        self.pool.ensure(req_id, n_tokens)
+
     def _decode(self) -> int:
         """One fixed-shape (num_slots, 1) decode step over every active
-        slot; idle slots ride along masked into the scratch page."""
+        slot; idle slots ride along masked into the scratch page.  With
+        a draft model attached, the step is propose-and-verify instead
+        (serve/fleet/spec.py) — up to ``spec_k + 1`` tokens per slot per
+        tick, bitwise the same streams."""
+        if self.spec is not None:
+            return self.spec.decode_step(self)
         active = self.batcher.active()
         if not active:
             return 0
@@ -555,7 +662,15 @@ class ServingEngine:
             # the fed token's K/V lands at index ``length``; its successor
             # is sampled at global position ``length + 1``
             try:
-                self.pool.ensure(req.id, self.pool.table(req.id).length + 1)
+                self._ensure_pages(req.id,
+                                   self.pool.table(req.id).length + 1)
+                if self.sharer is not None:
+                    # copy-on-write guard: never write into a page another
+                    # table or the trie also references (sharing keeps the
+                    # write target private by construction; this enforces
+                    # the invariant rather than expecting it)
+                    self.pool.copy_on_write(
+                        req.id, self.pool.table(req.id).length)
             except OutOfPages:
                 # only reachable under an explicitly overcommitted pool
                 # (custom num_pages below full per-slot capacity); growth
@@ -737,6 +852,10 @@ class ServingEngine:
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,
                 "num_slots": self.batcher.num_slots,
+                "prefix": (None if self.sharer is None
+                           else self.sharer.stats()),
+                "speculative": (None if self.spec is None
+                                else self.spec.stats()),
                 "pool": self.pool.utilization(),
                 "max_seq_len": self.max_seq_len,
                 "sampling": self.sampling,

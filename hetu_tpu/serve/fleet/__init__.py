@@ -1,0 +1,36 @@
+"""Serving fleet tier: prefix sharing, speculative decoding, routing.
+
+The per-chip serve/ stack (paged Pallas decode + fused sampling, request
+tracing, SLO burn rates, controller actuators) stalls the ROADMAP's
+million-user north star at one replica's token rate.  This package is
+the multi-replica tier on top — the paper's hybrid-communication
+philosophy (cache-enabled parameter tier for hot state + topology-aware
+placement) applied to inference:
+
+- :mod:`~hetu_tpu.serve.fleet.prefix` — copy-on-write prefix sharing:
+  a trie keyed on token-block hashes maps identical prompt prefixes
+  (system prompts, few-shot templates) to shared refcounted KV pages in
+  the :class:`~hetu_tpu.serve.kv_cache.KVCachePool`, so the fleet stops
+  recomputing and re-storing the same prefill;
+- :mod:`~hetu_tpu.serve.fleet.spec` — speculative decoding: a small
+  draft GPT proposes k tokens per slot and the target verifies all of
+  them in ONE batched paged-decode step; the per-(request, position)
+  seeded sampler regenerates the same draws, so every accepted stream is
+  bitwise identical to its non-speculative replay — a stronger guarantee
+  than distribution-preserving rejection samplers offer;
+- :mod:`~hetu_tpu.serve.fleet.router` — :class:`FleetRouter` placing
+  requests across N in-process ``ServingEngine`` replicas by
+  prefix-cache affinity, shedding by each replica's published
+  shed-pressure gauge, with bounded re-routes on shed/freeze rejections.
+
+Everything stays deterministic under a fixed seed: placements, streams,
+and journal replay bitwise — the fleet inherits the single-replica
+guarantee.
+"""
+
+from hetu_tpu.serve.fleet.prefix import PrefixSharer, PrefixTrie
+from hetu_tpu.serve.fleet.router import FleetRouter
+from hetu_tpu.serve.fleet.spec import SpeculativeDecoder
+
+__all__ = ["PrefixTrie", "PrefixSharer", "SpeculativeDecoder",
+           "FleetRouter"]

@@ -1,0 +1,188 @@
+"""Cache-affinity request routing across ServingEngine replicas.
+
+A fleet of N replicas each carries its own KV pool and prefix trie, so
+WHERE a request lands decides whether its system prompt is a page alias
+or a full recompute.  :class:`FleetRouter` places each request by
+ranking replicas on:
+
+1. **prefix affinity** — the longest token-verified trie match for the
+   prompt (``PrefixSharer.match_tokens``, a read-only probe: ranking N
+   replicas must not perturb any trie's LRU state between replays);
+2. **shed pressure** — the replica's published SLO burn gauge
+   (``SLOEngine.shed_pressure``), the same signal the runtime controller
+   sheds on, so routing and remediation agree about who is drowning;
+3. **load factor** — queue + slot occupancy, the cold-start tie-breaker
+   before any SLO burn exists;
+4. replica index — the deterministic final tie-break.
+
+A placement that comes back as LOAD SHEDDING (controller shed latch,
+admission-queue depth, compile-storm bucket freeze —
+``RequestHandle.shed_reason``) is re-routed to the next-ranked replica
+with bounded retries; validation rejections (empty prompt, over-budget)
+return immediately — every replica would say the same thing.  Placements
+are counted (``hetu_router_placements_total{reason=affinity|pressure|
+retry}``), journaled (kind ``router_place``), and recorded on
+``router.placements`` — the replay acceptance test asserts the whole
+placement sequence is identical across same-seed runs.
+
+The router is in-process and synchronous (the replicas' scheduler
+threads or a deterministic ``step()`` driver do the work) — the
+disaggregated prefill/decode tier (ROADMAP item 2) will swap the
+in-process list for gang-dir transport without changing this policy.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["FleetRouter"]
+
+_router_metrics = None
+
+
+def _router_m() -> dict:
+    global _router_metrics
+    if _router_metrics is None:
+        reg = _obs.get_registry()
+        _router_metrics = {
+            "placements": reg.counter(
+                "hetu_router_placements_total",
+                "fleet placements by deciding signal (affinity: a prefix-"
+                "trie match won; pressure: no affinity anywhere, lowest "
+                "shed-pressure/load won; retry: re-routed after a load-"
+                "shedding rejection)",
+                ("reason",)),
+        }
+    return _router_metrics
+
+
+class FleetRouter:
+    """Front end over N in-process ``ServingEngine`` replicas."""
+
+    def __init__(self, engines, *, max_retries: int | None = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("need at least one replica")
+        self.engines = engines
+        if max_retries is None:
+            env = os.environ.get("HETU_TPU_FLEET_MAX_RETRIES")
+            # default: a retry budget of N-1 visits every other replica once
+            max_retries = len(engines) - 1 if env is None else int(env)
+        self.max_retries = int(max_retries)
+        self.placements: list = []  # the deterministic placement log
+
+    # -- placement ----------------------------------------------------------
+
+    def _rank(self, prompt) -> list:
+        """Replicas best-first: (-affinity, shed_pressure, load_factor,
+        index) ascending — all four components deterministic under the
+        engines' injected clocks."""
+        return sorted(
+            (-(e.sharer.match_tokens(prompt) if e.sharer is not None
+               else 0),
+             e.slo.shed_pressure(), e.batcher.load_factor(), i)
+            for i, e in enumerate(self.engines))
+
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               deadline_s: float | None = None):
+        """Place one request; returns the chosen replica's handle.  On a
+        load-shedding rejection the request re-routes to the next-ranked
+        replica (bounded by ``max_retries``); the last handle is returned
+        when every candidate shed."""
+        prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        ranked = self._rank(prompt)
+        tries = min(len(ranked), self.max_retries + 1)
+        for a, (neg_aff, _pressure, _load, idx) in enumerate(ranked[:tries]):
+            handle = self.engines[idx].submit(prompt, max_new_tokens,
+                                              deadline_s=deadline_s)
+            if handle.status == "rejected" and handle.shed_reason is None:
+                # a validation rejection is identical on every replica
+                return handle
+            shed = (handle.status == "rejected")
+            if shed and a + 1 < tries:
+                continue  # re-route around the shedding replica
+            reason = ("retry" if a > 0
+                      else "affinity" if neg_aff < 0 else "pressure")
+            self._place(handle, idx, reason)
+            return handle
+        raise AssertionError("unreachable: the loop always returns")
+
+    def _place(self, handle, replica: int, reason: str) -> None:
+        _router_m()["placements"].labels(reason=reason).inc()
+        _journal.record("router_place", request_id=handle.request_id,
+                        replica=replica, reason=reason)
+        self.placements.append({"request_id": handle.request_id,
+                                "replica": replica, "reason": reason})
+
+    # -- fleet drivers ------------------------------------------------------
+
+    def step(self) -> int:
+        """One deterministic fleet tick: step every replica in index
+        order; returns tokens produced fleet-wide."""
+        return sum(e.step() for e in self.engines)
+
+    @property
+    def idle(self) -> bool:
+        return all(e.batcher.idle for e in self.engines)
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            self.step()
+            if self.idle:
+                return
+        raise RuntimeError(f"fleet not idle after {max_steps} ticks")
+
+    def start(self, poll_interval: float = 0.001) -> "FleetRouter":
+        for e in self.engines:
+            e.start(poll_interval)
+        return self
+
+    def stop(self) -> None:
+        for e in self.engines:
+            e.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``/fleet/serve`` payload: per-replica occupancy/pressure/
+        cache state plus fleet aggregates and the placement tally."""
+        reasons: dict = {}
+        for p in self.placements:
+            reasons[p["reason"]] = reasons.get(p["reason"], 0) + 1
+        replicas = []
+        for i, e in enumerate(self.engines):
+            pool = e.pool.stats()
+            replicas.append({
+                "replica": i,
+                "queue_len": e.batcher.queue_len,
+                "active_slots": e.batcher.active_slots,
+                "num_slots": e.batcher.num_slots,
+                "shed_pressure": e.slo.shed_pressure(),
+                "load_factor": round(e.batcher.load_factor(), 6),
+                "shedding": e.batcher.shed_reason,
+                "pages_free": pool["pages_free"],
+                "pages_shared": pool["pages_shared"],
+                "prefix": (None if e.sharer is None else e.sharer.stats()),
+                "speculative": (None if e.spec is None else e.spec.stats()),
+            })
+        return {
+            "replicas": replicas,
+            "num_replicas": len(self.engines),
+            "placements": len(self.placements),
+            "placements_by_reason": reasons,
+            "max_retries": self.max_retries,
+            "queue_len": sum(r["queue_len"] for r in replicas),
+            "active_slots": sum(r["active_slots"] for r in replicas),
+            "pages_shared": sum(r["pages_shared"] for r in replicas),
+        }

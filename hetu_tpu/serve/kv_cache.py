@@ -31,16 +31,35 @@ same physical placement, which the bitwise-replay acceptance tests rely
 on.  ``defrag()`` compacts live pages toward low indices (the long-lived
 server shape: after hours of ragged arrivals, a fresh long request needs
 contiguous-ish headroom only the compactor can guarantee).
+
+**Copy-on-write prefix sharing** (the fleet tier, serve/fleet/prefix.py):
+pages are REFCOUNTED.  ``alloc(..., shared_pages=)`` returns a table
+whose leading entries alias already-written pages of an identical prompt
+prefix (each alias is a refcount, not a copy — the fleet stops re-storing
+the same system prompt per request); :meth:`~KVCachePool.retain` lets the
+prefix trie keep a page alive after its publishing sequence retires;
+``free`` only returns a page to the free list when its last reference
+drops, and a second ``free`` of the same sequence raises the NAMED
+:class:`DoubleFree` instead of silently corrupting the free list.
+:meth:`~KVCachePool.copy_on_write` un-shares a page the moment a
+sequence needs to WRITE into it, and ``defrag`` treats every shared or
+trie-cached page as pinned-by-refcount (moving a page another table or
+the trie also points at would corrupt them all).  :meth:`stats` is the
+supported introspection surface — pages by class, the refcount
+histogram, and an alloc/free balance invariant asserted on every call.
 """
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 
 import jax.numpy as jnp
 
-__all__ = ["KVCachePool", "PageTable", "OutOfPages", "SCRATCH_PAGE",
-           "gather_view_count", "reset_gather_view_count"]
+__all__ = ["KVCachePool", "PageTable", "OutOfPages", "DoubleFree",
+           "SCRATCH_PAGE", "gather_view_count", "reset_gather_view_count",
+           "pages_written_count", "reset_pages_written_count",
+           "note_pages_written"]
 
 # Counting seam for the no-materialization acceptance test: gather_views
 # is THE place a contiguous (L, batch, max_len, H, D) view of the pool is
@@ -59,6 +78,31 @@ def reset_gather_view_count() -> None:
     global _gather_view_calls
     _gather_view_calls = 0
 
+
+# Second counting seam, same style: how many KV pages were freshly
+# COMPUTED-AND-WRITTEN by prefill (the engine notes them after each
+# prefill step).  A shared-prefix prefill aliases its prefix pages
+# instead of recomputing them, so the acceptance test can prove that an
+# identical-prefix request writes ZERO duplicate prefix pages — the
+# whole point of copy-on-write sharing.
+_pages_written = 0
+
+
+def pages_written_count() -> int:
+    """Pages freshly written by prefill since the last reset (aliased
+    shared-prefix pages are never counted — they were not recomputed)."""
+    return _pages_written
+
+
+def note_pages_written(n: int) -> None:
+    global _pages_written
+    _pages_written += int(n)
+
+
+def reset_pages_written_count() -> None:
+    global _pages_written
+    _pages_written = 0
+
 # Physical page 0 is reserved: page-table padding points at it, and the
 # scatter of a padded decode batch dumps dead rows into it.  Never
 # allocated, never trusted.
@@ -68,6 +112,14 @@ SCRATCH_PAGE = 0
 class OutOfPages(RuntimeError):
     """The pool cannot satisfy an allocation — admission control should
     hold the request in the queue until sequences retire."""
+
+
+class DoubleFree(RuntimeError):
+    """A sequence (or page) was freed twice.  Raised by ``free`` for an
+    unknown sequence id and by ``release`` for a page already on the
+    free list — NAMED, so the bug surfaces at the second free instead of
+    corrupting the free list and handing one physical page to two
+    sequences steps later."""
 
 
 @dataclasses.dataclass
@@ -112,6 +164,13 @@ class KVCachePool:
         # ascending free list => lowest-index-first placement, deterministic
         self._free: list = list(range(1, num_pages))
         self._tables: dict = {}
+        # page -> reference count (tables aliasing it + trie retains);
+        # absent == on the free list.  A page leaves the free list with
+        # rc 1 and returns only when its LAST reference drops.
+        self._refcount: dict = {}
+        # alloc/free balance for the stats() invariant
+        self._allocs = 0
+        self._frees = 0
 
     # -- allocator ----------------------------------------------------------
 
@@ -129,19 +188,39 @@ class KVCachePool:
     def can_admit(self, n_tokens: int) -> bool:
         return self.pages_needed(n_tokens) <= len(self._free)
 
-    def alloc(self, seq_id: int, n_tokens: int) -> PageTable:
+    def alloc(self, seq_id: int, n_tokens: int,
+              shared_pages=()) -> PageTable:
         """Reserve capacity for ``n_tokens`` (>=1 page).  Raises
-        :exc:`OutOfPages` without side effects when the pool is short."""
+        :exc:`OutOfPages` without side effects when the pool is short.
+
+        ``shared_pages`` are already-allocated pages holding an identical
+        prompt prefix (the prefix trie's match): the returned table's
+        leading entries ALIAS them — each gains a refcount, no K/V bytes
+        move — and only the remainder is freshly allocated."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
         need = self.pages_needed(n_tokens)
         if n_tokens > self.max_seq_len:
             raise ValueError(f"sequence of {n_tokens} tokens exceeds "
                              f"max_seq_len {self.max_seq_len}")
-        if need > len(self._free):
-            raise OutOfPages(f"need {need} pages, {len(self._free)} free")
-        pt = PageTable(seq_id, [self._free.pop(0) for _ in range(need)])
+        shared = list(shared_pages)
+        if len(shared) > need:
+            raise ValueError(f"{len(shared)} shared prefix pages exceed "
+                             f"the {need} pages {n_tokens} tokens need")
+        for p in shared:
+            if self._refcount.get(p, 0) < 1:
+                raise ValueError(f"shared page {p} is not allocated")
+        fresh = need - len(shared)
+        if fresh > len(self._free):
+            raise OutOfPages(f"need {fresh} pages, {len(self._free)} free")
+        for p in shared:
+            self._refcount[p] += 1
+        pages = shared + [self._free.pop(0) for _ in range(fresh)]
+        for p in pages[len(shared):]:
+            self._refcount[p] = 1
+        pt = PageTable(seq_id, pages)
         self._tables[seq_id] = pt
+        self._allocs += 1
         return pt
 
     def ensure(self, seq_id: int, n_tokens: int) -> PageTable:
@@ -154,29 +233,151 @@ class KVCachePool:
         while pt.capacity(self.page_size) < n_tokens:
             if not self._free:
                 raise OutOfPages(f"growing sequence {seq_id}: no free pages")
-            pt.pages.append(self._free.pop(0))
+            p = self._free.pop(0)
+            self._refcount[p] = 1
+            pt.pages.append(p)
         return pt
 
+    def retain(self, page: int) -> None:
+        """Add one reference to an allocated page (the prefix trie's hold:
+        a published prefix outlives the sequence that computed it)."""
+        if self._refcount.get(page, 0) < 1:
+            raise ValueError(f"retain of unallocated page {page}")
+        self._refcount[page] += 1
+
+    def release(self, page: int) -> None:
+        """Drop one reference; the page returns to the free list only at
+        zero (sorted insert keeps placement deterministic)."""
+        rc = self._refcount.get(page)
+        if rc is None:
+            raise DoubleFree(f"page {page} is already on the free list")
+        if rc == 1:
+            del self._refcount[page]
+            bisect.insort(self._free, page)
+        else:
+            self._refcount[page] = rc - 1
+
     def free(self, seq_id: int) -> None:
-        """Return the sequence's pages to the pool (sorted re-insert keeps
-        placement deterministic)."""
-        pt = self._tables.pop(seq_id)
-        self._free = sorted(self._free + pt.pages)
+        """Drop the sequence's reference on each of its pages; pages whose
+        last reference this was return to the pool.  A second ``free`` of
+        the same sequence raises :exc:`DoubleFree`."""
+        pt = self._tables.pop(seq_id, None)
+        if pt is None:
+            raise DoubleFree(f"sequence {seq_id} already freed (or never "
+                             f"allocated)")
+        for p in pt.pages:
+            self.release(p)
+        self._frees += 1
+
+    def copy_on_write(self, seq_id: int, token_index: int) -> bool:
+        """Un-share before a write: if the page holding ``token_index``
+        is aliased (refcount > 1), copy its K/V rows into a fresh private
+        page, point this sequence's table at the copy, and drop the
+        reference on the original — the other aliases keep the original
+        bytes.  Returns True when a copy happened (refcount-1 pages are
+        already private: no copy, False)."""
+        pt = self._tables[seq_id]
+        i = token_index // self.page_size
+        old = pt.pages[i]
+        if self._refcount[old] == 1:
+            return False
+        if not self._free:
+            raise OutOfPages(f"copy-on-write for sequence {seq_id}: "
+                             f"no free page for the private copy")
+        new = self._free.pop(0)
+        self.k = self.k.at[:, new].set(self.k[:, old])
+        self.v = self.v.at[:, new].set(self.v[:, old])
+        self._refcount[new] = 1
+        pt.pages[i] = new
+        self.release(old)
+        return True
 
     def table(self, seq_id: int) -> PageTable:
         return self._tables[seq_id]
 
+    def refcount(self, page: int) -> int:
+        """Current reference count (0 == on the free list)."""
+        return self._refcount.get(page, 0)
+
+    def shared_pages_count(self) -> int:
+        """Pages with more than one reference — the hot-path form of
+        ``stats()['pages_shared']`` (no invariant sweep)."""
+        return sum(1 for rc in self._refcount.values() if rc > 1)
+
+    def stats(self) -> dict:
+        """The supported introspection surface: page classes, the
+        refcount histogram, and the alloc/free balance — with the pool's
+        accounting invariants ASSERTED on every call (a violation here is
+        a double-free/leak caught at the scrape, not at the much-later
+        wrong-answer)."""
+        self._check_invariants()
+        hist: dict = {}
+        for rc in self._refcount.values():
+            hist[rc] = hist.get(rc, 0) + 1
+        shared = sum(1 for rc in self._refcount.values() if rc > 1)
+        return {
+            "pages_total": self.num_pages - 1,
+            "pages_free": len(self._free),
+            "pages_private": len(self._refcount) - shared,
+            "pages_shared": shared,
+            "refcount_histogram": {str(k): hist[k] for k in sorted(hist)},
+            "sequences": len(self._tables),
+            "allocs": self._allocs,
+            "frees": self._frees,
+            "page_size": self.page_size,
+        }
+
+    def _check_invariants(self) -> None:
+        free = set(self._free)
+        assert len(free) == len(self._free), \
+            f"free list holds duplicates: {sorted(self._free)}"
+        assert SCRATCH_PAGE not in free and \
+            SCRATCH_PAGE not in self._refcount, "scratch page was allocated"
+        overlap = free & set(self._refcount)
+        assert not overlap, \
+            f"pages {sorted(overlap)} are both free and refcounted"
+        assert len(free) + len(self._refcount) == self.num_pages - 1, \
+            (f"page accounting leak: {len(free)} free + "
+             f"{len(self._refcount)} allocated != {self.num_pages - 1}")
+        # every table reference must be backed by at least that many refs
+        held: dict = {}
+        for pt in self._tables.values():
+            for p in pt.pages:
+                held[p] = held.get(p, 0) + 1
+        for p, n in held.items():
+            assert self._refcount.get(p, 0) >= n, \
+                (f"page {p} referenced by {n} table entries but refcount "
+                 f"is {self._refcount.get(p, 0)}")
+        assert self._allocs - self._frees == len(self._tables), \
+            (f"alloc/free imbalance: {self._allocs} allocs - "
+             f"{self._frees} frees != {len(self._tables)} live sequences")
+
     def defrag(self) -> int:
-        """Compact live pages into the lowest physical indices, moving the
-        K/V rows along (one permutation gather per array) and rewriting the
-        page tables.  Returns the number of pages moved.  Call between
-        steps — the arrays are replaced, so in-flight views are stale."""
-        live = [(pt.seq_id, i, p)
-                for pt in sorted(self._tables.values(),
-                                 key=lambda t: t.seq_id)
-                for i, p in enumerate(pt.pages)]
-        # target layout: scratch, then live pages packed in (seq, pos) order
-        mapping = {old: new for new, (_, _, old) in enumerate(live, start=1)}
+        """Compact movable live pages into the lowest physical indices,
+        moving the K/V rows along (one permutation gather per array) and
+        rewriting the page tables.  Returns the number of pages moved.
+        Call between steps — the arrays are replaced, so in-flight views
+        are stale.
+
+        Pages are PINNED-BY-REFCOUNT: a page aliased by several tables
+        (refcount > 1) or held only by the prefix trie (allocated but in
+        no table) stays at its physical index — moving it would require
+        rewriting every alias atomically, and the trie's references are
+        not table entries this compactor can see.  Only single-reference,
+        single-table pages move; the compaction target slots skip the
+        pinned indices."""
+        held_by_table = set()
+        for pt in self._tables.values():
+            held_by_table.update(pt.pages)
+        pinned = {p for p, rc in self._refcount.items()
+                  if rc > 1 or p not in held_by_table}
+        movable = [p for pt in sorted(self._tables.values(),
+                                      key=lambda t: t.seq_id)
+                   for p in pt.pages if p not in pinned]
+        # target layout: scratch, then (skipping pinned slots) movable
+        # pages packed in (seq, pos) order, then the free pages
+        slots = [s for s in range(1, self.num_pages) if s not in pinned]
+        mapping = dict(zip(movable, slots))
         moved = sum(1 for old, new in mapping.items() if old != new)
         if moved == 0:
             return 0
@@ -184,16 +385,17 @@ class KVCachePool:
         for old, new in mapping.items():
             perm[new] = old
         moved_from = set(mapping)  # old indices already placed
-        spare = iter(p for p in range(1, self.num_pages)
-                     if p not in moved_from)
-        for new in range(1 + len(live), self.num_pages):
+        spare = iter(p for p in slots if p not in moved_from)
+        for new in slots[len(movable):]:
             perm[new] = next(spare)
         perm_arr = jnp.asarray(perm, jnp.int32)
         self.k = jnp.take(self.k, perm_arr, axis=1)
         self.v = jnp.take(self.v, perm_arr, axis=1)
         for pt in self._tables.values():
-            pt.pages = [mapping[p] for p in pt.pages]
-        self._free = list(range(1 + len(live), self.num_pages))
+            pt.pages = [mapping.get(p, p) for p in pt.pages]
+        self._refcount = {mapping.get(p, p): rc
+                          for p, rc in self._refcount.items()}
+        self._free = sorted(slots[len(movable):])
         return moved
 
     # -- the static-shape bridge -------------------------------------------

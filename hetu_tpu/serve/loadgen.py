@@ -15,7 +15,7 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["LoadItem", "generate_load"]
+__all__ = ["LoadItem", "generate_load", "generate_shared_prefix_load"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,6 +26,10 @@ class LoadItem:
     prompt: tuple          # token ids
     max_new_tokens: int
     deadline_s: float | None = None
+    # shared-prefix traces: which template pool entry this prompt leads
+    # with (None = unique-prompt traffic) — lets tests assert affinity
+    # placement without re-deriving the prefix from tokens
+    template: int | None = None
 
 
 def generate_load(seed: int, n_requests: int, *, vocab: int,
@@ -48,4 +52,43 @@ def generate_load(seed: int, n_requests: int, *, vocab: int,
             prompt=tuple(int(x) for x in rng.integers(0, vocab, plen)),
             max_new_tokens=int(rng.integers(max_new[0], max_new[1] + 1)),
             deadline_s=deadline_s))
+    return out
+
+
+def generate_shared_prefix_load(seed: int, n_requests: int, *, vocab: int,
+                                n_templates: int = 4,
+                                prefix_len: int = 16,
+                                suffix_len=(2, 8), max_new=(1, 8),
+                                shared_fraction: float = 0.7,
+                                unique_len=(4, 24),
+                                mean_gap_s: float = 0.002,
+                                deadline_s: float | None = None) -> list:
+    """Template-heavy production traffic, seeded: a pool of
+    ``n_templates`` fixed ``prefix_len``-token system prompts, each
+    request drawing (with probability ``shared_fraction``) one template
+    plus a fresh uniform suffix of ``suffix_len`` tokens — the remainder
+    is unique-prompt traffic of ``unique_len`` tokens.  ``template`` on
+    each item names the drawn template (None for unique traffic), so the
+    prefix-sharing win and the router's affinity placements are
+    assertable from the trace spec alone.  Same seed, same trace — bit
+    for bit (unit-tested)."""
+    rng = np.random.default_rng(seed)
+    templates = [tuple(int(x) for x in rng.integers(0, vocab, prefix_len))
+                 for _ in range(n_templates)]
+    out, t = [], 0.0
+    for _ in range(n_requests):
+        t += float(rng.exponential(mean_gap_s))
+        if float(rng.random()) < shared_fraction:
+            tid = int(rng.integers(0, n_templates))
+            slen = int(rng.integers(suffix_len[0], suffix_len[1] + 1))
+            prompt = templates[tid] + tuple(
+                int(x) for x in rng.integers(0, vocab, slen))
+        else:
+            tid = None
+            ulen = int(rng.integers(unique_len[0], unique_len[1] + 1))
+            prompt = tuple(int(x) for x in rng.integers(0, vocab, ulen))
+        out.append(LoadItem(
+            submit_at=t, prompt=prompt,
+            max_new_tokens=int(rng.integers(max_new[0], max_new[1] + 1)),
+            deadline_s=deadline_s, template=tid))
     return out

@@ -40,7 +40,8 @@ import json
 
 from hetu_tpu.obs.server import Routes, RoutedHTTPServer, telemetry_routes
 
-__all__ = ["ServingServer", "serve_engine"]
+__all__ = ["ServingServer", "serve_engine", "FleetServingServer",
+           "serve_fleet_router"]
 
 
 def serving_routes(engine) -> Routes:
@@ -136,5 +137,66 @@ def serve_engine(engine, port: int = 0,
     stops the HTTP thread — stop the engine separately)."""
     engine.start()
     srv = ServingServer(engine, port, host)
+    srv.start()
+    return srv
+
+
+def fleet_serving_routes(router) -> Routes:
+    """Telemetry routes + the FLEET serving endpoints: ``POST /infer``
+    places each request through the router's affinity policy (same
+    request/response contract as the single-engine handler — callers
+    cannot tell one replica from N, which is the point), and ``GET
+    /fleet/serve`` reports the router's aggregated stats (per-replica
+    occupancy/pressure/cache state, placement tally by reason)."""
+    routes = telemetry_routes()
+
+    def infer(query, body):
+        req = json.loads(body or b"{}")
+        handle = router.submit(
+            req["prompt"], int(req.get("max_new_tokens", 16)),
+            deadline_s=req.get("deadline_s"))
+        if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
+            return (json.dumps({"request_id": handle.request_id,
+                                "trace_id": handle.trace_id,
+                                "status": "pending"}).encode(),
+                    "application/json", 504)
+        status = {"completed": 200, "rejected": 429,
+                  "expired": 504, "evicted": 503}[handle.status]
+        out = {
+            "request_id": handle.request_id,
+            "trace_id": handle.trace_id,
+            "status": handle.status,
+            "tokens": handle.tokens,
+            "stream_fingerprint": handle.stream_fingerprint,
+            "ttft_s": handle.ttft_s,
+            "latency_s": handle.latency_s,
+        }
+        if handle.error is not None:
+            out["error"] = handle.error
+        return json.dumps(out).encode(), "application/json", status
+
+    routes.add("POST", "/infer", infer)
+    routes.add("GET", "/fleet/serve",
+               lambda q, b: json.dumps(router.stats()).encode())
+    return routes
+
+
+class FleetServingServer(RoutedHTTPServer):
+    """HTTP front end over a :class:`~hetu_tpu.serve.fleet.FleetRouter`
+    (whose replicas should be ``start()``-ed so their scheduler loops
+    drain the queues)."""
+
+    def __init__(self, router, port: int = 0, host: str = "127.0.0.1"):
+        super().__init__(fleet_serving_routes(router), port, host,
+                         thread_name="hetu-fleet-http")
+        self.router = router
+
+
+def serve_fleet_router(router, port: int = 0,
+                       host: str = "127.0.0.1") -> FleetServingServer:
+    """Start every replica's scheduler thread and one fleet HTTP front
+    end; returns the started server."""
+    router.start()
+    srv = FleetServingServer(router, port, host)
     srv.start()
     return srv

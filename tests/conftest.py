@@ -109,6 +109,14 @@ def pytest_configure(config):
                    "4-worker chaos acceptance rides the slow tier — the "
                    "in-process 2-worker deadline-retune smoke, the serve "
                    "latches, and the overhead guard stay in tier-1")
+    config.addinivalue_line(
+        "markers", "fleet: serving-fleet tests (serve.fleet copy-on-write "
+                   "prefix sharing / speculative decoding / cache-affinity "
+                   "routing and their engine/pool/endpoint seams); "
+                   "multi-replica chaos and perf-comparison runs ride the "
+                   "slow tier — the 2-replica in-process router smoke with "
+                   "one shared-prefix pair, the CoW/refcount unit tests, "
+                   "and the bitwise spec-vs-baseline checks stay in tier-1")
 
 
 @pytest.fixture(autouse=True)
