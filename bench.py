@@ -879,6 +879,102 @@ def bench_serve_fleet(on_tpu, kind, peak, *, replicas: int,
         device=kind, timing="wall-trace", spread=None)
 
 
+def bench_serve_disagg(on_tpu, kind, peak):
+    """``--mode serve --disagg``: the seeded PREFILL-BURST trace (steady
+    short-decode traffic + clumped long-prompt bursts, the workload
+    where colocation loses) through a 1-prefill + 1-decode
+    ``DisaggRouter`` against the same trace through two colocated
+    engines — equal chips, arrivals interleaved with fleet ticks as in
+    the PR 13 fleet bench.  One JSON line; ``vs_baseline`` = disagg /
+    colocated decode tokens/s, with TTFT p99 for both modes alongside.
+    Rides the same rc=3 preflight as every serve round."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.obs import registry as _obs
+    from hetu_tpu.serve import (DisaggRouter, ServingEngine,
+                                generate_prefill_burst_load)
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        kw = dict(page_size=64, max_seq_len=2048,
+                  prompt_buckets=(128, 256, 512, 1024))
+        trace = generate_prefill_burst_load(
+            17, 24, vocab=cfg.vocab_size, short_len=(64, 192),
+            short_new=(32, 64), long_len=(512, 1024), long_new=(4, 8),
+            burst_every=6, burst_size=3, mean_gap_s=0.0)
+    else:  # CI smoke: tiny shapes, still the full disagg-vs-colocated A/B
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        kw = dict(page_size=8, max_seq_len=64, prompt_buckets=(8, 16, 32))
+        trace = generate_prefill_burst_load(
+            17, 12, vocab=cfg.vocab_size, short_len=(2, 6),
+            short_new=(2, 6), long_len=(20, 30), long_new=(1, 3),
+            burst_every=4, burst_size=2, mean_gap_s=0.0)
+
+    set_random_seed(0)
+    model = GPT(cfg)
+    hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
+
+    def drive(roles, slots):
+        engines = [ServingEngine(model, role=r, num_slots=s,
+                                 queue_depth=len(trace) + 8,
+                                 sampling="top_k", top_k=5, seed=11, **kw)
+                   for r, s in zip(roles, slots)]
+        router = DisaggRouter(engines)
+        # warmup: every prefill bucket on EVERY engine (router placement
+        # would leave the unchosen replica cold and bill its compiles to
+        # the measured window), which also warms the migration path —
+        # a prefill-role engine's direct submit migrates via the hook
+        for eng in engines:
+            for bucket in kw["prompt_buckets"]:
+                eng.submit(list(range(1, bucket + 1)), 2)
+            router.run_until_idle()
+        cum0 = hist.cumulative()
+        # the migration tallies are cumulative from construction: delta
+        # them past the warmup (its handoffs are not measured traffic),
+        # the TTFT-histogram convention applied to the counters
+        mig0 = {k: v for k, v in router.stats()["migrations"].items()}
+        t0 = time.perf_counter()
+        handles = []
+        for it in trace:
+            handles.append(router.submit(list(it.prompt),
+                                         it.max_new_tokens))
+            router.step()
+        router.run_until_idle(max_steps=10**7)
+        dt = time.perf_counter() - t0
+        done = [h for h in handles if h.status == "completed"]
+        decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        stats = router.stats()
+        stats["migrations"] = {k: v - mig0[k]
+                               for k, v in stats["migrations"].items()}
+        return (decode_tokens / dt if dt > 0 else 0.0,
+                _hist_quantile(cum0, hist.cumulative(), 0.99),
+                len(done), stats)
+
+    # equal chips: the decode worker dedicates the HBM a colocated chip
+    # must reserve for prefill activations to wider decode batching
+    disagg_tps, d99, done, dstats = drive(
+        ["prefill", "decode"], [4, 8] if not on_tpu else [8, 16])
+    coloc_tps, c99, cdone, _ = drive(
+        ["colocated", "colocated"], [4, 4] if not on_tpu else [8, 8])
+    return _line(
+        "serve_disagg_decode_tokens_per_sec", disagg_tps, "tokens/s",
+        disagg_tps / coloc_tps if coloc_tps > 0 else 1.0,
+        ttft_p99_s=_q_or_none(d99),
+        colocated_tokens_per_sec=round(coloc_tps, 2),
+        colocated_ttft_p99_s=_q_or_none(c99),
+        requests=len(trace), completed=done, colocated_completed=cdone,
+        migrations=dstats["migrations"],
+        baseline_note="vs_baseline = disagg/colocated decode tokens/s on "
+                      "the same seeded prefill-burst trace; in-process "
+                      "workers TIMESHARE this one device, so the ratio "
+                      "isolates the scheduling effect (prefill bursts no "
+                      "longer preempt decode) — an N-chip deployment "
+                      "multiplies it by its parallelism",
+        device=kind, timing="wall-trace", spread=None)
+
+
 CONFIGS = [
     ("resnet", bench_resnet),
     ("ctr", bench_ctr),
@@ -988,13 +1084,21 @@ def main():
             args.remove("--prefix-share")
         if prefix_share and replicas is None:
             replicas = 2  # sharing is a fleet feature; A/B needs a fleet
+        disagg = "--disagg" in args
+        if disagg:
+            args.remove("--disagg")
+        if disagg and (replicas is not None or prefix_share):
+            sys.exit("bench: --disagg runs its own 1-prefill + 1-decode "
+                     "vs 2-colocated A/B; drop --replicas/--prefix-share")
         if args:
             sys.exit(f"bench: --mode serve takes no config names, "
                      f"got {args}")
         _require_backend_alive()
         on_tpu, kind, peak = _env()
         try:
-            if replicas is not None:
+            if disagg:
+                bench_serve_disagg(on_tpu, kind, peak)
+            elif replicas is not None:
                 bench_serve_fleet(on_tpu, kind, peak, replicas=replicas,
                                   prefix_share=prefix_share)
             else:

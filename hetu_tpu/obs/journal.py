@@ -80,6 +80,17 @@ kind                fields (beyond ``seq``/``ts``)
                       ``band`` (the memory estimator's prediction left
                       its cross-check band against XLA's own
                       ``memory_analysis`` bytes)
+``kv_migrate``        ``request_id``, ``pages``, ``bytes``, ``src``,
+                      ``dst`` (one prefill worker's KV pages handed to
+                      a decode worker — the disaggregated tier's
+                      transport event)
+``migrate_verify_failed``  ``request_id``, ``reason`` (``torn``/
+                      ``page_crc``/``fingerprint``/``geometry``: a
+                      migration record refused at import verification;
+                      the request fell back to re-prefill)
+``role_assign``       ``replica``, ``role`` (``prefill``/``decode``/
+                      ``colocated`` — the DisaggRouter's worker-role
+                      assignment at construction)
 ==================  =====================================================
 
 Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
@@ -172,6 +183,12 @@ EVENT_KINDS = {
                                "prompt_len"}),
     "spec_verify": frozenset({"proposed", "accepted"}),
     "router_place": frozenset({"request_id", "replica", "reason"}),
+    # disaggregated prefill/decode serving (PR 14): KV-page migration
+    # over the page fabric
+    "kv_migrate": frozenset({"request_id", "pages", "bytes", "src",
+                             "dst"}),
+    "migrate_verify_failed": frozenset({"request_id", "reason"}),
+    "role_assign": frozenset({"replica", "role"}),
     # performance calibration plane (PR 12)
     "calibration_update": frozenset({"record_kind", "key", "version"}),
     "perf_regression": frozenset(

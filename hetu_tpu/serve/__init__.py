@@ -28,7 +28,11 @@ one.  This package is the inference path the training stack feeds:
   prefix sharing over the paged pool, speculative decoding with a draft
   GPT (accepted streams bitwise identical to non-speculative runs), and
   :class:`~hetu_tpu.serve.fleet.FleetRouter` placing requests across N
-  replicas by prefix-cache affinity and shed pressure.
+  replicas by prefix-cache affinity and shed pressure, and the
+  disaggregated prefill/decode tier
+  (:class:`~hetu_tpu.serve.fleet.DisaggRouter`): finished prefills
+  migrate their KV pages to decode workers as verified records, streams
+  staying bitwise identical to colocated same-seed runs.
 
 Everything is deterministic under a fixed seed: same schedule, same
 tokens, bit-for-bit — the serving counterpart of the training stack's
@@ -41,10 +45,14 @@ from hetu_tpu.serve.engine import RequestHandle, ServingEngine
 from hetu_tpu.serve.kv_cache import (DoubleFree, KVCachePool, OutOfPages,
                                      PageTable)
 from hetu_tpu.serve.loadgen import (LoadItem, generate_load,
+                                    generate_prefill_burst_load,
                                     generate_shared_prefix_load)
 from hetu_tpu.serve.server import (FleetServingServer, ServingServer,
                                    serve_engine, serve_fleet_router)
-from hetu_tpu.serve.fleet import (FleetRouter, PrefixSharer, PrefixTrie,
+from hetu_tpu.serve.fleet import (DisaggRouter, FleetRouter,
+                                  MigrationFileFabric,
+                                  MigrationIntegrityError, MigrationRecord,
+                                  PrefixSharer, PrefixTrie,
                                   SpeculativeDecoder)
 
 __all__ = [
@@ -53,6 +61,9 @@ __all__ = [
     "ServingEngine", "RequestHandle",
     "ServingServer", "serve_engine",
     "FleetServingServer", "serve_fleet_router",
-    "generate_load", "generate_shared_prefix_load", "LoadItem",
+    "generate_load", "generate_shared_prefix_load",
+    "generate_prefill_burst_load", "LoadItem",
     "PrefixTrie", "PrefixSharer", "SpeculativeDecoder", "FleetRouter",
+    "DisaggRouter", "MigrationRecord", "MigrationIntegrityError",
+    "MigrationFileFabric",
 ]

@@ -59,6 +59,10 @@ class Request:
     tokens: list = dataclasses.field(default_factory=list)  # generated
     prefill_at: Optional[float] = None
     slot: Optional[int] = None
+    # disaggregated serving: the inbound migration ticket (record +
+    # settle callback) a decode worker ingests at slot admission instead
+    # of running prefill; None for ordinary requests
+    migration: Optional[object] = None
 
     @property
     def total_budget(self) -> int:

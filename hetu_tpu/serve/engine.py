@@ -63,6 +63,21 @@ run; a :class:`~hetu_tpu.serve.fleet.router.FleetRouter` places
 requests across N engines by trie affinity and shed pressure
 (``RequestHandle.shed_reason`` marks re-routable rejections).
 
+**Disaggregated serving** (serve/fleet/disagg.py): ``role=`` splits the
+fleet into prefill workers (compute-bound: prefill, sample the first
+token, then MIGRATE the KV pages to a decode worker and recycle the
+slot immediately) and decode workers (memory-bound: ingest verified
+migration records — or re-prefill on a corrupt one — and decode without
+ever being stalled by a long-prompt burst); ``colocated`` (the default)
+is the classic timeslicing engine.  Because sampling keys derive from
+``(seed, request id, position)`` and migration preserves
+``cache_index``/lengths exactly, a migrated stream is bitwise identical
+to its colocated same-seed twin — the PR 13 guarantee carried across a
+worker boundary.  ``prefill_tick_cost`` enables the virtual-time
+timeslice model the deterministic A/B tests and benches drive
+(``HETU_TPU_DISAGG_ROLE`` / ``HETU_TPU_DISAGG_PREFILL_COST`` back the
+kwargs).
+
 Deadlines: ``deadline_s`` bounds a request's total age.  A request past
 its deadline while still *queued* is dropped before admission (stage
 ``queued``); one that exceeds it while *running* is retired at the next
@@ -73,6 +88,7 @@ with status ``expired`` and a human-readable ``error``.
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -211,10 +227,49 @@ class ServingEngine:
                  slo_targets=None, trace_capacity: int = 256,
                  trace_slow_n: int = 8, trace_window: int = 128,
                  controller=None, prefix_sharing: Optional[bool] = None,
-                 draft_model=None, spec_k: Optional[int] = None):
+                 draft_model=None, spec_k: Optional[int] = None,
+                 role: Optional[str] = None,
+                 prefill_tick_cost: Optional[float] = None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
+        # disaggregated serving (serve/fleet/disagg.py): the worker ROLE.
+        # "colocated" (default) timeslices prefill and decode on this
+        # engine; "prefill" hands every freshly prefilled request's KV
+        # pages to a decode worker through the router-installed
+        # ``migrate_out`` hook; "decode" only ever decodes (migrated
+        # requests arrive via accept_migration; re-prefill is the
+        # verify-failure fallback).  HETU_TPU_DISAGG_ROLE backs the kwarg
+        # — one env block configures every worker, the fleet convention.
+        if role is None:
+            role = os.environ.get("HETU_TPU_DISAGG_ROLE", "colocated")
+        if role not in ("prefill", "decode", "colocated"):
+            raise ValueError(f"unknown role {role!r}; one of 'prefill', "
+                             f"'decode', 'colocated'")
+        self.role = role
+        # virtual-time cost model for the deterministic fleet ticks: a
+        # prefill of bucket B makes this engine BUSY for
+        # ceil(B * prefill_tick_cost) scheduler ticks (admission and
+        # decode both skip — the chip is crunching the prefill), so the
+        # simulation reproduces the timeslice stall a colocated chip
+        # pays and a disaggregated decode worker never does.  0 (the
+        # default) disables the model entirely: production engines on a
+        # real clock measure real compute instead.
+        if prefill_tick_cost is None:
+            prefill_tick_cost = float(os.environ.get(
+                "HETU_TPU_DISAGG_PREFILL_COST", "0") or 0)
+        self.prefill_tick_cost = float(prefill_tick_cost)
+        self._busy_ticks = 0
+        self._tick_prefill_charge = 0
+        # router-installed migration hook (role "prefill" only):
+        # called as migrate_out(engine, request, record) -> bool
+        self.migrate_out = None
+        # migration settle callbacks (export-hold acks against the
+        # SOURCE pool) deferred to run outside this engine's lock — a
+        # decode worker settling while a prefill worker migrates to it
+        # must not deadlock on crossed engine locks
+        self._pending_settles: list = []
+        self._migrations = {"out": 0, "in": 0, "reprefill": 0}
         if sampling not in ("greedy", "top_k", "temperature"):
             raise ValueError(f"unknown sampling mode {sampling!r}; one of "
                              f"'greedy', 'top_k', 'temperature'")
@@ -381,14 +436,27 @@ class ServingEngine:
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               request_id: Optional[int] = None) -> RequestHandle:
         """Queue one generation request; never blocks.  Returns a handle
         that resolves when the request completes, is rejected (queue
-        depth / too long), or expires at its deadline."""
+        depth / too long), or expires at its deadline.
+
+        ``request_id`` pins the id instead of drawing from this engine's
+        counter — the disaggregated router's seam: token streams are a
+        pure function of ``(seed, request id, prompt)``, so a router that
+        assigns GLOBAL ids in submission order makes a migrated stream
+        bitwise comparable to its colocated same-seed twin."""
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
         with self._lock:
-            rid = self._next_id
-            self._next_id += 1
+            if request_id is None:
+                rid = self._next_id
+            else:
+                rid = int(request_id)
+                if rid in self._handles:
+                    raise ValueError(f"request id {rid} is already in "
+                                     f"flight on this engine")
+            self._next_id = max(self._next_id, rid + 1)
             handle = RequestHandle(rid)
             req = Request(id=rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
@@ -453,71 +521,116 @@ class ServingEngine:
     # -- the scheduler loop -------------------------------------------------
 
     def step(self) -> int:
-        """One scheduler tick: expire, admit+prefill, one decode step.
-        Returns the number of tokens produced (0 when idle)."""
+        """One scheduler tick: expire, admit+prefill (or ingest a
+        migrated request's KV pages), one decode step.  Returns the
+        number of tokens produced (0 when idle, or while the virtual
+        prefill-cost model holds the engine busy)."""
         with self._lock:
-            self._tick += 1
-            plan = _faults.active_plan()
-            if plan is not None:
-                # chaos seam: a scheduled compile_storm fault notes `arg`
-                # synthetic distinct-shape compiles (default: enough to
-                # cross the threshold) into the process storm detector —
-                # the deterministic stand-in for an unbucketed-shape
-                # flood.  Only this kind is consumed here; the training
-                # harnesses keep their own conventions.
-                f = plan.take("compile_storm", late_ok=True, now=self._tick)
-                if f is not None:
-                    storm = _compile.get_storm()
-                    for _ in range(int(f.arg or storm.threshold + 1)):
-                        storm.note("fault_injection")
-            _controller.maybe_serve_tick(self)
-            now = self.clock()
-            m = _serve_m()
-            # reserving gate: poll admits several requests before any of
-            # them allocates, so the budget must be decremented as each
-            # one passes — gating on live pool state alone would overcommit
-            budget = self.pool.free_pages
+            produced = self._step_locked()
+        # settle migration export holds OUTSIDE this engine's lock: the
+        # settle acquires the SOURCE engine's lock, and a prefill worker
+        # migrating into this engine holds its own lock while taking
+        # ours — nesting the other direction too would deadlock
+        while True:
+            try:
+                settle = self._pending_settles.pop(0)
+            except IndexError:
+                break
+            settle()
+        return produced
 
-            def gate(r):
-                nonlocal budget
-                need = self.pool.pages_needed(len(r.prompt))
-                if need > budget and self.sharer is not None:
-                    # cached prefixes are a loan: evict trie-only pages
-                    # (least-recently-matched first) to admit real work
-                    budget += self.sharer.reclaim(need - budget)
-                if need > budget:
-                    return False
-                budget -= need
-                return True
+    def _step_locked(self) -> int:
+        self._tick += 1
+        plan = _faults.active_plan()
+        if plan is not None:
+            # chaos seam: a scheduled compile_storm fault notes `arg`
+            # synthetic distinct-shape compiles (default: enough to
+            # cross the threshold) into the process storm detector —
+            # the deterministic stand-in for an unbucketed-shape
+            # flood.  Only this kind is consumed here; the training
+            # harnesses keep their own conventions.
+            f = plan.take("compile_storm", late_ok=True, now=self._tick)
+            if f is not None:
+                storm = _compile.get_storm()
+                for _ in range(int(f.arg or storm.threshold + 1)):
+                    storm.note("fault_injection")
+        _controller.maybe_serve_tick(self)
+        m = _serve_m()
+        if self._busy_ticks > 0:
+            # the virtual prefill-cost model: the chip is still crunching
+            # an earlier prefill — no admission, no decode this tick.
+            # This is the timeslice stall a colocated worker pays under a
+            # long-prompt burst and a disaggregated decode worker never
+            # sees (its role never prefills).
+            self._busy_ticks -= 1
+            return 0
+        now = self.clock()
+        # reserving gate: poll admits several requests before any of
+        # them allocates, so the budget must be decremented as each
+        # one passes — gating on live pool state alone would overcommit
+        budget = self.pool.free_pages
 
-            tick = self.batcher.poll(now, can_admit=gate)
-            for req in tick.expired:
-                waited = now - req.arrival
-                _journal.record("request_expired", request_id=req.id,
-                                stage="queued", waited_s=round(waited, 6))
-                m["requests"].labels(outcome="expired").inc()
-                m["deadline"].labels(stage="queued").inc()
-                tl = self._timelines.pop(req.id)
-                tl.close("expired", now, stage="queued")
-                self._finalize_timeline(tl)
-                self._handles.pop(req.id)._finish(
-                    "expired",
-                    error=f"deadline of {req.deadline_s}s expired after "
-                          f"{waited:.6g}s in the admission queue")
-            for req in tick.admitted:
-                m["requests"].labels(outcome="admitted").inc()
-                self._timelines[req.id].admit(
-                    now, slot=req.slot, queue_depth=self.batcher.queue_len)
-                self._prefill(req, now)
-            # a running request past its deadline is cut off here, with
-            # the tokens it has — serving it further is serving it late
-            for _slot, req in self.batcher.active():
-                if req.expired(now):
-                    self._retire(req, "expired", now)
+        def gate(r):
+            nonlocal budget
+            need = self.pool.pages_needed(len(r.prompt))
+            if need > budget and self.sharer is not None:
+                # cached prefixes are a loan: evict trie-only pages
+                # (least-recently-matched first) to admit real work
+                budget += self.sharer.reclaim(need - budget)
+            if need > budget:
+                return False
+            budget -= need
+            return True
+
+        tick = self.batcher.poll(now, can_admit=gate)
+        for req in tick.expired:
+            waited = now - req.arrival
+            if req.migration is not None:
+                # a migrated request expired waiting for a decode slot:
+                # its KV never imported — settle the source's export hold
+                self._pending_settles.append(req.migration.settle)
+            _journal.record("request_expired", request_id=req.id,
+                            stage="queued", waited_s=round(waited, 6))
+            m["requests"].labels(outcome="expired").inc()
+            m["deadline"].labels(stage="queued").inc()
+            tl = self._timelines.pop(req.id)
+            tl.close("expired", now, stage="queued")
+            self._finalize_timeline(tl)
+            self._handles.pop(req.id)._finish(
+                "expired",
+                error=f"deadline of {req.deadline_s}s expired after "
+                      f"{waited:.6g}s in the admission queue")
+        for req in tick.admitted:
+            if req.migration is not None:
+                # a migrated request enters a decode slot: import its KV
+                # (or re-prefill on a corrupt record) — it was already
+                # counted admitted by the prefill worker
+                self._ingest_migration(req, now)
+                continue
+            m["requests"].labels(outcome="admitted").inc()
+            self._timelines[req.id].admit(
+                now, slot=req.slot, queue_depth=self.batcher.queue_len)
+            self._prefill(req, now)
+            if (self.role == "prefill" and self.migrate_out is not None
+                    and req.id in self._handles):
+                self._migrate_after_prefill(req)
+        # a running request past its deadline is cut off here, with
+        # the tokens it has — serving it further is serving it late
+        for _slot, req in self.batcher.active():
+            if req.expired(now):
+                self._retire(req, "expired", now)
+        charge = self._tick_prefill_charge
+        self._tick_prefill_charge = 0
+        if charge > 0:
+            # this tick was spent prefilling (the first busy tick);
+            # decode resumes when the remaining charge drains
+            self._busy_ticks += charge - 1
+            produced = 0
+        else:
             produced = self._decode()
-            m["queue"].set(self.batcher.queue_len)
-            m["slots"].set(self.batcher.active_slots)
-            return produced
+        m["queue"].set(self.batcher.queue_len)
+        m["slots"].set(self.batcher.active_slots)
+        return produced
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         for _ in range(max_steps):
@@ -595,6 +708,11 @@ class ServingEngine:
         suffix = req.prompt[shared_len:]
         bucket = self.batcher.bucket_for(len(suffix))
         self._prefill_buckets.add(bucket)  # warm: survives a freeze
+        if self.prefill_tick_cost > 0:
+            # virtual-time cost model: this prefill occupies the chip for
+            # ceil(bucket * cost) scheduler ticks (consumed in step())
+            self._tick_prefill_charge += max(
+                1, math.ceil(bucket * self.prefill_tick_cost))
         self.pool.alloc(req.id, plen, shared_pages=shared_pages)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(suffix)] = suffix
@@ -628,6 +746,130 @@ class ServingEngine:
                    **({"shared_tokens": shared_len} if shared_len else {}))
         self._append_token(req, tok, done_at, ttft=done_at - req.arrival,
                            batch=1)
+
+    # -- KV-page migration (disaggregated serving) --------------------------
+
+    def _migrate_after_prefill(self, req: Request) -> None:
+        """Role ``prefill``: hand the freshly prefilled request's KV
+        pages to a decode worker through the router-installed
+        ``migrate_out`` hook.  The export places a HOLD on the pages (the
+        export/free race fix in kv_cache.py); a successful handoff
+        recycles this engine's slot and pages immediately — prefill
+        workers hold KV only for the duration of one prefill, which is
+        what keeps their admission capacity high under a burst.  A failed
+        placement (every decode worker shed) cancels the export and the
+        request simply decodes here — degraded, never dropped."""
+        record = self.pool.export_pages(req.id)
+        placed = False
+        try:
+            placed = bool(self.migrate_out(self, req, record))
+        finally:
+            if not placed:
+                self.pool.cancel_export(req.id)
+        if placed:
+            self._migrations["out"] += 1
+            self.batcher.finish(req.slot)
+            self.pool.free(req.id)
+            self._recycled += 1
+            if self.defrag_every and self._recycled % self.defrag_every == 0:
+                self.pool.defrag()
+            self._handles.pop(req.id)
+            self._timelines.pop(req.id)
+
+    def accept_migration(self, req: Request, record, ticket, handle,
+                         timeline) -> Optional[str]:
+        """Decode-side intake: queue a migrated request for a decode
+        slot.  The KV import is DEFERRED to slot admission (so the
+        ordinary page-budget admission gate covers it); the handle and
+        timeline transfer so the request resolves here exactly as it
+        would have colocated.  Returns ``None`` on acceptance, or the
+        shed reason (``controller`` | ``queue_full``) so the router can
+        re-route to the next-ranked decode worker."""
+        if self.role == "prefill":
+            raise ValueError("a prefill-role engine cannot accept "
+                             "migrations")
+        with self._lock:
+            if req.id in self._handles:
+                # a direct submission on this engine drew the same id
+                # (mixing router-pinned and engine-local ids): refuse so
+                # the router re-routes instead of stranding the in-flight
+                # request by overwriting its handle
+                return "id_collision"
+            mreq = Request(
+                id=req.id, prompt=list(req.prompt),
+                max_new_tokens=req.max_new_tokens, arrival=req.arrival,
+                deadline_s=req.deadline_s, tokens=list(req.tokens),
+                prefill_at=req.prefill_at, migration=ticket)
+            try:
+                self.batcher.submit(mreq)
+            except AdmissionShed:
+                return "controller"
+            except AdmissionQueueFull:
+                return "queue_full"
+            self._handles[req.id] = handle
+            self._timelines[req.id] = timeline
+            self._next_id = max(self._next_id, req.id + 1)
+            _serve_m()["queue"].set(self.batcher.queue_len)
+            return None
+
+    def _ingest_migration(self, req: Request, now: float) -> None:
+        """A migrated request enters a decode slot: verify + import its
+        KV pages.  A torn or tampered record is journaled by named
+        reason (``migrate_verify_failed``) and the request falls back to
+        a local re-prefill — corrupt KV is never served, and the stream
+        stays bitwise what the colocated engine would have produced
+        because sampling keys derive from ``(seed, request id,
+        position)`` alone."""
+        from hetu_tpu.serve.fleet.migrate import (MigrationIntegrityError,
+                                                  migrate_metrics)
+        ticket = req.migration
+        tl = self._timelines[req.id]
+        verified = True
+        try:
+            self.pool.import_pages(ticket.record, seq_id=req.id)
+            self._migrations["in"] += 1
+        except MigrationIntegrityError as e:
+            verified = False
+            migrate_metrics()["failures"].labels(reason=e.reason).inc()
+            _journal.record("migrate_verify_failed", request_id=req.id,
+                            reason=e.reason)
+            self._reprefill(req)
+            self._migrations["reprefill"] += 1
+        finally:
+            # settle the source pool's export hold outside our lock
+            self._pending_settles.append(ticket.settle)
+        tl.span("serve.migrate", now, self.clock(), slot=req.slot,
+                pages=ticket.record.num_pages, verified=verified)
+
+    def _reprefill(self, req: Request) -> None:
+        """Recompute a migrated request's prompt KV locally (the
+        corrupt-record fallback): one bucketed prefill step, no sharing.
+        The first token was already sampled by the prefill worker from
+        the same ``(seed, request id, position)`` key — recomputing it
+        here must agree bitwise, and the locally recomputed draw is the
+        one trusted (a record corrupt enough to fail verification is a
+        record whose producer's outputs are not to be taken on faith)."""
+        plen = len(req.prompt)
+        bucket = self.batcher.bucket_for(plen)
+        self._prefill_buckets.add(bucket)
+        if self.prefill_tick_cost > 0:
+            self._tick_prefill_charge += max(
+                1, math.ceil(bucket * self.prefill_tick_cost))
+        self.pool.alloc(req.id, plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = req.prompt
+        logits, k, v = self._step_fn(
+            self.model, self.pool.k, self.pool.v,
+            self.pool.gather_indices([req.id]),
+            jnp.asarray([0], jnp.int32), jnp.asarray(tokens),
+            jnp.asarray([plen], jnp.int32))
+        self.pool.commit(k, v)
+        self.pool.table(req.id).length = plen
+        _kv.note_pages_written(self.pool.pages_needed(plen))
+        tok = int(self._sample_fn(
+            logits, jnp.asarray([req.id], jnp.int32),
+            jnp.asarray([plen], jnp.int32))[0])
+        req.tokens[0] = tok
 
     def _ensure_pages(self, req_id: int, n_tokens: int) -> None:
         """Grow a sequence's allocation, evicting trie-only cached
@@ -852,6 +1094,8 @@ class ServingEngine:
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,
                 "num_slots": self.batcher.num_slots,
+                "role": self.role,
+                "migrations": dict(self._migrations),
                 "prefix": (None if self.sharer is None
                            else self.sharer.stats()),
                 "speculative": (None if self.spec is None

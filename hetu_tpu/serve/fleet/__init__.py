@@ -21,16 +21,30 @@ placement) applied to inference:
 - :mod:`~hetu_tpu.serve.fleet.router` — :class:`FleetRouter` placing
   requests across N in-process ``ServingEngine`` replicas by
   prefix-cache affinity, shedding by each replica's published
-  shed-pressure gauge, with bounded re-routes on shed/freeze rejections.
+  shed-pressure gauge, with bounded re-routes on shed/freeze rejections;
+- :mod:`~hetu_tpu.serve.fleet.migrate` — self-describing, CRC- and
+  fingerprint-verified KV-page migration records plus the atomic-file
+  fabric (``<dir>/kv/``) for the multi-process form;
+- :mod:`~hetu_tpu.serve.fleet.disagg` — :class:`DisaggRouter` splitting
+  the fleet into prefill and decode worker pools: a finished prefill
+  migrates its KV pages to a decode worker, streams stay bitwise
+  identical to colocated same-seed runs, and a long-prompt burst never
+  stalls an in-flight decode stream again.
 
 Everything stays deterministic under a fixed seed: placements, streams,
 and journal replay bitwise — the fleet inherits the single-replica
 guarantee.
 """
 
+from hetu_tpu.serve.fleet.disagg import DisaggRouter, MigrationTicket
+from hetu_tpu.serve.fleet.migrate import (MigrationFileFabric,
+                                          MigrationIntegrityError,
+                                          MigrationRecord)
 from hetu_tpu.serve.fleet.prefix import PrefixSharer, PrefixTrie
 from hetu_tpu.serve.fleet.router import FleetRouter
 from hetu_tpu.serve.fleet.spec import SpeculativeDecoder
 
 __all__ = ["PrefixTrie", "PrefixSharer", "SpeculativeDecoder",
-           "FleetRouter"]
+           "FleetRouter", "DisaggRouter", "MigrationTicket",
+           "MigrationRecord", "MigrationIntegrityError",
+           "MigrationFileFabric"]

@@ -1,0 +1,182 @@
+"""Disaggregated prefill/decode serving over the page fabric.
+
+Prefill is compute-bound and decode is memory-bound, yet a colocated
+replica timeslices both on the same chip — so a burst of long prompts
+stalls every in-flight decode stream behind it (ROADMAP item 2's named
+failure mode).  This module splits the PR 13 fleet into ROLE pools:
+
+- **prefill workers** (``ServingEngine(role="prefill")``) admit, prefill
+  at high slot turnover (a slot is held for ONE prefill, then recycled),
+  sample the first token, and migrate the request's KV pages out;
+- **decode workers** (``role="decode"``) ingest verified migration
+  records (serve/fleet/migrate.py) and decode continuously — no prefill
+  ever preempts their token loop;
+- **colocated** engines do both (the baseline, and the degraded mode a
+  one-chip deployment falls back to).
+
+:class:`DisaggRouter` fronts both pools with the PR 13 placement
+discipline applied per side: submissions rank the PREFILL pool by
+prefix-trie affinity (then shed pressure, load, index — exactly
+``FleetRouter``'s ordering), migrations rank the DECODE pool by shed
+pressure then load, and both sides re-route around load-shedding
+rejections with the same bounded retry budget.  The router assigns
+GLOBAL request ids in submission order, so a request's token stream —
+a pure function of ``(seed, request id, prompt)`` — is bitwise
+identical whether it was served colocated or migrated across workers:
+the stronger-than-vLLM guarantee PR 13's speculative decoding proved,
+now across a worker boundary.
+
+Migration is a first-class, journaled artifact: every successful
+handoff emits ``kv_migrate`` (+ ``hetu_migrate_{pages,bytes}_total``),
+every refused record emits ``migrate_verify_failed`` with its named
+diagnosis, and role assignment itself is journaled (``role_assign``) —
+a same-seed replay reproduces the whole migration journal bitwise.
+Transport is the in-process hook below for the fleet simulation and
+:class:`~hetu_tpu.serve.fleet.migrate.MigrationFileFabric` (atomic
+files under ``<dir>/kv/``) for the multi-process form.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.serve.fleet.migrate import migrate_metrics
+from hetu_tpu.serve.fleet.router import FleetRouter
+
+__all__ = ["DisaggRouter", "MigrationTicket"]
+
+
+class MigrationTicket:
+    """The settle side of one in-process migration: the record plus the
+    obligation to release the SOURCE pool's export hold exactly once —
+    at import, at re-prefill fallback, or at queue expiry, whichever
+    resolves the migrated request's intake."""
+
+    def __init__(self, record, src_engine):
+        self.record = record
+        self._src = src_engine
+        self._settled = False
+
+    def settle(self) -> None:
+        """Release the source's export hold (idempotent; the caller runs
+        this OUTSIDE its own engine lock — see ``ServingEngine.step``)."""
+        if self._settled:
+            return
+        self._settled = True
+        src = self._src
+        with src._lock:
+            src.pool.ack_export(self.record.seq_id)
+
+
+class DisaggRouter(FleetRouter):
+    """Role-aware front end over prefill / decode / colocated engines."""
+
+    def __init__(self, engines, *, max_retries=None):
+        super().__init__(engines, max_retries=max_retries)
+        self._prefill_idx = [i for i, e in enumerate(self.engines)
+                             if e.role in ("prefill", "colocated")]
+        self._decode_idx = [i for i, e in enumerate(self.engines)
+                            if e.role in ("decode", "colocated")]
+        if not self._prefill_idx:
+            raise ValueError("no prefill-capable engine (role 'prefill' "
+                             "or 'colocated') in the fleet")
+        if not self._decode_idx:
+            raise ValueError("no decode-capable engine (role 'decode' "
+                             "or 'colocated') in the fleet")
+        self.migrations: list = []   # the deterministic migration log
+        self._next_rid = 0
+        # global-id draws must be atomic: the HTTP front end submits
+        # from concurrent handler threads, and two requests sharing one
+        # id would share their sampling keys
+        self._rid_lock = threading.Lock()
+        for i, e in enumerate(self.engines):
+            _journal.record("role_assign", replica=i, role=e.role)
+            if e.role == "prefill":
+                e.migrate_out = self._migrate_out
+
+    # -- placement ----------------------------------------------------------
+
+    def _rank(self, prompt) -> list:
+        """Prefill-side ranking: the FleetRouter ordering (-affinity,
+        shed pressure, load factor, index) restricted to the
+        prefill-capable pool."""
+        return sorted(
+            (-(self.engines[i].sharer.match_tokens(prompt)
+               if self.engines[i].sharer is not None else 0),
+             self.engines[i].slo.shed_pressure(),
+             self.engines[i].batcher.load_factor(), i)
+            for i in self._prefill_idx)
+
+    def _rank_decode(self) -> list:
+        """Decode-side ranking: shed pressure, then load factor, then
+        index — migrations have no prompt affinity (their KV travels
+        with them), so who is drowning is the whole signal."""
+        return sorted(
+            (self.engines[i].slo.shed_pressure(),
+             self.engines[i].batcher.load_factor(), i)
+            for i in self._decode_idx)
+
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               deadline_s=None):
+        """Place one request on the prefill side (``_rank`` restricts
+        the base placement loop to the prefill-capable pool).  The
+        router assigns a GLOBAL request id in submission order (re-route
+        retries reuse it), so streams are bitwise comparable to a
+        colocated same-seed run of the same trace."""
+        with self._rid_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        return super().submit(prompt, max_new_tokens,
+                              deadline_s=deadline_s, request_id=rid)
+
+    # -- the migration hook -------------------------------------------------
+
+    def _migrate_out(self, src, req, record) -> bool:
+        """Installed as every prefill engine's ``migrate_out``: place the
+        exported record on the best decode worker, re-routing around
+        shed rejections with the submission-side retry budget.  Returns
+        False when every candidate shed — the source cancels the export
+        and decodes the request itself (degraded, never dropped)."""
+        src_idx = self.engines.index(src)
+        handle = src._handles[req.id]
+        timeline = src._timelines[req.id]
+        ticket = MigrationTicket(record, src)
+        order = self._rank_decode()
+        tries = min(len(order), self.max_retries + 1)
+        for _pressure, _load, j in order[:tries]:
+            shed = self.engines[j].accept_migration(
+                req, record, ticket, handle, timeline)
+            if shed is not None:
+                continue
+            mm = migrate_metrics()
+            mm["pages"].inc(record.num_pages)
+            mm["bytes"].inc(record.nbytes)
+            _journal.record("kv_migrate", request_id=req.id,
+                            pages=record.num_pages, bytes=record.nbytes,
+                            src=src_idx, dst=j)
+            self.migrations.append({"request_id": req.id, "src": src_idx,
+                                    "dst": j, "pages": record.num_pages})
+            return True
+        return False
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """``/fleet/serve`` with role columns + migration tallies on top
+        of the FleetRouter payload."""
+        out = super().stats()
+        for row, e in zip(out["replicas"], self.engines):
+            row["role"] = e.role
+            row["migrations"] = dict(e._migrations)
+            pool = e.pool.stats()
+            row["pages_export_held"] = pool["pages_export_held"]
+        out["roles"] = {r: sum(1 for e in self.engines if e.role == r)
+                        for r in ("prefill", "decode", "colocated")}
+        out["migrations"] = {
+            "count": len(self.migrations),
+            "pages": sum(m["pages"] for m in self.migrations),
+            "reprefills": sum(e._migrations["reprefill"]
+                              for e in self.engines),
+        }
+        return out

@@ -1,0 +1,334 @@
+"""KV-page migration records: verifiable transport for disaggregation.
+
+Disaggregated serving (serve/fleet/disagg.py) moves a finished prefill's
+KV pages from the prefill worker's pool to a decode worker's pool.  The
+unit of transport is the :class:`MigrationRecord` — a SELF-DESCRIBING
+snapshot of one sequence's pages that carries everything the importer
+needs to re-verify it before a single byte is admitted:
+
+- the page payloads (K and V, per page, in page-table order) and the
+  geometry they were cut from (page size, layers, heads, head dim,
+  dtype) — an importer with a different pool shape refuses with a
+  ``geometry`` diagnosis instead of silently reinterpreting bytes;
+- the sequence's token ``length`` (the decode cursor: migration must
+  preserve ``cache_index`` exactly for the bitwise-stream guarantee);
+- a **per-page CRC32** over each page's K||V bytes, so a single torn or
+  bit-rotted page is named by index;
+- the **PR 10 deterministic fingerprint**
+  (:func:`~hetu_tpu.obs.numerics.host_fingerprint`) folded over the full
+  payload *and* the record's metadata — a tampered ``length`` or a
+  CRC-colliding payload rewrite fails this cross-check even when every
+  per-page CRC still matches.
+
+:func:`verify_record` runs the checks in diagnosis order (``torn`` →
+``page_crc`` → ``fingerprint``; :meth:`KVCachePool.import_pages` adds
+``geometry``) and raises the NAMED :class:`MigrationIntegrityError` —
+the decode engine journals the reason and falls back to re-prefill, so a
+corrupt record can never become corrupt served KV.
+
+Transport has two forms, matching the gang fabric's conventions:
+
+- **in-process handoff** — the fleet simulation passes the record object
+  directly (the router's ``migrate_out`` hook);
+- **atomic files** — :class:`MigrationFileFabric` writes
+  ``<dir>/kv/seq_NNNNNN.kvmig`` via the checkpoint layer's
+  tmp+fsync+replace (``exec/checkpoint._atomic_write_bytes``), so a
+  reader never observes a torn file from a crashed writer; acks are
+  marker files the exporting process polls to settle its export holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+
+import numpy as np
+
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs.numerics import (host_combine, host_fingerprint,
+                                   host_fingerprint_ints)
+
+__all__ = ["MigrationRecord", "MigrationIntegrityError", "build_record",
+           "verify_record", "MigrationFileFabric"]
+
+FORMAT = "hetu-kv-migration-v1"
+
+_migrate_metrics = None
+
+
+def migrate_metrics() -> dict:
+    global _migrate_metrics
+    if _migrate_metrics is None:
+        reg = _obs.get_registry()
+        _migrate_metrics = {
+            "pages": reg.counter(
+                "hetu_migrate_pages_total",
+                "KV pages migrated from a prefill worker to a decode "
+                "worker (counted at successful handoff)"),
+            "bytes": reg.counter(
+                "hetu_migrate_bytes_total",
+                "KV payload bytes migrated prefill -> decode"),
+            "failures": reg.counter(
+                "hetu_migrate_failures_total",
+                "migration records refused at import verification, by "
+                "diagnosis (torn: payload shorter than the header "
+                "declares; page_crc: a page's K||V bytes fail their "
+                "CRC32; fingerprint: the whole-record content "
+                "fingerprint disagrees — metadata tamper or a CRC-"
+                "colliding rewrite; geometry: the importing pool's "
+                "shape/dtype differs from the exporter's)",
+                ("reason",)),
+        }
+    return _migrate_metrics
+
+
+class MigrationIntegrityError(RuntimeError):
+    """A migration record failed verification.  ``reason`` is the named
+    diagnosis (``torn`` | ``page_crc`` | ``fingerprint`` | ``geometry``)
+    the decode engine journals before falling back to re-prefill."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"migration record rejected ({reason}): {detail}")
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class MigrationRecord:
+    """One sequence's KV pages, self-describing and verifiable."""
+
+    seq_id: int
+    length: int            # valid tokens written (the decode cursor)
+    page_size: int
+    dtype: str             # numpy/ml_dtypes name, e.g. "float32"
+    k_pages: np.ndarray    # (num_layers, num_pages, page_size, H, D)
+    v_pages: np.ndarray
+    page_crcs: list        # crc32 over page i's K||V bytes
+    fingerprint: int       # host_combine over payload + metadata words
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.k_pages.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+
+    # -- file form ----------------------------------------------------------
+
+    def header_bytes(self) -> bytes:
+        """One header line of JSON: geometry, lengths, CRCs, fingerprint,
+        declared payload size — everything needed to re-verify."""
+        header = {
+            "format": FORMAT,
+            "seq_id": self.seq_id, "length": self.length,
+            "page_size": self.page_size, "dtype": self.dtype,
+            "k_shape": list(self.k_pages.shape),
+            "v_shape": list(self.v_pages.shape),
+            "page_crcs": [int(c) for c in self.page_crcs],
+            "fingerprint": int(self.fingerprint),
+            "payload_bytes": self.nbytes,
+        }
+        return json.dumps(header).encode() + b"\n"
+
+    def to_bytes(self) -> bytes:
+        """The header line followed by the raw K then V page bytes (the
+        in-memory form; the file fabric writes the same three pieces as
+        separate chunks to skip this concatenation copy)."""
+        return (self.header_bytes()
+                + self.k_pages.tobytes() + self.v_pages.tobytes())
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "MigrationRecord":
+        """Parse the file form; a truncated header or a payload shorter
+        than the header declares is diagnosed ``torn``."""
+        nl = data.find(b"\n")
+        if nl < 0:
+            raise MigrationIntegrityError(
+                "torn", "no header line (truncated before the newline)")
+        try:
+            h = json.loads(data[:nl])
+        except ValueError as e:
+            raise MigrationIntegrityError("torn", f"unparseable header: {e}")
+        if h.get("format") != FORMAT:
+            raise MigrationIntegrityError(
+                "torn", f"unknown format {h.get('format')!r} "
+                        f"(expected {FORMAT})")
+        payload = data[nl + 1:]
+        # a bit-rotted header can still be valid JSON: every field it
+        # feeds into parsing arithmetic below must diagnose as "torn",
+        # never escape as a bare ValueError/AttributeError — the
+        # importer's contract is named diagnosis + re-prefill fallback
+        try:
+            if len(payload) != h["payload_bytes"]:
+                raise MigrationIntegrityError(
+                    "torn", f"payload is {len(payload)} bytes, header "
+                            f"declares {h['payload_bytes']}")
+            dt = _resolve_dtype(h["dtype"])
+            k_shape, v_shape = tuple(h["k_shape"]), tuple(h["v_shape"])
+            k_bytes = int(np.prod(k_shape)) * dt.itemsize
+            k = np.frombuffer(payload[:k_bytes], dt).reshape(k_shape)
+            v = np.frombuffer(payload[k_bytes:], dt).reshape(v_shape)
+            return MigrationRecord(
+                seq_id=int(h["seq_id"]), length=int(h["length"]),
+                page_size=int(h["page_size"]), dtype=h["dtype"],
+                k_pages=k, v_pages=v, page_crcs=list(h["page_crcs"]),
+                fingerprint=int(h["fingerprint"]))
+        except MigrationIntegrityError:
+            raise
+        except (KeyError, ValueError, TypeError, AttributeError,
+                OverflowError) as e:
+            raise MigrationIntegrityError(
+                "torn", f"corrupt header: {type(e).__name__}: {e}")
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Numpy dtype by name, falling back to ml_dtypes for the TPU types
+    numpy does not know natively (bfloat16 et al.)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _page_crc(k_pages: np.ndarray, v_pages: np.ndarray, i: int) -> int:
+    return zlib.crc32(np.ascontiguousarray(k_pages[:, i]).tobytes()
+                      + np.ascontiguousarray(v_pages[:, i]).tobytes())
+
+
+def _content_fingerprint(seq_id: int, length: int, page_size: int,
+                         k_pages: np.ndarray, v_pages: np.ndarray) -> int:
+    """The record-level cross-check: payload fingerprints folded with the
+    metadata words, so tampering with ``length`` (the decode cursor the
+    bitwise guarantee hangs on) is as detectable as flipping a payload
+    bit."""
+    return host_combine([
+        host_fingerprint(k_pages), host_fingerprint(v_pages),
+        host_fingerprint_ints(
+            [seq_id, length, page_size, k_pages.shape[1]]),
+    ])
+
+
+def build_record(*, seq_id: int, length: int, page_size: int,
+                 k_pages: np.ndarray, v_pages: np.ndarray
+                 ) -> MigrationRecord:
+    """Assemble a verified-by-construction record from page payload
+    snapshots (``KVCachePool.export_pages`` is the caller)."""
+    k_pages = np.asarray(k_pages)
+    v_pages = np.asarray(v_pages)
+    crcs = [_page_crc(k_pages, v_pages, i)
+            for i in range(k_pages.shape[1])]
+    return MigrationRecord(
+        seq_id=int(seq_id), length=int(length), page_size=int(page_size),
+        dtype=str(k_pages.dtype), k_pages=k_pages, v_pages=v_pages,
+        page_crcs=crcs,
+        fingerprint=_content_fingerprint(seq_id, length, page_size,
+                                         k_pages, v_pages))
+
+
+def verify_record(record: MigrationRecord) -> None:
+    """Re-verify before admitting: structural completeness (``torn``),
+    each page's CRC32 (``page_crc``, naming the page), then the whole-
+    record content fingerprint (``fingerprint``).  Raises the named
+    :class:`MigrationIntegrityError`; returning means every byte and
+    every metadata word matches what the exporter recorded."""
+    k, v = np.asarray(record.k_pages), np.asarray(record.v_pages)
+    if k.ndim != 5 or v.shape != k.shape:
+        raise MigrationIntegrityError(
+            "torn", f"payload shapes {k.shape} / {v.shape} are not a "
+                    f"matched (L, pages, page, H, D) pair")
+    if record.page_size < 1 or record.length < 0:
+        raise MigrationIntegrityError(
+            "torn", f"nonsensical geometry: page_size "
+                    f"{record.page_size}, length {record.length}")
+    n = k.shape[1]
+    if len(record.page_crcs) != n:
+        raise MigrationIntegrityError(
+            "torn", f"{len(record.page_crcs)} page CRCs for {n} pages")
+    if k.shape[2] != record.page_size:
+        raise MigrationIntegrityError(
+            "torn", f"payload page dimension {k.shape[2]} != declared "
+                    f"page_size {record.page_size}")
+    need = -(-max(record.length, 1) // record.page_size)
+    if n < need:
+        raise MigrationIntegrityError(
+            "torn", f"{n} pages cannot hold the declared length "
+                    f"{record.length}")
+    for i in range(n):
+        crc = _page_crc(k, v, i)
+        if crc != (int(record.page_crcs[i]) & 0xFFFFFFFF):
+            raise MigrationIntegrityError(
+                "page_crc", f"page {i}: payload CRC32 {crc:#010x} != "
+                            f"recorded {int(record.page_crcs[i]):#010x}")
+    fp = _content_fingerprint(record.seq_id, record.length,
+                              record.page_size, k, v)
+    if fp != int(record.fingerprint):
+        raise MigrationIntegrityError(
+            "fingerprint", f"content fingerprint {fp:#010x} != recorded "
+                           f"{int(record.fingerprint):#010x} (metadata "
+                           f"tamper or CRC-colliding payload rewrite)")
+
+
+class MigrationFileFabric:
+    """The multi-process transport: records as atomic files under
+    ``<dir>/kv/``, acks as marker files.
+
+    The exporter calls :meth:`export` (tmp+fsync+replace through the
+    checkpoint writer — a reader never sees a torn file from a crashed
+    writer; torn can only mean on-disk corruption after the fact, which
+    verification catches).  The importer polls :meth:`pending`, reads
+    with :meth:`read` and acks with :meth:`ack`; the exporter polls
+    :meth:`acked` to settle its pools' export holds
+    (``KVCachePool.ack_export``) and :meth:`clear` to retire the pair of
+    files."""
+
+    def __init__(self, root: str):
+        self.dir = os.path.join(root, "kv")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, seq_id: int) -> str:
+        return os.path.join(self.dir, f"seq_{int(seq_id):06d}.kvmig")
+
+    def _ack_path(self, seq_id: int) -> str:
+        return self._path(seq_id) + ".ack"
+
+    def export(self, record: MigrationRecord) -> str:
+        from hetu_tpu.exec.checkpoint import _atomic_write_bytes
+        path = self._path(record.seq_id)
+        # three chunks written back to back: no concatenation copy of
+        # the KV payload (the checkpoint writer's own discipline)
+        _atomic_write_bytes(path, record.header_bytes(),
+                            record.k_pages.tobytes(),
+                            record.v_pages.tobytes())
+        return path
+
+    def pending(self) -> list:
+        """Unacked sequence ids with a record file, ascending."""
+        out = []
+        for name in os.listdir(self.dir):
+            if name.endswith(".kvmig"):
+                sid = int(name[len("seq_"):-len(".kvmig")])
+                if not os.path.exists(self._ack_path(sid)):
+                    out.append(sid)
+        return sorted(out)
+
+    def read(self, seq_id: int) -> MigrationRecord:
+        with open(self._path(seq_id), "rb") as f:
+            return MigrationRecord.from_bytes(f.read())
+
+    def ack(self, seq_id: int) -> None:
+        from hetu_tpu.exec.checkpoint import _atomic_write_bytes
+        _atomic_write_bytes(self._ack_path(seq_id), b"ok\n")
+
+    def acked(self) -> list:
+        return sorted(int(n[len("seq_"):-len(".kvmig.ack")])
+                      for n in os.listdir(self.dir)
+                      if n.endswith(".kvmig.ack"))
+
+    def clear(self, seq_id: int) -> None:
+        """Retire a settled migration's record + ack files."""
+        for p in (self._path(seq_id), self._ack_path(seq_id)):
+            if os.path.exists(p):
+                os.remove(p)

@@ -89,17 +89,21 @@ class FleetRouter:
             for i, e in enumerate(self.engines))
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
-               deadline_s: float | None = None):
+               deadline_s: float | None = None,
+               request_id: int | None = None):
         """Place one request; returns the chosen replica's handle.  On a
         load-shedding rejection the request re-routes to the next-ranked
         replica (bounded by ``max_retries``); the last handle is returned
-        when every candidate shed."""
+        when every candidate shed.  ``request_id`` pins the engine-side
+        id across every retry (the DisaggRouter's global-id seam); None
+        lets the chosen engine draw its own."""
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
         ranked = self._rank(prompt)
         tries = min(len(ranked), self.max_retries + 1)
         for a, (neg_aff, _pressure, _load, idx) in enumerate(ranked[:tries]):
             handle = self.engines[idx].submit(prompt, max_new_tokens,
-                                              deadline_s=deadline_s)
+                                              deadline_s=deadline_s,
+                                              request_id=request_id)
             if handle.status == "rejected" and handle.shed_reason is None:
                 # a validation rejection is identical on every replica
                 return handle

@@ -47,6 +47,19 @@ trie-cached page as pinned-by-refcount (moving a page another table or
 the trie also points at would corrupt them all).  :meth:`stats` is the
 supported introspection surface — pages by class, the refcount
 histogram, and an alloc/free balance invariant asserted on every call.
+
+**KV-page migration** (the disaggregated tier, serve/fleet/disagg.py):
+:meth:`~KVCachePool.export_pages` snapshots one sequence's pages into a
+self-describing, CRC- and fingerprint-verified record
+(serve/fleet/migrate.py) and places an EXPORT HOLD (one extra refcount
+per page) so that ``free()`` of the exporting sequence cannot recycle
+the pages until :meth:`~KVCachePool.ack_export` /
+:meth:`~KVCachePool.cancel_export` settles the handoff;
+:meth:`~KVCachePool.import_pages` re-verifies the record (torn / CRC /
+fingerprint / geometry, each a named diagnosis) before a single byte is
+admitted into the destination pool.  ``stats()`` carries the
+``exported_pages`` / ``imported_pages`` / ``pages_export_held``
+counters and asserts the hold-backed-by-refcount invariant.
 """
 
 from __future__ import annotations
@@ -55,6 +68,7 @@ import bisect
 import dataclasses
 
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["KVCachePool", "PageTable", "OutOfPages", "DoubleFree",
            "SCRATCH_PAGE", "gather_view_count", "reset_gather_view_count",
@@ -164,13 +178,22 @@ class KVCachePool:
         # ascending free list => lowest-index-first placement, deterministic
         self._free: list = list(range(1, num_pages))
         self._tables: dict = {}
-        # page -> reference count (tables aliasing it + trie retains);
-        # absent == on the free list.  A page leaves the free list with
-        # rc 1 and returns only when its LAST reference drops.
+        # page -> reference count (tables aliasing it + trie retains +
+        # export holds); absent == on the free list.  A page leaves the
+        # free list with rc 1 and returns only when its LAST reference
+        # drops.
         self._refcount: dict = {}
         # alloc/free balance for the stats() invariant
         self._allocs = 0
         self._frees = 0
+        # outstanding KV-page exports (disaggregated serving): seq_id ->
+        # the pages snapshotted into a MigrationRecord, each holding one
+        # extra reference until the import acks or the export is
+        # cancelled — free() of an exporting sequence must never recycle
+        # a page an in-flight migration may still need
+        self._exports: dict = {}
+        self._exported_pages = 0   # cumulative pages exported
+        self._imported_pages = 0   # cumulative pages imported
 
     # -- allocator ----------------------------------------------------------
 
@@ -292,6 +315,94 @@ class KVCachePool:
         self.release(old)
         return True
 
+    # -- KV-page migration (disaggregated serving) --------------------------
+
+    def export_pages(self, seq_id: int):
+        """Snapshot ``seq_id``'s pages into a self-describing, verifiable
+        :class:`~hetu_tpu.serve.fleet.migrate.MigrationRecord` (payload +
+        page order + length + per-page CRC32 + the PR 10 content
+        fingerprint) and place an EXPORT HOLD on every page: a
+        subsequent ``free()`` of the sequence keeps the pages off the
+        free list until :meth:`ack_export` (the import landed) or
+        :meth:`cancel_export` (the handoff was abandoned) settles the
+        hold — closing the export/free race that would otherwise hand an
+        in-flight migration's physical pages to a new sequence."""
+        from hetu_tpu.serve.fleet.migrate import build_record
+        pt = self._tables[seq_id]
+        if seq_id in self._exports:
+            raise ValueError(f"sequence {seq_id} already has an "
+                             f"outstanding export")
+        pages = list(pt.pages)
+        idx = jnp.asarray(pages, jnp.int32)
+        k = np.asarray(self.k[:, idx])   # (L, n_pages, page, H, D) copies
+        v = np.asarray(self.v[:, idx])
+        for p in pages:
+            self._refcount[p] += 1       # the export hold
+        self._exports[seq_id] = pages
+        self._exported_pages += len(pages)
+        return build_record(seq_id=seq_id, length=pt.length,
+                            page_size=self.page_size, k_pages=k, v_pages=v)
+
+    def _settle_export(self, seq_id: int) -> None:
+        pages = self._exports.pop(seq_id, None)
+        if pages is None:
+            raise DoubleFree(f"export of sequence {seq_id} already "
+                             f"settled (or never exported)")
+        for p in pages:
+            self.release(p)
+
+    def ack_export(self, seq_id: int) -> None:
+        """The importer admitted (or terminally resolved) the migrated
+        sequence: drop the export hold; pages whose last reference this
+        was return to the free list.  A second settle of the same export
+        raises :exc:`DoubleFree` — the same named-at-the-bug contract as
+        a double ``free``."""
+        self._settle_export(seq_id)
+
+    def cancel_export(self, seq_id: int) -> None:
+        """The handoff was abandoned (every decode worker shed, or the
+        exporter is shutting down): identical mechanics to
+        :meth:`ack_export`, kept as its own name so call sites read as
+        what happened."""
+        self._settle_export(seq_id)
+
+    def import_pages(self, record, *, seq_id=None) -> PageTable:
+        """Verify and admit a migrated sequence: re-check the record
+        (``verify_record`` — torn payloads, per-page CRCs, the content
+        fingerprint) and the pool geometry BEFORE allocating, then write
+        the page payloads into freshly allocated private pages and set
+        the table's ``length`` to the record's decode cursor.  Raises the
+        named :exc:`~hetu_tpu.serve.fleet.migrate.MigrationIntegrityError`
+        without side effects when anything disagrees — corrupt KV is
+        never admitted."""
+        from hetu_tpu.serve.fleet.migrate import (MigrationIntegrityError,
+                                                  verify_record)
+        verify_record(record)
+        L, n, page, H, D = record.k_pages.shape
+        mine = (self.num_layers, self.page_size, self.num_heads,
+                self.head_dim)
+        theirs = (L, page, H, D)
+        if mine != theirs:
+            raise MigrationIntegrityError(
+                "geometry", f"record pages are (layers, page, heads, "
+                            f"head_dim)={theirs}, this pool is {mine}")
+        if str(record.dtype) != str(self.k.dtype):
+            raise MigrationIntegrityError(
+                "geometry", f"record dtype {record.dtype} != pool dtype "
+                            f"{self.k.dtype}")
+        if n * self.page_size > self.max_seq_len:
+            raise MigrationIntegrityError(
+                "geometry", f"{n} pages exceed this pool's max_seq_len "
+                            f"{self.max_seq_len}")
+        sid = record.seq_id if seq_id is None else seq_id
+        pt = self.alloc(sid, n * self.page_size)
+        idx = jnp.asarray(pt.pages, jnp.int32)
+        self.k = self.k.at[:, idx].set(jnp.asarray(record.k_pages))
+        self.v = self.v.at[:, idx].set(jnp.asarray(record.v_pages))
+        pt.length = record.length
+        self._imported_pages += n
+        return pt
+
     def table(self, seq_id: int) -> PageTable:
         return self._tables[seq_id]
 
@@ -325,6 +436,14 @@ class KVCachePool:
             "allocs": self._allocs,
             "frees": self._frees,
             "page_size": self.page_size,
+            # KV-page migration accounting (disaggregated serving):
+            # cumulative export/import totals plus the pages currently
+            # pinned by an unsettled export hold
+            "exported_pages": self._exported_pages,
+            "imported_pages": self._imported_pages,
+            "pages_export_held": sum(len(p)
+                                     for p in self._exports.values()),
+            "exports_outstanding": len(self._exports),
         }
 
     def _check_invariants(self) -> None:
@@ -339,15 +458,20 @@ class KVCachePool:
         assert len(free) + len(self._refcount) == self.num_pages - 1, \
             (f"page accounting leak: {len(free)} free + "
              f"{len(self._refcount)} allocated != {self.num_pages - 1}")
-        # every table reference must be backed by at least that many refs
+        # every table reference AND export hold must be backed by at
+        # least that many refs — the export/free-race invariant: a page
+        # under an unsettled export hold can never be on the free list
         held: dict = {}
         for pt in self._tables.values():
             for p in pt.pages:
                 held[p] = held.get(p, 0) + 1
+        for pages in self._exports.values():
+            for p in pages:
+                held[p] = held.get(p, 0) + 1
         for p, n in held.items():
             assert self._refcount.get(p, 0) >= n, \
-                (f"page {p} referenced by {n} table entries but refcount "
-                 f"is {self._refcount.get(p, 0)}")
+                (f"page {p} referenced by {n} table entries / export "
+                 f"holds but refcount is {self._refcount.get(p, 0)}")
         assert self._allocs - self._frees == len(self._tables), \
             (f"alloc/free imbalance: {self._allocs} allocs - "
              f"{self._frees} frees != {len(self._tables)} live sequences")
@@ -360,10 +484,11 @@ class KVCachePool:
         are stale.
 
         Pages are PINNED-BY-REFCOUNT: a page aliased by several tables
-        (refcount > 1) or held only by the prefix trie (allocated but in
-        no table) stays at its physical index — moving it would require
-        rewriting every alias atomically, and the trie's references are
-        not table entries this compactor can see.  Only single-reference,
+        (refcount > 1) or held only by the prefix trie or an unsettled
+        export hold (allocated but in no table) stays at its physical
+        index — moving it would require rewriting every alias
+        atomically, and the trie's/export's references are not table
+        entries this compactor can see.  Only single-reference,
         single-table pages move; the compaction target slots skip the
         pinned indices."""
         held_by_table = set()

@@ -15,7 +15,8 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["LoadItem", "generate_load", "generate_shared_prefix_load"]
+__all__ = ["LoadItem", "generate_load", "generate_shared_prefix_load",
+           "generate_prefill_burst_load"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,6 +31,10 @@ class LoadItem:
     # with (None = unique-prompt traffic) — lets tests assert affinity
     # placement without re-deriving the prefix from tokens
     template: int | None = None
+    # prefill-burst traces: True on the bursty long-prompt arrivals —
+    # lets the disaggregation A/B attribute tail latency to the burst
+    # without re-deriving it from prompt lengths
+    burst: bool = False
 
 
 def generate_load(seed: int, n_requests: int, *, vocab: int,
@@ -91,4 +96,45 @@ def generate_shared_prefix_load(seed: int, n_requests: int, *, vocab: int,
             submit_at=t, prompt=prompt,
             max_new_tokens=int(rng.integers(max_new[0], max_new[1] + 1)),
             deadline_s=deadline_s, template=tid))
+    return out
+
+
+def generate_prefill_burst_load(seed: int, n_requests: int, *, vocab: int,
+                                short_len=(2, 8), short_new=(8, 16),
+                                long_len=(40, 60), long_new=(1, 4),
+                                burst_every: int = 8, burst_size: int = 4,
+                                mean_gap_s: float = 0.002,
+                                burst_gap_s: float | None = None,
+                                deadline_s: float | None = None) -> list:
+    """The workload where colocation loses: steady SHORT-prompt traffic
+    with real decode budgets (the memory-bound stream a production fleet
+    must keep flowing), punctuated by clumped BURSTS of long prompts
+    with tiny decode budgets (the compute-bound prefill wall that stalls
+    a timeslicing chip).  Every ``burst_every`` steady arrivals, a burst
+    of ``burst_size`` long items lands nearly at once (``burst_gap_s``,
+    default ``mean_gap_s / 50``).  ``burst`` on each item marks the
+    bursty arrivals, so the disaggregation A/B can attribute the TTFT
+    tail from the trace spec alone.  Same seed, same trace — bit for bit
+    (unit-tested)."""
+    if burst_every < 1:
+        raise ValueError(f"burst_every must be >= 1, got {burst_every}")
+    if burst_gap_s is None:
+        burst_gap_s = mean_gap_s / 50.0
+    rng = np.random.default_rng(seed)
+    period = burst_every + max(burst_size, 0)
+    out, t = [], 0.0
+    for i in range(n_requests):
+        in_burst = burst_size > 0 and (i % period) >= burst_every
+        if in_burst:
+            t += float(rng.exponential(burst_gap_s))
+            plen = int(rng.integers(long_len[0], long_len[1] + 1))
+            mnt = int(rng.integers(long_new[0], long_new[1] + 1))
+        else:
+            t += float(rng.exponential(mean_gap_s))
+            plen = int(rng.integers(short_len[0], short_len[1] + 1))
+            mnt = int(rng.integers(short_new[0], short_new[1] + 1))
+        out.append(LoadItem(
+            submit_at=t,
+            prompt=tuple(int(x) for x in rng.integers(0, vocab, plen)),
+            max_new_tokens=mnt, deadline_s=deadline_s, burst=in_burst))
     return out

@@ -147,7 +147,11 @@ def fleet_serving_routes(router) -> Routes:
     request/response contract as the single-engine handler — callers
     cannot tell one replica from N, which is the point), and ``GET
     /fleet/serve`` reports the router's aggregated stats (per-replica
-    occupancy/pressure/cache state, placement tally by reason)."""
+    occupancy/pressure/cache state, placement tally by reason).  A
+    :class:`~hetu_tpu.serve.fleet.DisaggRouter` adds role columns
+    (``role`` + per-replica ``migrations``/``pages_export_held``) and
+    the fleet-wide migration tally to the same payload — the
+    disaggregated tier serves through this front end unchanged."""
     routes = telemetry_routes()
 
     def infer(query, body):
@@ -195,7 +199,8 @@ class FleetServingServer(RoutedHTTPServer):
 def serve_fleet_router(router, port: int = 0,
                        host: str = "127.0.0.1") -> FleetServingServer:
     """Start every replica's scheduler thread and one fleet HTTP front
-    end; returns the started server."""
+    end; returns the started server.  Accepts a ``FleetRouter`` or a
+    role-aware ``DisaggRouter`` — the endpoint contract is identical."""
     router.start()
     srv = FleetServingServer(router, port, host)
     srv.start()

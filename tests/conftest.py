@@ -117,6 +117,15 @@ def pytest_configure(config):
                    "slow tier — the 2-replica in-process router smoke with "
                    "one shared-prefix pair, the CoW/refcount unit tests, "
                    "and the bitwise spec-vs-baseline checks stay in tier-1")
+    config.addinivalue_line(
+        "markers", "disagg: disaggregated prefill/decode serving tests "
+                   "(serve.fleet.disagg role-aware routing, "
+                   "serve.fleet.migrate verifiable KV-page migration "
+                   "records, the export-hold pool machinery, and the "
+                   "prefill-burst A/B); the 1-prefill + 1-decode "
+                   "in-process smoke, record-integrity, and bitwise-vs-"
+                   "colocated checks stay in tier-1 — the multi-process "
+                   "file-fabric chaos rides the slow tier")
 
 
 @pytest.fixture(autouse=True)
