@@ -284,15 +284,13 @@ def bench_resnet(on_tpu, kind, peak):
 # config 2: Wide&Deep CTR with the HET host-embedding cache (hybrid path)
 # ---------------------------------------------------------------------------
 
-def bench_ctr(on_tpu, kind, peak):
-    from hetu_tpu.core import set_random_seed
-    from hetu_tpu.data.datasets import synthetic_ctr
-    from hetu_tpu.exec import Trainer
-    from hetu_tpu.models import CTRConfig, WideDeep
-    from hetu_tpu.optim import AdamOptimizer
+def _ctr_cfg(on_tpu, embedding: str, storage: str = "f32"):
+    """The wdl_ctr workload config for one A/B arm.  ``host`` is the
+    standing baseline (HET host cache); ``tiered`` layers the HBM hot-row
+    budget + touch-gated promotion on the same host cache
+    (embed.TieredEmbedding), optionally over int8 PS storage."""
+    from hetu_tpu.models import CTRConfig
 
-    set_random_seed(0)
-    batch, chunk = (512, 10) if on_tpu else (64, 2)
     vocab = 26000 if on_tpu else 2000
     # cache sized to the working set: a 4096-row cache thrashed on the
     # 26k-vocab batches and cost 3.3x (engine pulls on every miss)
@@ -300,12 +298,42 @@ def bench_ctr(on_tpu, kind, peak):
     # gradient push's device->host round trip hides under the next step
     # instead of serializing every step — 2.9 -> 3.9 steps/s on the
     # tunneled chip (r03 A/B)
-    cfg = CTRConfig(vocab=vocab, embed_dim=16, embedding="host",
-                    cache_capacity=65536 if on_tpu else 2048,
-                    cache_policy="lfuopt", host_optimizer="adagrad",
-                    host_lr=0.05, host_async_push=bool(on_tpu))
+    host_cache = 65536 if on_tpu else 2048
+    if embedding == "tiered":
+        # HBM budget sized to the hot set (zipf head), host tier at the
+        # host arm's width so the PS traffic comparison is apples-to-
+        # apples; async push does not apply (the HBM layer pushes grads
+        # through the host cache synchronously, off the gather path)
+        # pull_bound=2 = HET's bounded staleness on the device tier: a
+        # hot row serves its HBM copy for up to 2 server updates before
+        # re-pulling — the amortization the tier exists for (VLDB'22);
+        # strict-freshness parity is covered by the deterministic tests
+        return CTRConfig(vocab=vocab, embed_dim=16, embedding="tiered",
+                         cache_capacity=8192 if on_tpu else 512,
+                         host_cache_capacity=host_cache,
+                         cache_policy="lfuopt", host_optimizer="adagrad",
+                         host_lr=0.05, storage=storage, pull_bound=2,
+                         promote_touches=2, demote_idle=0)
+    return CTRConfig(vocab=vocab, embed_dim=16, embedding="host",
+                     cache_capacity=host_cache,
+                     cache_policy="lfuopt", host_optimizer="adagrad",
+                     host_lr=0.05, host_async_push=bool(on_tpu),
+                     storage=storage)
+
+
+def _ctr_time(on_tpu, cfg):
+    """Build + time the wdl_ctr workload under ``cfg``; returns
+    ``(timing, trainer, batch_size)``."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.data.datasets import synthetic_ctr
+    from hetu_tpu.exec import Trainer
+    from hetu_tpu.models import WideDeep
+    from hetu_tpu.optim import AdamOptimizer
+
+    set_random_seed(0)
+    batch, chunk = (512, 10) if on_tpu else (64, 2)
     model = WideDeep(cfg)
-    data = synthetic_ctr(n=batch * 8, vocab_per_field=vocab // 26)
+    data = synthetic_ctr(n=batch * 8, vocab_per_field=cfg.vocab // 26)
     trainer = Trainer(
         model, AdamOptimizer(1e-3),
         lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))
@@ -331,6 +359,11 @@ def bench_ctr(on_tpu, kind, peak):
     for m_ in trainer.staged_modules():
         m_.stage(data["sparse"][(state["i"] * batch) % (n - batch):]
                  [:batch])  # retire the final pending prefetch
+    return t, trainer, batch
+
+
+def bench_ctr(on_tpu, kind, peak):
+    t, trainer, batch = _ctr_time(on_tpu, _ctr_cfg(on_tpu, "host"))
     return _line(
         "wdl_ctr_steps_per_sec", 1.0 / t["median_s"], "steps/s", 1.0,
         samples_per_sec=round(batch / t["median_s"], 1),
@@ -340,6 +373,51 @@ def bench_ctr(on_tpu, kind, peak):
                       "the baseline",
         device=kind, batch=batch, embedding="host+lfuopt-cache",
         **_controller_fields(), **_tinfo(t))
+
+
+def bench_ctr_tiered(on_tpu, kind, peak, storage: str = "f32"):
+    """Tiered-vs-host wdl_ctr A/B (``--mode ctr --embedding tiered``):
+    both arms run the SAME seeded workload, vs_baseline = tiered/host
+    steps/s, and the line carries the tiered arm's exact per-tier hit
+    accounting (plus an ``embed`` calibration record when a store is
+    installed), so the win is attributable, not vibes."""
+    t_host, _, batch = _ctr_time(on_tpu, _ctr_cfg(on_tpu, "host"))
+    t_tier, trainer, _ = _ctr_time(
+        on_tpu, _ctr_cfg(on_tpu, "tiered", storage=storage))
+    tier_stats = {}
+    for m_ in trainer.staged_modules():
+        ts = getattr(m_, "tier_stats", None)
+        if ts is not None:
+            tier_stats = ts()
+            break
+    if tier_stats:
+        from hetu_tpu.obs import calibration as _calibration
+        store = _calibration.get_store()
+        if store is not None and os.environ.get(
+                "HETU_TPU_BENCH_CALIB", "1") != "0":
+            store.ingest_embed(tier_stats, model_sig="wdl_ctr",
+                               device_kind=kind)
+    host_sps = 1.0 / t_host["median_s"]
+    tier_sps = 1.0 / t_tier["median_s"]
+    return _line(
+        "wdl_ctr_tiered_steps_per_sec", tier_sps, "steps/s",
+        tier_sps / host_sps if host_sps > 0 else 1.0,
+        samples_per_sec=round(batch / t_tier["median_s"], 1),
+        host_steps_per_sec=round(host_sps, 2),
+        storage=storage,
+        hbm_hit_rate=(round(tier_stats["hbm"]["hit_rate"], 4)
+                      if tier_stats else None),
+        host_hit_rate=(round(tier_stats["host"]["hit_rate"], 4)
+                       if tier_stats else None),
+        pull_bytes_per_stage=(round(tier_stats["pull_bytes_per_stage"], 1)
+                              if tier_stats else None),
+        ps_resident_bytes=(tier_stats["ps"]["resident_bytes"]
+                           if tier_stats else None),
+        baseline_note="vs_baseline = tiered/host steps/s on the same "
+                      "seeded wdl_ctr workload; hit rates are the tiered "
+                      "arm's exact per-tier counters",
+        device=kind, batch=batch, embedding=f"tiered+{storage}",
+        **_controller_fields(), **_tinfo(t_tier))
 
 
 # ---------------------------------------------------------------------------
@@ -1063,8 +1141,45 @@ def main():
             sys.exit("bench: --mode needs a value (train | serve)")
         mode = args[i + 1]
         del args[i:i + 2]
-    if mode not in ("train", "serve"):
-        sys.exit(f"bench: unknown mode {mode!r}; one of 'train', 'serve'")
+    if mode not in ("train", "serve", "ctr"):
+        sys.exit(f"bench: unknown mode {mode!r}; one of 'train', 'serve', "
+                 f"'ctr'")
+    if mode == "ctr":
+        embedding = "host"
+        if "--embedding" in args:
+            i = args.index("--embedding")
+            if i + 1 >= len(args):
+                sys.exit("bench: --embedding needs a value (host | tiered)")
+            embedding = args[i + 1]
+            del args[i:i + 2]
+        if embedding not in ("host", "tiered"):
+            sys.exit(f"bench: unknown embedding {embedding!r}; one of "
+                     f"'host', 'tiered'")
+        storage = "f32"
+        if "--storage" in args:
+            i = args.index("--storage")
+            if i + 1 >= len(args):
+                sys.exit("bench: --storage needs a value (f32 | int8)")
+            storage = args[i + 1]
+            del args[i:i + 2]
+        if storage not in ("f32", "int8"):
+            sys.exit(f"bench: unknown storage {storage!r}; one of 'f32', "
+                     f"'int8'")
+        if args:
+            sys.exit(f"bench: --mode ctr takes no config names, got {args}")
+        # behind the same rc=3 preflight as every mode: a dead tunnel must
+        # never record a bogus A/B round (or calibration baseline)
+        _require_backend_alive()
+        on_tpu, kind, peak = _env()
+        try:
+            if embedding == "tiered":
+                bench_ctr_tiered(on_tpu, kind, peak, storage=storage)
+            else:
+                bench_ctr(on_tpu, kind, peak)
+        except Exception:
+            traceback.print_exc()
+            sys.exit(1)
+        return
     if mode == "serve":
         replicas = None
         if "--replicas" in args:

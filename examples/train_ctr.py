@@ -29,8 +29,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=sorted(MODELS), default="wdl")
     ap.add_argument("--embedding",
-                    choices=["device", "host", "hbm", "remote"],
+                    choices=["device", "host", "hbm", "tiered", "remote"],
                     default="device")
+    ap.add_argument("--storage", choices=["f32", "int8"], default="f32",
+                    help="PS storage form for host-engine embeddings "
+                         "(int8 = per-row-quantized rows, ~4x fewer "
+                         "resident/pull bytes)")
     ap.add_argument("--servers", default=None,
                     help="comma-separated PS addresses for --embedding "
                          "remote; default spawns two local in-process "
@@ -60,11 +64,16 @@ def main():
             local_servers = [EmbeddingServer(), EmbeddingServer()]
             servers = [f"127.0.0.1:{s.port}" for s in local_servers]
             print(f"spawned local embedding servers: {servers}")
+    storage = args.storage if args.embedding != "remote" else "f32"
+    cache = args.cache
+    if args.embedding == "tiered" and not cache:
+        cache = 8192  # the HBM row budget must be positive for tiering
     cfg = CTRConfig(vocab=26000, embed_dim=16, embedding=args.embedding,
-                    cache_capacity=args.cache, cache_policy=args.policy,
+                    cache_capacity=cache,
+                    cache_policy=args.policy,
                     host_optimizer="adagrad", host_lr=0.05, servers=servers,
                     reconnect_attempts=args.reconnect,
-                    restore_path=args.restore_path)
+                    restore_path=args.restore_path, storage=storage)
     model = MODELS[args.model](cfg)
     # real Criteo TSV when datasets/criteo/train.txt exists; synthetic
     # otherwise.  Small real files are tiled so the batch-rotation modulo
@@ -99,6 +108,10 @@ def main():
                       if args.embedding == "host"
                       else model.embed.stats())
                 line += f" cache_hit {st['hit_rate']:.3f}"
+            elif args.embedding == "tiered":
+                st = model.embed.tier_stats()
+                line += (f" hbm_hit {st['hbm']['hit_rate']:.3f}"
+                         f" host_hit {st['host']['hit_rate']:.3f}")
             print(line)
 
 

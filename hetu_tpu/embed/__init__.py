@@ -13,13 +13,17 @@ from hetu_tpu.embed.engine import (
     AsyncEngine,
     CacheTable,
     HostEmbeddingTable,
+    Int8HostEmbeddingTable,
     PartialReduceCoordinator,
     PReduceGroup,
+    PythonCacheTable,
     SSPBarrier,
 )
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup
 from hetu_tpu.embed.layer import (HBMCachedEmbedding, HostEmbedding,
                                   StagedHostEmbedding)
+from hetu_tpu.embed.tier import TieredEmbedding, TierPolicy
+from hetu_tpu.embed.stream import SnapshotFollower, SnapshotWriter
 from hetu_tpu.embed.sharded import ShardedHostEmbedding
 from hetu_tpu.embed.net import (EmbeddingServer, RemoteCacheTable,
                                 RemoteEmbeddingTable, RemoteHostEmbedding)
@@ -27,10 +31,13 @@ from hetu_tpu.embed.ps_dp import PSDataParallel
 from hetu_tpu.embed.graph import RemoteGraph
 
 __all__ = [
-    "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
+    "HostEmbeddingTable", "Int8HostEmbeddingTable", "CacheTable",
+    "PythonCacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "PReduceGroup", "Prefetcher",
     "make_host_lookup",
     "HostEmbedding", "StagedHostEmbedding", "HBMCachedEmbedding",
+    "TieredEmbedding", "TierPolicy",
+    "SnapshotWriter", "SnapshotFollower",
     "ShardedHostEmbedding",
     "EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
     "RemoteGraph",

@@ -23,11 +23,48 @@ from hetu_tpu.init import constant, xavier_normal
 from hetu_tpu.layers.norm import LayerNorm
 
 __all__ = ["QuantizedEmbedding", "ALPTEmbedding", "DPQEmbedding",
-           "MGQEmbedding"]
+           "MGQEmbedding", "quantize_rows", "dequantize_rows"]
 
 
 def _ste(x, q):
     return x + jax.lax.stop_gradient(q - x)
+
+
+def quantize_rows(rows, digit: int = 8):
+    """Host-side per-row quantization of an embedding-row block — the
+    storage form of the ``scale``/``middle``/``digit`` scheme the fake-quant
+    layers above train against (ALPT's per-row granularity, AAAI'23).
+
+    Per row: ``middle`` = the row's value midpoint, ``scale`` = its value
+    range over the code range, codes = ``clip(round((x-middle)/scale))``.
+    Returns ``(codes, scale, middle)`` with codes int8/int16 ``(n, dim)``
+    and scale/middle float32 ``(n,)``.  Used by the PS int8 storage mode
+    (embed.engine ``storage="int8"``) — numpy only, no jax trace.
+    """
+    if digit not in (8, 16):
+        raise ValueError("digit must be 8 or 16")
+    rows = np.ascontiguousarray(rows, np.float32)
+    if rows.ndim != 2:
+        raise ValueError(f"expected (n, dim) rows, got shape {rows.shape}")
+    lo = -(2 ** (digit - 1))
+    hi = 2 ** (digit - 1) - 1
+    mx = rows.max(axis=1)
+    mn = rows.min(axis=1)
+    middle = (mx + mn) * 0.5
+    # guard the all-constant row: scale 0 would divide by zero; any tiny
+    # positive scale reproduces the row exactly through q=0 + middle
+    scale = np.maximum((mx - mn) / (hi - lo), np.float32(1e-12))
+    q = np.clip(np.rint((rows - middle[:, None]) / scale[:, None]), lo, hi)
+    dtype = np.int8 if digit == 8 else np.int16
+    return q.astype(dtype), scale.astype(np.float32), middle.astype(np.float32)
+
+
+def dequantize_rows(codes, scale, middle):
+    """Inverse of :func:`quantize_rows`: ``codes * scale + middle``,
+    float32 ``(n, dim)``."""
+    codes = np.asarray(codes)
+    return (codes.astype(np.float32) * np.asarray(scale, np.float32)[:, None]
+            + np.asarray(middle, np.float32)[:, None])
 
 
 def _fake_quant(x, scale, middle, digit):

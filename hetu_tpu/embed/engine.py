@@ -14,13 +14,15 @@ import itertools
 import os
 import pathlib
 import subprocess
+import threading
 
 import numpy as np
 
 from hetu_tpu.obs import registry as _obs
 
 __all__ = [
-    "HostEmbeddingTable", "CacheTable", "AsyncEngine", "SSPBarrier",
+    "HostEmbeddingTable", "Int8HostEmbeddingTable", "CacheTable",
+    "PythonCacheTable", "AsyncEngine", "SSPBarrier",
     "PartialReduceCoordinator", "PReduceGroup", "decode_preduce_mask",
     "PREDUCE_QUORUM_FAIL_BIT", "OPTIMIZERS", "POLICIES",
     "publish_cache_stats",
@@ -116,10 +118,13 @@ _cache_names = itertools.count(0)
 def publish_cache_stats(name: str, stats: dict) -> None:
     """Mirror one HET cache's cumulative hit/miss counters (and current
     size) into the process registry under the ``cache`` label.  Shared by
-    the in-process ``CacheTable`` and the network ``RemoteCacheTable`` so
-    both expose one scrape surface.  Evictions are derived: every miss
-    inserts, so ``misses - size`` rows have been evicted since the cache
-    started empty."""
+    the in-process ``CacheTable``, the network ``RemoteCacheTable``, and
+    the HBM-tier layers so all expose one scrape surface.  An explicit
+    ``evictions`` count in ``stats`` is used as-is (the HBM tier counts
+    exactly — its misses include staleness refreshes that never insert);
+    otherwise evictions are derived: every C-cache miss inserts, so
+    ``misses - size`` rows have been evicted since the cache started
+    empty."""
     global _cache_metrics
     if not _obs.enabled():
         return
@@ -144,7 +149,8 @@ def publish_cache_stats(name: str, stats: dict) -> None:
     m["hits"].labels(cache=name).set_total(stats["hits"])
     m["misses"].labels(cache=name).set_total(stats["misses"])
     m["evictions"].labels(cache=name).set_total(
-        max(stats["misses"] - stats["size"], 0))
+        stats["evictions"] if "evictions" in stats
+        else max(stats["misses"] - stats["size"], 0))
     m["size"].labels(cache=name).set(stats["size"])
     m["hit_rate"].labels(cache=name).set(stats["hit_rate"])
 
@@ -165,18 +171,45 @@ class HostEmbeddingTable:
     The "server" of the PS pair: rows live in host RAM, gradient pushes run
     the optimizer on the host (ps-lite optimizer.h:25 capability), versions
     track per-row update counts for cache staleness.
+
+    ``storage`` selects the resident form: ``"f32"`` (default, the C
+    engine's float rows) or ``"int8"`` — per-row-quantized codes with a
+    float shadow of only the optimizer-touched rows (the VLDB'24
+    compression suite's scale/middle/digit scheme applied to PS storage;
+    see :class:`Int8HostEmbeddingTable`, which this constructor returns
+    for ``storage="int8"``).
     """
+
+    storage = "f32"
+
+    def __new__(cls, rows=0, dim=0, **kw):
+        if cls is HostEmbeddingTable and kw.get("storage", "f32") == "int8":
+            return super().__new__(Int8HostEmbeddingTable)
+        return super().__new__(cls)
 
     def __init__(self, rows: int, dim: int, *, optimizer: str = "sgd",
                  lr: float = 0.01, momentum: float = 0.9, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
                  weight_decay: float = 0.0, seed: int = 0,
-                 init_scale: float = 0.01):
+                 init_scale: float = 0.01, storage: str = "f32"):
+        if storage != "f32":
+            raise ValueError(f"unknown storage {storage!r}: 'f32' or 'int8'")
         self._lib = _load()
         self.rows, self.dim = rows, dim
         self._h = self._lib.het_table_create(
             rows, dim, OPTIMIZERS[optimizer], lr, momentum, beta1, beta2,
             eps, weight_decay, seed, init_scale)
+
+    def resident_bytes(self) -> int:
+        """Host bytes resident for the ROW PAYLOAD (the quantity int8
+        storage shrinks; per-row version counters and optimizer slots are
+        excluded on both storage modes so the ratio compares payloads)."""
+        return int(self.rows) * int(self.dim) * 4
+
+    def pull_wire_bytes(self, n_rows: int) -> int:
+        """Bytes a pull of ``n_rows`` moves across the PS boundary in this
+        table's storage form (f32: full float rows)."""
+        return int(n_rows) * int(self.dim) * 4
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -219,6 +252,245 @@ class HostEmbeddingTable:
             raise IOError(f"load failed ({rc}): {path}")
 
 
+class Int8HostEmbeddingTable(HostEmbeddingTable):
+    """PS storage tier with per-row int8-quantized rows (VLDB'24 suite's
+    scale/middle/digit scheme, ``compress.quant.quantize_rows``) — the
+    ``storage="int8"`` form of :class:`HostEmbeddingTable`.
+
+    Resident payload per row: ``dim`` int8 codes + one float16 scale + one
+    float16 middle (vs ``4*dim`` f32 bytes), so a dim-32 table shrinks
+    3.6x and dim-64 3.8x; ``pull`` dequantizes AT THE HOST BOUNDARY and
+    returns ordinary float32 rows, so every consumer (caches, staged
+    bridge, shard router, snapshot writer) is storage-oblivious.
+
+    ``push`` applies gradients against a FLOAT SHADOW of only the
+    optimizer-touched rows: the touched row's exact f32 value (and its
+    momentum/adagrad/adam slots) lives beside the quantized store, so
+    repeated updates never accumulate quantization error — cold rows pay
+    1 byte/weight, hot rows pay float precision, which is the HET skew
+    bet again at the storage layer.  Optimizer arithmetic mirrors the C
+    engine exactly (dedup-accumulate per batch, one global step counter
+    for adam bias correction), and the same ``seed`` produces the same
+    initial rows as the f32 table (drawn through the C initializer, then
+    quantized) so an int8-vs-f32 A/B starts from one init.
+    """
+
+    storage = "int8"
+
+    def __init__(self, rows: int, dim: int, *, optimizer: str = "sgd",
+                 lr: float = 0.01, momentum: float = 0.9, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0, seed: int = 0,
+                 init_scale: float = 0.01, storage: str = "int8",
+                 shadow_limit: int = 0):
+        if storage != "int8":
+            raise ValueError("Int8HostEmbeddingTable is storage='int8'")
+        from collections import OrderedDict
+
+        from hetu_tpu.embed.compress.quant import quantize_rows
+        self.rows, self.dim = int(rows), int(dim)
+        self._opt = OPTIMIZERS[optimizer]  # validated against the C enum
+        self._lr = float(lr)
+        self._momentum = float(momentum)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._eps = float(eps)
+        self._weight_decay = float(weight_decay)
+        self._q = np.empty((self.rows, self.dim), np.int8)
+        self._scale = np.empty((self.rows,), np.float16)
+        self._middle = np.empty((self.rows,), np.float16)
+        self._version = np.zeros((self.rows,), np.uint64)
+        self._step = 0
+        # float shadow: row id -> exact f32 row for optimizer-touched rows
+        # (evictable beyond shadow_limit; 0 = unbounded); slot dicts are
+        # NOT evictable — dropping an adagrad accumulator would change the
+        # training trajectory, exactly like the C engine's persistent slots
+        self._shadow = OrderedDict()
+        self._m1 = {}
+        self._m2 = {}
+        self.shadow_limit = int(shadow_limit)
+        self._lock = threading.Lock()
+        # same-seed init parity with the f32 table: draw the rows through
+        # the C initializer (mt19937_64 + normal), then quantize
+        src = HostEmbeddingTable(self.rows, self.dim, seed=seed,
+                                 init_scale=init_scale)
+        chunk = 65536
+        for lo in range(0, self.rows, chunk):
+            ids = np.arange(lo, min(lo + chunk, self.rows), dtype=np.int64)
+            q, s, m = quantize_rows(src.pull(ids))
+            self._q[ids] = q
+            self._scale[ids] = s.astype(np.float16)
+            self._middle[ids] = m.astype(np.float16)
+        del src
+
+    def __del__(self):  # no C handle to release
+        pass
+
+    def resident_bytes(self) -> int:
+        shadow = sum(v.nbytes for v in self._shadow.values())
+        return (self._q.nbytes + self._scale.nbytes + self._middle.nbytes
+                + shadow)
+
+    def pull_wire_bytes(self, n_rows: int) -> int:
+        return int(n_rows) * (int(self.dim) + 4)  # codes + f16 scale/middle
+
+    def _dequant(self, keys: np.ndarray) -> np.ndarray:
+        from hetu_tpu.embed.compress.quant import dequantize_rows
+        rows = dequantize_rows(self._q[keys], self._scale[keys],
+                               self._middle[keys])
+        for i, k in enumerate(keys):
+            w = self._shadow.get(int(k))
+            if w is not None:
+                rows[i] = w
+        return rows
+
+    def pull(self, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(np.asarray(keys).ravel(), np.int64)
+        with self._lock:
+            return self._dequant(keys)
+
+    def push(self, keys, grads):
+        keys = np.ascontiguousarray(np.asarray(keys).ravel(), np.int64)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            keys.size, self.dim)
+        from hetu_tpu.embed.compress.quant import quantize_rows
+        with self._lock:
+            self._step += 1
+            uniq, inv = np.unique(keys, return_inverse=True)
+            g = np.zeros((uniq.size, self.dim), np.float32)
+            np.add.at(g, inv, grads)  # dedup-accumulate (ApplySparse)
+            w = self._dequant(uniq)
+            kind, lr, wd = self._opt, self._lr, self._weight_decay
+            if kind == OPTIMIZERS["sgd"]:
+                w -= lr * (g + wd * w)
+            elif kind == OPTIMIZERS["momentum"]:
+                v = self._gather_slot(self._m1, uniq)
+                gj = g + wd * w
+                v = self._momentum * v + gj
+                w -= lr * v
+                self._scatter_slot(self._m1, uniq, v)
+            elif kind == OPTIMIZERS["adagrad"]:
+                a = self._gather_slot(self._m1, uniq)
+                gj = g + wd * w
+                a += gj * gj
+                w -= lr * gj / (np.sqrt(a) + self._eps)
+                self._scatter_slot(self._m1, uniq, a)
+            else:  # adam / adamw
+                m = self._gather_slot(self._m1, uniq)
+                v = self._gather_slot(self._m2, uniq)
+                t = np.float32(self._step)
+                bc1 = 1.0 - np.float32(self._beta1) ** t
+                bc2 = 1.0 - np.float32(self._beta2) ** t
+                gj = g + wd * w if kind == OPTIMIZERS["adam"] else g
+                m = self._beta1 * m + (1.0 - self._beta1) * gj
+                v = self._beta2 * v + (1.0 - self._beta2) * gj * gj
+                upd = (m / bc1) / (np.sqrt(v / bc2) + self._eps)
+                if kind == OPTIMIZERS["adamw"]:
+                    upd = upd + wd * w
+                w -= lr * upd
+                self._scatter_slot(self._m1, uniq, m)
+                self._scatter_slot(self._m2, uniq, v)
+            q, s, mid = quantize_rows(w)
+            self._q[uniq] = q
+            self._scale[uniq] = s.astype(np.float16)
+            self._middle[uniq] = mid.astype(np.float16)
+            self._version[uniq] += 1
+            for i, k in enumerate(uniq):
+                k = int(k)
+                # copy, not a view: a view's base is the whole (uniq, dim)
+                # work array, and one long-tail row would pin its entire
+                # originating batch in memory
+                self._shadow[k] = w[i].copy()
+                self._shadow.move_to_end(k)
+            if self.shadow_limit > 0:
+                while len(self._shadow) > self.shadow_limit:
+                    # the evicted row's quantized form is already current;
+                    # only its float precision is given back
+                    self._shadow.popitem(last=False)
+
+    def _gather_slot(self, slot: dict, uniq: np.ndarray) -> np.ndarray:
+        # slots default to zeros for never-touched rows (lazy, like the C
+        # engine's ensure_slots)
+        out = np.zeros((uniq.size, self.dim), np.float32)
+        for i, k in enumerate(uniq):
+            r = slot.get(int(k))
+            if r is not None:
+                out[i] = r
+        return out
+
+    def _scatter_slot(self, slot: dict, uniq: np.ndarray, vals: np.ndarray):
+        for i, k in enumerate(uniq):
+            slot[int(k)] = vals[i].copy()  # no views of the batch array
+
+    def set_rows(self, keys, values):
+        from hetu_tpu.embed.compress.quant import quantize_rows
+        keys = np.ascontiguousarray(np.asarray(keys).ravel(), np.int64)
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            keys.size, self.dim)
+        with self._lock:
+            q, s, m = quantize_rows(values)
+            self._q[keys] = q
+            self._scale[keys] = s.astype(np.float16)
+            self._middle[keys] = m.astype(np.float16)
+            self._version[keys] += 1
+            # a direct write supersedes any float shadow: leaving one
+            # would silently mask the install on the next pull
+            for k in keys:
+                self._shadow.pop(int(k), None)
+
+    def version(self, row: int) -> int:
+        return int(self._version[row])
+
+    def versions(self, keys) -> np.ndarray:
+        return self._version[np.asarray(keys, np.int64)]
+
+    def set_lr(self, lr: float):
+        self._lr = float(lr)
+
+    def save(self, path: str):
+        import io
+        buf = io.BytesIO()
+        sk = np.fromiter(self._shadow.keys(), np.int64,
+                         count=len(self._shadow))
+        sv = (np.stack(list(self._shadow.values()))
+              if self._shadow else np.zeros((0, self.dim), np.float32))
+
+        def pack(d):
+            k = np.fromiter(d.keys(), np.int64, count=len(d))
+            v = (np.stack(list(d.values())) if d
+                 else np.zeros((0, self.dim), np.float32))
+            return k, v
+
+        m1k, m1v = pack(self._m1)
+        m2k, m2v = pack(self._m2)
+        np.savez(buf, q=self._q, scale=self._scale, middle=self._middle,
+                 version=self._version, step=np.int64(self._step),
+                 shadow_keys=sk, shadow_vals=sv, m1_keys=m1k, m1_vals=m1v,
+                 m2_keys=m2k, m2_vals=m2v)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+
+    def load(self, path: str):
+        with np.load(path) as z:
+            if z["q"].shape != (self.rows, self.dim):
+                raise IOError(
+                    f"load failed (-2): {path} holds shape {z['q'].shape}, "
+                    f"table is {(self.rows, self.dim)}")
+            self._q[:] = z["q"]
+            self._scale[:] = z["scale"]
+            self._middle[:] = z["middle"]
+            self._version[:] = z["version"]
+            self._step = int(z["step"])
+            self._shadow.clear()
+            for k, v in zip(z["shadow_keys"], z["shadow_vals"]):
+                self._shadow[int(k)] = np.asarray(v, np.float32)
+            self._m1 = {int(k): np.asarray(v, np.float32)
+                        for k, v in zip(z["m1_keys"], z["m1_vals"])}
+            self._m2 = {int(k): np.asarray(v, np.float32)
+                        for k, v in zip(z["m2_keys"], z["m2_vals"])}
+
+
 class CacheTable:
     """Worker-side cache over a HostEmbeddingTable (HET protocol).
 
@@ -226,7 +498,18 @@ class CacheTable:
     ``pull_bound`` server updates. ``push(keys, grads)`` = pushEmbedding:
     accumulate locally, flushing rows after ``push_bound`` accumulations.
     (src/hetu_cache/include/hetu_client.h:19-30.)
+
+    Over an ``storage="int8"`` table (a Python object with no C handle)
+    the constructor returns a :class:`PythonCacheTable` with the same
+    facade and semantics.
     """
+
+    is_het_cache = True  # duck tag shared with PythonCacheTable
+
+    def __new__(cls, table=None, capacity: int = 0, **kw):
+        if cls is CacheTable and getattr(table, "storage", "f32") != "f32":
+            return PythonCacheTable(table, capacity, **kw)
+        return super().__new__(cls)
 
     def __init__(self, table: HostEmbeddingTable, capacity: int, *,
                  policy: str = "lru", pull_bound: int = 0,
@@ -285,6 +568,161 @@ class CacheTable:
         out = {"hits": h.value, "misses": m.value, "size":
                int(self._lib.het_cache_size(self._h)),
                "hit_rate": h.value / total if total else 0.0}
+        publish_cache_stats(self.name, out)
+        return out
+
+
+class PythonCacheTable:
+    """HET worker-side cache in Python — the :class:`CacheTable` facade
+    (sync/push/flush/stats/read_only) over tables the C cache cannot wrap
+    (the ``storage="int8"`` Python table has no C handle).
+
+    Same protocol: ``sync`` serves cached rows, re-pulling those whose
+    server version advanced more than ``pull_bound`` updates past the
+    cached copy (one batched table pull per sync); ``push`` accumulates
+    locally and flushes a row after ``push_bound`` accumulations; LRU
+    eviction at capacity flushes the victim's pending grads first.  A
+    lock serializes readers and writers, so the staged layer's
+    ``async_push`` worker is safe against ``stage()`` pulls — the same
+    guarantee the C engine cache provides.
+    """
+
+    is_het_cache = True
+
+    def __init__(self, table, capacity: int, *, policy: str = "lru",
+                 pull_bound: int = 0, push_bound: int = 0,
+                 name: str | None = None, read_only: bool = False):
+        from collections import OrderedDict
+        if capacity <= 0:
+            raise ValueError("cache capacity must be > 0")
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}")
+        self.table = table
+        self.dim = table.dim
+        self.capacity = int(capacity)
+        self.pull_bound = int(pull_bound)
+        self.push_bound = int(push_bound)
+        self.name = name if name is not None else f"cache{next(_cache_names)}"
+        self.read_only = bool(read_only)
+        # key -> [row f32, fetched_version, pending_grad|None, pending_n]
+        self._entries = OrderedDict()  # order = LRU (lfu/lfuopt degrade to
+        # LRU here; the C cache keeps the exact policies)
+        self._hits = 0
+        self._misses = 0
+        self._lock = threading.Lock()
+
+    def _server_versions(self, keys: np.ndarray) -> np.ndarray:
+        vfn = getattr(self.table, "versions", None)
+        if vfn is not None:
+            return np.asarray(vfn(keys), np.uint64)
+        return np.fromiter((self.table.version(int(k)) for k in keys),
+                           np.uint64, count=keys.size)
+
+    def _flush_entry(self, key: int, ent) -> None:
+        if ent[2] is not None and ent[3] > 0:
+            self.table.push(np.asarray([key], np.int64), ent[2][None, :])
+            ent[2], ent[3] = None, 0
+
+    def sync(self, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(np.asarray(keys).ravel(), np.int64)
+        out = np.empty((keys.size, self.dim), np.float32)
+        with self._lock:
+            sv = self._server_versions(keys)
+            need_idx = []
+            for i, k in enumerate(keys):
+                k = int(k)
+                ent = self._entries.get(k)
+                if ent is not None and int(sv[i]) - int(ent[1]) \
+                        <= self.pull_bound:
+                    out[i] = ent[0]
+                    self._entries.move_to_end(k)
+                    self._hits += 1
+                else:
+                    need_idx.append(i)
+                    self._misses += 1
+            if need_idx:
+                need_idx = np.asarray(need_idx, np.int64)
+                need = keys[need_idx]
+                # a stale entry's pending grads flush BEFORE the re-pull so
+                # the refreshed copy reflects them (C cache sync semantics)
+                for k in need:
+                    ent = self._entries.get(int(k))
+                    if ent is not None:
+                        self._flush_entry(int(k), ent)
+                fresh = self.table.pull(need)
+                sv_need = self._server_versions(need)
+                for j, k in enumerate(need):
+                    k = int(k)
+                    out[need_idx[j]] = fresh[j]
+                    ent = self._entries.get(k)
+                    if ent is None:
+                        self._entries[k] = [fresh[j].copy(),
+                                            int(sv_need[j]), None, 0]
+                    else:
+                        ent[0] = fresh[j].copy()
+                        ent[1] = int(sv_need[j])
+                    self._entries.move_to_end(k)
+                while len(self._entries) > self.capacity:
+                    vk, vent = self._entries.popitem(last=False)
+                    self._flush_entry(vk, vent)
+        if _obs.enabled():
+            self.stats()  # refresh the registry mirror for live scrapes
+        return out
+
+    # plain pull = cache-served read (same aliasing as RemoteCacheTable)
+    pull = sync
+
+    def push(self, keys, grads):
+        if self.read_only:
+            raise RuntimeError(
+                f"cache {self.name!r} is read-only (serving mode): "
+                f"gradient pushes are disabled so inference cannot "
+                f"silently train the table")
+        keys = np.ascontiguousarray(np.asarray(keys).ravel(), np.int64)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            keys.size, self.dim)
+        with self._lock:
+            flush_k, flush_g = [], []
+            for i, k in enumerate(keys):
+                k = int(k)
+                ent = self._entries.get(k)
+                if ent is None:
+                    # evicted between fwd and bwd: apply directly (C path)
+                    flush_k.append(k)
+                    flush_g.append(grads[i])
+                    continue
+                if ent[2] is None:
+                    ent[2] = grads[i].copy()
+                else:
+                    ent[2] += grads[i]
+                ent[3] += 1
+                if ent[3] > self.push_bound:
+                    flush_k.append(k)
+                    flush_g.append(ent[2])
+                    ent[2], ent[3] = None, 0
+            if flush_k:
+                self.table.push(np.asarray(flush_k, np.int64),
+                                np.stack(flush_g))
+
+    def flush(self):
+        with self._lock:
+            for k, ent in self._entries.items():
+                self._flush_entry(k, ent)
+
+    def invalidate(self):
+        """Flush pending grads and drop every cached copy."""
+        self.flush()
+        with self._lock:
+            self._entries.clear()
+
+    def size(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        total = self._hits + self._misses
+        out = {"hits": self._hits, "misses": self._misses,
+               "size": len(self._entries),
+               "hit_rate": self._hits / total if total else 0.0}
         publish_cache_stats(self.name, out)
         return out
 

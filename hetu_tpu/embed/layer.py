@@ -21,14 +21,23 @@ a host-side table (optionally cached), reached one of two ways:
 
 from __future__ import annotations
 
+import itertools
+
 import jax.numpy as jnp
 import numpy as np
 
 from hetu_tpu.core.module import Module
 from hetu_tpu.embed.bridge import Prefetcher, make_host_lookup, sync_fn
-from hetu_tpu.embed.engine import CacheTable, HostEmbeddingTable
+from hetu_tpu.embed.engine import (CacheTable, HostEmbeddingTable,
+                                   publish_cache_stats)
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
 
 __all__ = ["HostEmbedding", "StagedHostEmbedding", "HBMCachedEmbedding"]
+
+# deterministic telemetry labels for layers constructed without a name
+# (process-local, so labels follow construction order like cache names)
+_layer_names = itertools.count(0)
 
 
 class _HostEmbeddingBase(Module):
@@ -41,23 +50,47 @@ class _HostEmbeddingBase(Module):
                  weight_decay: float = 0.0, seed: int = 0,
                  init_scale: float = 0.01, cache_capacity: int = 0,
                  policy: str = "lru", pull_bound: int = 0,
-                 push_bound: int = 0, dtype=jnp.float32):
+                 push_bound: int = 0, dtype=jnp.float32,
+                 storage: str = "f32", name: str | None = None):
         self.num_embeddings = num_embeddings
         self.dim = dim
         self.dtype = dtype
+        self.name = name if name is not None else f"embed{next(_layer_names)}"
         self.table = HostEmbeddingTable(
             num_embeddings, dim, optimizer=optimizer, lr=lr,
-            weight_decay=weight_decay, seed=seed, init_scale=init_scale)
+            weight_decay=weight_decay, seed=seed, init_scale=init_scale,
+            storage=storage)
         if cache_capacity > 0:
             self.store = CacheTable(self.table, cache_capacity,
                                     policy=policy, pull_bound=pull_bound,
-                                    push_bound=push_bound)
+                                    push_bound=push_bound,
+                                    name=f"{self.name}.host")
         else:
             self.store = self.table
 
     def flush(self):
-        if isinstance(self.store, CacheTable):
+        # engine CacheTable or PythonCacheTable (int8 tables); bare tables
+        # have nothing to flush
+        if getattr(self.store, "is_het_cache", False):
             self.store.flush()
+
+    def attach_snapshot_writer(self, writer) -> None:
+        """Register a :class:`~hetu_tpu.embed.stream.SnapshotWriter`: every
+        gradient push's ids are reported so delta snapshots cover exactly
+        the rows that changed.  Staged subclasses only (the callback
+        bridge pushes inside jit, outside this hook's reach)."""
+        h = getattr(self, "_handle", None)
+        if h is None:
+            raise TypeError(
+                f"{type(self).__name__} has no host-side push hook; attach "
+                f"the writer to a staged/HBM-cached embedding instead")
+        h.snapshot_writers.append(writer)
+
+    def _note_push(self, ids) -> None:
+        h = getattr(self, "_handle", None)
+        if h is not None:
+            for w in h.snapshot_writers:
+                w.note_push(ids)
 
     def save(self, path: str):
         # staged subclasses may have queued async pushes: drain them before
@@ -99,7 +132,7 @@ class _HostHandle:
     contents, which are read exclusively OUTSIDE jit)."""
 
     __slots__ = ("ids", "prefetcher", "pusher", "push_err", "autosave",
-                 "autosave_n", "__weakref__")
+                 "autosave_n", "snapshot_writers", "__weakref__")
 
     def __init__(self):
         self.ids = None
@@ -108,6 +141,7 @@ class _HostHandle:
         self.push_err = None  # first exception from an async push
         self.autosave = None  # (path, every) from ShardedHostEmbedding
         self.autosave_n = 0
+        self.snapshot_writers = []  # stream.SnapshotWriter note_push hooks
 
 
 class StagedHostEmbedding(_HostEmbeddingBase):
@@ -142,7 +176,7 @@ class StagedHostEmbedding(_HostEmbeddingBase):
             # the bare (uncached) table's pull is a lockless read in the C
             # engine; only the cache path serializes reader and writer, so
             # async pushes against a bare table would race stage() pulls
-            if not isinstance(self.store, CacheTable):
+            if not getattr(self.store, "is_het_cache", False):
                 raise ValueError(
                     "async_push needs cache_capacity > 0: the engine cache "
                     "serializes the worker thread's pushes against stage() "
@@ -221,6 +255,7 @@ class StagedHostEmbedding(_HostEmbeddingBase):
                 "push_grads without a fresh stage(): call stage(ids) before "
                 "every training step")
         h.ids = None
+        self._note_push(ids)
         if not self.async_push:
             self.store.push(ids.ravel(), np.asarray(
                 grad_rows, np.float32).reshape(-1, self.dim))
@@ -266,7 +301,9 @@ class _HBMHandle:
     version metadata at the same order)."""
 
     __slots__ = ("slot_of", "id_of", "staleness", "last_used", "tick",
-                 "ids", "touched_ids", "prefetcher", "pushed_since_prefetch")
+                 "ids", "touched_ids", "prefetcher", "pushed_since_prefetch",
+                 "hits", "misses", "evictions", "overflows",
+                 "snapshot_writers", "rows_dirty", "tier")
 
     def __init__(self, capacity: int, num_embeddings: int):
         self.slot_of = np.full(num_embeddings, -1, np.int64)  # id -> slot
@@ -278,6 +315,15 @@ class _HBMHandle:
         self.touched_ids = None
         self.prefetcher = None
         self.pushed_since_prefetch = None  # ids pushed after prefetch issue
+        # cumulative HBM-tier accounting (unique rows per stage: resident-
+        # and-fresh = hit, refreshed/overflowed = miss)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.overflows = 0
+        self.snapshot_writers = []  # stream.SnapshotWriter note_push hooks
+        self.rows_dirty = False  # rows leaf carries overflow values
+        self.tier = None  # tier.TieredEmbedding bookkeeping (_TierState)
 
 
 class HBMCachedEmbedding(_HostEmbeddingBase):
@@ -372,6 +418,30 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
         # stage() must not install them from the buffer as "fresh"
         self._handle.pushed_since_prefetch = []
 
+    def _split_residency(self, uniq: np.ndarray):
+        """Partition the batch's unique rows into ``(cached, overflow)``:
+        rows that may occupy HBM slots this stage vs rows served through
+        the host path for this batch only.  The base rule is capacity:
+        more unique rows than slots keeps every currently-resident row,
+        fills the remaining capacity, and spills the rest (journaled) —
+        a fat batch degrades to the staged transfer instead of killing
+        the step.  ``TieredEmbedding`` layers its promotion policy on
+        top."""
+        h = self._handle
+        if uniq.size <= self.capacity:
+            return uniq, np.empty(0, np.int64)
+        cached_mask = h.slot_of[uniq] >= 0
+        resident, nonres = uniq[cached_mask], uniq[~cached_mask]
+        budget = self.capacity - resident.size
+        cuniq = np.sort(np.concatenate([resident, nonres[:budget]]))
+        overflow = nonres[budget:]  # sorted (nonres is)
+        h.overflows += int(overflow.size)
+        _obs_journal.record(
+            "hbm_overflow", table=self.name,
+            batch_rows=int(uniq.size), overflow=int(overflow.size),
+            capacity=int(self.capacity))
+        return cuniq, overflow
+
     def stage(self, ids):
         h = self._handle
         if self.refresh_slots.shape != (1,):
@@ -384,16 +454,16 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
             self.refresh_rows = jnp.zeros((1, self.dim), jnp.float32)
         ids = np.asarray(ids, np.int64)
         uniq = np.unique(ids.ravel())
-        if uniq.size > self.capacity:
-            raise ValueError(
-                f"batch touches {uniq.size} unique rows > hbm_capacity "
-                f"{self.capacity}")
         h.tick += 1
-        cur_slots = h.slot_of[uniq]
+        cuniq, overflow = self._split_residency(uniq)
+        cur_slots = h.slot_of[cuniq]
         cached = cur_slots >= 0
-        need_mask = (~cached) | (h.staleness[uniq] > self.pull_bound)
-        need = uniq[need_mask]
-        if need.size:
+        need_mask = (~cached) | (h.staleness[cuniq] > self.pull_bound)
+        need = cuniq[need_mask]
+        h.hits += int(cuniq.size - need.size)
+        h.misses += int(need.size + overflow.size)
+        over_rows = None
+        if need.size or overflow.size:
             need_slots = cur_slots[need_mask]  # -1 where not resident
             miss = need_slots < 0
             n_miss = int(miss.sum())
@@ -411,10 +481,11 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
                     victims = order[occupied & ~in_batch[order]]
                     extra = n_miss - free.size
                     # always satisfiable: free + occupied-not-in-batch =
-                    # capacity - cached >= uniq - cached >= n_miss (the
-                    # uniq > capacity case raised above)
+                    # capacity - cached >= cuniq - cached >= n_miss (the
+                    # uniq > capacity case was trimmed to cuniq above)
                     assert victims.size >= extra, "slot accounting broken"
                     evict = victims[:extra]
+                    h.evictions += int(evict.size)
                     h.slot_of[h.id_of[evict]] = -1
                     free = np.concatenate([free, evict])
                 alloc = free[:n_miss]
@@ -422,23 +493,28 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
             h.slot_of[need] = need_slots
             h.id_of[need_slots] = need
             h.staleness[need] = 0
-            fresh = None
+            # one batched host fetch covers the cache refresh AND the
+            # overflow rows served host-side this batch
+            fetch = np.concatenate([need, overflow])
             if h.prefetcher is not None:
                 rows_all = np.asarray(h.prefetcher.get(uniq))
-                fresh = rows_all[need_mask]
+                fetched = rows_all[np.searchsorted(uniq, fetch)].copy()
                 # the buffered pull predates any push issued after
                 # prefetch(): re-pull those rows synchronously so a stale
-                # snapshot is never installed with staleness 0
+                # snapshot is never installed (or served) with staleness 0
                 pushed = h.pushed_since_prefetch or []
                 if pushed:
-                    dirty = np.isin(need, np.concatenate(pushed))
+                    dirty = np.isin(fetch, np.concatenate(pushed))
                     if dirty.any():
-                        fresh[dirty] = np.asarray(
-                            sync_fn(self.store)(need[dirty])).reshape(
+                        fetched[dirty] = np.asarray(
+                            sync_fn(self.store)(fetch[dirty])).reshape(
                                 -1, self.dim)
             else:
-                fresh = np.asarray(sync_fn(self.store)(need))
-            fresh = fresh.reshape(need.size, self.dim).astype(np.float32)
+                fetched = np.asarray(sync_fn(self.store)(fetch))
+            fetched = fetched.reshape(fetch.size, self.dim).astype(
+                np.float32)
+            fresh, over_rows = fetched[:need.size], fetched[need.size:]
+        if need.size:
             # pad the refresh to a power-of-two bucket so the step
             # compiles once per bucket instead of once per distinct
             # refresh size (a per-step recompile would dwarf the transfer
@@ -462,17 +538,34 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
             self.refresh_slots = jnp.asarray(need_slots, jnp.float32)
             self.refresh_rows = jnp.asarray(fresh)
         else:
-            if h.prefetcher is not None:
+            if h.prefetcher is not None and not overflow.size:
                 h.prefetcher.get(uniq)  # retire the pending pull
             self.refresh_slots = jnp.full((1,), self.capacity, jnp.float32)
             self.refresh_rows = jnp.zeros((1, self.dim), jnp.float32)
-        slot_lut = h.slot_of[uniq]
-        h.last_used[slot_lut] = h.tick
+        slot_lut = h.slot_of[uniq]          # -1 for overflow ids
+        live = slot_lut >= 0
+        h.last_used[slot_lut[live]] = h.tick
         batch_slots = slot_lut[np.searchsorted(uniq, ids.ravel())]
+        # overflow ids gather the fill row (zeros) from the cache; their
+        # values ride the ``rows`` leaf instead
+        batch_slots = np.where(batch_slots >= 0, batch_slots, self.capacity)
         self.slots = jnp.asarray(batch_slots.reshape(ids.shape), jnp.float32)
-        if tuple(self.rows.shape) != tuple(ids.shape) + (self.dim,):
+        if overflow.size:
+            rows_arr = np.zeros(tuple(ids.shape) + (self.dim,), np.float32)
+            flat = ids.ravel()
+            m = np.isin(flat, overflow)
+            rows_flat = rows_arr.reshape(-1, self.dim)
+            rows_flat[m] = over_rows[np.searchsorted(overflow, flat[m])]
+            # explicit copy: the leaf is donate-eligible in the jitted
+            # step, and a zero-copy view of rows_arr's host buffer being
+            # donated would free memory numpy still owns
+            self.rows = jnp.array(rows_arr)
+            h.rows_dirty = True
+        elif (h.rows_dirty
+              or tuple(self.rows.shape) != tuple(ids.shape) + (self.dim,)):
             self.rows = jnp.zeros(tuple(ids.shape) + (self.dim,),
                                   jnp.float32)
+            h.rows_dirty = False
         h.ids = ids
         h.touched_ids = uniq
 
@@ -486,9 +579,12 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
 
         # gather from the cache WITH the pending refresh merged in (a
         # no-op scatter once the Trainer has applied it); values are
-        # stop_gradient'd — the cotangent rides the zeros ``rows`` leaf
+        # stop_gradient'd — the cotangent rides the ``rows`` leaf, which
+        # is zeros except at overflow positions (whose values it carries:
+        # slot == capacity gathers the fill row)
         gathered = jax.lax.stop_gradient(
-            self._merged_cache()[self.slots.astype(jnp.int32)])
+            jnp.take(self._merged_cache(), self.slots.astype(jnp.int32),
+                     axis=0, mode="fill", fill_value=0.0))
         return (gathered + self.rows).astype(self.dtype)
 
     def is_fresh(self) -> bool:
@@ -496,23 +592,54 @@ class HBMCachedEmbedding(_HostEmbeddingBase):
 
     def push_grads(self, grad_rows):
         """``grad_rows`` is the batch-shaped cotangent of the lookup; ship
-        it to the host engine (duplicate ids accumulate there) and bump
-        the pushed ids' staleness."""
+        it to the host engine and bump the pushed ids' staleness.
+        Duplicate ids are accumulated HERE (one optimizer apply per unique
+        row): the bare table dedups internally, but the HET cache's push
+        path applies per occurrence, and the tiered layer routes pushes
+        through the host cache — pre-deduping keeps both stores on the
+        reference ReduceIndexedSlice-then-update semantics (and halves
+        push bytes on skewed batches for free)."""
         h = self._handle
         if h.ids is None:
             raise RuntimeError(
                 "push_grads without a fresh stage(): call stage(ids) before "
                 "every training step")
-        self.store.push(h.ids.ravel(),
-                        np.asarray(grad_rows, np.float32).reshape(
-                            -1, self.dim))
+        flat = h.ids.ravel()
+        g = np.asarray(grad_rows, np.float32).reshape(-1, self.dim)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, g)
+        self.store.push(uniq, acc)
         h.staleness[h.touched_ids] += 1
         if h.pushed_since_prefetch is not None:
             h.pushed_since_prefetch.append(h.touched_ids)
+        self._note_push(h.ids)
         h.ids = None
         h.touched_ids = None
 
+    def invalidate_rows(self, ids) -> None:
+        """Force a host re-pull of ``ids`` on their next stage regardless
+        of ``hbm_pull_bound`` — the hook a snapshot install (or any
+        external ``set_rows``) uses so the device copies never serve
+        pre-install values."""
+        ids = np.asarray(ids, np.int64).ravel()
+        self._handle.staleness[ids] = np.iinfo(np.int32).max
+
     def hit_stats(self) -> dict:
-        """Occupancy snapshot for debugging."""
-        return {"resident": int((self._handle.id_of >= 0).sum()),
-                "capacity": self.capacity}
+        """HBM-tier cache accounting (unique rows per stage: resident-and-
+        fresh = hit, refreshed or overflowed = miss), mirrored onto
+        /metrics via :func:`~hetu_tpu.embed.engine.publish_cache_stats`
+        under this layer's ``name`` — embedding hit rates scrape beside
+        the serve tier's prefix-cache rates."""
+        h = self._handle
+        total = h.hits + h.misses
+        out = {"hits": int(h.hits), "misses": int(h.misses),
+               "size": int((h.id_of >= 0).sum()),
+               "hit_rate": h.hits / total if total else 0.0,
+               "evictions": int(h.evictions),
+               "overflows": int(h.overflows),
+               "resident": int((h.id_of >= 0).sum()),
+               "capacity": self.capacity}
+        if _obs.enabled():
+            publish_cache_stats(self.name, out)
+        return out

@@ -32,7 +32,7 @@ from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _obs_tracing
 
 __all__ = ["EmbeddingServer", "RemoteCacheTable", "RemoteEmbeddingTable",
-           "RemoteHostEmbedding", "attach_loads_client"]
+           "RemoteHostEmbedding", "attach_loads_client", "hot_row_signal"]
 
 # Fault-injection seam (hetu_tpu.exec.faults.install wires this up; None in
 # production, so the RPC hot path costs one global load).  Called with
@@ -159,6 +159,15 @@ def _get_loads(lib, conn, table_id: int, topk: int) -> dict:
     out = {k: int(v) for k, v in zip(names, counters)}
     out["hot_rows"] = [(int(rows[i]), int(touches[i])) for i in range(int(n))]
     return out
+
+
+def hot_row_signal(loads: dict) -> list:
+    """``[(row, touches)]`` from a ``get_loads``/``attach_loads_client``
+    dump — the PS server's hot-key skew in the shape
+    :meth:`~hetu_tpu.embed.tier.TieredEmbedding.seed_hot_rows` consumes,
+    so a (re)built worker warms its HBM promotion policy from the
+    server's measured traffic instead of re-learning the hot set."""
+    return [(int(r), int(t)) for r, t in loads.get("hot_rows", [])]
 
 
 def attach_loads_client(address: str, table_id: int, *, topk: int = 10) -> dict:

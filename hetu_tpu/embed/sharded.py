@@ -224,6 +224,25 @@ class ShardedHostEmbedding(StagedHostEmbedding):
         for s, t in enumerate(self.tables):
             t.load(f"{path}.shard{s}")
 
+    def set_rows(self, ids, values) -> None:
+        """Direct (optimizer-bypassing) row write routed across the shard
+        tables — the snapshot follower's install path on a sharded
+        serving replica.  Caches that track versions re-pull changed
+        rows on their own; caches that cannot (net.RemoteCacheTable
+        drops everything via ``set_rows``'s invalidate) are written
+        through their own entry point instead."""
+        ids = np.asarray(ids, np.int64).ravel()
+        values = np.asarray(values, np.float32).reshape(ids.size, self.dim)
+        shard, local = self.store.route(ids)
+        for s in range(self.n_shards):
+            m = shard == s
+            if m.any():
+                st = self.stores[s]
+                if hasattr(st, "set_rows") and st is not self.tables[s]:
+                    st.set_rows(local[m], values[m])  # cache-aware write
+                else:
+                    self.tables[s].set_rows(local[m], values[m])
+
     def pull_rows(self, ids) -> np.ndarray:
         """Direct (cache-bypassing) host pull, e.g. for eval/export."""
         ids = np.asarray(ids, np.int64).ravel()

@@ -1,0 +1,401 @@
+"""Streaming embedding snapshots: training pushes -> read-only serving.
+
+The CTR serving replicas (serve.ServingEngine ``ctr_model``) hold their
+HET stores READ-ONLY — serving never trains in place — which until now
+also meant they never saw fresh weights.  This module streams them:
+
+- :class:`SnapshotWriter` rides the training side.  Staged embedding
+  layers report every gradient push's ids (``attach_snapshot_writer``),
+  and ``publish()`` emits a versioned DELTA snapshot — just the rows
+  changed since the last version — as a signed artifact pair reusing the
+  gang-manifest trust model (exec.gang): a payload file (ids + f32 rows)
+  plus a sorted-JSON manifest carrying the payload CRC32, the
+  order-sensitive content fingerprint (obs.numerics host fingerprint),
+  and the gang signing rule over the body.  Version 1 is always FULL so
+  a fresh follower can bootstrap.
+- :class:`SnapshotFollower` rides the serving side.  ``poll()`` installs
+  every new intact version in order through the store's ``set_rows``
+  (the one sanctioned write path — the read-only push guard stays
+  untouched); a torn/tampered artifact is diagnosed BY NAME (``torn``/
+  ``signature``/``crc``/``fingerprint``/``geometry``/``missing_base``),
+  journaled ``snapshot_skipped``, and the previous version keeps
+  serving.  ``gate()`` enforces the staleness bound
+  (``HETU_TPU_EMBED_STALENESS`` versions): call it before serving and
+  the replica is never more than ``bound`` published versions behind.
+
+Both sides are deterministic: same training trajectory -> byte-identical
+artifacts (no wall-clock in the manifest), so snapshot install replays
+bitwise under a seeded run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import zlib
+
+import numpy as np
+
+from hetu_tpu.exec.checkpoint import _atomic_write_bytes
+from hetu_tpu.exec.gang import sign_body
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.obs.numerics import host_combine, host_fingerprint
+
+__all__ = ["SnapshotWriter", "SnapshotFollower", "SnapshotError",
+           "SNAPSHOT_FORMAT", "read_snapshot", "list_snapshots"]
+
+SNAPSHOT_FORMAT = "hetu-embed-snapshot-v1"
+_SIGN_KEY = b"hetu-tpu-embed-snapshot-v1"
+_MANIFEST_RE = re.compile(r"^(?P<name>.+)\.v(?P<ver>\d{6})\.json$")
+
+
+class SnapshotError(RuntimeError):
+    """A snapshot artifact could not be used; ``reason`` is the named
+    diagnosis (``torn``/``format``/``signature``/``crc``/``fingerprint``/
+    ``geometry``/``missing_base``)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"[{reason}] {detail}")
+        self.reason = reason
+
+
+_snap_metrics = None
+
+
+def _snap_m() -> dict:
+    global _snap_metrics
+    if _snap_metrics is None:
+        reg = _obs.get_registry()
+        _snap_metrics = {
+            "ops": reg.counter(
+                "hetu_embed_snapshots_total",
+                "embedding snapshot operations by outcome",
+                ("op",)),
+            "rows": reg.counter(
+                "hetu_embed_snapshot_rows_total",
+                "embedding rows published/installed via snapshots",
+                ("op",)),
+        }
+    return _snap_metrics
+
+
+def _manifest_path(snap_dir: str, name: str, version: int) -> str:
+    return os.path.join(snap_dir, f"{name}.v{version:06d}.json")
+
+
+def _payload_path(snap_dir: str, name: str, version: int) -> str:
+    return os.path.join(snap_dir, f"{name}.v{version:06d}.rows")
+
+
+def list_snapshots(snap_dir: str, name: str) -> list:
+    """Manifest versions present for ``name``, ascending (presence only —
+    verification happens at read)."""
+    out = []
+    try:
+        entries = os.listdir(snap_dir)
+    except OSError:
+        return out
+    for fn in entries:
+        m = _MANIFEST_RE.match(fn)
+        if m and m.group("name") == name:
+            out.append(int(m.group("ver")))
+    return sorted(out)
+
+
+def read_snapshot(snap_dir: str, name: str, version: int):
+    """Verify + load one snapshot: returns ``(manifest, ids, rows)`` or
+    raises :class:`SnapshotError` with the named diagnosis.  EVERY field
+    is validated before use — a bit-rotted-but-still-JSON manifest must
+    diagnose, not escape as a bare TypeError."""
+    mpath = _manifest_path(snap_dir, name, version)
+    try:
+        raw = open(mpath, "rb").read()
+    except OSError as e:
+        raise SnapshotError("torn", f"manifest unreadable: {e}")
+    try:
+        body = json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SnapshotError("torn", f"manifest not parseable JSON ({e}) — "
+                                    f"most likely a torn write")
+    if not isinstance(body, dict) or body.get("format") != SNAPSHOT_FORMAT:
+        raise SnapshotError(
+            "format", f"missing/unknown format tag {body.get('format')!r} "
+                      f"(expected {SNAPSHOT_FORMAT})")
+    if body.get("sig") != sign_body(body, _SIGN_KEY):
+        raise SnapshotError(
+            "signature", f"manifest {mpath} was modified after signing "
+                         f"(partial write, bit rot, or tampering)")
+    # the signature covers the body, so from here on the fields are
+    # trusted AS WRITTEN — but still type-checked (an old/foreign writer)
+    try:
+        n = int(body["rows"])
+        dim = int(body["dim"])
+        crc = int(body["crc32"])
+        fp = int(body["fingerprint"])
+        base = int(body["base_version"])
+        ver = int(body["version"])
+        if n < 0 or dim <= 0 or ver != version or base < 0:
+            raise ValueError(f"inconsistent geometry rows={n} dim={dim} "
+                             f"version={ver} base={base}")
+    except (KeyError, ValueError, TypeError) as e:
+        raise SnapshotError("torn", f"manifest field invalid: {e}")
+    ppath = _payload_path(snap_dir, name, version)
+    try:
+        payload = open(ppath, "rb").read()
+    except OSError as e:
+        raise SnapshotError("torn", f"payload unreadable: {e}")
+    want = n * 8 + n * dim * 4
+    if len(payload) != want:
+        raise SnapshotError(
+            "torn", f"payload {ppath} holds {len(payload)} bytes, manifest "
+                    f"says {want} ({n} rows x dim {dim})")
+    if zlib.crc32(payload) != crc:
+        raise SnapshotError(
+            "crc", f"payload CRC mismatch on {ppath} (bit rot or partial "
+                   f"write the length check cannot see)")
+    ids = np.frombuffer(payload[:n * 8], np.int64)
+    rows = np.frombuffer(payload[n * 8:], np.float32).reshape(n, dim)
+    got_fp = host_combine([host_fingerprint(ids), host_fingerprint(rows)])
+    if got_fp != fp:
+        raise SnapshotError(
+            "fingerprint", f"content fingerprint mismatch on {ppath} "
+                           f"(CRC-colliding rewrite or foreign payload)")
+    return body, ids, rows
+
+
+def _resolve_pull(source):
+    """(pull(ids)->rows, num_embeddings, dim, drain()) for a layer or a
+    bare table — pulls BYPASS caches so a snapshot is the PS truth."""
+    if hasattr(source, "pull_rows"):        # ShardedHostEmbedding family
+        def drain():
+            fp = getattr(source, "flush_pushes", None)
+            if fp is not None:
+                fp()
+            source.flush()
+        return source.pull_rows, source.num_embeddings, source.dim, drain
+    if hasattr(source, "table"):            # staged/HBM/tiered layer
+        def drain():
+            fp = getattr(source, "flush_pushes", None)
+            if fp is not None:
+                fp()
+            source.flush()
+        return (source.table.pull, source.num_embeddings, source.dim,
+                drain)
+    if hasattr(source, "pull"):             # bare table
+        return source.pull, source.rows, source.dim, (lambda: None)
+    raise TypeError(f"cannot snapshot {type(source).__name__}: no "
+                    f"pull_rows/table/pull surface")
+
+
+class SnapshotWriter:
+    """Training-side publisher of versioned delta snapshots (module doc).
+
+    Attach to every staged embedding layer feeding the stream
+    (``layer.attach_snapshot_writer(writer)``) so pushes mark their rows
+    dirty; ``publish()`` then emits exactly the changed rows.  Versions
+    continue from whatever the snapshot dir already holds, so a restarted
+    trainer appends instead of overwriting history."""
+
+    def __init__(self, source, snap_dir: str, *, name: str = "embed"):
+        self.source = source
+        self.snap_dir = str(snap_dir)
+        self.name = str(name)
+        os.makedirs(self.snap_dir, exist_ok=True)
+        self._pull, self.num_embeddings, self.dim, self._drain = \
+            _resolve_pull(source)
+        existing = list_snapshots(self.snap_dir, self.name)
+        self.version = existing[-1] if existing else 0
+        # a RESTARTED writer re-anchors with a full snapshot: its dirty
+        # set is empty and its table state may come from a checkpoint
+        # restored to a different point than the last published version —
+        # a delta from here would silently omit every row that changed
+        # (or was reverted) in the crash window, and the follower's
+        # base-version check could never notice
+        self._force_full = bool(existing)
+        self._dirty: set = set()
+        attach = getattr(source, "attach_snapshot_writer", None)
+        if attach is not None:
+            attach(self)
+
+    def note_push(self, ids) -> None:
+        """Mark rows dirty (called by the staged layers' push path)."""
+        self._dirty.update(int(i) for i in np.asarray(ids, np.int64).ravel())
+
+    def publish(self, *, full: bool = False):
+        """Emit the next version; returns it, or None when there is
+        nothing to publish (no dirty rows and a delta was requested).
+        Version 1 is always full."""
+        self._drain()  # queued async pushes land before the table read
+        version = self.version + 1
+        full = full or version == 1 or self._force_full
+        if full:
+            ids = np.arange(self.num_embeddings, dtype=np.int64)
+        else:
+            if not self._dirty:
+                return None
+            ids = np.fromiter(sorted(self._dirty), np.int64,
+                              count=len(self._dirty))
+        rows = np.ascontiguousarray(self._pull(ids), np.float32).reshape(
+            ids.size, self.dim)
+        payload = ids.tobytes() + rows.tobytes()
+        ppath = _payload_path(self.snap_dir, self.name, version)
+        # payload BEFORE manifest: readers discover a version through its
+        # manifest, so a crash between the writes leaves it invisible
+        _atomic_write_bytes(ppath, payload)
+        body = {
+            "format": SNAPSHOT_FORMAT, "name": self.name,
+            "version": int(version),
+            "base_version": 0 if full else int(self.version),
+            "full": bool(full), "rows": int(ids.size), "dim": int(self.dim),
+            "crc32": int(zlib.crc32(payload)),
+            "fingerprint": int(host_combine([host_fingerprint(ids),
+                                             host_fingerprint(rows)])),
+            "payload": os.path.basename(ppath),
+        }
+        body["sig"] = sign_body(body, _SIGN_KEY)
+        _atomic_write_bytes(_manifest_path(self.snap_dir, self.name,
+                                           version),
+                            (json.dumps(body, sort_keys=True)
+                             + "\n").encode())
+        self._dirty.clear()
+        self.version = version
+        self._force_full = False
+        _obs_journal.record("snapshot_publish", name=self.name,
+                            version=int(version), rows=int(ids.size),
+                            bytes=len(payload), full=bool(full))
+        if _obs.enabled():
+            m = _snap_m()
+            m["ops"].labels(op="publish").inc()
+            m["rows"].labels(op="publish").inc(int(ids.size))
+        return version
+
+
+def _resolve_install(target):
+    """(set_rows(ids, rows), dim) for the serving-side store: a layer
+    with a table (+ device-tier invalidation when it has one), a sharded
+    layer, or a bare table/remote cache."""
+    inval = getattr(target, "invalidate_rows", None)
+    if hasattr(target, "tables") and hasattr(target, "set_rows"):
+        return target.set_rows, target.dim        # sharded (handles caches)
+    if hasattr(target, "table"):                  # staged/HBM/tiered layer
+        table = target.table
+
+        def install(ids, rows):
+            table.set_rows(ids, rows)
+            # the in-process HET cache re-pulls via server versions; the
+            # DEVICE tier keeps its own staleness and must be told
+            if inval is not None:
+                inval(ids)
+        return install, target.dim
+    if hasattr(target, "set_rows"):               # bare table / remote cache
+        return target.set_rows, target.dim
+    raise TypeError(f"cannot install snapshots into "
+                    f"{type(target).__name__}: no set_rows surface")
+
+
+class SnapshotFollower:
+    """Serving-side installer with a bounded-staleness gate (module doc).
+
+    The follower never trains: installs go through ``set_rows`` only,
+    so the read-only push guard on serving caches stays the invariant.
+    """
+
+    def __init__(self, target, snap_dir: str, *, name: str = "embed",
+                 staleness_bound: int | None = None,
+                 check_interval_s: float | None = None, clock=None):
+        self.target = target
+        self.snap_dir = str(snap_dir)
+        self.name = str(name)
+        if staleness_bound is None:
+            staleness_bound = int(
+                os.environ.get("HETU_TPU_EMBED_STALENESS", "0"))
+        if staleness_bound < 0:
+            raise ValueError("staleness_bound must be >= 0")
+        self.staleness_bound = int(staleness_bound)
+        # gate() throttle: how often the snapshot dir is re-listed (a
+        # per-request listdir of shared/NFS storage inside the serving
+        # lock is real latency; 0 = every call, exact).  Between checks
+        # the replica may additionally lag by whatever was published in
+        # the window — size the interval against the publish cadence.
+        if check_interval_s is None:
+            check_interval_s = float(
+                os.environ.get("HETU_TPU_EMBED_CHECK_INTERVAL", "0") or 0)
+        self.check_interval_s = float(check_interval_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self._last_check = None
+        self._install, self.dim = _resolve_install(target)
+        self.installed = 0
+
+    def available(self) -> int:
+        """Newest published version (by manifest presence; 0 = none)."""
+        versions = list_snapshots(self.snap_dir, self.name)
+        return versions[-1] if versions else 0
+
+    def lag(self) -> int:
+        """Published versions this replica is behind."""
+        return max(self.available() - self.installed, 0)
+
+    def _skip(self, version: int, reason: str) -> None:
+        _obs_journal.record("snapshot_skipped", name=self.name,
+                            version=int(version), reason=reason)
+        if _obs.enabled():
+            _snap_m()["ops"].labels(op="skip").inc()
+
+    def poll(self) -> list:
+        """Install every new intact version in order; returns the list of
+        versions installed.  A damaged version is skipped by name and the
+        previous version keeps serving; later DELTAS chained on the
+        skipped one refuse with ``missing_base`` until a full snapshot
+        re-anchors the chain (the writer's recovery path)."""
+        installed = []
+        for version in list_snapshots(self.snap_dir, self.name):
+            if version <= self.installed:
+                continue
+            try:
+                body, ids, rows = read_snapshot(self.snap_dir, self.name,
+                                                version)
+            except SnapshotError as e:
+                self._skip(version, e.reason)
+                continue
+            if int(body["dim"]) != int(self.dim):
+                self._skip(version, "geometry")
+                continue
+            if not body["full"] and int(body["base_version"]) \
+                    != self.installed:
+                # the delta's base was skipped (or never seen): applying
+                # it would silently lose the base's rows
+                self._skip(version, "missing_base")
+                continue
+            if ids.size:
+                self._install(ids, rows)
+            self.installed = version
+            installed.append(version)
+            _obs_journal.record("snapshot_install", name=self.name,
+                                version=int(version), rows=int(ids.size))
+            if _obs.enabled():
+                m = _snap_m()
+                m["ops"].labels(op="install").inc()
+                m["rows"].labels(op="install").inc(int(ids.size))
+        return installed
+
+    def gate(self) -> None:
+        """Enforce the staleness bound: poll when more than
+        ``staleness_bound`` versions behind — call before serving and a
+        replica never serves older than the bound (modulo the
+        ``check_interval_s`` freshness-check throttle, 0 by default)."""
+        if self.check_interval_s > 0:
+            now = self._clock()
+            if self._last_check is not None \
+                    and now - self._last_check < self.check_interval_s:
+                return
+            self._last_check = now
+        if self.lag() > self.staleness_bound:
+            self.poll()
+
+    def stats(self) -> dict:
+        return {"name": self.name, "installed": int(self.installed),
+                "available": int(self.available()), "lag": int(self.lag()),
+                "staleness_bound": int(self.staleness_bound)}

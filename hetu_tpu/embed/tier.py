@@ -1,0 +1,306 @@
+"""TieredEmbedding — the HBM -> host-cache -> PS embedding hierarchy.
+
+Hetu's two signature embedding results composed into one production path:
+the cache-enabled parameter server HET (VLDB'22; ``engine.CacheTable``)
+and the compression suite (VLDB'24; ``engine`` ``storage="int8"``) under
+the measured hot-row HBM cache (``layer.HBMCachedEmbedding``).  One layer,
+three tiers:
+
+- **HBM** — a fixed budget of device-resident hot rows, gathered inside
+  the jitted step (zero per-step transfer for warm rows).  Residency is
+  EARNED: a row enters HBM only after ``TierPolicy.promote_touches``
+  batches touched it (one-shot rows stop evicting the working set), and
+  rows idle for ``demote_idle`` stages are demoted so the budget tracks
+  the CURRENT hot set, not history.
+- **host cache** — the HET worker cache (bounded staleness, server-side
+  versions) absorbing the mid-frequency rows; tier-crossing pulls are
+  batched and, when prefetch is driven, run on the engine's AsyncEngine
+  thread pool so the host->HBM refresh overlaps the jitted step.
+- **PS** — the full table with the server-side optimizer; ``storage=
+  "int8"`` stores it per-row quantized (float shadow for optimizer-touched
+  rows), cutting resident and pull wire bytes ~4x at dim 64.
+
+Every tier crossing is accounted: ``hetu_embed_{hits,misses,promotions,
+evictions}_total{tier=...}`` counters, ``hetu_embed_pull_bytes_total``
+per source tier, and ``tier_promote``/``tier_demote`` journal events —
+so a tiered-vs-host A/B compares EXACT reuse, not vibes (the acceptance
+test replays the id trace through an oracle and matches the counters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from hetu_tpu.embed.layer import HBMCachedEmbedding
+from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["TierPolicy", "TieredEmbedding"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TierPolicy:
+    """Promotion/demotion policy between the HBM and host tiers.
+
+    ``promote_touches``: batches that must touch a row before it earns an
+    HBM slot (1 = promote on first touch, the plain HBM-cache behavior).
+    ``demote_idle``: stages without a touch before a resident row is
+    demoted back to the host tier (0 = never; LRU eviction under pressure
+    still applies).
+    """
+
+    promote_touches: int = 2
+    demote_idle: int = 0
+
+    def __post_init__(self):
+        if self.promote_touches < 1:
+            raise ValueError("promote_touches must be >= 1")
+        if self.demote_idle < 0:
+            raise ValueError("demote_idle must be >= 0")
+
+    @classmethod
+    def from_env(cls) -> "TierPolicy":
+        return cls(
+            promote_touches=int(
+                os.environ.get("HETU_TPU_TIER_PROMOTE_TOUCHES", "2")),
+            demote_idle=int(os.environ.get("HETU_TPU_TIER_DEMOTE_IDLE",
+                                           "0")))
+
+
+_tier_metrics = None
+
+
+def _tier_m() -> dict:
+    global _tier_metrics
+    if _tier_metrics is None:
+        reg = _obs.get_registry()
+        labels = ("tier", "table")
+        _tier_metrics = {
+            "hits": reg.counter(
+                "hetu_embed_hits_total",
+                "tiered-embedding rows served from the tier without a "
+                "deeper pull", labels),
+            "misses": reg.counter(
+                "hetu_embed_misses_total",
+                "tiered-embedding rows the tier had to pull from the "
+                "tier below", labels),
+            "promotions": reg.counter(
+                "hetu_embed_promotions_total",
+                "rows promoted INTO the tier", labels),
+            "evictions": reg.counter(
+                "hetu_embed_evictions_total",
+                "rows evicted/demoted OUT of the tier (LRU pressure + "
+                "idle demotion)", labels),
+            "pull_bytes": reg.counter(
+                "hetu_embed_pull_bytes_total",
+                "bytes pulled FROM the tier by the tier above (host: "
+                "host->HBM refresh uploads; ps: PS->host-cache wire "
+                "bytes in the table's storage form)", labels),
+        }
+    return _tier_metrics
+
+
+class TieredEmbedding(HBMCachedEmbedding):
+    """Three-level HBM -> host-cache -> PS embedding (see module doc).
+
+    Drop-in for :class:`HBMCachedEmbedding` (same staging protocol; the
+    Trainer integration, refresh leaves, and gradient path are inherited
+    unchanged) — only residency policy and accounting differ.  The host
+    tier is the HET cache ``host_capacity`` rows wide; ``storage="int8"``
+    quantizes the PS tier (see ``engine.Int8HostEmbeddingTable``).
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 hbm_capacity: int = 4096, host_capacity: int | None = None,
+                 policy: TierPolicy | None = None,
+                 hbm_pull_bound: int = 0, host_pull_bound: int = 0,
+                 storage: str = "f32", cache_policy: str = "lru",
+                 push_bound: int = 0, **kw):
+        if host_capacity is None:
+            # host tier defaults to 4x the HBM budget — wide enough that
+            # an HBM demotion lands in cache, not back on the PS
+            host_capacity = 4 * int(hbm_capacity)
+        super().__init__(
+            num_embeddings, dim, hbm_capacity=hbm_capacity,
+            hbm_pull_bound=hbm_pull_bound, cache_capacity=host_capacity,
+            policy=cache_policy, pull_bound=host_pull_bound,
+            push_bound=push_bound, storage=storage, **kw)
+        self.policy = policy if policy is not None else TierPolicy.from_env()
+        self.host_capacity = int(host_capacity)
+        th = self._handle
+        # identity-stable tier bookkeeping rides the HBM handle's object
+        # (module instances are rebuilt on every pytree unflatten)
+        th.tier = _TierState(num_embeddings)
+
+    # -- policy hooks --------------------------------------------------------
+
+    def _split_residency(self, uniq: np.ndarray):
+        """Capacity AND promotion policy: non-resident rows below the
+        touch threshold stay on the host path (no HBM insert, no
+        eviction); qualified rows compete for slots hottest-first."""
+        h = self._handle
+        t = h.tier
+        resident_mask = h.slot_of[uniq] >= 0
+        resident = uniq[resident_mask]
+        cand = uniq[~resident_mask]
+        qualified = cand[t.touches[cand] >= self.policy.promote_touches]
+        cold = cand[t.touches[cand] < self.policy.promote_touches]
+        budget = self.capacity - resident.size
+        if qualified.size > budget:
+            order = np.argsort(-t.touches[qualified], kind="stable")
+            keep = np.sort(qualified[order[:budget]])
+            spill = np.setdiff1d(qualified, keep)
+            h.overflows += int(spill.size)
+            _obs_journal.record(
+                "hbm_overflow", table=self.name,
+                batch_rows=int(uniq.size), overflow=int(spill.size),
+                capacity=int(self.capacity))
+        else:
+            keep, spill = qualified, np.empty(0, np.int64)
+        cuniq = np.sort(np.concatenate([resident, keep]))
+        return cuniq, np.union1d(cold, spill)
+
+    def _demote_idle(self, now: int) -> None:
+        pol = self.policy
+        if pol.demote_idle <= 0:
+            return
+        h = self._handle
+        t = h.tier
+        rows = h.id_of[h.id_of >= 0]
+        if not rows.size:
+            return
+        demote = rows[now - t.last_touch[rows] > pol.demote_idle]
+        if not demote.size:
+            return
+        h.id_of[h.slot_of[demote]] = -1
+        h.slot_of[demote] = -1
+        h.evictions += int(demote.size)
+        t.demotions += int(demote.size)
+        _obs_journal.record("tier_demote", table=self.name,
+                            rows=int(demote.size), tick=int(now))
+
+    # -- staging -------------------------------------------------------------
+
+    def stage(self, ids):
+        h = self._handle
+        t = h.tier
+        uniq = np.unique(np.asarray(ids, np.int64).ravel())
+        now = h.tick + 1  # super().stage bumps the tick to this value
+        t.touches[uniq] += 1
+        t.last_touch[uniq] = now
+        self._demote_idle(now)
+        pre_resident = h.slot_of[uniq] >= 0
+        host0 = self._host_stats()
+        super().stage(ids)
+        promoted = uniq[(~pre_resident) & (h.slot_of[uniq] >= 0)]
+        if promoted.size:
+            t.promotions += int(promoted.size)
+            _obs_journal.record("tier_promote", table=self.name,
+                                rows=int(promoted.size), tick=int(now))
+        host1 = self._host_stats()
+        # bytes crossing tiers this stage: every HBM miss pulls one f32
+        # row host->device; every host-cache miss pulls one row PS->host
+        # in the table's storage form (int8 wire = codes + scales)
+        hbm_missed = h.misses - t.hbm_misses_seen
+        t.hbm_misses_seen = h.misses
+        t.bytes_from_host += hbm_missed * self.dim * 4
+        ps_rows = host1["misses"] - host0["misses"]
+        t.ps_rows += ps_rows
+        t.bytes_from_ps += self.table.pull_wire_bytes(ps_rows)
+        t.stages += 1
+        if _obs.enabled():
+            self._publish(host1)
+
+    # -- accounting ----------------------------------------------------------
+
+    def _host_stats(self) -> dict:
+        if getattr(self.store, "is_het_cache", False):
+            return self.store.stats()
+        return {"hits": 0, "misses": 0, "size": 0, "hit_rate": 0.0}
+
+    def _publish(self, host: dict | None = None) -> None:
+        h = self._handle
+        t = h.tier
+        host = host if host is not None else self._host_stats()
+        m = _tier_m()
+        for tier, vals in (
+            ("hbm", {"hits": h.hits, "misses": h.misses,
+                     "promotions": t.promotions,
+                     "evictions": h.evictions}),
+            ("host", {"hits": host["hits"], "misses": host["misses"],
+                      "promotions": host["misses"],  # every miss inserts
+                      "evictions": max(host["misses"] - host["size"], 0),
+                      "pull_bytes": t.bytes_from_host}),
+            ("ps", {"hits": t.ps_rows, "misses": 0,
+                    "pull_bytes": t.bytes_from_ps}),
+        ):
+            for k, v in vals.items():
+                m[k].labels(tier=tier, table=self.name).set_total(float(v))
+
+    def tier_stats(self) -> dict:
+        """Per-tier accounting snapshot — the supported introspection
+        surface (also what ``obs.calibration.ingest_embed`` records)."""
+        h = self._handle
+        t = h.tier
+        host = self._host_stats()
+        hbm_total = h.hits + h.misses
+        host_total = host["hits"] + host["misses"]
+        if _obs.enabled():
+            self._publish(host)
+        return {
+            "table": self.name,
+            "stages": int(t.stages),
+            "hbm": {"hits": int(h.hits), "misses": int(h.misses),
+                    "hit_rate": h.hits / hbm_total if hbm_total else 0.0,
+                    "promotions": int(t.promotions),
+                    "demotions": int(t.demotions),
+                    "evictions": int(h.evictions),
+                    "overflows": int(h.overflows),
+                    "resident": int((h.id_of >= 0).sum()),
+                    "capacity": int(self.capacity)},
+            "host": {**{k: int(v) if isinstance(v, (int, np.integer))
+                        else v for k, v in host.items()},
+                     "capacity": int(self.host_capacity),
+                     "pull_bytes": int(t.bytes_from_host)},
+            "ps": {"rows_pulled": int(t.ps_rows),
+                   "pull_bytes": int(t.bytes_from_ps),
+                   "resident_bytes": int(self.table.resident_bytes()),
+                   "storage": self.table.storage},
+            "pull_bytes_per_stage": (
+                (t.bytes_from_host + t.bytes_from_ps) / t.stages
+                if t.stages else 0.0),
+        }
+
+    def seed_hot_rows(self, hot_rows) -> None:
+        """Warm the promotion policy from an external hot-row signal —
+        the PS server's ``get_loads`` top-k (``net.hot_row_signal``), so
+        a freshly-(re)built worker promotes the known-hot set on first
+        touch instead of re-learning it."""
+        t = self._handle.tier
+        for row, touches in hot_rows:
+            row = int(row)
+            if 0 <= row < self.num_embeddings:
+                t.touches[row] = max(int(t.touches[row]), int(touches),
+                                     self.policy.promote_touches)
+
+
+class _TierState:
+    """Mutable tier bookkeeping on the identity-stable HBM handle."""
+
+    __slots__ = ("touches", "last_touch", "promotions", "demotions",
+                 "stages", "bytes_from_host", "bytes_from_ps", "ps_rows",
+                 "hbm_misses_seen")
+
+    def __init__(self, num_embeddings: int):
+        self.touches = np.zeros(num_embeddings, np.int64)
+        self.last_touch = np.zeros(num_embeddings, np.int64)
+        self.promotions = 0
+        self.demotions = 0
+        self.stages = 0
+        self.bytes_from_host = 0
+        self.bytes_from_ps = 0
+        self.ps_rows = 0
+        self.hbm_misses_seen = 0

@@ -230,7 +230,15 @@ class Trainer:
                 train_step, eval_step, self._state
             )
         else:
-            donate_args = (0,) if donate else ()
+            # staged host embeddings: NEVER donate the state.  stage()
+            # re-installs leaf objects from the previous state (the reused
+            # zeros ``rows`` buffer, the HBM cache between refreshes), so
+            # donating hands XLA buffers the host-side staging protocol
+            # still references — observed as a use-after-free when the
+            # persistent compile cache serves the step executable (the
+            # deserialized aliasing config bypasses the compile-time
+            # "donated buffer not usable" rejection that masked this).
+            donate_args = (0,) if donate and not self._has_staged else ()
             train_step = jax.jit(train_step, donate_argnums=donate_args)
             eval_step = jax.jit(eval_step)
         # compile-counting seams (obs.compile watch mode: the wrapped jit

@@ -89,7 +89,7 @@ __all__ = ["GangError", "GangManifestError", "shard_owner", "ring_neighbor",
            "write_manifest", "read_manifest", "list_manifests",
            "compose_state", "load_gang_checkpoint", "prune_gang",
            "gang_data_partition", "worker_rng_key", "GangCheckpointer",
-           "GangMembership", "ElasticGang"]
+           "GangMembership", "ElasticGang", "sign_body"]
 
 
 class GangError(RuntimeError):
@@ -227,10 +227,18 @@ def save_shard(gang_dir: str, rank: int, world_size: int, step: int,
     return p
 
 
-def _sign(body: dict) -> str:
+def sign_body(body: dict, key: bytes) -> str:
+    """Content signature over a canonical (sorted-JSON, ``sig``-stripped)
+    manifest body — the gang-manifest signing rule, shared with every
+    artifact family that reuses the format (embed.stream snapshots).
+    Tamper/torn-*evidence*, not secrecy."""
     canon = json.dumps({k: v for k, v in body.items() if k != "sig"},
                        sort_keys=True).encode()
-    return hashlib.sha256(_SIGN_KEY + canon).hexdigest()
+    return hashlib.sha256(key + canon).hexdigest()
+
+
+def _sign(body: dict) -> str:
+    return sign_body(body, _SIGN_KEY)
 
 
 def write_manifest(gang_dir: str, step: int, generation: int,

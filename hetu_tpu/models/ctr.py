@@ -41,7 +41,9 @@ class CTRConfig:
                  pull_bound: int = 0, push_bound: int = 0,
                  host_bridge: str = "auto", host_async_push: bool = False,
                  servers=None, reconnect_attempts: int = 0,
-                 restore_path: str | None = None):
+                 restore_path: str | None = None, storage: str = "f32",
+                 host_cache_capacity: int | None = None,
+                 promote_touches: int = 2, demote_idle: int = 0):
         self.dense_dim = dense_dim
         self.sparse_fields = sparse_fields
         self.vocab = vocab
@@ -54,6 +56,20 @@ class CTRConfig:
         self.cache_policy = cache_policy
         self.pull_bound = pull_bound
         self.push_bound = push_bound
+        # PS storage form ("f32" | "int8" — the quantized PS tier) for the
+        # host-engine embedding modes; tier policy knobs apply to
+        # embedding="tiered" (cache_capacity = the HBM row budget there,
+        # host_cache_capacity = the host HET-cache width, default 4x)
+        if storage not in ("f32", "int8"):
+            raise ValueError(f"unknown storage {storage!r}: 'f32' or 'int8'")
+        if storage != "f32" and embedding in ("device", "remote"):
+            raise ValueError(
+                'storage="int8" is the host-PS storage knob: it needs a '
+                'host-engine embedding ("host" | "hbm" | "tiered")')
+        self.storage = storage
+        self.host_cache_capacity = host_cache_capacity
+        self.promote_touches = promote_touches
+        self.demote_idle = demote_idle
         # "callback" = io_callback bridge inside jit; "staged" = pull/push
         # outside jit (works on backends without host callbacks, e.g. the
         # tunneled axon TPU); "auto" picks per backend.
@@ -93,6 +109,23 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
             pull_bound=cfg.pull_bound, push_bound=cfg.push_bound,
             reconnect_attempts=cfg.reconnect_attempts,
             restore_path=cfg.restore_path)
+    if cfg.embedding == "tiered":
+        # the full production hierarchy: HBM hot rows over the host HET
+        # cache over the (optionally int8-quantized) PS table, with
+        # touch-frequency promotion/demotion (embed.tier)
+        from hetu_tpu.embed import TieredEmbedding, TierPolicy
+        if cfg.cache_capacity <= 0:
+            raise ValueError('embedding="tiered" needs cache_capacity > 0 '
+                             "(the HBM-resident row budget)")
+        return TieredEmbedding(
+            cfg.vocab, dim, hbm_capacity=cfg.cache_capacity,
+            host_capacity=cfg.host_cache_capacity,
+            policy=TierPolicy(promote_touches=cfg.promote_touches,
+                              demote_idle=cfg.demote_idle),
+            hbm_pull_bound=cfg.pull_bound, host_pull_bound=cfg.pull_bound,
+            storage=cfg.storage, cache_policy=cfg.cache_policy,
+            push_bound=cfg.push_bound, optimizer=cfg.host_optimizer,
+            lr=cfg.host_lr, seed=seed)
     if cfg.embedding == "hbm":
         # host store + hot rows staged into device HBM (the north-star
         # layout; warm steps transfer only refreshed rows).  The device
@@ -104,7 +137,7 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
         return HBMCachedEmbedding(
             cfg.vocab, dim, optimizer=cfg.host_optimizer, lr=cfg.host_lr,
             seed=seed, hbm_capacity=cfg.cache_capacity,
-            hbm_pull_bound=cfg.pull_bound)
+            hbm_pull_bound=cfg.pull_bound, storage=cfg.storage)
     if cfg.embedding == "host":
         bridge = cfg.host_bridge
         if bridge == "auto":
@@ -114,7 +147,7 @@ def make_embedding(cfg: CTRConfig, dim: int | None = None, seed: int = 0):
         kw = dict(optimizer=cfg.host_optimizer, lr=cfg.host_lr, seed=seed,
                   cache_capacity=cfg.cache_capacity,
                   policy=cfg.cache_policy, pull_bound=cfg.pull_bound,
-                  push_bound=cfg.push_bound)
+                  push_bound=cfg.push_bound, storage=cfg.storage)
         if cls is StagedHostEmbedding:
             kw["async_push"] = cfg.host_async_push
         elif cfg.host_async_push:

@@ -132,6 +132,11 @@ DEFAULT_THRESHOLDS = {
     "prefill_mean_s": ("high", 1.25),
     "decode_mean_s": ("high", 1.25),
     "ttft_p99_s": ("high", 1.25),
+    # tiered embedding (PR 15): cache efficiency dropping or pull traffic
+    # growing past the baseline regresses the CTR path
+    "hbm_hit_rate": ("low", 0.90),
+    "host_hit_rate": ("low", 0.90),
+    "pull_bytes_per_stage": ("high", 1.15),
 }
 
 
@@ -688,6 +693,32 @@ class ProfileStore:
         return self.put("ops", values, model_sig=model_sig,
                         mesh_sig=mesh_sig, policy=policy,
                         device_kind=device_kind, source="exec.profiler")
+
+    def ingest_embed(self, embedding, *, model_sig: str, mesh_sig: str = "",
+                     policy: str = "",
+                     device_kind: Optional[str] = None) -> dict:
+        """One ``embed`` record from a
+        :class:`~hetu_tpu.embed.tier.TieredEmbedding` (or its
+        ``tier_stats()`` dict): per-tier hit rates, pull bytes/step, and
+        PS resident bytes — the CTR-path signals the regression sentinel
+        grades (a hit-rate drop >10% or pull-traffic growth >15% against
+        the stored baseline journals ``perf_regression``)."""
+        stats = embedding if isinstance(embedding, Mapping) \
+            else embedding.tier_stats()
+        values = {
+            "hbm_hit_rate": float(stats["hbm"]["hit_rate"]),
+            "host_hit_rate": float(stats["host"]["hit_rate"]),
+            "pull_bytes_per_stage": float(stats["pull_bytes_per_stage"]),
+            "ps_resident_bytes": float(stats["ps"]["resident_bytes"]),
+            "hbm_resident": float(stats["hbm"]["resident"]),
+            "promotions": float(stats["hbm"]["promotions"]),
+            "demotions": float(stats["hbm"]["demotions"]),
+            "evictions": float(stats["hbm"]["evictions"]),
+            "stages": float(stats["stages"]),
+        }
+        return self.put("embed", values, model_sig=model_sig,
+                        mesh_sig=mesh_sig, policy=policy,
+                        device_kind=device_kind, source="embed.tier")
 
     def ingest_bench_line(self, rec: Mapping, *,
                           device_kind: Optional[str] = None) -> dict:

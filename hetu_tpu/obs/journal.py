@@ -189,6 +189,16 @@ EVENT_KINDS = {
                              "dst"}),
     "migrate_verify_failed": frozenset({"request_id", "reason"}),
     "role_assign": frozenset({"replica", "role"}),
+    # tiered embedding fabric (PR 15): HBM -> host -> PS hot-row tiering
+    # + streaming versioned snapshots to read-only serving replicas
+    "hbm_overflow": frozenset({"table", "batch_rows", "overflow",
+                               "capacity"}),
+    "tier_promote": frozenset({"table", "rows", "tick"}),
+    "tier_demote": frozenset({"table", "rows", "tick"}),
+    "snapshot_publish": frozenset({"name", "version", "rows", "bytes",
+                                   "full"}),
+    "snapshot_install": frozenset({"name", "version", "rows"}),
+    "snapshot_skipped": frozenset({"name", "version", "reason"}),
     # performance calibration plane (PR 12)
     "calibration_update": frozenset({"record_kind", "key", "version"}),
     "perf_regression": frozenset(

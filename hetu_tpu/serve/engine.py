@@ -229,7 +229,8 @@ class ServingEngine:
                  controller=None, prefix_sharing: Optional[bool] = None,
                  draft_model=None, spec_k: Optional[int] = None,
                  role: Optional[str] = None,
-                 prefill_tick_cost: Optional[float] = None):
+                 prefill_tick_cost: Optional[float] = None,
+                 ctr_follower=None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -332,6 +333,14 @@ class ServingEngine:
         self.ctr_model = ctr_model
         if ctr_model is not None:
             _mark_stores_read_only(ctr_model)
+        # streaming freshness (embed.stream): a SnapshotFollower over the
+        # CTR model's stores — infer_ctr gates on it, so training pushes
+        # reach this read-only replica within the staleness bound without
+        # the stores ever training in place
+        if ctr_follower is not None and ctr_model is None:
+            raise ValueError("ctr_follower needs a ctr_model to install "
+                             "snapshots into")
+        self.ctr_follower = ctr_follower
         # closed-loop remediation (exec.controller): the attached (or
         # process-wide installed) RuntimeController runs once per
         # scheduler tick — shed latch on sustained SLO burn, bucket
@@ -1054,6 +1063,11 @@ class ServingEngine:
         # the HTTP front end is one-thread-per-request: serialize against
         # both concurrent CTR calls and the generation scheduler
         with self._lock:
+            if self.ctr_follower is not None:
+                # bounded staleness: install pending snapshot versions
+                # BEFORE staging, so this batch never serves older than
+                # the bound
+                self.ctr_follower.gate()
             for mod in _staged_modules(self.ctr_model):
                 mod.stage(sparse_np)
             logits = self.ctr_model.logits(dense, jnp.asarray(sparse_np))
@@ -1061,6 +1075,30 @@ class ServingEngine:
         return np.asarray(jax.nn.sigmoid(logits))
 
     # -- introspection ------------------------------------------------------
+
+    def _embedding_stats(self) -> dict:
+        """Embedding hit rates for ``/stats`` — tier stats for tiered
+        layers, HBM hit stats otherwise, aggregated shard-cache stats as
+        the fallback — beside the snapshot follower's freshness, so the
+        CTR replica's cache efficiency scrapes next to the prefix-cache
+        rates.  Reading the stats also refreshes the registry mirror
+        (publish_cache_stats / the hetu_embed_* families), so
+        ``/fleet/metrics`` carries the same numbers."""
+        tables = []
+        for mod in _staged_modules(self.ctr_model):
+            fn = None
+            for attr in ("tier_stats", "hit_stats", "stats"):
+                fn = getattr(mod, attr, None)
+                if fn is not None:
+                    break
+            if fn is None:
+                # plain staged layer: the stats live on its HET cache
+                fn = getattr(getattr(mod, "store", None), "stats", None)
+            if fn is not None:
+                tables.append(fn())
+        return {"tables": tables,
+                "snapshot": (None if self.ctr_follower is None
+                             else self.ctr_follower.stats())}
 
     def stats(self) -> dict:
         """The ``/stats`` payload: scheduler + pool occupancy, the
@@ -1098,6 +1136,8 @@ class ServingEngine:
                 "migrations": dict(self._migrations),
                 "prefix": (None if self.sharer is None
                            else self.sharer.stats()),
+                "embedding": (None if self.ctr_model is None
+                              else self._embedding_stats()),
                 "speculative": (None if self.spec is None
                                 else self.spec.stats()),
                 "pool": self.pool.utilization(),
@@ -1125,13 +1165,15 @@ def _mark_stores_read_only(model) -> None:
     (``push_bound > 0``) and queued async pushes — drain them FIRST, so
     flipping the flag freezes the table instead of silently dropping the
     tail of training."""
-    from hetu_tpu.embed.engine import CacheTable
     for mod in _staged_modules(model):
         flush_pushes = getattr(mod, "flush_pushes", None)
         if flush_pushes is not None:
             flush_pushes()
         stores = getattr(mod, "stores", None) or [getattr(mod, "store", None)]
         for st in stores:
-            if isinstance(st, CacheTable):
+            # engine CacheTable or PythonCacheTable (int8 tables) — the
+            # shared is_het_cache duck tag
+            if getattr(st, "is_het_cache", False) \
+                    and hasattr(st, "read_only"):
                 st.flush()  # apply buffered grads before freezing
                 st.read_only = True

@@ -126,6 +126,14 @@ def pytest_configure(config):
                    "in-process smoke, record-integrity, and bitwise-vs-"
                    "colocated checks stay in tier-1 — the multi-process "
                    "file-fabric chaos rides the slow tier")
+    config.addinivalue_line(
+        "markers", "embed_tier: tiered embedding fabric tests "
+                   "(embed.tier HBM->host->PS promotion/demotion, "
+                   "embed.engine int8 PS storage, embed.stream versioned "
+                   "snapshots); the 2-tier promote/demote smoke, quant "
+                   "round-trip, counter-exactness oracle, and one "
+                   "snapshot publish->install cycle stay in tier-1 — "
+                   "multi-process PS chaos rides the slow tier")
 
 
 @pytest.fixture(autouse=True)

@@ -233,6 +233,48 @@ class TestServeMode:
             bench.main()
         assert probed == []  # usage errors never touch the backend
 
+    def test_ctr_mode_cli_gate_and_preflight(self, monkeypatch):
+        """--mode ctr: usage errors exit before the preflight; the tiered
+        A/B runs BEHIND it (a dead tunnel must never record a bogus
+        vs_baseline round or calibration baseline)."""
+        probed = []
+        monkeypatch.setattr(bench, "_require_backend_alive",
+                            lambda *a, **k: probed.append(1))
+        for argv, msg in ((["--mode", "ctr", "--embedding", "paged"],
+                           "unknown embedding"),
+                          (["--mode", "ctr", "--embedding"],
+                           "--embedding needs"),
+                          (["--mode", "ctr", "--storage", "f64"],
+                           "unknown storage"),
+                          (["--mode", "ctr", "resnet"],
+                           "takes no config")):
+            monkeypatch.setattr(bench.sys, "argv", ["bench.py"] + argv)
+            with pytest.raises(SystemExit, match=msg):
+                bench.main()
+        assert probed == []  # usage errors never touch the backend
+
+        order = []
+        monkeypatch.setattr(bench, "_require_backend_alive",
+                            lambda *a, **k: order.append("preflight"))
+        monkeypatch.setattr(
+            bench, "bench_ctr_tiered",
+            lambda on_tpu, kind, peak, storage: order.append(
+                f"tiered:{storage}"))
+        monkeypatch.setattr(bench.sys, "argv",
+                            ["bench.py", "--mode", "ctr", "--embedding",
+                             "tiered", "--storage", "int8"])
+        bench.main()
+        assert order == ["preflight", "tiered:int8"]
+
+        def dead(*a, **k):
+            raise SystemExit(bench.PREFLIGHT_RC)
+
+        monkeypatch.setattr(bench, "_require_backend_alive", dead)
+        order.clear()
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == bench.PREFLIGHT_RC and order == []
+
     def test_serve_mode_runs_behind_preflight(self, monkeypatch, capture):
         """--mode serve goes through the SAME fast-fail preflight as the
         training configs: a dead tunnel means rc=3 and NO stdout metric."""

@@ -137,8 +137,51 @@ def test_eviction_and_thrash():
     emb.stage(jnp.asarray([[4, 5]]))  # evicts two LRU rows
     assert emb.hit_stats()["resident"] == 4
     assert emb._handle.slot_of[4] >= 0 and emb._handle.slot_of[5] >= 0
-    with pytest.raises(ValueError, match="unique rows > hbm_capacity"):
-        emb.stage(jnp.asarray([[1, 2, 3, 4, 5]]))
+
+
+def test_overflow_falls_back_to_host_path():
+    """A batch touching more unique rows than hbm_capacity degrades to the
+    host path for the overflow rows (journaled) instead of killing the
+    step — and still serves every row's correct value."""
+    from hetu_tpu.obs import journal as obs_journal
+
+    emb = HBMCachedEmbedding(100, 4, hbm_capacity=4, init_scale=1.0)
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        ids = jnp.asarray([[1, 2, 3, 4, 5, 6]])  # 6 unique > 4 slots
+        emb.stage(ids)
+        got = np.asarray(emb(ids))[0]
+    np.testing.assert_allclose(
+        got, emb.table.pull(np.arange(1, 7)), rtol=1e-6)
+    st = emb.hit_stats()
+    assert st["overflows"] == 2 and st["resident"] == 4
+    ev = [e for e in j.events if e["kind"] == "hbm_overflow"]
+    assert len(ev) == 1 and ev[0]["overflow"] == 2 \
+        and ev[0]["capacity"] == 4 and ev[0]["batch_rows"] == 6
+    # gradients still reach the host engine for ALL rows, incl. overflow
+    emb.push_grads(jnp.ones(tuple(ids.shape) + (4,), jnp.float32))
+    # and the next small batch is back on the pure-HBM path
+    emb.stage(jnp.asarray([[1, 2]]))
+    assert np.asarray(emb.rows).max() == 0.0  # leaf back to zeros
+
+
+def test_overflow_trains_like_staged_oracle():
+    """Regression for the fallback math: a training run whose EVERY batch
+    overflows (capacity 2) must still match the plain staged path exactly
+    under strict freshness — the overflow rows are just staged transfers."""
+    set_random_seed(0)
+    l_ref, tr_ref = _train(StagedHostEmbedding(50, 4, optimizer="adagrad",
+                                               lr=0.05, seed=7))
+    set_random_seed(0)
+    l_hbm, tr_hbm = _train(HBMCachedEmbedding(50, 4, optimizer="adagrad",
+                                              lr=0.05, seed=7,
+                                              hbm_capacity=2,
+                                              hbm_pull_bound=0))
+    np.testing.assert_allclose(l_hbm, l_ref, rtol=1e-5)
+    ids = np.arange(50)
+    np.testing.assert_allclose(
+        tr_hbm.state.model.emb.table.pull(ids),
+        tr_ref.state.model.emb.table.pull(ids), rtol=1e-5)
 
 
 def test_ctr_config_hbm_path():

@@ -1,0 +1,418 @@
+"""Streaming embedding snapshots: training pushes -> read-only serving.
+
+Acceptance: training-side pushes are visible on a read-only serving
+replica within the staleness bound, snapshot install is bitwise-
+replayable same-seed, and torn/tampered snapshots are skipped with a
+NAMED diagnosis while the previous version keeps serving.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.embed import (ShardedHostEmbedding, SnapshotFollower,
+                            SnapshotWriter, StagedHostEmbedding,
+                            TieredEmbedding, TierPolicy)
+from hetu_tpu.embed.stream import (SnapshotError, _manifest_path,
+                                   _payload_path, read_snapshot, sign_body,
+                                   _SIGN_KEY)
+from hetu_tpu.obs import journal as obs_journal
+
+pytestmark = pytest.mark.embed_tier
+
+
+def _trainer_side(tmp, seed=3, dim=8, rows=50):
+    src = StagedHostEmbedding(rows, dim, optimizer="sgd", lr=1.0, seed=seed)
+    return src, SnapshotWriter(src, tmp, name="wdl")
+
+
+def _push(src, ids, value=1.0):
+    ids = np.asarray(ids, np.int64).reshape(1, -1)
+    src.stage(jnp.asarray(ids))
+    src.push_grads(np.full(ids.shape + (src.dim,), value, np.float32))
+
+
+def test_publish_install_cycle():
+    """Tier-1 smoke: full bootstrap + one delta reach a replica with a
+    DIFFERENT init; both sides journal; deltas carry only changed rows."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        src, w = _trainer_side(tmp)
+        assert w.publish() == 1                     # full bootstrap
+        dst = StagedHostEmbedding(50, 8, seed=99)   # different init
+        f = SnapshotFollower(dst, tmp, name="wdl")
+        assert f.poll() == [1]
+        np.testing.assert_allclose(dst.table.pull(np.arange(50)),
+                                   src.table.pull(np.arange(50)), rtol=1e-6)
+        _push(src, [1, 2])
+        assert w.publish() == 2                     # delta
+        body, ids, _ = read_snapshot(tmp, "wdl", 2)
+        assert not body["full"] and ids.tolist() == [1, 2]
+        assert f.poll() == [2]
+        np.testing.assert_allclose(dst.table.pull(np.arange(50)),
+                                   src.table.pull(np.arange(50)), rtol=1e-6)
+        # nothing dirty -> nothing published
+        assert w.publish() is None
+    kinds = [e["kind"] for e in j.events]
+    assert kinds.count("snapshot_publish") == 2
+    assert kinds.count("snapshot_install") == 2
+
+
+def test_staleness_bound_never_violated():
+    """With bound k, a replica that gates before every serve is never
+    more than k published versions behind — and with bound 0 it is
+    always current."""
+    import tempfile
+    for bound in (0, 2):
+        tmp = tempfile.mkdtemp()
+        src, w = _trainer_side(tmp)
+        dst = StagedHostEmbedding(50, 8, seed=99)
+        f = SnapshotFollower(dst, tmp, name="wdl", staleness_bound=bound)
+        for step in range(6):
+            _push(src, [step % 5])
+            w.publish()
+            f.gate()                      # the serving-side pre-batch hook
+            assert f.available() - f.installed <= bound, (bound, f.stats())
+        # the gate catches up exactly when the bound is exceeded
+        if bound == 0:
+            np.testing.assert_allclose(
+                dst.table.pull(np.arange(50)),
+                src.table.pull(np.arange(50)), rtol=1e-6)
+
+
+def test_env_var_staleness_bound(monkeypatch):
+    import tempfile
+    monkeypatch.setenv("HETU_TPU_EMBED_STALENESS", "3")
+    f = SnapshotFollower(StagedHostEmbedding(10, 4), tempfile.mkdtemp())
+    assert f.staleness_bound == 3
+
+
+def test_torn_tampered_skipped_by_name():
+    """The corruption triad + chain semantics: every damage class is
+    diagnosed BY NAME, journaled ``snapshot_skipped``, and the previous
+    version keeps serving; a full snapshot re-anchors a broken chain."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    src, w = _trainer_side(tmp)
+    w.publish()                                     # v1 full
+    dst = StagedHostEmbedding(50, 8, seed=99)
+    f = SnapshotFollower(dst, tmp, name="wdl")
+    f.poll()
+    served_v1 = dst.table.pull(np.arange(50)).copy()
+
+    _push(src, [1])
+    w.publish()                                     # v2 delta
+    # (a) torn manifest: truncate to garbage
+    with open(_manifest_path(tmp, "wdl", 2), "wb") as fh:
+        fh.write(b'{"format": "hetu-embed-sna')
+    # (b) v3: payload bit flip -> crc
+    _push(src, [2])
+    w.publish()
+    p3 = _payload_path(tmp, "wdl", 3)
+    raw = bytearray(open(p3, "rb").read())
+    raw[5] ^= 0x40
+    with open(p3, "wb") as fh:
+        fh.write(bytes(raw))
+    # (c) v4: manifest field tampered after signing -> signature
+    _push(src, [3])
+    w.publish()
+    m4 = _manifest_path(tmp, "wdl", 4)
+    body = json.loads(open(m4).read())
+    body["rows"] = body["rows"] + 7     # tampered after signing
+    with open(m4, "w") as fh:
+        fh.write(json.dumps(body, sort_keys=True))
+    # (d) v5: wrong fingerprint but correctly re-signed -> fingerprint
+    _push(src, [4])
+    w.publish()
+    m5 = _manifest_path(tmp, "wdl", 5)
+    body = json.loads(open(m5).read())
+    body["fingerprint"] = (body["fingerprint"] + 1) % (1 << 32)
+    body["sig"] = sign_body(body, _SIGN_KEY)
+    with open(m5, "w") as fh:
+        fh.write(json.dumps(body, sort_keys=True))
+    # (e) v6: intact delta — but its base (v5) was skipped
+    _push(src, [5])
+    w.publish()
+
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        installed = f.poll()
+    assert installed == []                          # nothing usable landed
+    assert f.installed == 1
+    np.testing.assert_allclose(dst.table.pull(np.arange(50)), served_v1,
+                               rtol=0, atol=0)      # v1 kept serving, intact
+    reasons = {e["version"]: e["reason"] for e in j.events
+               if e["kind"] == "snapshot_skipped"}
+    assert reasons == {2: "torn", 3: "crc", 4: "signature",
+                       5: "fingerprint", 6: "missing_base"}
+
+    # recovery: the writer publishes a FULL snapshot; the chain re-anchors
+    with obs_journal.use(j):
+        v = w.publish(full=True)
+        assert f.poll() == [v]
+    np.testing.assert_allclose(dst.table.pull(np.arange(50)),
+                               src.table.pull(np.arange(50)), rtol=1e-6)
+
+
+def test_geometry_mismatch_skipped():
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    _, w = _trainer_side(tmp, dim=8)
+    w.publish()
+    wrong = StagedHostEmbedding(50, 4, seed=1)      # dim 4 != 8
+    f = SnapshotFollower(wrong, tmp, name="wdl")
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        assert f.poll() == []
+    assert [e["reason"] for e in j.events
+            if e["kind"] == "snapshot_skipped"] == ["geometry"]
+
+
+def test_publish_bitwise_replayable():
+    """Same seed, same trajectory -> byte-identical artifacts (manifest
+    AND payload), so snapshot install replays bitwise."""
+    import tempfile
+
+    def run(tmp):
+        set_random_seed(0)
+        src, w = _trainer_side(tmp, seed=11)
+        w.publish()
+        for step in range(3):
+            _push(src, [step, step + 7], value=0.5)
+            w.publish()
+        return sorted(os.listdir(tmp))
+
+    t1, t2 = tempfile.mkdtemp(), tempfile.mkdtemp()
+    files1, files2 = run(t1), run(t2)
+    assert files1 == files2 and len(files1) == 8    # 4 versions x 2 files
+    for fn in files1:
+        b1 = open(os.path.join(t1, fn), "rb").read()
+        b2 = open(os.path.join(t2, fn), "rb").read()
+        assert b1 == b2, f"{fn} differs between same-seed runs"
+
+
+def test_restarted_writer_reanchors_with_full_snapshot():
+    """A writer constructed over an existing version line publishes FULL
+    first: its dirty set is empty and its table may be checkpoint-
+    restored to a different point than the last published version — a
+    delta would silently omit the crash window's changes and the
+    follower's base check could never notice."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    src, w = _trainer_side(tmp)
+    w.publish()
+    _push(src, [1])
+    w.publish()
+    # "restart": fresh writer, table rolled back (simulates checkpoint
+    # restore to a pre-push point)
+    src2 = StagedHostEmbedding(50, 8, optimizer="sgd", lr=1.0, seed=3)
+    w2 = SnapshotWriter(src2, tmp, name="wdl")
+    _push(src2, [7])
+    v = w2.publish()                    # delta requested implicitly
+    body, ids, _ = read_snapshot(tmp, "wdl", v)
+    assert body["full"] and ids.size == 50      # re-anchored
+    # the next publish is a delta again
+    _push(src2, [9])
+    body, ids, _ = read_snapshot(tmp, "wdl", w2.publish())
+    assert not body["full"] and ids.tolist() == [9]
+    dst = StagedHostEmbedding(50, 8, seed=99)
+    f = SnapshotFollower(dst, tmp, name="wdl")
+    f.poll()
+    np.testing.assert_allclose(dst.table.pull(np.arange(50)),
+                               src2.table.pull(np.arange(50)), rtol=1e-6)
+
+
+def test_gate_check_interval_throttles_listdir():
+    """check_interval_s bounds how often gate() re-lists the snapshot
+    dir (the serving hot path holds the engine lock through it)."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    src, w = _trainer_side(tmp)
+    w.publish()
+    now = [0.0]
+    dst = StagedHostEmbedding(50, 8, seed=99)
+    f = SnapshotFollower(dst, tmp, name="wdl", check_interval_s=5.0,
+                         clock=lambda: now[0])
+    f.gate()
+    assert f.installed == 1
+    _push(src, [1])
+    w.publish()
+    f.gate()                            # inside the interval: no listdir
+    assert f.installed == 1
+    now[0] = 6.0
+    f.gate()                            # interval elapsed: catches up
+    assert f.installed == 2
+
+
+def test_snapshot_error_unknown_version():
+    import tempfile
+    with pytest.raises(SnapshotError) as ei:
+        read_snapshot(tempfile.mkdtemp(), "wdl", 1)
+    assert ei.value.reason == "torn"
+
+
+def test_sharded_replica_install():
+    """Follower over a sharded serving replica: set_rows routes across
+    shard tables (and through shard caches where they support it)."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    src, w = _trainer_side(tmp, rows=40)
+    w.publish()
+    dst = ShardedHostEmbedding(40, 8, n_shards=3, seed=5)
+    f = SnapshotFollower(dst, tmp, name="wdl")
+    assert f.poll() == [1]
+    np.testing.assert_allclose(dst.pull_rows(np.arange(40)),
+                               src.table.pull(np.arange(40)), rtol=1e-6)
+
+
+def test_tiered_replica_invalidates_device_rows():
+    """Install into a tiered replica: the PS write alone would leave the
+    HBM copy serving pre-install values within its staleness bound — the
+    follower's invalidate hook forces the re-pull."""
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    src, w = _trainer_side(tmp)
+    w.publish()
+    dst = TieredEmbedding(50, 8, hbm_capacity=16, host_capacity=32,
+                          policy=TierPolicy(promote_touches=1),
+                          hbm_pull_bound=10, seed=99)   # loose bound
+    f = SnapshotFollower(dst, tmp, name="wdl")
+    f.poll()
+    ids = jnp.asarray([[1, 2]])
+    dst.stage(ids)                                  # rows now HBM-resident
+    dst._handle.ids = None
+    _push(src, [1, 2])
+    w.publish()
+    f.poll()
+    dst.stage(ids)                                  # bound would allow stale
+    got = np.asarray(dst(ids))[0]
+    np.testing.assert_allclose(got, src.table.pull(np.array([1, 2])),
+                               rtol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_multiprocess_publish_crash_atomicity(tmp_path):
+    """PS chaos across processes: a writer process killed MID-PUBLISH
+    (payload landed, manifest write aborted) leaves the directory with
+    no trace of the torn version — a concurrently-polling follower never
+    observes a partial artifact, and a restarted writer continues the
+    version line cleanly."""
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    snap = str(tmp_path / "snaps")
+    script = textwrap.dedent(f"""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import numpy as np
+        import jax.numpy as jnp
+        from hetu_tpu.embed import StagedHostEmbedding, SnapshotWriter
+        from hetu_tpu.embed import stream as S
+        from hetu_tpu.exec import checkpoint as C
+
+        src = StagedHostEmbedding(50, 8, optimizer="sgd", lr=1.0, seed=3)
+        w = SnapshotWriter(src, {snap!r}, name="wdl")
+        real = C._atomic_write_bytes
+        def dying(path, *chunks):
+            # die exactly on version 3's MANIFEST write (payload landed)
+            if path.endswith(".v000003.json"):
+                os._exit(7)
+            real(path, *chunks)
+        S._atomic_write_bytes = dying
+        for step in range(5):
+            src.stage(jnp.asarray(np.asarray([[step]], np.int64)))
+            src.push_grads(np.ones((1, 1, 8), np.float32))
+            w.publish()
+        """)
+    rc = subprocess.run([_sys.executable, "-c", script],
+                        cwd=os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__)))).returncode
+    assert rc == 7                                  # died mid-publish of v3
+    from hetu_tpu.embed.stream import list_snapshots
+    assert list_snapshots(snap, "wdl") == [1, 2]    # v3 invisible
+    assert os.path.exists(_payload_path(snap, "wdl", 3))  # orphan payload
+    dst = StagedHostEmbedding(50, 8, seed=99)
+    f = SnapshotFollower(dst, snap, name="wdl")
+    assert f.poll() == [1, 2]                       # clean install, no skips
+    # a restarted writer continues from the last VISIBLE version and its
+    # v3 atomically replaces the orphan payload
+    src2 = StagedHostEmbedding(50, 8, optimizer="sgd", lr=1.0, seed=3)
+    w2 = SnapshotWriter(src2, snap, name="wdl")
+    assert w2.version == 2
+    assert w2.publish(full=True) == 3
+    assert f.poll() == [3]
+    np.testing.assert_allclose(dst.table.pull(np.arange(50)),
+                               src2.table.pull(np.arange(50)), rtol=1e-6)
+
+
+class TestServingIntegration:
+    def test_follower_gated_ctr_serving(self):
+        """The full streaming story on a read-only CTR replica: training
+        pushes become fresh predictions within the bound, the stores
+        never train in place, and /stats carries the embedding section."""
+        import tempfile
+
+        from hetu_tpu.models.ctr import CTRConfig, WideDeep
+        from hetu_tpu.serve import ServingEngine
+        from tests.test_serve import tiny_gpt
+
+        tmp = tempfile.mkdtemp()
+        set_random_seed(0)
+        # training side
+        train_cfg = CTRConfig(dense_dim=4, sparse_fields=3, vocab=50,
+                              embed_dim=4, mlp_hidden=16, embedding="host",
+                              host_bridge="staged", host_optimizer="sgd",
+                              host_lr=1.0)
+        train_model = WideDeep(train_cfg)
+        writer = SnapshotWriter(train_model.embed, tmp, name="ctr")
+        writer.publish()
+        # serving side: same dense params (state_dict copy), own PS
+        set_random_seed(0)
+        serve_model = WideDeep(CTRConfig(
+            dense_dim=4, sparse_fields=3, vocab=50, embed_dim=4,
+            mlp_hidden=16, embedding="host", host_bridge="staged",
+            cache_capacity=16))
+        follower = SnapshotFollower(serve_model.embed, tmp, name="ctr",
+                                    staleness_bound=0)
+        eng = ServingEngine(tiny_gpt(), num_slots=1, page_size=8,
+                            max_seq_len=32, ctr_model=serve_model,
+                            ctr_follower=follower)
+        dense = np.zeros((2, 4), np.float32)
+        sparse = [[1, 2, 3], [4, 5, 6]]
+        p0 = eng.infer_ctr(dense, sparse)
+        assert follower.installed == 1              # gate bootstrapped v1
+        # train: push a fat gradient, publish — next infer must see it
+        ids = np.asarray([[1, 2, 3]])
+        train_model.embed.stage(jnp.asarray(ids))
+        train_model.embed.push_grads(
+            np.full((1, 3, 4), 5.0, np.float32))
+        writer.publish()
+        p1 = eng.infer_ctr(dense, sparse)
+        assert follower.installed == 2
+        assert abs(float(p1[0]) - float(p0[0])) > 1e-4  # fresh weights
+        # the read-only invariant survived the whole stream
+        with pytest.raises(RuntimeError, match="read-only"):
+            serve_model.embed.store.push([1], np.zeros((1, 4), np.float32))
+        st = eng.stats()
+        assert st["embedding"]["snapshot"]["installed"] == 2
+        assert st["embedding"]["tables"]            # cache stats present
+
+    def test_ctr_follower_requires_ctr_model(self):
+        import tempfile
+
+        from hetu_tpu.serve import ServingEngine
+        from tests.test_serve import tiny_gpt
+
+        f = SnapshotFollower(StagedHostEmbedding(10, 4), tempfile.mkdtemp())
+        with pytest.raises(ValueError, match="ctr_model"):
+            ServingEngine(tiny_gpt(), num_slots=1, page_size=8,
+                          max_seq_len=32, ctr_follower=f)

@@ -1,0 +1,419 @@
+"""Tiered embedding fabric: HBM -> host -> PS tiering + int8 PS storage.
+
+The A/B acceptance bar is EXACT accounting: the per-tier hit counters
+must match what an independent replay of the id trace computes (no
+vibes), the int8 tier must hit its byte-reduction floor with the quality
+delta bounded, and strict-freshness tiering must train bit-compatibly
+with the plain staged path (the tier is a transport optimization, not a
+semantics change).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.core.module import Module
+from hetu_tpu.embed import (HostEmbeddingTable, Int8HostEmbeddingTable,
+                            StagedHostEmbedding, TieredEmbedding,
+                            TierPolicy)
+from hetu_tpu.embed.compress.quant import dequantize_rows, quantize_rows
+from hetu_tpu.exec import Trainer
+from hetu_tpu.layers import Linear
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.ops import binary_cross_entropy_with_logits
+from hetu_tpu.optim import AdamOptimizer
+
+pytestmark = pytest.mark.embed_tier
+
+
+# ------------------------------------------------------------ tier policy
+
+class Tiny(Module):
+    def __init__(self, emb):
+        self.emb = emb
+        self.head = Linear(4 * 3, 1)
+
+    def loss(self, sp, y):
+        e = self.emb(sp).reshape(sp.shape[0], -1)
+        return binary_cross_entropy_with_logits(self.head(e)[:, 0], y).mean()
+
+
+def _train(emb, steps=12, batch=16):
+    set_random_seed(0)
+    model = Tiny(emb)
+    tr = Trainer(model, AdamOptimizer(1e-2),
+                 lambda m, b, k: (m.loss(b["sp"], b["y"]), {}))
+    rng = np.random.default_rng(0)
+    sp = np.minimum(rng.zipf(1.5, (64, 3)) - 1, 49).astype(np.int32)
+    y = (sp.sum(1) % 2).astype(np.float32)
+    losses = []
+    for s in range(steps):
+        lo = (s * batch) % (len(y) - batch)
+        b = {"sp": jnp.asarray(sp[lo:lo + batch]),
+             "y": jnp.asarray(y[lo:lo + batch])}
+        for m in tr.staged_modules():
+            m.stage(b["sp"])
+        losses.append(float(tr.step(b)["loss"]))
+    return losses, tr
+
+
+def test_promote_demote_smoke():
+    """Tier-1 smoke: a row earns HBM residency on its promote_touches-th
+    batch, idles out after demote_idle stages, and both transitions are
+    journaled."""
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        emb = TieredEmbedding(100, 8, hbm_capacity=8, host_capacity=32,
+                              policy=TierPolicy(promote_touches=2,
+                                                demote_idle=3),
+                              optimizer="sgd", lr=1.0, name="smoke")
+        ids = jnp.asarray([[1, 2, 3]])
+        emb.stage(ids)                      # touch 1: host-served
+        v1 = np.asarray(emb(ids)).copy()
+        assert emb.tier_stats()["hbm"]["resident"] == 0
+        emb._handle.ids = None
+        emb.stage(ids)                      # touch 2: promoted
+        v2 = np.asarray(emb(ids))
+        st = emb.tier_stats()
+        assert st["hbm"]["resident"] == 3 and st["hbm"]["promotions"] == 3
+        np.testing.assert_allclose(v1, v2, rtol=1e-6)
+        np.testing.assert_allclose(
+            v1[0], emb.table.pull(np.array([1, 2, 3])), rtol=1e-6)
+        for k in range(4):                  # idle the hot rows out
+            emb._handle.ids = None
+            emb.stage(jnp.asarray([[10 + k]]))
+        st = emb.tier_stats()
+        assert st["hbm"]["demotions"] == 3
+        assert not any(emb._handle.slot_of[[1, 2, 3]] >= 0)
+    kinds = [e["kind"] for e in j.events]
+    assert "tier_promote" in kinds and "tier_demote" in kinds
+
+
+def test_tiered_strict_matches_staged_oracle():
+    """Strict freshness + always-promote == the plain staged path, step
+    by step and in the final host table — tiering is a transport
+    optimization, not a semantics change."""
+    set_random_seed(0)
+    l_ref, tr_ref = _train(StagedHostEmbedding(50, 4, optimizer="adagrad",
+                                               lr=0.05, seed=7))
+    set_random_seed(0)
+    l_tier, tr_tier = _train(TieredEmbedding(
+        50, 4, hbm_capacity=64, host_capacity=128,
+        policy=TierPolicy(promote_touches=1), hbm_pull_bound=0,
+        optimizer="adagrad", lr=0.05, seed=7))
+    np.testing.assert_allclose(l_tier, l_ref, rtol=1e-5)
+    ids = np.arange(50)
+    np.testing.assert_allclose(tr_tier.state.model.emb.table.pull(ids),
+                               tr_ref.state.model.emb.table.pull(ids),
+                               rtol=1e-5)
+
+
+def test_tiered_cold_path_matches_staged_oracle():
+    """Same bit-compatibility with the promotion gate ON (cold rows ride
+    the host path for their first touches) — value routing never changes
+    the math."""
+    set_random_seed(0)
+    l_ref, tr_ref = _train(StagedHostEmbedding(50, 4, optimizer="adagrad",
+                                               lr=0.05, seed=7))
+    set_random_seed(0)
+    l_tier, tr_tier = _train(TieredEmbedding(
+        50, 4, hbm_capacity=16, host_capacity=64,
+        policy=TierPolicy(promote_touches=3), hbm_pull_bound=0,
+        optimizer="adagrad", lr=0.05, seed=7))
+    np.testing.assert_allclose(l_tier, l_ref, rtol=1e-5)
+    ids = np.arange(50)
+    np.testing.assert_allclose(tr_tier.state.model.emb.table.pull(ids),
+                               tr_ref.state.model.emb.table.pull(ids),
+                               rtol=1e-5)
+
+
+def _counter_oracle(trace, *, promote_touches, pull_bound, train):
+    """Independent replay of the documented tier policy over an id trace
+    (no-eviction regime: capacity >= distinct rows).  Returns the
+    expected HBM counters."""
+    touches, staleness, resident = {}, {}, set()
+    hits = misses = promotions = 0
+    for batch in trace:
+        uniq = sorted(set(int(i) for i in batch.ravel()))
+        for r in uniq:
+            touches[r] = touches.get(r, 0) + 1
+        for r in uniq:
+            if r in resident:
+                if staleness.get(r, 0) > pull_bound:
+                    misses += 1     # stale: re-pull, stays resident
+                    staleness[r] = 0
+                else:
+                    hits += 1
+            elif touches[r] >= promote_touches:
+                misses += 1
+                promotions += 1
+                resident.add(r)
+                staleness[r] = 0
+            else:
+                misses += 1         # cold: host-served, not promoted
+        if train:
+            for r in uniq:          # push bumps every touched row
+                staleness[r] = staleness.get(r, 0) + 1
+    return {"hits": hits, "misses": misses, "promotions": promotions}
+
+
+@pytest.mark.parametrize("train,pull_bound", [(False, 0), (True, 0),
+                                              (True, 2)])
+def test_counters_match_trace_reuse_exactly(train, pull_bound):
+    """The acceptance bar: per-tier hit counters == the trace's computed
+    reuse, exactly — including the cross-tier invariant that every HBM
+    miss is one host-tier row (host hits + host misses == hbm misses)."""
+    rng = np.random.default_rng(5)
+    trace = [np.minimum(rng.zipf(1.4, (6, 3)) - 1, 79).astype(np.int64)
+             for _ in range(20)]
+    emb = TieredEmbedding(80, 4, hbm_capacity=96, host_capacity=256,
+                          policy=TierPolicy(promote_touches=2),
+                          hbm_pull_bound=pull_bound, host_pull_bound=0,
+                          optimizer="sgd", lr=1.0, name=f"ex{train}"
+                                                       f"{pull_bound}")
+    for batch in trace:
+        emb.stage(batch)
+        if train:
+            emb.push_grads(np.ones(batch.shape + (4,), np.float32))
+        else:
+            emb._handle.ids = None
+    st = emb.tier_stats()
+    want = _counter_oracle(trace, promote_touches=2, pull_bound=pull_bound,
+                           train=train)
+    assert st["hbm"]["hits"] == want["hits"]
+    assert st["hbm"]["misses"] == want["misses"]
+    assert st["hbm"]["promotions"] == want["promotions"]
+    assert st["hbm"]["evictions"] == 0          # no-eviction regime
+    host_total = st["host"]["hits"] + st["host"]["misses"]
+    assert host_total == st["hbm"]["misses"]
+    assert st["ps"]["rows_pulled"] == st["host"]["misses"]
+
+
+def test_eviction_pressure_keeps_invariants():
+    """Small HBM budget under a wide trace: residency stays bounded, the
+    directory stays consistent, and hits+misses still covers every
+    unique row staged."""
+    rng = np.random.default_rng(7)
+    emb = TieredEmbedding(200, 4, hbm_capacity=8, host_capacity=64,
+                          policy=TierPolicy(promote_touches=1),
+                          optimizer="sgd", lr=1.0)
+    total_uniq = 0
+    for _ in range(30):
+        batch = rng.integers(0, 200, (4, 3))
+        total_uniq += len(set(int(i) for i in batch.ravel()))
+        emb.stage(batch)
+        emb._handle.ids = None
+    h = emb._handle
+    st = emb.tier_stats()
+    assert st["hbm"]["resident"] <= 8
+    assert st["hbm"]["hits"] + st["hbm"]["misses"] == total_uniq
+    for s in range(8):          # directory round-trips
+        if h.id_of[s] >= 0:
+            assert h.slot_of[h.id_of[s]] == s
+
+
+def test_tier_metrics_published():
+    from hetu_tpu.obs import registry as obs_registry
+
+    emb = TieredEmbedding(50, 4, hbm_capacity=8, host_capacity=32,
+                          name="pubsmoke")
+    emb.stage(jnp.asarray([[1, 2]]))
+    emb._handle.ids = None
+    emb.stage(jnp.asarray([[1, 2]]))
+    snap = obs_registry.get_registry().snapshot()
+    for fam in ("hetu_embed_hits_total", "hetu_embed_misses_total",
+                "hetu_embed_promotions_total", "hetu_embed_evictions_total",
+                "hetu_embed_pull_bytes_total"):
+        keys = [k for k in snap if k.startswith(fam)
+                and "pubsmoke" in k and "tier=" in k.replace('"', "")]
+        assert keys, f"{fam} not published: {sorted(snap)[:5]}"
+
+
+def test_seed_hot_rows_promotes_on_first_touch():
+    from hetu_tpu.embed.net import hot_row_signal
+
+    emb = TieredEmbedding(50, 4, hbm_capacity=8, host_capacity=32,
+                          policy=TierPolicy(promote_touches=3))
+    emb.seed_hot_rows(hot_row_signal({"hot_rows": [(7, 99), (9, 50)]}))
+    emb.stage(jnp.asarray([[7, 9, 11]]))
+    h = emb._handle
+    assert h.slot_of[7] >= 0 and h.slot_of[9] >= 0  # seeded: first touch
+    assert h.slot_of[11] < 0                        # unseeded: still cold
+
+
+# ------------------------------------------------------------ int8 storage
+
+def test_quant_roundtrip_property():
+    """Seeded property: per-row quantization reconstructs within half a
+    code step per element (the documented tolerance), rows of any scale,
+    including constant rows."""
+    rng = np.random.default_rng(11)
+    for _ in range(20):
+        scale = 10.0 ** rng.uniform(-4, 2)
+        rows = (rng.normal(size=(16, 32)) * scale).astype(np.float32)
+        rows[3] = 0.0                       # constant row edge case
+        rows[4] = 7.5
+        q, s, m = quantize_rows(rows)
+        back = dequantize_rows(q, s, m)
+        tol = np.maximum(s[:, None] * 0.5, 1e-7)  # half a code step
+        assert np.all(np.abs(back - rows) <= tol + 1e-6 * np.abs(rows))
+
+
+def test_int8_store_pull_push_pull_matches_f32():
+    """int8 store -> pull -> push -> pull tracks the f32 table within the
+    documented tolerance: touched rows ride the float shadow (exact
+    optimizer math), so the residual error is bounded by the INITIAL
+    quantization step, never compounded by training."""
+    f32 = HostEmbeddingTable(200, 32, seed=3, optimizer="adagrad", lr=0.05,
+                             init_scale=0.05)
+    i8 = HostEmbeddingTable(200, 32, seed=3, optimizer="adagrad", lr=0.05,
+                            init_scale=0.05, storage="int8")
+    assert isinstance(i8, Int8HostEmbeddingTable)
+    ids = np.arange(200)
+    step = float(i8._scale.astype(np.float32).max())
+    np.testing.assert_allclose(i8.pull(ids), f32.pull(ids),
+                               atol=step, rtol=0)
+    rng = np.random.default_rng(0)
+    keys = np.arange(20)
+    for _ in range(10):
+        g = rng.normal(size=(20, 32)).astype(np.float32)
+        f32.push(keys, g)
+        i8.push(keys, g)
+    # trajectories differ only through the quantized INITIAL values
+    np.testing.assert_allclose(i8.pull(keys), f32.pull(keys),
+                               atol=5 * step, rtol=0)
+    # untouched rows: still within one quantization step of f32
+    cold = np.arange(100, 200)
+    np.testing.assert_allclose(i8.pull(cold), f32.pull(cold),
+                               atol=step, rtol=0)
+
+
+def test_int8_resident_and_wire_bytes_floor():
+    """Acceptance: resident + wire bytes reduced >= 3.5x (dim 64, the
+    documented configuration; per-row f16 scale/middle overhead)."""
+    f32 = HostEmbeddingTable(2000, 64, seed=0)
+    i8 = HostEmbeddingTable(2000, 64, seed=0, storage="int8",
+                            shadow_limit=20)
+    # train a hot subset so the shadow is realistically non-empty
+    for _ in range(5):
+        i8.push(np.arange(20), np.ones((20, 64), np.float32))
+    assert len(i8._shadow) <= 20
+    resident_ratio = f32.resident_bytes() / i8.resident_bytes()
+    wire_ratio = f32.pull_wire_bytes(1000) / i8.pull_wire_bytes(1000)
+    assert resident_ratio >= 3.5, resident_ratio
+    assert wire_ratio >= 3.5, wire_ratio
+
+
+def test_int8_wdl_ctr_quality_delta_bounded():
+    """Acceptance: wdl_ctr trained on int8 PS storage stays within the
+    documented tolerance of f32 — loss trajectory within 2e-2 absolute,
+    ranking (AUC) within 0.02."""
+    from hetu_tpu.models import CTRConfig, WideDeep
+
+    def run(storage):
+        set_random_seed(0)
+        cfg = CTRConfig(vocab=300, embed_dim=16, embedding="host",
+                        host_bridge="staged", cache_capacity=0,
+                        host_optimizer="adagrad", host_lr=0.05,
+                        storage=storage)
+        model = WideDeep(cfg)
+        tr = Trainer(model, AdamOptimizer(1e-3),
+                     lambda m, b, k: m.loss(b["dense"], b["sparse"],
+                                            b["label"]))
+        rng = np.random.default_rng(0)
+        b = {"dense": jnp.asarray(rng.normal(size=(32, 13)), jnp.float32),
+             "sparse": jnp.asarray(rng.integers(0, 300, (32, 26)),
+                                   jnp.int32),
+             "label": jnp.asarray(rng.integers(0, 2, (32,)), jnp.float32)}
+        losses = []
+        for _ in range(15):
+            for m in tr.staged_modules():
+                m.stage(b["sparse"])
+            out = tr.step(b)
+            losses.append(float(out["loss"]))
+        pred = np.asarray(out["pred"])
+        return np.asarray(losses), pred, np.asarray(b["label"])
+
+    l_f32, p_f32, y = run("f32")
+    l_i8, p_i8, _ = run("int8")
+    assert l_f32[-1] < l_f32[0] and l_i8[-1] < l_i8[0]
+    np.testing.assert_allclose(l_i8, l_f32, atol=2e-2, rtol=0)
+
+    def auc(pred, y):
+        order = np.argsort(pred, kind="stable")
+        rank = np.empty_like(order, float)
+        rank[order] = np.arange(1, len(pred) + 1)
+        pos = y > 0.5
+        n1, n0 = int(pos.sum()), int((~pos).sum())
+        return ((rank[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+                if n1 and n0 else 0.5)
+
+    assert abs(auc(p_i8, y) - auc(p_f32, y)) < 0.02
+
+
+def test_int8_cached_layer_trains():
+    """The full composition: int8 PS + PythonCacheTable host tier under a
+    staged layer — trains, and the read-only guard still bites."""
+    from hetu_tpu.embed import PythonCacheTable
+
+    emb = StagedHostEmbedding(50, 4, optimizer="adagrad", lr=0.05, seed=7,
+                              cache_capacity=32, storage="int8")
+    assert isinstance(emb.store, PythonCacheTable)
+    losses, _ = _train(emb)
+    assert losses[-1] < losses[0]
+    emb.store.read_only = True
+    with pytest.raises(RuntimeError, match="read-only"):
+        emb.store.push([1], np.zeros((1, 4), np.float32))
+
+
+def test_ctr_config_tiered_path():
+    from hetu_tpu.models import CTRConfig, WideDeep
+
+    set_random_seed(0)
+    cfg = CTRConfig(vocab=200, embed_dim=4, embedding="tiered",
+                    cache_capacity=64, host_cache_capacity=256,
+                    host_optimizer="adagrad", host_lr=0.05,
+                    promote_touches=2)
+    model = WideDeep(cfg)
+    tr = Trainer(model, AdamOptimizer(1e-3),
+                 lambda m, b, k: m.loss(b["dense"], b["sparse"], b["label"]))
+    rng = np.random.default_rng(0)
+    b = {"dense": jnp.asarray(rng.normal(size=(16, 13)), jnp.float32),
+         "sparse": jnp.asarray(rng.integers(0, 200, (16, 26)), jnp.int32),
+         "label": jnp.asarray(rng.integers(0, 2, (16,)), jnp.float32)}
+    for m in tr.staged_modules():
+        m.stage(b["sparse"])
+    l0 = float(tr.step(b)["loss"])
+    for _ in range(10):
+        for m in tr.staged_modules():
+            m.stage(b["sparse"])
+        out = tr.step(b)
+    assert float(out["loss"]) < l0
+    st = model.embed.tier_stats()
+    assert st["hbm"]["promotions"] > 0      # the hot set landed in HBM
+
+
+# --------------------------------------------------------- calibration
+
+def test_calibration_ingest_embed_and_sentinel():
+    """ingest_embed records the tier profile; a degraded later version
+    (hit rate down >10%) trips the PR 12 regression sentinel naming the
+    metric."""
+    from hetu_tpu.obs.calibration import ProfileStore
+
+    store = ProfileStore(clock=lambda: 0.0)
+    good = {"hbm": {"hit_rate": 0.8, "resident": 10, "promotions": 5,
+                    "demotions": 0, "evictions": 0},
+            "host": {"hit_rate": 0.9},
+            "ps": {"resident_bytes": 1000},
+            "pull_bytes_per_stage": 100.0, "stages": 10}
+    rec = store.ingest_embed(good, model_sig="wdl_ctr", device_kind="cpu")
+    assert rec["version"] == 1
+    bad = {**good, "hbm": {**good["hbm"], "hit_rate": 0.5}}
+    j = obs_journal.EventJournal()
+    with obs_journal.use(j):
+        store.ingest_embed(bad, model_sig="wdl_ctr", device_kind="cpu")
+    regs = [e for e in j.events if e["kind"] == "perf_regression"]
+    assert regs and regs[0]["metric"] == "hbm_hit_rate"
