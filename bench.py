@@ -1053,6 +1053,120 @@ def bench_serve_disagg(on_tpu, kind, peak):
         device=kind, timing="wall-trace", spread=None)
 
 
+def bench_serve_tenants(on_tpu, kind, peak):
+    """``--mode serve --tenants``: the seeded FLOOD A/B — an adversarial
+    multi-tenant mix (one batch-class tenant flooding heavy decode
+    budgets over a latency-class victim) through a 2-replica fleet with
+    the WFQ front door, quotas, and scoped shedding engaged, against the
+    victim's OWN arrivals alone on the same fleet.  One JSON line;
+    ``vs_baseline`` = victim TTFT p99 under flood / without flood (the
+    isolation ratio — 1.0 is perfect isolation, the acceptance bar is
+    <1.1), with the shed/quota attribution alongside (the sheds must
+    land on the flooder).  Rides the same rc=3 preflight as every serve
+    round."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.serve import (FleetRouter, ServingEngine, Tenant,
+                                TenantPolicy, TokenBucket,
+                                generate_multitenant_load)
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        kw = dict(num_slots=8, page_size=64, max_seq_len=2048,
+                  prompt_buckets=(128, 256, 512, 1024))
+        trace = generate_multitenant_load(
+            17, 32, vocab=cfg.vocab_size, mean_gap_s=0.0, tenants=[
+                {"id": "flood", "share": 0.8, "prompt_len": (64, 512),
+                 "max_new": (32, 64)},
+                {"id": "victim", "share": 0.2, "prompt_len": (64, 256),
+                 "max_new": (8, 16)}])
+        flood_bucket = TokenBucket(capacity=2048.0, refill_per_s=512.0)
+    else:  # CI smoke: tiny shapes, still the full flood-vs-quiet A/B
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        kw = dict(num_slots=4, page_size=8, max_seq_len=64,
+                  prompt_buckets=(8, 16, 32))
+        trace = generate_multitenant_load(
+            17, 16, vocab=cfg.vocab_size, mean_gap_s=0.0, tenants=[
+                {"id": "flood", "share": 0.8, "prompt_len": (2, 12),
+                 "max_new": (8, 16)},
+                {"id": "victim", "share": 0.2, "prompt_len": (2, 8),
+                 "max_new": (2, 4)}])
+        flood_bucket = TokenBucket(capacity=128.0, refill_per_s=64.0)
+
+    set_random_seed(0)
+    model = GPT(cfg)
+
+    def drive(items, *, quota):
+        # ONE policy shared by both replicas: the flooder's token bucket
+        # is a fleet-wide contract, not a per-replica loophole
+        policy = TenantPolicy()
+        policy.register(Tenant(id="victim", klass="latency", weight=4.0))
+        policy.register(Tenant(id="flood", klass="batch", weight=1.0),
+                        quota=quota)
+        engines = [ServingEngine(model, queue_depth=len(items) + 8,
+                                 sampling="top_k", top_k=5, seed=11,
+                                 tenants=policy, **kw)
+                   for _ in range(2)]
+        router = FleetRouter(engines)
+        # warmup: compile every prefill bucket on every replica outside
+        # the measured window (the _serve_run convention; default-tenant
+        # traffic, so no quota charge)
+        for eng in engines:
+            for bucket in kw["prompt_buckets"]:
+                eng.submit(list(range(1, bucket + 1)), 2)
+            eng.run_until_idle()
+        handles = []
+        for it in items:
+            handles.append((it, router.submit(list(it.prompt),
+                                              it.max_new_tokens,
+                                              tenant=it.tenant)))
+            router.step()
+        router.run_until_idle(max_steps=10**7)
+        return handles
+
+    def victim_p99(handles):
+        ttfts = sorted(h.ttft_s for it, h in handles
+                       if it.tenant == "victim"
+                       and h.status == "completed"
+                       and h.ttft_s is not None)
+        if not ttfts:
+            return None
+        return ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+
+    flood_handles = drive(trace, quota=flood_bucket)
+    quiet_handles = drive([it for it in trace if it.tenant == "victim"],
+                          quota=None)
+    p99_flood = victim_p99(flood_handles)
+    p99_quiet = victim_p99(quiet_handles)
+    rejected = [(it, h) for it, h in flood_handles
+                if h.status == "rejected"]
+    shed_by_tenant: dict = {}
+    for it, h in rejected:
+        shed_by_tenant[it.tenant] = shed_by_tenant.get(it.tenant, 0) + 1
+    return _line(
+        "serve_tenant_victim_ttft_p99_s",
+        p99_flood if p99_flood is not None else 0.0, "s",
+        (p99_flood / p99_quiet
+         if p99_flood is not None and p99_quiet else 1.0),
+        noflood_victim_ttft_p99_s=_q_or_none(p99_quiet),
+        requests=len(trace),
+        completed=sum(1 for _, h in flood_handles
+                      if h.status == "completed"),
+        victim_completed=sum(1 for it, h in flood_handles
+                             if it.tenant == "victim"
+                             and h.status == "completed"),
+        sheds_by_tenant=shed_by_tenant,
+        quota_rejections=sum(1 for _, h in rejected
+                             if h.shed_reason == "quota"),
+        baseline_note="vs_baseline = victim TTFT p99 with the flood / "
+                      "without it on the same seeded arrivals — 1.0 is "
+                      "perfect tenant isolation (acceptance bar <1.1); "
+                      "sheds_by_tenant must load on the flooder",
+        device=kind, timing="wall-trace", spread=None)
+
+
 CONFIGS = [
     ("resnet", bench_resnet),
     ("ctr", bench_ctr),
@@ -1205,13 +1319,21 @@ def main():
         if disagg and (replicas is not None or prefix_share):
             sys.exit("bench: --disagg runs its own 1-prefill + 1-decode "
                      "vs 2-colocated A/B; drop --replicas/--prefix-share")
+        tenants = "--tenants" in args
+        if tenants:
+            args.remove("--tenants")
+        if tenants and (disagg or replicas is not None or prefix_share):
+            sys.exit("bench: --tenants runs its own 2-replica flood A/B; "
+                     "drop --disagg/--replicas/--prefix-share")
         if args:
             sys.exit(f"bench: --mode serve takes no config names, "
                      f"got {args}")
         _require_backend_alive()
         on_tpu, kind, peak = _env()
         try:
-            if disagg:
+            if tenants:
+                bench_serve_tenants(on_tpu, kind, peak)
+            elif disagg:
                 bench_serve_disagg(on_tpu, kind, peak)
             elif replicas is not None:
                 bench_serve_fleet(on_tpu, kind, peak, replicas=replicas,

@@ -119,6 +119,11 @@ class ControllerConfig:
     shed_on: float = 0.9
     shed_off: float = 0.25
     sustain_ticks: int = 3
+    # 3b: tenant-scoped shedding (multi-tenant engines only): batch-
+    # class tenants engage at shed_on * batch_shed_factor — throughput
+    # traffic is the first to go under sustained burn, latency-class
+    # tenants shed only on their OWN burn at the full threshold
+    batch_shed_factor: float = 0.5
     # 4: compile-storm bucket freeze
     freeze_buckets: bool = True
 
@@ -144,6 +149,10 @@ class ControllerConfig:
         if self.sustain_ticks < 1:
             raise ValueError(f"sustain_ticks must be >= 1, got "
                              f"{self.sustain_ticks}")
+        if not 0.0 < self.batch_shed_factor <= 1.0:
+            raise ValueError(f"batch_shed_factor scales shed_on for "
+                             f"batch-class tenants, must be in (0, 1], "
+                             f"got {self.batch_shed_factor}")
 
     @classmethod
     def from_env(cls, **overrides) -> "ControllerConfig":
@@ -155,7 +164,7 @@ class ControllerConfig:
                 "hysteresis": float, "cooldown_steps": int,
                 "quarantine": bool, "shed": bool, "shed_on": float,
                 "shed_off": float, "sustain_ticks": int,
-                "freeze_buckets": bool}
+                "batch_shed_factor": float, "freeze_buckets": bool}
         kw = {}
         for field, typ in spec.items():
             raw = os.environ.get(_ENV_PREFIX + field.upper())
@@ -409,14 +418,19 @@ class RuntimeController:
         st = self._serve_state.get(engine)
         if st is None:
             st = {"shed_active": False, "freeze_active": False,
-                  "shed_streak": 0, "ok_streak": 0}
+                  "shed_streak": 0, "ok_streak": 0,
+                  # tenant-scoped latches (multi-tenant engines):
+                  # tid -> {"active", "shed_streak", "ok_streak"}
+                  "tenants": {}}
             self._serve_state[engine] = st
         return st
 
     @property
     def shed_active(self) -> bool:
-        """Any driven engine currently latched shedding."""
+        """Any driven engine currently latched shedding (global or
+        tenant-scoped)."""
         return any(st["shed_active"]
+                   or any(t["active"] for t in st["tenants"].values())
                    for st in self._serve_state.values())
 
     @property
@@ -455,6 +469,15 @@ class RuntimeController:
 
     def _maybe_shed(self, engine) -> None:
         st = self._serve_st(engine)
+        if getattr(engine.slo, "multi_tenant", False):
+            # the scoped policy: per-tenant burn drives per-tenant
+            # latches, so a flooding tenant's aggregate burn can never
+            # close a victim's door.  The switch is monotone (tenant
+            # windows never un-observe), so a replay flips policies at
+            # the same tick.  Engines that only ever see the default
+            # tenant stay on the legacy global path below, bit for bit.
+            self._maybe_shed_tenants(engine, st)
+            return
         pressure = float(engine.slo.shed_pressure())
         if pressure >= self.config.shed_on:
             st["shed_streak"] += 1
@@ -487,6 +510,65 @@ class RuntimeController:
         if _obs.enabled():
             self._m()["shed_active"].set(1.0 if self.shed_active else 0.0)
 
+    def _maybe_shed_tenants(self, engine, st: dict) -> None:
+        """The scoped shed loop: one streak/hysteresis machine per
+        observed (tenant, class), same sustain discipline as the global
+        path, engaging :meth:`~hetu_tpu.serve.batcher.ContinuousBatcher.
+        set_tenant_shed` instead of the global latch.  Batch-class
+        tenants engage at ``shed_on * batch_shed_factor`` (and release
+        at the equally scaled ``shed_off``): under sustained burn the
+        throughput tier is shed FIRST, and a latency-class tenant is
+        shed only when its OWN windows burn at the full threshold."""
+        cfg = self.config
+        observed = engine.slo.observed_tenants()
+        for tid in sorted(observed):
+            klass = observed[tid]
+            ts = st["tenants"].get(tid)
+            if ts is None:
+                ts = {"active": False, "shed_streak": 0, "ok_streak": 0}
+                st["tenants"][tid] = ts
+            factor = cfg.batch_shed_factor if klass == "batch" else 1.0
+            on = cfg.shed_on * factor
+            off = cfg.shed_off * factor
+            pressure = float(engine.slo.tenant_shed_pressure(tid))
+            if pressure >= on:
+                ts["shed_streak"] += 1
+                ts["ok_streak"] = 0
+            elif pressure <= off:
+                ts["ok_streak"] += 1
+                ts["shed_streak"] = 0
+            else:
+                ts["shed_streak"] = 0
+                ts["ok_streak"] = 0
+            if not ts["active"] \
+                    and ts["shed_streak"] >= cfg.sustain_ticks:
+                ts["active"] = True
+                reason = (f"controller shed: sustained SLO burn by "
+                          f"tenant {tid} ({klass}-class, shed pressure "
+                          f"{pressure:.3f} >= {on:g})")
+                self._act("admission_shed", "slo_burn", tenant=tid,
+                          klass=klass, pressure=round(pressure, 6),
+                          sustained_ticks=int(ts["shed_streak"]))
+                _obs_journal.record("tenant_shed", tenant=tid,
+                                    engaged=True, reason="slo_burn",
+                                    klass=klass,
+                                    pressure=round(pressure, 6))
+                if not cfg.dry_run:
+                    engine.batcher.set_tenant_shed(tid, reason)
+            elif ts["active"] and ts["ok_streak"] >= cfg.sustain_ticks:
+                ts["active"] = False
+                self._act("admission_release", "slo_burn", tenant=tid,
+                          klass=klass, pressure=round(pressure, 6),
+                          sustained_ticks=int(ts["ok_streak"]))
+                _obs_journal.record("tenant_shed", tenant=tid,
+                                    engaged=False, reason="slo_burn",
+                                    klass=klass,
+                                    pressure=round(pressure, 6))
+                if not cfg.dry_run:
+                    engine.batcher.clear_tenant_shed(tid)
+        if _obs.enabled():
+            self._m()["shed_active"].set(1.0 if self.shed_active else 0.0)
+
     def release(self) -> None:
         """Release every latch this controller actuated (admission shed,
         bucket freeze) on every engine it drove, and reset the sustain
@@ -501,6 +583,18 @@ class RuntimeController:
                 self._act("admission_release", "controller_detach")
                 if getattr(eng.batcher, "shedding", False):
                     eng.batcher.clear_shed()
+            for tid, ts in st["tenants"].items():
+                if ts["active"]:
+                    ts["active"] = False
+                    self._act("admission_release", "controller_detach",
+                              tenant=tid)
+                    _obs_journal.record("tenant_shed", tenant=tid,
+                                        engaged=False,
+                                        reason="controller_detach")
+                    if eng.batcher.tenant_shed_reason(tid) is not None:
+                        eng.batcher.clear_tenant_shed(tid)
+                ts["shed_streak"] = 0
+                ts["ok_streak"] = 0
             if st["freeze_active"]:
                 st["freeze_active"] = False
                 self._act("bucket_unfreeze", "controller_detach")
@@ -530,6 +624,9 @@ class RuntimeController:
                          if self._deadline is None
                          or math.isfinite(self._deadline) else None),
             "shed_active": bool(self.shed_active),
+            "tenant_shed_active": sorted(
+                {tid for st in self._serve_state.values()
+                 for tid, ts in st["tenants"].items() if ts["active"]}),
             "freeze_active": bool(self.freeze_active),
             "quarantined": sorted(self._quarantined),
             "actions_total": int(self.actions_total),

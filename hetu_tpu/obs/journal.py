@@ -199,6 +199,10 @@ EVENT_KINDS = {
                                    "full"}),
     "snapshot_install": frozenset({"name", "version", "rows"}),
     "snapshot_skipped": frozenset({"name", "version", "reason"}),
+    # multi-tenant front door (PR 16): WFQ admission, per-tenant quotas,
+    # scoped shedding
+    "tenant_quota": frozenset({"request_id", "tenant"}),
+    "tenant_shed": frozenset({"tenant", "engaged", "reason"}),
     # performance calibration plane (PR 12)
     "calibration_update": frozenset({"record_kind", "key", "version"}),
     "perf_regression": frozenset(

@@ -36,6 +36,16 @@ future multi-replica router reads this gauge for placement and
 admission decisions, and ``/slo`` (per process) and ``/fleet/slo``
 (aggregated) publish it.
 
+**Per-tenant grading** (multi-tenant front door): every timeline whose
+attrs carry a ``tenant`` id is additionally folded into per-(tenant,
+class) violation windows, yielding :meth:`SLOEngine.tenant_shed_pressure`
+— the scoped signal the controller's shed actuator uses to shed the
+tenant *causing* the burn instead of everyone.  The aggregate windows
+above are untouched (single-tenant runs produce bit-identical burn
+state and ``/slo`` payloads); the tenants section appears in
+:meth:`SLOEngine.summary` and the ``hetu_tenant_shed_pressure`` gauge
+only once a non-default tenant has been observed.
+
 Everything is clock-injectable (the serving engine passes its own
 clock), so deterministic tests drive the windows exactly.  All metrics
 are lazily registered and no-ops while telemetry is disabled.
@@ -142,6 +152,14 @@ class SLOEngine:
         self.stage_totals = dict.fromkeys(STAGES, 0.0)
         self.requests = 0
         self.violations = dict.fromkeys(TARGETS, 0)
+        # per-tenant scoped burn state: tenant id -> {target: (short,
+        # long)} window pairs, plus class / request / violation rosters.
+        # Populated lazily from timeline attrs; a pre-tenant deployment
+        # only ever materializes the "default" row.
+        self._tenant_windows: dict = {}
+        self._tenant_class: dict = {}
+        self._tenant_requests: dict = {}
+        self._tenant_violations: dict = {}
         self._reg = registry
         self._m = None
         self._lock = threading.Lock()
@@ -175,6 +193,12 @@ class SLOEngine:
                     "admission shed signal in [0, 1]: max over targets of "
                     "min(short, long) burn, normalized by the shed burn "
                     "threshold — the router/admission input"),
+                "tenant_shed": reg.gauge(
+                    "hetu_tenant_shed_pressure",
+                    "per-(tenant, class) admission shed signal in [0, 1] "
+                    "— the controller's scoped-shed input; published only "
+                    "once a non-default tenant has been observed",
+                    ("tenant", "klass")),
             }
         return self._m
 
@@ -217,14 +241,28 @@ class SLOEngine:
                 self.stage_totals[stage] += dt
                 if enabled and dt:
                     m["stage"].labels(stage=stage).inc(dt)
+            tid = str(tl.attrs.get("tenant", "default"))
+            tw = self._tenant_windows.get(tid)
+            if tw is None:
+                tw = {t: (_Window(self.short_window_s),
+                          _Window(self.long_window_s)) for t in TARGETS}
+                self._tenant_windows[tid] = tw
+                self._tenant_class[tid] = str(
+                    tl.attrs.get("tenant_class", "latency"))
+                self._tenant_requests[tid] = 0
+                self._tenant_violations[tid] = dict.fromkeys(TARGETS, 0)
+            self._tenant_requests[tid] += 1
             for target in TARGETS:
                 v = bool(g["violated"][target])
                 any_violation |= v
                 if v:
                     self.violations[target] += 1
+                    self._tenant_violations[tid][target] += 1
                     if enabled:
                         m["violations"].labels(target=target).inc()
                 for w in self._windows[target]:
+                    w.add(now, v)
+                for w in tw[target]:
                     w.add(now, v)
             if enabled:
                 m["requests"].labels(
@@ -254,6 +292,37 @@ class SLOEngine:
                     default=0.0)
         return min(max(worst / self.shed_burn, 0.0), 1.0)
 
+    def _pressure_of(self, windows: dict, now: float) -> float:
+        # caller holds self._lock
+        budget = self._budget()
+        worst = max((min(short.fraction(now), long.fraction(now)) / budget
+                     for short, long in windows.values()), default=0.0)
+        return min(max(worst / self.shed_burn, 0.0), 1.0)
+
+    def tenant_shed_pressure(self, tenant_id: str,
+                             now: Optional[float] = None) -> float:
+        """The scoped shed signal: :meth:`shed_pressure` computed over
+        ONE tenant's violation windows (0.0 for a never-observed
+        tenant).  The controller's surgical actuator reads this so a
+        flooding tenant's burn cannot shed a victim."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            tw = self._tenant_windows.get(str(tenant_id))
+            return self._pressure_of(tw, now) if tw is not None else 0.0
+
+    def observed_tenants(self) -> dict:
+        """Tenants seen so far (id -> priority class)."""
+        with self._lock:
+            return dict(self._tenant_class)
+
+    @property
+    def multi_tenant(self) -> bool:
+        """True once any non-default tenant has been graded — the
+        monotone switch the controller uses to pick the scoped shed
+        policy over the legacy global one."""
+        with self._lock:
+            return any(tid != "default" for tid in self._tenant_windows)
+
     def _publish(self, now: float, m: dict) -> None:
         # caller holds self._lock; recompute without re-locking
         budget = self._budget()
@@ -264,6 +333,13 @@ class SLOEngine:
             m["burn"].labels(target=target, window="long").set(l_)
             worst = max(worst, min(s, l_))
         m["shed"].set(min(max(worst / self.shed_burn, 0.0), 1.0))
+        # the per-tenant gauge only once real multi-tenant traffic
+        # exists — a pre-tenant deployment's metric surface is unchanged
+        if any(tid != "default" for tid in self._tenant_windows):
+            for tid, tw in self._tenant_windows.items():
+                m["tenant_shed"].labels(
+                    tenant=tid, klass=self._tenant_class[tid]).set(
+                        self._pressure_of(tw, now))
 
     # -- read side ----------------------------------------------------------
 
@@ -301,4 +377,17 @@ class SLOEngine:
         worst = max((min(r["short"], r["long"]) for r in rates.values()),
                     default=0.0)
         body["shed_pressure"] = min(max(worst / self.shed_burn, 0.0), 1.0)
+        with self._lock:
+            if any(tid != "default" for tid in self._tenant_windows):
+                budget = self._budget()
+                body["tenants"] = {
+                    tid: {"class": self._tenant_class[tid],
+                          "requests": self._tenant_requests[tid],
+                          "violations": dict(self._tenant_violations[tid]),
+                          "burn_rates": {
+                              t: {"short": short.fraction(now) / budget,
+                                  "long": long.fraction(now) / budget}
+                              for t, (short, long) in tw.items()},
+                          "shed_pressure": self._pressure_of(tw, now)}
+                    for tid, tw in sorted(self._tenant_windows.items())}
         return body

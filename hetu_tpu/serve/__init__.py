@@ -21,9 +21,15 @@ one.  This package is the inference path the training stack feeds:
 - :mod:`~hetu_tpu.serve.server` — stdlib-HTTP ``/infer`` + ``/stats``
   endpoint registered on the ``obs.server`` route table, sharing a port
   with ``/metrics``;
+- :mod:`~hetu_tpu.serve.tenant` — the multi-tenant front door: priority
+  classes (``latency`` / ``batch``), deterministic token-bucket quotas,
+  and the per-tenant metering artifact the ``/tenants`` endpoint serves;
+  the batcher schedules admission weighted-fair across tenants and the
+  controller sheds one tenant without touching the others;
 - :mod:`~hetu_tpu.serve.loadgen` — seeded deterministic load generator
   (the acceptance tests replay identical request schedules), including
-  template-heavy shared-prefix traces;
+  template-heavy shared-prefix traces and adversarial multi-tenant
+  mixes;
 - :mod:`~hetu_tpu.serve.fleet` — the multi-replica tier: copy-on-write
   prefix sharing over the paged pool, speculative decoding with a draft
   GPT (accepted streams bitwise identical to non-speculative runs), and
@@ -40,15 +46,19 @@ chaos-lineage guarantee.
 """
 
 from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
-                                    ContinuousBatcher, Request)
+                                    ContinuousBatcher, Request,
+                                    TenantQuotaExceeded)
 from hetu_tpu.serve.engine import RequestHandle, ServingEngine
 from hetu_tpu.serve.kv_cache import (DoubleFree, KVCachePool, OutOfPages,
                                      PageTable)
 from hetu_tpu.serve.loadgen import (LoadItem, generate_load,
+                                    generate_multitenant_load,
                                     generate_prefill_burst_load,
                                     generate_shared_prefix_load)
 from hetu_tpu.serve.server import (FleetServingServer, ServingServer,
                                    serve_engine, serve_fleet_router)
+from hetu_tpu.serve.tenant import (DEFAULT_TENANT, Tenant, TenantPolicy,
+                                   TokenBucket)
 from hetu_tpu.serve.fleet import (DisaggRouter, FleetRouter,
                                   MigrationFileFabric,
                                   MigrationIntegrityError, MigrationRecord,
@@ -58,11 +68,13 @@ from hetu_tpu.serve.fleet import (DisaggRouter, FleetRouter,
 __all__ = [
     "KVCachePool", "PageTable", "OutOfPages", "DoubleFree",
     "ContinuousBatcher", "Request", "AdmissionQueueFull", "AdmissionShed",
+    "TenantQuotaExceeded",
+    "Tenant", "TenantPolicy", "TokenBucket", "DEFAULT_TENANT",
     "ServingEngine", "RequestHandle",
     "ServingServer", "serve_engine",
     "FleetServingServer", "serve_fleet_router",
     "generate_load", "generate_shared_prefix_load",
-    "generate_prefill_burst_load", "LoadItem",
+    "generate_prefill_burst_load", "generate_multitenant_load", "LoadItem",
     "PrefixTrie", "PrefixSharer", "SpeculativeDecoder", "FleetRouter",
     "DisaggRouter", "MigrationRecord", "MigrationIntegrityError",
     "MigrationFileFabric",

@@ -1,22 +1,47 @@
 """Continuous-batching scheduler (Orca, OSDI'22 — iteration-level
-scheduling restated for the paged pool).
+scheduling restated for the paged pool) with a multi-tenant front door.
 
 The batcher owns the *decisions*; the engine owns the *compute*.  Each
 scheduler tick (:meth:`ContinuousBatcher.poll`):
 
 1. expire — waiting requests past their admission deadline are dropped
    (they never held a slot; serving them late is serving them wrong);
-2. admit — free slots are filled FIFO from the queue, but only when the
-   KV pool can actually hold the request's worst case *prompt* (its
-   decode growth is page-at-a-time, backstopped by per-slot headroom);
+2. admit — free slots are filled from the per-tenant sub-queues by
+   deterministic virtual-time weighted-fair queueing (below), but only
+   when the KV pool can actually hold the request's worst case *prompt*
+   (its decode growth is page-at-a-time, backstopped by per-slot
+   headroom);
 3. the engine prefill-then-decodes whatever :meth:`active` returns, and
    recycles slots via :meth:`finish` the moment a sequence hits EOS or
    its token budget — the next tick's admissions take over mid-flight,
    which is the whole point of continuous batching.
 
-Everything is deterministic given the same submit/poll sequence and an
-injected clock: FIFO admission, lowest-free-slot placement, sorted
-expiry.  The engine exploits this for bitwise-replayable serving runs.
+Admission is **virtual-time WFQ** (self-clocked fair queueing,
+Golestani '94, restated for request admission): each tenant has a FIFO
+sub-queue; at *submit* a request is stamped with its finish tag
+``max(V, tenant_last_tag) + cost / weight`` where cost =
+``prompt + max_new_tokens`` work tokens, and each admission picks the
+sub-queue head with the smallest ``(tag, seq)`` then advances the
+global virtual clock ``V`` to the admitted tag.  Heavier weights accrue
+tag mass slower and therefore admit more work per unit of virtual time,
+yet a backlogged tenant's tags grow without bound while a queued
+request's tag is frozen at enqueue — every nonzero-weight tenant's head
+eventually becomes the minimum.  Weighted sharing with starvation
+freedom, completely deterministic: no wall clock, no randomness, ties
+broken by global submit order.  **With a single tenant this reduces
+exactly to the old FIFO** (one sub-queue's tags are monotone in submit
+order), so pre-tenant traces replay bitwise.
+
+Queue depth is enforced *per tenant sub-queue*: a flooding tenant
+exhausts its own depth while everyone else's front door stays open —
+with one tenant this is the same global limit as before.  Per-tenant
+token buckets (:class:`~hetu_tpu.serve.tenant.TokenBucket` via
+:class:`~hetu_tpu.serve.tenant.TenantPolicy`) gate submit *before*
+enqueue, raising :class:`TenantQuotaExceeded` with the bucket's exact
+refill time as the retry hint.  The controller's shed actuator comes in
+two scopes: the original global latch (:meth:`set_shed`) and per-tenant
+latches (:meth:`set_tenant_shed`) so sustained burn can shed the tenant
+*causing* it without closing the door on victims.
 
 Prompt length buckets quantize prefill shapes (``bucket_for``), so XLA
 compiles one prefill program per bucket instead of one per prompt
@@ -26,10 +51,12 @@ length; decode always runs at the fixed (num_slots, 1) shape.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Dict, Optional
+
+from hetu_tpu.serve.tenant import DEFAULT_TENANT, TenantPolicy
 
 __all__ = ["Request", "ContinuousBatcher", "AdmissionQueueFull",
-           "AdmissionShed", "SchedulerTick"]
+           "AdmissionShed", "TenantQuotaExceeded", "SchedulerTick"]
 
 
 class AdmissionQueueFull(RuntimeError):
@@ -46,6 +73,21 @@ class AdmissionShed(AdmissionQueueFull):
     (kind ``shed``), and surfaced on ``/infer`` distinguishably."""
 
 
+class TenantQuotaExceeded(AdmissionQueueFull):
+    """The submitting tenant's token-bucket quota is exhausted — the
+    request was rejected by the tenant's *contract*, not by engine
+    congestion.  Subclasses :class:`AdmissionQueueFull` so existing
+    catch sites keep working; carries the bucket's deterministic refill
+    arithmetic as ``retry_after_s`` so ``/infer`` can tell the client
+    exactly how long to back off."""
+
+    def __init__(self, message: str, *, tenant: str,
+                 retry_after_s: float):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request as the scheduler sees it."""
@@ -55,10 +97,18 @@ class Request:
     max_new_tokens: int
     arrival: float
     deadline_s: Optional[float] = None  # waiting-time budget; None = never
+    # multi-tenant front door: the submitting tenant's id (None = the
+    # default tenant — the anonymous pre-tenant caller)
+    tenant: Optional[str] = None
     # engine-owned running state
     tokens: list = dataclasses.field(default_factory=list)  # generated
     prefill_at: Optional[float] = None
     slot: Optional[int] = None
+    # batcher-owned: global submit sequence number (WFQ tie-breaker;
+    # equals FIFO arrival order) and the WFQ virtual finish tag stamped
+    # at enqueue
+    seq: Optional[int] = None
+    vft: Optional[float] = None
     # disaggregated serving: the inbound migration ticket (record +
     # settle callback) a decode worker ingests at slot admission instead
     # of running prefill; None for ordinary requests
@@ -67,6 +117,10 @@ class Request:
     @property
     def total_budget(self) -> int:
         return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def tenant_id(self) -> str:
+        return self.tenant if self.tenant is not None else DEFAULT_TENANT.id
 
     def expired(self, now: float) -> bool:
         return (self.deadline_s is not None
@@ -82,21 +136,36 @@ class SchedulerTick:
 
 
 class ContinuousBatcher:
-    """Admission queue + slot map.  Pure scheduling — no jax, no model —
-    so its behavior is unit-testable and deterministic by construction."""
+    """Admission queues + slot map.  Pure scheduling — no jax, no model —
+    so its behavior is unit-testable and deterministic by construction.
+
+    ``policy`` is the tenant registry (class, WFQ weight, quota bucket);
+    omitted, every caller is the default tenant and the scheduler
+    behaves exactly like the pre-tenant FIFO."""
 
     def __init__(self, num_slots: int, *, queue_depth: int = 64,
-                 prompt_buckets=(16, 32, 64, 128, 256, 512, 1024)):
+                 prompt_buckets=(16, 32, 64, 128, 256, 512, 1024),
+                 policy: Optional[TenantPolicy] = None):
         if num_slots <= 0:
             raise ValueError("need at least one slot")
         self.num_slots = num_slots
         self.queue_depth = queue_depth
         self.prompt_buckets = tuple(sorted(prompt_buckets))
-        self._waiting: list = []
+        self.policy = policy if policy is not None else TenantPolicy()
+        # per-tenant FIFO sub-queues, keyed by tenant id
+        self._queues: Dict[str, list] = {}
         self._slots: list = [None] * num_slots
+        # WFQ state: global virtual time (the tag of the last admitted
+        # request) + each tenant's last *enqueued* finish tag
+        self._vtime: float = 0.0
+        self._last_tag: Dict[str, float] = {}
+        self._seq: int = 0
         # controller shed latch: while set, submit rejects with
         # AdmissionShed naming the reason (released by clear_shed)
         self.shed_reason: Optional[str] = None
+        # tenant-scoped shed latches (the controller's surgical
+        # actuator: shed the burning tenant, keep the door open)
+        self._tenant_shed: Dict[str, str] = {}
 
     # -- admission ----------------------------------------------------------
 
@@ -113,17 +182,74 @@ class ContinuousBatcher:
     def shedding(self) -> bool:
         return self.shed_reason is not None
 
+    def set_tenant_shed(self, tenant_id: str, reason: str) -> None:
+        """Engage admission shedding for ONE tenant: its submits raise
+        :exc:`AdmissionShed` while everyone else's keep flowing — how
+        the controller sheds the tenant burning the SLO without
+        punishing the victims."""
+        self._tenant_shed[str(tenant_id)] = str(reason)
+
+    def clear_tenant_shed(self, tenant_id: Optional[str] = None) -> None:
+        """Release one tenant's shed latch (all of them when ``None``)."""
+        if tenant_id is None:
+            self._tenant_shed.clear()
+        else:
+            self._tenant_shed.pop(str(tenant_id), None)
+
+    def tenant_shed_reason(self, tenant_id: str) -> Optional[str]:
+        return self._tenant_shed.get(str(tenant_id))
+
+    @property
+    def tenant_sheds(self) -> Dict[str, str]:
+        """Engaged tenant-scoped shed latches (id -> reason), a copy."""
+        return dict(self._tenant_shed)
+
     def submit(self, request: Request) -> None:
         """Queue a request; raises :exc:`AdmissionShed` while the
-        controller's shed latch is engaged, :exc:`AdmissionQueueFull` at
-        the depth limit (the engine counts and journals both,
-        distinguishably)."""
+        controller's global or tenant-scoped shed latch is engaged,
+        :exc:`AdmissionQueueFull` at the tenant sub-queue's depth limit,
+        and :exc:`TenantQuotaExceeded` when the tenant's token bucket
+        cannot cover the request's work cost (the engine counts and
+        journals all three, distinguishably).  The bucket is charged
+        only for requests actually enqueued."""
+        tid = request.tenant_id
         if self.shed_reason is not None:
             raise AdmissionShed(self.shed_reason)
-        if len(self._waiting) >= self.queue_depth:
+        scoped = self._tenant_shed.get(tid)
+        if scoped is not None:
+            raise AdmissionShed(scoped)
+        q = self._queues.get(tid)
+        if q is not None and len(q) >= self.queue_depth:
             raise AdmissionQueueFull(
-                f"admission queue at depth limit {self.queue_depth}")
-        self._waiting.append(request)
+                f"admission queue at depth limit {self.queue_depth}"
+                + (f" for tenant {tid}" if tid != DEFAULT_TENANT.id
+                   else ""))
+        bucket = self.policy.bucket(tid)
+        # migrated requests already paid their quota at the front-door
+        # engine's submit — charging the shared fleet bucket again at
+        # the decode worker would double-bill the tenant
+        if bucket is not None and request.migration is None:
+            cost = float(request.total_budget)
+            if not bucket.try_take(cost, request.arrival):
+                raise TenantQuotaExceeded(
+                    f"tenant {tid} quota exhausted "
+                    f"(cost {cost:g} work tokens)",
+                    tenant=tid,
+                    retry_after_s=bucket.retry_after(cost,
+                                                     request.arrival))
+        request.seq = self._seq
+        self._seq += 1
+        # stamp the WFQ finish tag NOW (self-clocked fair queueing): the
+        # tag is frozen at enqueue while a backlogged tenant's future
+        # tags keep growing, which is exactly what guarantees every
+        # nonzero-weight tenant's head eventually wins the admission
+        weight = self.policy.resolve(tid).weight
+        start = max(self._vtime, self._last_tag.get(tid, 0.0))
+        request.vft = start + float(request.total_budget) / weight
+        self._last_tag[tid] = request.vft
+        if q is None:
+            q = self._queues.setdefault(tid, [])
+        q.append(request)
 
     def bucket_for(self, prompt_len: int) -> int:
         """Smallest configured bucket holding ``prompt_len`` (prefill pads
@@ -139,17 +265,32 @@ class ContinuousBatcher:
     def poll(self, now: float, can_admit=None) -> SchedulerTick:
         """Expire + admit.  ``can_admit(request) -> bool`` is the engine's
         capacity gate (KV pages); admission stops at the first refusal to
-        preserve FIFO order — skipping ahead would starve long prompts."""
-        expired = [r for r in self._waiting if r.expired(now)]
-        if expired:
-            self._waiting = [r for r in self._waiting
-                             if not r.expired(now)]
+        preserve schedule order — skipping ahead would starve long
+        prompts.  Admission picks the sub-queue head with the minimum
+        WFQ ``(finish tag, submit seq)``; one tenant => plain FIFO."""
+        expired: list = []
+        for q in self._queues.values():
+            dead = [r for r in q if r.expired(now)]
+            if dead:
+                expired.extend(dead)
+                q[:] = [r for r in q if not r.expired(now)]
+        if len(self._queues) > 1:
+            expired.sort(key=lambda r: r.seq)
         admitted = []
-        while self._waiting and None in self._slots:
-            head = self._waiting[0]
+        while None in self._slots:
+            head = None
+            for q in self._queues.values():
+                if not q:
+                    continue
+                if head is None or (q[0].vft, q[0].seq) < (head.vft,
+                                                           head.seq):
+                    head = q[0]
+            if head is None:
+                break
             if can_admit is not None and not can_admit(head):
                 break
-            self._waiting.pop(0)
+            self._queues[head.tenant_id].pop(0)
+            self._vtime = max(self._vtime, head.vft)
             slot = self._slots.index(None)  # lowest free slot: deterministic
             head.slot = slot
             self._slots[slot] = head
@@ -173,15 +314,21 @@ class ContinuousBatcher:
 
     def load_factor(self) -> float:
         """Occupancy in [0, 1]: (waiting + decoding) over total capacity
-        (queue depth + slots).  The fleet router's cold-start tie-breaker:
-        before any SLO burn exists, shed-pressure gauges tie at 0.0 on
-        every replica, and occupancy is the honest load signal."""
-        return ((len(self._waiting) + self.active_slots)
-                / max(self.queue_depth + self.num_slots, 1))
+        (queue depth + slots), clamped — with several tenants the
+        aggregate backlog can exceed one sub-queue's depth.  The fleet
+        router's cold-start tie-breaker: before any SLO burn exists,
+        shed-pressure gauges tie at 0.0 on every replica, and occupancy
+        is the honest load signal."""
+        return min(1.0, (self.queue_len + self.active_slots)
+                   / max(self.queue_depth + self.num_slots, 1))
+
+    def queue_lens(self) -> Dict[str, int]:
+        """Per-tenant waiting depth (only tenants with queued work)."""
+        return {tid: len(q) for tid, q in self._queues.items() if q}
 
     @property
     def queue_len(self) -> int:
-        return len(self._waiting)
+        return sum(len(q) for q in self._queues.values())
 
     @property
     def active_slots(self) -> int:
@@ -189,4 +336,4 @@ class ContinuousBatcher:
 
     @property
     def idle(self) -> bool:
-        return not self._waiting and self.active_slots == 0
+        return self.queue_len == 0 and self.active_slots == 0

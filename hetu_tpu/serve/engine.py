@@ -111,7 +111,10 @@ from hetu_tpu.ops.pallas.lm_head import lm_head_sample_pallas
 from hetu_tpu.ops.random import (greedy_sample, temperature_sample,
                                  top_k_sample)
 from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
-                                    ContinuousBatcher, Request)
+                                    ContinuousBatcher, Request,
+                                    TenantQuotaExceeded)
+from hetu_tpu.serve.tenant import (DEFAULT_TENANT, TenantMeter,
+                                   TenantPolicy, _tenant_m)
 from hetu_tpu.serve import kv_cache as _kv
 from hetu_tpu.serve.kv_cache import (KVCachePool, OutOfPages, gather_views,
                                      scatter_views)
@@ -160,9 +163,12 @@ def _serve_m() -> dict:
                 "hetu_serve_shed_total",
                 "admission rejections that were load shedding, by cause "
                 "(controller: the runtime controller's sustained-SLO-"
-                "burn latch; queue_full: the depth limit; bucket_freeze: "
-                "prompt-bucket growth frozen during a compile storm)",
-                ("reason",)),
+                "burn latch — global or tenant-scoped; queue_full: the "
+                "per-tenant depth limit; bucket_freeze: prompt-bucket "
+                "growth frozen during a compile storm; quota: the "
+                "tenant's token bucket) and by submitting tenant "
+                "(single-tenant deployments only ever emit "
+                "tenant=\"default\")", ("reason", "tenant")),
         }
     return _serve_metrics
 
@@ -182,10 +188,17 @@ class RequestHandle:
         self.latency_s: Optional[float] = None
         self.error: Optional[str] = None   # human-readable failure reason
         # set on LOAD-SHEDDING rejections only ("controller" |
-        # "queue_full" | "bucket_freeze"): the fleet router re-routes
-        # these to another replica; validation rejections (None) would
-        # fail identically everywhere and are returned as-is
+        # "queue_full" | "bucket_freeze" | "quota"): the fleet router
+        # re-routes the first three to another replica; validation
+        # rejections (None) would fail identically everywhere and quota
+        # rejections are the tenant's own contract (re-routing would be
+        # quota evasion) — both are returned as-is
         self.shed_reason: Optional[str] = None
+        # multi-tenant front door: the resolved submitting tenant's id,
+        # and — on shed/quota rejections — the deterministic backoff
+        # hint /infer surfaces as retry_after_s
+        self.tenant: Optional[str] = None
+        self.retry_after_s: Optional[float] = None
         # deterministic uint32 fingerprint of the token stream
         # (obs.numerics.host_fingerprint_ints): two same-seed runs of the
         # same schedule must agree — a mismatch in prod IS sampler
@@ -230,7 +243,7 @@ class ServingEngine:
                  draft_model=None, spec_k: Optional[int] = None,
                  role: Optional[str] = None,
                  prefill_tick_cost: Optional[float] = None,
-                 ctr_follower=None):
+                 ctr_follower=None, tenants: Optional[TenantPolicy] = None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
@@ -295,8 +308,20 @@ class ServingEngine:
             dtype=cfg.dtype)
         buckets = tuple(b for b in sorted(prompt_buckets)
                         if b <= self.max_seq_len) or (self.max_seq_len,)
+        # multi-tenant front door: the tenant policy (priority classes,
+        # WFQ weights, quota buckets) feeds the batcher's weighted-fair
+        # admission; share ONE TenantPolicy across a fleet's replicas
+        # and the token buckets become fleet-wide quotas.  None = every
+        # caller is the default tenant (the exact pre-tenant FIFO).
         self.batcher = ContinuousBatcher(num_slots, queue_depth=queue_depth,
-                                         prompt_buckets=buckets)
+                                         prompt_buckets=buckets,
+                                         policy=tenants)
+        # per-tenant usage metering (tokens, KV pages, compile-seconds,
+        # outcomes) — the billing artifact behind /tenants
+        self.tenant_meter = TenantMeter()
+        # tenant ids whose queue-depth gauge has been published at least
+        # once (so drained tenants can be zeroed on later steps)
+        self._tenant_depth_published: set = set()
         self._base_key = jax.random.PRNGKey(seed)
         self._lock = threading.RLock()
         self._handles: dict = {}
@@ -446,10 +471,20 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
                deadline_s: Optional[float] = None,
-               request_id: Optional[int] = None) -> RequestHandle:
+               request_id: Optional[int] = None,
+               tenant=None) -> RequestHandle:
         """Queue one generation request; never blocks.  Returns a handle
         that resolves when the request completes, is rejected (queue
-        depth / too long), or expires at its deadline.
+        depth / quota / too long), or expires at its deadline.
+
+        ``tenant`` names the submitting tenant (an id string or a
+        :class:`~hetu_tpu.serve.tenant.Tenant`; ``None`` = the default
+        tenant, the exact pre-tenant path): admission runs weighted-fair
+        over per-tenant sub-queues, quota buckets gate the front door
+        (:class:`TenantQuotaExceeded` -> status ``rejected`` with
+        ``shed_reason="quota"`` and a ``retry_after_s`` backoff hint),
+        and the controller's scoped shed latch can close ONE tenant's
+        door.
 
         ``request_id`` pins the id instead of drawing from this engine's
         counter — the disaggregated router's seam: token streams are a
@@ -467,13 +502,23 @@ class ServingEngine:
                                      f"flight on this engine")
             self._next_id = max(self._next_id, rid + 1)
             handle = RequestHandle(rid)
+            ten = self.batcher.policy.resolve(tenant)
+            handle.tenant = ten.id
+            is_default = ten.id == DEFAULT_TENANT.id
             req = Request(id=rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
-                          arrival=self.clock(), deadline_s=deadline_s)
+                          arrival=self.clock(), deadline_s=deadline_s,
+                          tenant=None if is_default else ten.id)
+            # tenant attrs only on non-default traffic, so a pre-tenant
+            # deployment's timelines/spans stay bit-identical
+            tattrs = {} if is_default else {"tenant": ten.id,
+                                            "tenant_class": ten.klass}
             tl = RequestTimeline(rid, req.arrival, prompt_len=len(prompt),
-                                 max_new_tokens=req.max_new_tokens)
+                                 max_new_tokens=req.max_new_tokens,
+                                 **tattrs)
             reason = None
             shed_reason = None  # set when the rejection is LOAD SHEDDING
+            retry_after = None  # the /infer backoff hint, shed only
             max_bucket = self.batcher.prompt_buckets[-1]
             if not prompt:
                 reason = "empty prompt"
@@ -499,20 +544,37 @@ class ServingEngine:
             if reason is None:
                 try:
                     self.batcher.submit(req)
+                except TenantQuotaExceeded as e:
+                    # before AdmissionShed/QueueFull: it subclasses them
+                    reason, shed_reason = str(e), "quota"
+                    retry_after = round(e.retry_after_s, 6)
                 except AdmissionShed as e:
                     reason, shed_reason = str(e), "controller"
                 except AdmissionQueueFull as e:
                     reason, shed_reason = str(e), "queue_full"
             if reason is not None:
                 _serve_m()["requests"].labels(outcome="rejected").inc()
+                self.tenant_meter.note_outcome(ten.id, "rejected")
                 if shed_reason is not None:
-                    _serve_m()["shed"].labels(reason=shed_reason).inc()
+                    if retry_after is None:
+                        retry_after = self._retry_hint(shed_reason)
+                    self.tenant_meter.note_shed(ten.id, shed_reason)
+                    _serve_m()["shed"].labels(reason=shed_reason,
+                                              tenant=ten.id).inc()
                     _journal.record("shed", request_id=rid,
                                     reason=shed_reason,
-                                    queue_depth=self.batcher.queue_len)
+                                    queue_depth=self.batcher.queue_len,
+                                    **({} if is_default
+                                       else {"tenant": ten.id}))
+                    if shed_reason == "quota":
+                        _journal.record("tenant_quota", request_id=rid,
+                                        tenant=ten.id,
+                                        retry_after_s=retry_after)
                 _journal.record("serve_reject", request_id=rid,
                                 reason=reason,
-                                queue_depth=self.batcher.queue_len)
+                                queue_depth=self.batcher.queue_len,
+                                **({} if is_default
+                                   else {"tenant": ten.id}))
                 # a zero-length timeline still lands in the trace buffer
                 # (a rejection is queryable forensics too), but it is NOT
                 # graded: it never entered the serving pipeline, so it
@@ -520,12 +582,31 @@ class ServingEngine:
                 tl.close("rejected", req.arrival, reason=reason)
                 self._finalize_timeline(tl, grade=False)
                 handle.shed_reason = shed_reason
+                handle.retry_after_s = retry_after
                 handle._finish("rejected", error=reason)
                 return handle
             self._handles[rid] = handle
             self._timelines[rid] = tl
             _serve_m()["queue"].set(self.batcher.queue_len)
         return handle
+
+    def _retry_hint(self, shed_reason: str) -> float:
+        """The deterministic ``retry_after_s`` backoff hint for non-quota
+        sheds (quota rejections carry the bucket's exact refill time
+        instead).  ``controller``: scale with how far past the engage
+        threshold the burn is (pressure 1.0 -> back off a long window's
+        worth of tenths); ``queue_full``: one scheduler wave per queued
+        batch ahead; ``bucket_freeze``: the storm detector's cool-down
+        order of magnitude.  All pure functions of current deterministic
+        state — same trace, same hints."""
+        if shed_reason == "controller":
+            return round(0.1 + self.slo.shed_pressure() *
+                         self.slo.short_window_s / 10.0, 6)
+        if shed_reason == "queue_full":
+            waves = -(-self.batcher.queue_len
+                      // max(self.batcher.num_slots, 1))
+            return round(0.05 * max(waves, 1), 6)
+        return 1.0  # bucket_freeze: wait out the compile storm
 
     # -- the scheduler loop -------------------------------------------------
 
@@ -602,6 +683,7 @@ class ServingEngine:
                             stage="queued", waited_s=round(waited, 6))
             m["requests"].labels(outcome="expired").inc()
             m["deadline"].labels(stage="queued").inc()
+            self.tenant_meter.note_outcome(req.tenant_id, "expired")
             tl = self._timelines.pop(req.id)
             tl.close("expired", now, stage="queued")
             self._finalize_timeline(tl)
@@ -617,6 +699,7 @@ class ServingEngine:
                 self._ingest_migration(req, now)
                 continue
             m["requests"].labels(outcome="admitted").inc()
+            self.tenant_meter.note_outcome(req.tenant_id, "admitted")
             self._timelines[req.id].admit(
                 now, slot=req.slot, queue_depth=self.batcher.queue_len)
             self._prefill(req, now)
@@ -639,6 +722,19 @@ class ServingEngine:
             produced = self._decode()
         m["queue"].set(self.batcher.queue_len)
         m["slots"].set(self.batcher.active_slots)
+        # per-tenant depth gauges only once real multi-tenant traffic
+        # exists (a pre-tenant deployment's metric surface is unchanged);
+        # drained tenants are zeroed, not dropped, so dashboards see the
+        # flood subside rather than a vanishing series
+        lens = self.batcher.queue_lens()
+        if any(tid != DEFAULT_TENANT.id for tid in lens) \
+                or self._tenant_depth_published:
+            tq = _tenant_m()["queue"]
+            for tid in self._tenant_depth_published - set(lens):
+                tq.labels(tenant=tid).set(0)
+            for tid, n in lens.items():
+                tq.labels(tenant=tid).set(n)
+            self._tenant_depth_published |= set(lens)
         return produced
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
@@ -717,6 +813,11 @@ class ServingEngine:
         suffix = req.prompt[shared_len:]
         bucket = self.batcher.bucket_for(len(suffix))
         self._prefill_buckets.add(bucket)  # warm: survives a freeze
+        # compile-seconds metering: whatever XLA compiles during THIS
+        # prefill (a cold bucket, typically) is billed to the tenant
+        # whose request warmed it — measured wall time, billing data
+        # only, never part of the replay surfaces
+        compile_before = self._compile_seconds()
         if self.prefill_tick_cost > 0:
             # virtual-time cost model: this prefill occupies the chip for
             # ceil(bucket * cost) scheduler ticks (consumed in step())
@@ -750,11 +851,23 @@ class ServingEngine:
         # same convention _decode uses for its post-compute timestamp
         done_at = self.clock()
         req.prefill_at = done_at
+        self.tenant_meter.note_tokens(req.tenant_id, prompt=plen)
+        self.tenant_meter.note_compile(
+            req.tenant_id, self._compile_seconds() - compile_before)
         tl = self._timelines[req.id]
         tl.prefill(tl.admitted_at, done_at, bucket=bucket, prompt_len=plen,
                    **({"shared_tokens": shared_len} if shared_len else {}))
         self._append_token(req, tok, done_at, ttft=done_at - req.arrival,
                            batch=1)
+
+    def _compile_seconds(self) -> float:
+        """Total XLA compile wall seconds across the three instrumented
+        step caches — the before/after delta attributes a prefill's cold
+        compiles to its tenant."""
+        return sum(p.compile_s
+                   for fn in (self._step_fn, self._paged_step_fn,
+                              self._sample_fn)
+                   for p in fn.programs.values())
 
     # -- KV-page migration (disaggregated serving) --------------------------
 
@@ -807,7 +920,8 @@ class ServingEngine:
             mreq = Request(
                 id=req.id, prompt=list(req.prompt),
                 max_new_tokens=req.max_new_tokens, arrival=req.arrival,
-                deadline_s=req.deadline_s, tokens=list(req.tokens),
+                deadline_s=req.deadline_s, tenant=req.tenant,
+                tokens=list(req.tokens),
                 prefill_at=req.prefill_at, migration=ticket)
             try:
                 self.batcher.submit(mreq)
@@ -998,6 +1112,7 @@ class ServingEngine:
         or — only under an overcommitted pool — ``evicted``; the last two
         keep the tokens generated so far."""
         self.batcher.finish(req.slot)
+        pages_held = len(self.pool.table(req.id).pages)
         self.pool.free(req.id)
         self._recycled += 1
         if self.defrag_every and self._recycled % self.defrag_every == 0:
@@ -1018,6 +1133,10 @@ class ServingEngine:
                      f"{age:.6g}s while decoding "
                      f"({len(req.tokens)} tokens generated)")
         m["requests"].labels(outcome=outcome).inc()
+        self.tenant_meter.note_outcome(req.tenant_id, outcome)
+        self.tenant_meter.note_tokens(req.tenant_id,
+                                      generated=len(req.tokens))
+        self.tenant_meter.note_pages(req.tenant_id, pages_held)
         # per-request token-stream fingerprint: O(tokens) host numpy, so
         # sampler nondeterminism is a field comparison in prod, not a
         # token-by-token diff (rides the handle, the /infer response, and
@@ -1126,8 +1245,14 @@ class ServingEngine:
                 "shed_pressure": self.slo.shed_pressure(),
                 "controller": {
                     "shedding": self.batcher.shed_reason,
+                    "tenant_shedding": self.batcher.tenant_sheds,
                     "freeze_bucket_growth": self.freeze_bucket_growth,
                     "warm_buckets": sorted(self._prefill_buckets),
+                },
+                "tenants": {
+                    "policy": self.batcher.policy.stats(),
+                    "meter": self.tenant_meter.summary(),
+                    "queue_lens": self.batcher.queue_lens(),
                 },
                 "queue_len": self.batcher.queue_len,
                 "active_slots": self.batcher.active_slots,

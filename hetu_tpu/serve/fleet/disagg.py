@@ -118,17 +118,21 @@ class DisaggRouter(FleetRouter):
             for i in self._decode_idx)
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
-               deadline_s=None):
+               deadline_s=None, tenant=None):
         """Place one request on the prefill side (``_rank`` restricts
         the base placement loop to the prefill-capable pool).  The
         router assigns a GLOBAL request id in submission order (re-route
         retries reuse it), so streams are bitwise comparable to a
-        colocated same-seed run of the same trace."""
+        colocated same-seed run of the same trace.  ``tenant`` rides the
+        request end to end: the prefill worker's front door charges the
+        quota and WFQ-schedules it, and the migrated request carries the
+        id to the decode worker (whose intake never re-charges it)."""
         with self._rid_lock:
             rid = self._next_rid
             self._next_rid += 1
         return super().submit(prompt, max_new_tokens,
-                              deadline_s=deadline_s, request_id=rid)
+                              deadline_s=deadline_s, request_id=rid,
+                              tenant=tenant)
 
     # -- the migration hook -------------------------------------------------
 

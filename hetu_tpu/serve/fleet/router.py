@@ -90,22 +90,29 @@ class FleetRouter:
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
                deadline_s: float | None = None,
-               request_id: int | None = None):
+               request_id: int | None = None, tenant=None):
         """Place one request; returns the chosen replica's handle.  On a
         load-shedding rejection the request re-routes to the next-ranked
         replica (bounded by ``max_retries``); the last handle is returned
-        when every candidate shed.  ``request_id`` pins the engine-side
-        id across every retry (the DisaggRouter's global-id seam); None
-        lets the chosen engine draw its own."""
+        when every candidate shed.  ``tenant`` passes through to the
+        chosen engine's multi-tenant front door; a QUOTA rejection is
+        never re-routed — the tenant's token bucket is its fleet-wide
+        contract, and walking the replica list with a drained bucket
+        would be quota evasion, not load balancing.  ``request_id`` pins
+        the engine-side id across every retry (the DisaggRouter's
+        global-id seam); None lets the chosen engine draw its own."""
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
         ranked = self._rank(prompt)
         tries = min(len(ranked), self.max_retries + 1)
         for a, (neg_aff, _pressure, _load, idx) in enumerate(ranked[:tries]):
             handle = self.engines[idx].submit(prompt, max_new_tokens,
                                               deadline_s=deadline_s,
-                                              request_id=request_id)
-            if handle.status == "rejected" and handle.shed_reason is None:
-                # a validation rejection is identical on every replica
+                                              request_id=request_id,
+                                              tenant=tenant)
+            if handle.status == "rejected" \
+                    and handle.shed_reason in (None, "quota"):
+                # a validation rejection is identical on every replica;
+                # a quota rejection is the tenant's own contract
                 return handle
             shed = (handle.status == "rejected")
             if shed and a + 1 < tries:
@@ -118,10 +125,15 @@ class FleetRouter:
 
     def _place(self, handle, replica: int, reason: str) -> None:
         _router_m()["placements"].labels(reason=reason).inc()
+        # tenant extra only on non-default traffic: pre-tenant fleet
+        # journals stay bit-identical
+        tenant = getattr(handle, "tenant", None)
+        extra = {} if tenant in (None, "default") else {"tenant": tenant}
         _journal.record("router_place", request_id=handle.request_id,
-                        replica=replica, reason=reason)
+                        replica=replica, reason=reason, **extra)
         self.placements.append({"request_id": handle.request_id,
-                                "replica": replica, "reason": reason})
+                                "replica": replica, "reason": reason,
+                                **extra})
 
     # -- fleet drivers ------------------------------------------------------
 
@@ -175,6 +187,8 @@ class FleetRouter:
                 "shed_pressure": e.slo.shed_pressure(),
                 "load_factor": round(e.batcher.load_factor(), 6),
                 "shedding": e.batcher.shed_reason,
+                "tenant_shedding": e.batcher.tenant_sheds,
+                "tenant_queue_lens": e.batcher.queue_lens(),
                 "pages_free": pool["pages_free"],
                 "pages_shared": pool["pages_shared"],
                 "prefix": (None if e.sharer is None else e.sharer.stats()),

@@ -16,7 +16,7 @@ import dataclasses
 import numpy as np
 
 __all__ = ["LoadItem", "generate_load", "generate_shared_prefix_load",
-           "generate_prefill_burst_load"]
+           "generate_prefill_burst_load", "generate_multitenant_load"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +35,10 @@ class LoadItem:
     # lets the disaggregation A/B attribute tail latency to the burst
     # without re-deriving it from prompt lengths
     burst: bool = False
+    # multi-tenant traces: which tenant submits this request (None =
+    # the default tenant) — drives the WFQ front door and lets the
+    # flood A/B attribute sheds per tenant from the trace spec alone
+    tenant: str | None = None
 
 
 def generate_load(seed: int, n_requests: int, *, vocab: int,
@@ -137,4 +141,48 @@ def generate_prefill_burst_load(seed: int, n_requests: int, *, vocab: int,
             submit_at=t,
             prompt=tuple(int(x) for x in rng.integers(0, vocab, plen)),
             max_new_tokens=mnt, deadline_s=deadline_s, burst=in_burst))
+    return out
+
+
+def generate_multitenant_load(seed: int, n_requests: int, *, vocab: int,
+                              tenants,
+                              mean_gap_s: float = 0.002,
+                              deadline_s: float | None = None) -> list:
+    """Seeded adversarial multi-tenant traffic: each arrival draws its
+    submitting tenant from ``tenants`` — a sequence of spec dicts ::
+
+        {"id": "acme", "share": 0.8,          # arrival-mix weight
+         "prompt_len": (2, 24), "max_new": (1, 12),   # optional ranges
+         "deadline_s": 0.5}                            # optional override
+
+    ``share`` weights are normalised over the pool, so a flooding mix is
+    one line: ``[{"id": "flood", "share": 0.9, "max_new": (16, 32)},
+    {"id": "victim", "share": 0.1}]``.  Per-tenant shape ranges let the
+    flood carry heavy decode budgets while the victim stays latency-
+    shaped; a per-tenant ``deadline_s`` overrides the trace default.
+    Arrivals accumulate one shared exponential-gap stream (the open-loop
+    model above), and the tenant choice is a seeded weighted draw per
+    arrival — same seed, same trace, bit for bit (unit-tested)."""
+    specs = [dict(s) for s in tenants]
+    if not specs:
+        raise ValueError("need at least one tenant spec")
+    shares = np.array([float(s.get("share", 1.0)) for s in specs])
+    if (shares < 0).any() or shares.sum() <= 0:
+        raise ValueError(f"tenant shares must be >= 0 with a positive "
+                         f"sum, got {shares.tolist()}")
+    shares = shares / shares.sum()
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for _ in range(n_requests):
+        t += float(rng.exponential(mean_gap_s))
+        spec = specs[int(rng.choice(len(specs), p=shares))]
+        lo, hi = spec.get("prompt_len", (2, 24))
+        nlo, nhi = spec.get("max_new", (1, 12))
+        plen = int(rng.integers(lo, hi + 1))
+        out.append(LoadItem(
+            submit_at=t,
+            prompt=tuple(int(x) for x in rng.integers(0, vocab, plen)),
+            max_new_tokens=int(rng.integers(nlo, nhi + 1)),
+            deadline_s=spec.get("deadline_s", deadline_s),
+            tenant=str(spec["id"])))
     return out

@@ -8,18 +8,27 @@ ephemeral port scrapes the serving SLO metrics next to the endpoints
 they describe.
 
 - ``POST /infer`` with ``{"prompt": [ids...], "max_new_tokens": n,
-  "deadline_s": s?, "timeout_s": s?}`` blocks until the request resolves
-  and returns ``{"request_id", "trace_id", "status", "tokens", "ttft_s",
-  "latency_s"}`` — 200 on completion, 429 on admission rejection, 504 on
-  deadline expiry.  Non-completed responses carry a human-readable
-  ``error`` naming what happened (rejection reason; deadline stage and
-  age), and ``trace_id`` keys the request's full timeline at
-  ``/trace/<request_id>``.  Rejections that are *load shedding* are
-  distinguishable by their ``error`` text: a controller shed under
-  sustained SLO burn says so ("controller shed: ..."), a compile-storm
-  bucket freeze names the frozen bucket, and a full queue names the
-  depth limit — each also counted under
-  ``hetu_serve_shed_total{reason=}`` and journaled (kind ``shed``).
+  "deadline_s": s?, "timeout_s": s?, "tenant": "id"?}`` blocks until
+  the request resolves and returns ``{"request_id", "trace_id",
+  "status", "tokens", "ttft_s", "latency_s"}`` — 200 on completion, 429
+  on admission rejection, 504 on deadline expiry.  ``tenant`` names the
+  submitting tenant (omitted = the default tenant): admission is
+  weighted-fair across tenants, quota buckets gate the front door, and
+  the controller can shed one tenant without the others.  Non-completed
+  responses carry a human-readable ``error`` naming what happened
+  (rejection reason; deadline stage and age), and ``trace_id`` keys the
+  request's full timeline at ``/trace/<request_id>``.  Rejections that
+  are *load shedding* additionally carry a machine-readable ``reason``
+  (``controller`` | ``queue_full`` | ``bucket_freeze`` | ``quota``) and
+  a deterministic ``retry_after_s`` backoff hint (the token bucket's
+  exact refill time on quota; pressure/queue-derived otherwise) — each
+  also counted under ``hetu_serve_shed_total{reason=,tenant=}`` and
+  journaled (kind ``shed``; quota rejections add ``tenant_quota``).
+- ``GET /tenants`` returns the per-tenant metering artifact: the tenant
+  policy (class, weight, quota bucket state), usage accumulators
+  (requests by outcome, prompt/generated tokens, KV pages held,
+  compile-seconds), per-tenant queue depths, and live scoped-shed
+  latches — the billing surface.
 - ``GET /controller`` (via the telemetry routes) reports the installed
   runtime controller's policy, latches, and decision list — README
   "Self-driving runtime".
@@ -44,6 +53,38 @@ __all__ = ["ServingServer", "serve_engine", "FleetServingServer",
            "serve_fleet_router"]
 
 
+def _handle_body(handle) -> dict:
+    """The shared /infer response body for a resolved handle."""
+    body = {
+        "request_id": handle.request_id,
+        "trace_id": handle.trace_id,
+        "status": handle.status,
+        "tokens": handle.tokens,
+        # deterministic token-stream fingerprint: same seed + same
+        # prompt must return the same value however the batch was
+        # composed — compare across replicas/replays to catch
+        # sampler nondeterminism in prod (null until a token lands)
+        "stream_fingerprint": handle.stream_fingerprint,
+        "ttft_s": handle.ttft_s,
+        "latency_s": handle.latency_s,
+    }
+    if handle.error is not None:
+        # the distinguishable-error contract: a shed/expired request
+        # says WHY, not just a status code
+        body["error"] = handle.error
+    if handle.shed_reason is not None:
+        # machine-readable backoff contract: WHICH door closed
+        # (controller | queue_full | bucket_freeze | quota) and how
+        # long to back off — the quota hint is the token bucket's
+        # exact refill arithmetic
+        body["reason"] = handle.shed_reason
+        if handle.retry_after_s is not None:
+            body["retry_after_s"] = handle.retry_after_s
+    if getattr(handle, "tenant", None) not in (None, "default"):
+        body["tenant"] = handle.tenant
+    return body
+
+
 def serving_routes(engine) -> Routes:
     """Telemetry routes + the serving endpoints over ``engine``.  Always
     scrapes the process-wide registry — that is where the engine's
@@ -58,7 +99,8 @@ def serving_routes(engine) -> Routes:
             return json.dumps({"pred": [float(p) for p in pred]}).encode()
         handle = engine.submit(
             req["prompt"], int(req.get("max_new_tokens", 16)),
-            deadline_s=req.get("deadline_s"))
+            deadline_s=req.get("deadline_s"),
+            tenant=req.get("tenant"))
         # `or`: a JSON null (or 0) timeout_s must not disable the timeout
         # and hang this handler thread forever
         if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
@@ -68,24 +110,16 @@ def serving_routes(engine) -> Routes:
                     "application/json", 504)
         status = {"completed": 200, "rejected": 429,
                   "expired": 504, "evicted": 503}[handle.status]
-        body = {
-            "request_id": handle.request_id,
-            "trace_id": handle.trace_id,
-            "status": handle.status,
-            "tokens": handle.tokens,
-            # deterministic token-stream fingerprint: same seed + same
-            # prompt must return the same value however the batch was
-            # composed — compare across replicas/replays to catch
-            # sampler nondeterminism in prod (null until a token lands)
-            "stream_fingerprint": handle.stream_fingerprint,
-            "ttft_s": handle.ttft_s,
-            "latency_s": handle.latency_s,
-        }
-        if handle.error is not None:
-            # the distinguishable-error contract: a shed/expired request
-            # says WHY, not just a status code
-            body["error"] = handle.error
-        return json.dumps(body).encode(), "application/json", status
+        return (json.dumps(_handle_body(handle)).encode(),
+                "application/json", status)
+
+    def tenants(query, body):
+        return json.dumps({
+            "policy": engine.batcher.policy.stats(),
+            "meter": engine.tenant_meter.summary(),
+            "queue_lens": engine.batcher.queue_lens(),
+            "shedding": engine.batcher.tenant_sheds,
+        }).encode()
 
     def trace_index(query, body):
         buf = engine.trace_buffer
@@ -110,6 +144,7 @@ def serving_routes(engine) -> Routes:
         return json.dumps(tl.summary()).encode()
 
     routes.add("POST", "/infer", infer)
+    routes.add("GET", "/tenants", tenants)
     routes.add("GET", "/stats",
                lambda q, b: json.dumps(engine.stats()).encode())
     routes.add("GET", "/slo",
@@ -158,7 +193,8 @@ def fleet_serving_routes(router) -> Routes:
         req = json.loads(body or b"{}")
         handle = router.submit(
             req["prompt"], int(req.get("max_new_tokens", 16)),
-            deadline_s=req.get("deadline_s"))
+            deadline_s=req.get("deadline_s"),
+            tenant=req.get("tenant"))
         if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
             return (json.dumps({"request_id": handle.request_id,
                                 "trace_id": handle.trace_id,
@@ -166,20 +202,24 @@ def fleet_serving_routes(router) -> Routes:
                     "application/json", 504)
         status = {"completed": 200, "rejected": 429,
                   "expired": 504, "evicted": 503}[handle.status]
-        out = {
-            "request_id": handle.request_id,
-            "trace_id": handle.trace_id,
-            "status": handle.status,
-            "tokens": handle.tokens,
-            "stream_fingerprint": handle.stream_fingerprint,
-            "ttft_s": handle.ttft_s,
-            "latency_s": handle.latency_s,
-        }
-        if handle.error is not None:
-            out["error"] = handle.error
-        return json.dumps(out).encode(), "application/json", status
+        return (json.dumps(_handle_body(handle)).encode(),
+                "application/json", status)
+
+    def tenants(query, body):
+        return json.dumps({
+            "replicas": [{
+                "replica": i,
+                "meter": e.tenant_meter.summary(),
+                "queue_lens": e.batcher.queue_lens(),
+                "shedding": e.batcher.tenant_sheds,
+            } for i, e in enumerate(router.engines)],
+            # replicas may share one TenantPolicy (fleet-wide quotas);
+            # report the first engine's view as the fleet policy
+            "policy": router.engines[0].batcher.policy.stats(),
+        }).encode()
 
     routes.add("POST", "/infer", infer)
+    routes.add("GET", "/tenants", tenants)
     routes.add("GET", "/fleet/serve",
                lambda q, b: json.dumps(router.stats()).encode())
     return routes

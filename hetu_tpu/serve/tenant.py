@@ -1,0 +1,329 @@
+"""Multi-tenant front door: tenant identity, quotas, and metering.
+
+"Millions of users" (ROADMAP north star) means tenants with different
+contracts sharing one fleet — not one anonymous FIFO queue where a
+single abusive client starves everyone and the SLO-burn shed punishes
+victims and attackers alike.  This module carries the identity and
+policy side of that story; the scheduling side (deterministic
+weighted-fair admission over per-tenant sub-queues) lives in
+:mod:`~hetu_tpu.serve.batcher`.
+
+- :class:`Tenant` — one tenant's identity: id, **priority class**
+  (``latency`` — interactive traffic graded against the tight SLO — or
+  ``batch`` — throughput traffic the controller sheds FIRST under
+  sustained burn), and WFQ **weight** (its fair share of admission).
+- :class:`TokenBucket` — a deterministic per-tenant admission quota in
+  *work tokens* (``prompt + max_new_tokens``, the same cost unit WFQ
+  schedules on).  Refill is computed from the injected clock's
+  timestamps, never wall time, so same-seed replays exhaust and refill
+  the bucket at identical instants.  Exhaustion raises
+  :class:`~hetu_tpu.serve.batcher.TenantQuotaExceeded` upstream, whose
+  ``retry_after_s`` is this bucket's refill arithmetic — the client is
+  told exactly how long to back off.
+- :class:`TenantPolicy` — the registry mapping tenant ids to their
+  contract (class, weight, quota).  Unknown tenants resolve to a
+  default-contract :class:`Tenant` (latency class, weight 1, no quota)
+  so the front door never 500s on a new customer; ``tenant=None``
+  resolves to :data:`DEFAULT_TENANT`, which keeps every pre-tenant
+  call site bitwise on its old path.  Share ONE policy across a fleet's
+  replicas and the token buckets become fleet-wide quotas (the bucket
+  state is the shared object).
+- :class:`TenantMeter` — the per-tenant billing artifact: requests by
+  outcome, prompt/generated tokens, KV pages held, and compile-seconds
+  attributed to the tenant whose prefill warmed the bucket.  Mirrors
+  onto the ``hetu_tenant_*`` metric family and serves as the
+  ``/tenants`` payload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, Optional
+
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["Tenant", "TokenBucket", "TenantPolicy", "TenantMeter",
+           "DEFAULT_TENANT", "PRIORITY_CLASSES"]
+
+#: the two contract tiers: ``latency`` (interactive; shed LAST) and
+#: ``batch`` (throughput; the controller's first shed target)
+PRIORITY_CLASSES = ("latency", "batch")
+
+
+@dataclasses.dataclass(frozen=True)
+class Tenant:
+    """One tenant's identity + contract as the scheduler sees it."""
+
+    id: str
+    klass: str = "latency"     # priority class: "latency" | "batch"
+    weight: float = 1.0        # WFQ share; admission cost is divided by it
+
+    def __post_init__(self):
+        if not self.id or not isinstance(self.id, str):
+            raise ValueError(f"tenant id must be a non-empty string, "
+                             f"got {self.id!r}")
+        if self.klass not in PRIORITY_CLASSES:
+            raise ValueError(f"unknown priority class {self.klass!r}; "
+                             f"one of {PRIORITY_CLASSES}")
+        if not self.weight > 0:
+            raise ValueError(f"tenant weight must be positive, "
+                             f"got {self.weight}")
+
+
+#: the anonymous pre-tenant caller: every request that names no tenant
+#: is this one, so single-tenant deployments keep their exact old
+#: admission order, journal, and metric series semantics
+DEFAULT_TENANT = Tenant(id="default", klass="latency", weight=1.0)
+
+
+class TokenBucket:
+    """Deterministic token-bucket quota in work tokens.
+
+    State advances only on the timestamps the caller passes (the
+    engine's injectable clock), so a replayed trace drains and refills
+    the bucket bitwise.  A request costing more than ``capacity`` is
+    charged ``capacity`` (it admits from a full bucket) — clamping, not
+    permanently starving, oversized-but-legal work.  Thread-safe so one
+    bucket can back a whole fleet's replicas as a shared quota.
+    """
+
+    def __init__(self, capacity: float, refill_per_s: float, *,
+                 tokens: Optional[float] = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if refill_per_s < 0:
+            raise ValueError(f"refill_per_s must be >= 0, "
+                             f"got {refill_per_s}")
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self.tokens = self.capacity if tokens is None else float(tokens)
+        self._updated: Optional[float] = None   # clock of last refill
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if self._updated is None:
+            self._updated = now
+            return
+        dt = max(now - self._updated, 0.0)
+        self._updated = now
+        if dt and self.refill_per_s:
+            self.tokens = min(self.capacity,
+                              self.tokens + dt * self.refill_per_s)
+
+    def _cost(self, cost: float) -> float:
+        return min(max(float(cost), 0.0), self.capacity)
+
+    def try_take(self, cost: float, now: float) -> bool:
+        """Refill to ``now`` and take ``cost`` tokens if available."""
+        with self._lock:
+            self._refill(now)
+            c = self._cost(cost)
+            if self.tokens + 1e-12 < c:
+                return False
+            self.tokens -= c
+            return True
+
+    def retry_after(self, cost: float, now: float) -> float:
+        """Seconds until ``cost`` tokens will be available (0.0 when
+        affordable right now; ``capacity / refill`` bounds it).  With a
+        zero refill rate the bucket never recovers — one full capacity
+        drain's worth of seconds is reported as the honest 'a while'."""
+        with self._lock:
+            self._refill(now)
+            c = self._cost(cost)
+            short = c - self.tokens
+            if short <= 0:
+                return 0.0
+            if self.refill_per_s <= 0:
+                return float(self.capacity)
+            return short / self.refill_per_s
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "refill_per_s": self.refill_per_s,
+                    "tokens": round(self.tokens, 6)}
+
+
+class TenantPolicy:
+    """Tenant id -> contract (class, weight, quota bucket).
+
+    ``resolve`` accepts a :class:`Tenant`, a bare id string, or ``None``
+    and always returns a :class:`Tenant`: unknown ids are auto-
+    registered with the default contract (a new customer is traffic,
+    not an error), ``None`` is :data:`DEFAULT_TENANT`.  Thread-safe;
+    share one instance across a fleet's engines for fleet-wide quota
+    semantics.
+    """
+
+    def __init__(self, tenants: Iterable[Tenant] = (),
+                 quotas: Optional[Dict[str, TokenBucket]] = None):
+        self._tenants: Dict[str, Tenant] = {DEFAULT_TENANT.id:
+                                            DEFAULT_TENANT}
+        self._quotas: Dict[str, TokenBucket] = dict(quotas or {})
+        self._lock = threading.Lock()
+        for t in tenants:
+            self.register(t)
+        for tid in self._quotas:
+            self.resolve(tid)   # a quota names a tenant into existence
+
+    def register(self, tenant: Tenant,
+                 quota: Optional[TokenBucket] = None) -> Tenant:
+        """Install (or replace) one tenant's contract."""
+        with self._lock:
+            self._tenants[tenant.id] = tenant
+            if quota is not None:
+                self._quotas[tenant.id] = quota
+        return tenant
+
+    def resolve(self, tenant) -> Tenant:
+        """``None`` | id-string | :class:`Tenant` -> :class:`Tenant`."""
+        if tenant is None:
+            return DEFAULT_TENANT
+        if isinstance(tenant, Tenant):
+            with self._lock:
+                known = self._tenants.get(tenant.id)
+                if known is None or known != tenant:
+                    self._tenants[tenant.id] = tenant
+            return tenant
+        tid = str(tenant)
+        with self._lock:
+            known = self._tenants.get(tid)
+            if known is None:
+                known = Tenant(id=tid)
+                self._tenants[tid] = known
+            return known
+
+    def bucket(self, tenant_id: str) -> Optional[TokenBucket]:
+        with self._lock:
+            return self._quotas.get(tenant_id)
+
+    def known(self) -> Dict[str, Tenant]:
+        with self._lock:
+            return dict(self._tenants)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {tid: {"class": t.klass, "weight": t.weight,
+                          "quota": (self._quotas[tid].stats()
+                                    if tid in self._quotas else None)}
+                    for tid, t in sorted(self._tenants.items())}
+
+
+_tenant_metrics = None
+
+
+def _tenant_m() -> dict:
+    global _tenant_metrics
+    if _tenant_metrics is None:
+        reg = _obs.get_registry()
+        _tenant_metrics = {
+            "requests": reg.counter(
+                "hetu_tenant_requests_total",
+                "per-tenant serving requests by outcome (the tenant-"
+                "scoped twin of hetu_serve_requests_total)",
+                ("tenant", "outcome")),
+            "tokens": reg.counter(
+                "hetu_tenant_tokens_total",
+                "per-tenant token metering by kind (prompt: tokens "
+                "admitted for prefill; generated: tokens decoded) — "
+                "the billing artifact",
+                ("tenant", "kind")),
+            "pages": reg.counter(
+                "hetu_tenant_kv_pages_total",
+                "per-tenant KV pages held at request retirement "
+                "(cumulative page-holds, the pool-occupancy billing "
+                "unit)", ("tenant",)),
+            "compile": reg.counter(
+                "hetu_tenant_compile_seconds_total",
+                "per-tenant XLA compile wall seconds, attributed to "
+                "the tenant whose prefill warmed the program",
+                ("tenant",)),
+            "queue": reg.gauge(
+                "hetu_tenant_queue_depth",
+                "per-tenant admission sub-queue depth", ("tenant",)),
+        }
+    return _tenant_metrics
+
+
+class TenantMeter:
+    """Per-tenant usage accumulators — the billing artifact.
+
+    All mutators take the tenant id; unknown ids materialize a zeroed
+    row.  Mirrors onto the ``hetu_tenant_*`` families when telemetry is
+    enabled; :meth:`summary` is the ``/tenants`` payload.  The recorded
+    quantities are schedule-deterministic (token counts, page counts)
+    except ``compile_s``, which is measured wall time — billing data,
+    deliberately excluded from the bitwise-replay surfaces.
+    """
+
+    def __init__(self):
+        self._rows: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def _row(self, tenant_id: str) -> dict:
+        row = self._rows.get(tenant_id)
+        if row is None:
+            row = {"requests": {}, "prompt_tokens": 0,
+                   "generated_tokens": 0, "kv_pages": 0,
+                   "compile_s": 0.0, "shed": {}}
+            self._rows[tenant_id] = row
+        return row
+
+    def note_outcome(self, tenant_id: str, outcome: str) -> None:
+        with self._lock:
+            req = self._row(tenant_id)["requests"]
+            req[outcome] = req.get(outcome, 0) + 1
+        if _obs.enabled():
+            _tenant_m()["requests"].labels(tenant=tenant_id,
+                                           outcome=outcome).inc()
+
+    def note_shed(self, tenant_id: str, reason: str) -> None:
+        with self._lock:
+            shed = self._row(tenant_id)["shed"]
+            shed[reason] = shed.get(reason, 0) + 1
+
+    def note_tokens(self, tenant_id: str, *, prompt: int = 0,
+                    generated: int = 0) -> None:
+        with self._lock:
+            row = self._row(tenant_id)
+            row["prompt_tokens"] += int(prompt)
+            row["generated_tokens"] += int(generated)
+        if _obs.enabled():
+            m = _tenant_m()
+            if prompt:
+                m["tokens"].labels(tenant=tenant_id, kind="prompt").inc(
+                    int(prompt))
+            if generated:
+                m["tokens"].labels(tenant=tenant_id,
+                                   kind="generated").inc(int(generated))
+
+    def note_pages(self, tenant_id: str, pages: int) -> None:
+        with self._lock:
+            self._row(tenant_id)["kv_pages"] += int(pages)
+        if _obs.enabled() and pages:
+            _tenant_m()["pages"].labels(tenant=tenant_id).inc(int(pages))
+
+    def note_compile(self, tenant_id: str, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._row(tenant_id)["compile_s"] += float(seconds)
+        if _obs.enabled():
+            _tenant_m()["compile"].labels(tenant=tenant_id).inc(
+                float(seconds))
+
+    def shed_counts(self, tenant_id: str) -> dict:
+        with self._lock:
+            return dict(self._rows.get(tenant_id, {}).get("shed", {}))
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {tid: {"requests": dict(row["requests"]),
+                          "prompt_tokens": row["prompt_tokens"],
+                          "generated_tokens": row["generated_tokens"],
+                          "kv_pages": row["kv_pages"],
+                          "compile_s": round(row["compile_s"], 6),
+                          "shed": dict(row["shed"])}
+                    for tid, row in sorted(self._rows.items())}

@@ -134,6 +134,15 @@ def pytest_configure(config):
                    "round-trip, counter-exactness oracle, and one "
                    "snapshot publish->install cycle stay in tier-1 — "
                    "multi-process PS chaos rides the slow tier")
+    config.addinivalue_line(
+        "markers", "tenant: multi-tenant front-door tests (serve.tenant "
+                   "priority classes / token-bucket quotas / metering, "
+                   "the batcher's weighted-fair admission, scoped "
+                   "shedding, and the /tenants endpoint); the WFQ "
+                   "starvation-freedom property suite, the quota/backoff "
+                   "contract, and a two-tenant /infer + /slo HTTP smoke "
+                   "stay in tier-1 — the seeded flood acceptance rides "
+                   "the slow tier")
 
 
 @pytest.fixture(autouse=True)

@@ -595,7 +595,8 @@ class TestServeControls:
         assert h2.status == "rejected"
         assert "controller shed" in h2.error
         d = reg.delta(reg.snapshot(), s0)
-        assert d.get('hetu_serve_shed_total{reason="controller"}') == 1
+        assert d.get('hetu_serve_shed_total'
+                     '{reason="controller",tenant="default"}') == 1
         assert [e["reason"] for e in journal.of_kind("shed")] == \
             ["controller"]
         # burn recovers once the windows drain -> release, then serve
@@ -622,7 +623,8 @@ class TestServeControls:
         h2 = eng.submit([1, 2, 3], max_new_tokens=2)
         assert h2.status == "rejected" and "depth limit" in h2.error
         d = reg.delta(reg.snapshot(), s0)
-        assert d.get('hetu_serve_shed_total{reason="queue_full"}') == 1
+        assert d.get('hetu_serve_shed_total'
+                     '{reason="queue_full",tenant="default"}') == 1
         shed, = journal.of_kind("shed")
         assert shed["reason"] == "queue_full"
         eng.run_until_idle()

@@ -591,6 +591,15 @@ def test_metric_naming_conventions():
                 ("_seconds", "_bytes", "_steps")):
             problems.append(f"{name}: histogram without a unit suffix "
                             f"({regs[0][3]})")
+        # the per-tenant metering family must be attributable: every
+        # hetu_tenant_* registration declares a `tenant` label (an
+        # unlabeled tenant metric is a billing artifact with no payer)
+        if name.startswith("hetu_tenant_"):
+            tenant_labels = [l for _k, l, _h, _w in regs if l is not None]
+            if not tenant_labels or any("tenant" not in l
+                                        for l in tenant_labels):
+                problems.append(f"{name}: hetu_tenant_* family must "
+                                f"declare a 'tenant' label ({regs[0][3]})")
         # conflicting re-registration: among sites that state a schema
         # (a help text or labels — a bare name is a family lookup, not a
         # registration), everyone must agree

@@ -1,0 +1,595 @@
+"""Multi-tenant front door: priority classes, token-bucket quotas,
+deterministic weighted-fair admission, scoped shedding, per-tenant
+SLO/metering surfaces, and the seeded flood acceptance.
+
+Layered like the subsystem: pure TokenBucket/WFQ unit tests first (no
+jax, no model), then the engine's quota/journal/metric contract, the
+controller's scoped latch, the two-tenant HTTP smoke, and finally the
+2-replica flood A/B with bitwise replay — the ISSUE 16 acceptance.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hetu_tpu import obs
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.models.gpt import GPT, GPTConfig
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.serve import (AdmissionQueueFull, AdmissionShed,
+                            ContinuousBatcher, DEFAULT_TENANT, FleetRouter,
+                            Request, ServingEngine, Tenant, TenantPolicy,
+                            TenantQuotaExceeded, TokenBucket,
+                            generate_multitenant_load, serve_engine)
+
+pytestmark = pytest.mark.tenant
+
+
+@pytest.fixture
+def journal():
+    j = obs_journal.EventJournal(clock=lambda: 0.0)
+    obs_journal.set_journal(j)
+    yield j
+    obs_journal.set_journal(None)
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def tiny_gpt(seed=0):
+    set_random_seed(seed)
+    return GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, max_seq_len=64))
+
+
+def req(i, *, plen=4, new=4, arrival=0.0, tenant=None, deadline=None):
+    return Request(id=i, prompt=list(range(1, plen + 1)),
+                   max_new_tokens=new, arrival=arrival,
+                   deadline_s=deadline, tenant=tenant)
+
+
+# ------------------------------------------------------------- token bucket
+
+class TestTokenBucket:
+    def test_drain_refill_and_exact_retry_after(self):
+        b = TokenBucket(capacity=10.0, refill_per_s=2.0)
+        assert b.try_take(8.0, now=0.0)
+        assert not b.try_take(8.0, now=0.0)
+        # 6 tokens short at 2/s -> exactly 3 seconds; pure arithmetic
+        assert b.retry_after(8.0, now=0.0) == 3.0
+        assert not b.try_take(8.0, now=2.9)
+        assert b.try_take(8.0, now=3.0)
+
+    def test_refill_clamps_at_capacity(self):
+        b = TokenBucket(capacity=5.0, refill_per_s=100.0)
+        assert b.try_take(5.0, now=0.0)
+        assert b.try_take(5.0, now=1000.0)
+        assert b.stats()["tokens"] == 0.0
+
+    def test_oversized_cost_clamps_not_starves(self):
+        b = TokenBucket(capacity=4.0, refill_per_s=1.0)
+        assert b.try_take(100.0, now=0.0)  # charged capacity, admitted
+        assert b.retry_after(100.0, now=0.0) == 4.0
+
+    def test_zero_refill_never_recovers(self):
+        b = TokenBucket(capacity=6.0, refill_per_s=0.0)
+        assert b.try_take(6.0, now=0.0)
+        assert not b.try_take(1.0, now=10**9)
+        assert b.retry_after(1.0, now=10**9) == 6.0
+
+    def test_replay_is_bitwise(self):
+        def run():
+            b = TokenBucket(capacity=7.0, refill_per_s=3.0)
+            out = []
+            for now, cost in [(0.0, 5.0), (0.1, 5.0), (1.0, 5.0),
+                              (2.5, 5.0), (2.5, 1.0)]:
+                out.append((b.try_take(cost, now),
+                            b.retry_after(5.0, now), b.stats()["tokens"]))
+            return out
+        assert run() == run()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            TokenBucket(0.0, 1.0)
+        with pytest.raises(ValueError, match="refill"):
+            TokenBucket(1.0, -1.0)
+
+
+# ------------------------------------------------------- identity + policy
+
+class TestTenantPolicy:
+    def test_tenant_validation(self):
+        with pytest.raises(ValueError, match="priority class"):
+            Tenant(id="x", klass="platinum")
+        with pytest.raises(ValueError, match="weight"):
+            Tenant(id="x", weight=0.0)
+        with pytest.raises(ValueError, match="tenant id"):
+            Tenant(id="")
+
+    def test_resolve_none_is_default_and_unknowns_materialize(self):
+        p = TenantPolicy()
+        assert p.resolve(None) is DEFAULT_TENANT
+        t = p.resolve("newcomer")
+        assert t.id == "newcomer" and t.klass == "latency"
+        assert t.weight == 1.0 and p.bucket("newcomer") is None
+        assert "newcomer" in p.known()
+
+    def test_register_contract_and_stats(self):
+        p = TenantPolicy()
+        p.register(Tenant(id="acme", klass="batch", weight=3.0),
+                   quota=TokenBucket(100.0, 10.0))
+        s = p.stats()
+        assert s["acme"]["class"] == "batch" and s["acme"]["weight"] == 3.0
+        assert s["acme"]["quota"]["capacity"] == 100.0
+        assert s["default"]["quota"] is None
+
+
+# ------------------------------------------------------------ WFQ admission
+
+class TestWFQAdmission:
+    def drain_order(self, batcher, n_slots_per_poll=None):
+        """Admit everything, one slot at a time; returns tenant order."""
+        order = []
+        while batcher.queue_len:
+            tick = batcher.poll(0.0)
+            if not tick.admitted:
+                break
+            for r in tick.admitted:
+                order.append(r.tenant_id)
+                batcher.finish(r.slot)
+        return order
+
+    def test_single_tenant_reduces_to_fifo(self):
+        b = ContinuousBatcher(num_slots=1, queue_depth=16)
+        for i in range(6):
+            b.submit(req(i))
+        tick_ids = []
+        while b.queue_len:
+            t = b.poll(0.0)
+            tick_ids.extend(r.id for r in t.admitted)
+            for r in t.admitted:
+                b.finish(r.slot)
+        assert tick_ids == list(range(6))
+
+    def test_weighted_interleave(self):
+        p = TenantPolicy([Tenant(id="heavy", weight=2.0),
+                          Tenant(id="light", weight=1.0)])
+        b = ContinuousBatcher(num_slots=1, queue_depth=64, policy=p)
+        for i in range(12):
+            b.submit(req(i, tenant="heavy" if i < 8 else "light"))
+        order = self.drain_order(b)
+        # equal per-request cost: weight-2 admits ~2 per 1 of weight-1
+        first6 = order[:6]
+        assert first6.count("heavy") == 4 and first6.count("light") == 2
+        assert set(order) == {"heavy", "light"}
+
+    def test_backlogged_heavy_cannot_starve_light(self):
+        """A saturating high-weight tenant's tags grow without bound
+        while a queued light request's tag is frozen at enqueue — the
+        light head must win within a bounded number of admissions."""
+        p = TenantPolicy([Tenant(id="flood", weight=9.0),
+                          Tenant(id="victim", weight=1.0)])
+        b = ContinuousBatcher(num_slots=1, queue_depth=256, policy=p)
+        for i in range(100):
+            b.submit(req(i, tenant="flood"))
+        b.submit(req(100, tenant="victim"))
+        order = self.drain_order(b)
+        assert "victim" in order[:95]
+
+    def test_starvation_freedom_property_seeded(self):
+        """Property suite: random weights, random interleaved arrivals,
+        one saturating high-weight tenant — every nonzero-weight tenant
+        drains, and the same seed yields the identical admission order
+        (the determinism half of the WFQ contract)."""
+        def episode(seed):
+            rng = np.random.default_rng(seed)
+            ids = [f"t{k}" for k in range(int(rng.integers(2, 6)))]
+            weights = {t: float(rng.uniform(0.1, 8.0)) for t in ids}
+            flood = ids[0]
+            weights[flood] = 50.0
+            p = TenantPolicy([Tenant(id=t, weight=w)
+                              for t, w in weights.items()])
+            b = ContinuousBatcher(num_slots=2, queue_depth=512, policy=p)
+            n = 0
+            for t in ids[1:]:
+                for _ in range(int(rng.integers(1, 5))):
+                    b.submit(req(n, plen=int(rng.integers(1, 9)),
+                                 new=int(rng.integers(1, 9)), tenant=t))
+                    n += 1
+            for _ in range(60):  # the flood
+                b.submit(req(n, plen=8, new=8, tenant=flood))
+                n += 1
+            order = []
+            while b.queue_len:
+                tick = b.poll(0.0)
+                assert tick.admitted, "WFQ starved with free slots"
+                for r in tick.admitted:
+                    order.append((r.tenant_id, r.id))
+                    b.finish(r.slot)
+            assert {t for t, _i in order} == set(ids)  # everyone drained
+            return order
+        for seed in range(8):
+            assert episode(seed) == episode(seed)
+
+    def test_per_tenant_depth_isolation(self):
+        p = TenantPolicy([Tenant(id="flood"), Tenant(id="victim")])
+        b = ContinuousBatcher(num_slots=1, queue_depth=4, policy=p)
+        for i in range(4):
+            b.submit(req(i, tenant="flood"))
+        with pytest.raises(AdmissionQueueFull, match="tenant flood"):
+            b.submit(req(4, tenant="flood"))
+        b.submit(req(5, tenant="victim"))  # victim's door is open
+        assert b.queue_lens() == {"flood": 4, "victim": 1}
+        assert b.load_factor() == 1.0  # clamped, not > 1
+
+    def test_scoped_shed_latches(self):
+        b = ContinuousBatcher(num_slots=1, queue_depth=8)
+        b.set_tenant_shed("flood", "slo burn by flood")
+        with pytest.raises(AdmissionShed, match="slo burn"):
+            b.submit(req(0, tenant="flood"))
+        b.submit(req(1, tenant="victim"))
+        b.submit(req(2))  # default unaffected too
+        assert b.tenant_sheds == {"flood": "slo burn by flood"}
+        b.clear_tenant_shed("flood")
+        b.submit(req(3, tenant="flood"))
+
+    def test_quota_charged_only_on_enqueue(self):
+        """Depth rejections must not drain the bucket, and migrated
+        requests (already billed at the front door) skip the charge."""
+        bucket = TokenBucket(capacity=8.0, refill_per_s=0.0)
+        p = TenantPolicy([Tenant(id="a")], quotas={"a": bucket})
+        b = ContinuousBatcher(num_slots=1, queue_depth=1, policy=p)
+        b.submit(req(0, tenant="a"))  # 8 tokens: drains the bucket
+        with pytest.raises(AdmissionQueueFull):
+            b.submit(req(1, tenant="a"))  # depth, not quota
+        assert bucket.stats()["tokens"] == 0.0  # not double-charged
+        tick = b.poll(0.0)
+        assert [r.id for r in tick.admitted] == [0]
+        with pytest.raises(TenantQuotaExceeded) as ei:
+            b.submit(req(2, tenant="a"))
+        assert ei.value.tenant == "a"
+        assert ei.value.retry_after_s == 8.0  # zero refill: capacity
+        mig = req(3, tenant="a")
+        mig.migration = object()  # pre-billed at the source engine
+        b.submit(mig)  # no quota charge on the decode-worker intake
+
+
+# ------------------------------------------------------- multitenant loadgen
+
+class TestMultitenantLoadgen:
+    SPECS = [{"id": "flood", "share": 0.75, "prompt_len": (4, 10),
+              "max_new": (8, 12)},
+             {"id": "victim", "share": 0.25, "prompt_len": (2, 4),
+              "max_new": (1, 3), "deadline_s": 0.5}]
+
+    def test_deterministic_and_mixture(self):
+        a = generate_multitenant_load(3, 200, vocab=97, tenants=self.SPECS)
+        b = generate_multitenant_load(3, 200, vocab=97, tenants=self.SPECS)
+        assert a == b
+        c = generate_multitenant_load(4, 200, vocab=97, tenants=self.SPECS)
+        assert a != c
+        counts = {t: sum(1 for it in a if it.tenant == t)
+                  for t in ("flood", "victim")}
+        assert counts["flood"] + counts["victim"] == 200
+        assert 100 <= counts["flood"] <= 190  # ~0.75 share
+
+    def test_per_tenant_shapes_and_deadline(self):
+        items = generate_multitenant_load(3, 100, vocab=97,
+                                          tenants=self.SPECS)
+        for it in items:
+            if it.tenant == "flood":
+                assert 4 <= len(it.prompt) <= 10
+                assert 8 <= it.max_new_tokens <= 12
+                assert it.deadline_s is None
+            else:
+                assert 2 <= len(it.prompt) <= 4
+                assert it.deadline_s == 0.5
+        assert all(x.submit_at < y.submit_at
+                   for x, y in zip(items, items[1:]))
+
+    def test_share_validation(self):
+        with pytest.raises(ValueError, match="share"):
+            generate_multitenant_load(0, 5, vocab=97,
+                                      tenants=[{"id": "a", "share": -1.0}])
+        with pytest.raises(ValueError, match="tenant spec"):
+            generate_multitenant_load(0, 5, vocab=97, tenants=[])
+
+
+# ------------------------------------------------- engine quota + journal
+
+class TestEngineFrontDoor:
+    def make(self, clk, policy, **kw):
+        return ServingEngine(tiny_gpt(), num_slots=2, page_size=4,
+                             seed=0, clock=clk, tenants=policy, **kw)
+
+    def test_quota_rejection_contract(self, journal):
+        clk = VClock()
+        policy = TenantPolicy([Tenant(id="acme")],
+                              quotas={"acme": TokenBucket(8.0, 2.0)})
+        reg = obs.get_registry()
+        s0 = reg.snapshot()
+        eng = self.make(clk, policy)
+        h1 = eng.submit([1, 2, 3, 4], 4, tenant="acme")  # drains the 8
+        h2 = eng.submit([1, 2, 3, 4], 4, tenant="acme")
+        assert h1.status is None and h2.status == "rejected"
+        assert h2.shed_reason == "quota" and h2.tenant == "acme"
+        assert h2.retry_after_s == 4.0  # 8 short at 2/s, exact
+        assert "quota exhausted" in h2.error
+        d = reg.delta(reg.snapshot(), s0)
+        assert d.get('hetu_serve_shed_total'
+                     '{reason="quota",tenant="acme"}') == 1
+        shed, = journal.of_kind("shed")
+        assert shed["reason"] == "quota" and shed["tenant"] == "acme"
+        quota, = journal.of_kind("tenant_quota")
+        assert quota["tenant"] == "acme"
+        assert quota["request_id"] == h2.request_id
+        assert quota["retry_after_s"] == 4.0
+        assert eng.tenant_meter.shed_counts("acme") == {"quota": 1}
+        eng.run_until_idle()
+        assert h1.status == "completed"
+        # the billing artifact accumulated both sides of the episode
+        row = eng.tenant_meter.summary()["acme"]
+        assert row["requests"] == {"admitted": 1, "completed": 1,
+                                   "rejected": 1}
+        assert row["prompt_tokens"] == 4 and row["generated_tokens"] == 4
+        assert row["kv_pages"] >= 1
+
+    def test_default_tenant_journal_and_slo_unchanged(self, journal):
+        """All-default traffic must not leak tenant fields anywhere:
+        the pre-PR journal schema, /slo key set, and shed metric
+        semantics stay bitwise (the compatibility satellite)."""
+        clk = VClock()
+        eng = ServingEngine(tiny_gpt(), num_slots=2, page_size=4, seed=0,
+                            clock=clk, queue_depth=1)
+        eng.submit([1, 2, 3], 2)
+        h2 = eng.submit([1, 2, 3], 2)  # depth-limit rejection
+        assert h2.status == "rejected" and h2.tenant == "default"
+        for e in journal.events:
+            assert "tenant" not in e, e
+        eng.run_until_idle()
+        body = eng.slo.summary()
+        assert set(body) == {"targets", "windows_s", "requests",
+                             "violations", "stages", "burn_rates",
+                             "shed_pressure"}
+        assert not eng.slo.multi_tenant
+
+    def test_per_tenant_slo_windows(self):
+        clk = VClock()
+        policy = TenantPolicy([Tenant(id="acme", klass="batch")])
+        eng = self.make(clk, policy)
+        ha = eng.submit([1, 2, 3], 2, tenant="acme")
+        hd = eng.submit([4, 5, 6], 2)
+        eng.run_until_idle()
+        assert ha.status == hd.status == "completed"
+        assert eng.slo.multi_tenant
+        assert eng.slo.observed_tenants() == {"acme": "batch",
+                                              "default": "latency"}
+        body = eng.slo.summary()
+        assert body["tenants"]["acme"]["class"] == "batch"
+        assert body["tenants"]["acme"]["requests"] == 1
+        assert body["tenants"]["default"]["requests"] == 1
+        assert 0.0 <= body["tenants"]["acme"]["shed_pressure"] <= 1.0
+
+
+# ------------------------------------------------- controller scoped shed
+
+@pytest.mark.controller
+class TestScopedShedding:
+    def test_batch_tenant_sheds_first_victim_keeps_flowing(self, journal):
+        from hetu_tpu.exec.controller import (ControllerConfig,
+                                              RuntimeController)
+        clk = VClock()
+        ctrl = RuntimeController(ControllerConfig(
+            sustain_ticks=2, shed_on=0.9, shed_off=0.1,
+            batch_shed_factor=0.5, tune_deadline=False, quarantine=False,
+            freeze_buckets=False))
+        policy = TenantPolicy([Tenant(id="flood", klass="batch"),
+                               Tenant(id="victim", klass="latency")])
+        eng = ServingEngine(tiny_gpt(), num_slots=2, page_size=4, seed=0,
+                            clock=clk, controller=ctrl, tenants=policy)
+        # the flooder's request ages a full second in the queue —
+        # every target violated, but only in ITS windows
+        h = eng.submit([1, 2, 3], 2, tenant="flood")
+        clk.t += 1.0
+        eng.run_until_idle()
+        assert h.status == "completed"
+        eng.step()
+        assert not eng.batcher.tenant_sheds  # sustain discipline holds
+        eng.step()
+        assert "flood" in eng.batcher.tenant_sheds
+        assert eng.batcher.shed_reason is None  # global latch untouched
+        # the victim's door is open while the flooder's is closed
+        h2 = eng.submit([1, 2, 3], 2, tenant="flood")
+        h3 = eng.submit([4, 5, 6], 2, tenant="victim")
+        assert h2.status == "rejected" and h2.shed_reason == "controller"
+        assert h2.retry_after_s is not None and h2.retry_after_s > 0
+        assert h3.status is None  # queued, not rejected
+        eng.run_until_idle()
+        assert h3.status == "completed"
+        engaged = [e for e in journal.of_kind("tenant_shed") if e["engaged"]]
+        assert engaged and engaged[0]["tenant"] == "flood"
+        assert engaged[0]["reason"] == "slo_burn"
+        assert engaged[0]["klass"] == "batch"
+        # release: drained windows clear the scoped latch
+        clk.t += 700.0
+        eng.step()
+        eng.step()
+        assert not eng.batcher.tenant_sheds
+        released = [e for e in journal.of_kind("tenant_shed")
+                    if not e["engaged"]]
+        assert released and released[0]["tenant"] == "flood"
+
+
+# ----------------------------------------------------- two-tenant HTTP smoke
+
+def test_two_tenant_infer_slo_tenants_smoke():
+    """Tier-1 satellite: two tenants through the live /infer endpoint,
+    per-tenant sections on /slo, and the /tenants billing payload."""
+    policy = TenantPolicy([Tenant(id="acme", klass="batch", weight=2.0)],
+                          quotas={"acme": TokenBucket(1000.0, 100.0)})
+    eng = ServingEngine(tiny_gpt(), num_slots=2, page_size=8,
+                        max_seq_len=32, prompt_buckets=(8, 16), seed=1,
+                        tenants=policy)
+    srv = serve_engine(eng)
+    try:
+        def post(payload):
+            r = urllib.request.Request(
+                srv.url + "/infer",
+                data=json.dumps(payload).encode(), method="POST")
+            with urllib.request.urlopen(r, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+        st, acme = post({"prompt": [5, 6, 7], "max_new_tokens": 3,
+                         "tenant": "acme", "timeout_s": 120})
+        assert st == 200 and acme["status"] == "completed"
+        assert acme["tenant"] == "acme" and len(acme["tokens"]) == 3
+        st, anon = post({"prompt": [8, 9, 10], "max_new_tokens": 3,
+                         "timeout_s": 120})
+        assert st == 200 and anon["status"] == "completed"
+        assert "tenant" not in anon  # default traffic: pre-PR payload
+        with urllib.request.urlopen(srv.url + "/slo", timeout=10) as r:
+            slo = json.loads(r.read())
+        assert set(slo["tenants"]) == {"acme", "default"}
+        assert slo["tenants"]["acme"]["class"] == "batch"
+        with urllib.request.urlopen(srv.url + "/tenants", timeout=10) as r:
+            ten = json.loads(r.read())
+        assert ten["policy"]["acme"]["weight"] == 2.0
+        assert ten["policy"]["acme"]["quota"]["capacity"] == 1000.0
+        assert ten["meter"]["acme"]["requests"]["completed"] == 1
+        assert ten["meter"]["acme"]["prompt_tokens"] == 3
+        assert ten["meter"]["acme"]["generated_tokens"] == 3
+        assert ten["shedding"] == {}
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+# ------------------------------------------------------- flood acceptance
+
+def _flood_specs():
+    return [{"id": "flood", "share": 0.75, "prompt_len": (4, 10),
+             "max_new": (8, 12)},
+            {"id": "victim", "share": 0.25, "prompt_len": (2, 6),
+             "max_new": (2, 4)}]
+
+
+def _drive_fleet(trace, *, with_quota):
+    """Deterministic 2-replica episode on a shared virtual clock; every
+    scheduler tick advances the clock a fixed quantum, so TTFTs and the
+    WFQ/quota decisions are pure functions of the trace."""
+    clk = VClock()
+    policy = TenantPolicy([Tenant(id="victim", klass="latency",
+                                  weight=4.0),
+                           Tenant(id="flood", klass="batch", weight=1.0)])
+    if with_quota:
+        policy.register(Tenant(id="flood", klass="batch", weight=1.0),
+                        quota=TokenBucket(40.0, 60.0))
+    model = tiny_gpt()
+    engines = [ServingEngine(model, num_slots=2, page_size=8,
+                             max_seq_len=64, prompt_buckets=(16, 32),
+                             seed=3, clock=clk, queue_depth=64,
+                             tenants=policy)
+               for _ in range(2)]
+    router = FleetRouter(engines)
+    handles = []
+    for it in trace:
+        clk.t = max(clk.t, it.submit_at)
+        handles.append((it, router.submit(list(it.prompt),
+                                          it.max_new_tokens,
+                                          tenant=it.tenant)))
+        router.step()
+        clk.t += 0.0005
+    for _ in range(10**6):
+        if router.idle:
+            break
+        router.step()
+        clk.t += 0.0005
+    return handles, router
+
+
+def _victim_p99(handles):
+    ttfts = sorted(h.ttft_s for it, h in handles
+                   if it.tenant in ("victim", None)
+                   and h.status == "completed" and h.ttft_s is not None)
+    assert ttfts, "victim completed nothing"
+    return ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+
+
+def test_flood_acceptance_isolation_attribution_and_replay():
+    """ISSUE 16 acceptance: one tenant floods a 2-replica fleet —
+    the victim's TTFT p99 degrades < 10% vs the no-flood same-seed
+    baseline, >= 90% of sheds land on the flooder, and the whole
+    episode replays bitwise."""
+    trace = generate_multitenant_load(23, 48, vocab=97,
+                                      tenants=_flood_specs(),
+                                      mean_gap_s=0.002)
+    flood_handles, router = _drive_fleet(trace, with_quota=True)
+    quiet_handles, _ = _drive_fleet(
+        [it for it in trace if it.tenant == "victim"], with_quota=True)
+
+    # 1) isolation: the victim's tail is within 10% of its quiet self
+    p99_flood = _victim_p99(flood_handles)
+    p99_quiet = _victim_p99(quiet_handles)
+    assert p99_flood <= p99_quiet * 1.10 + 1e-9, \
+        f"victim TTFT p99 degraded {p99_flood / p99_quiet:.3f}x"
+
+    # 2) every victim request completed — nobody shed the victim
+    rejected = [(it, h) for it, h in flood_handles
+                if h.status == "rejected"]
+    assert all(h.status == "completed" for it, h in flood_handles
+               if it.tenant == "victim")
+
+    # 3) attribution: >= 90% of sheds landed on the flooder
+    assert rejected, "the flood was never shed — quota too loose"
+    on_flood = sum(1 for it, _h in rejected if it.tenant == "flood")
+    assert on_flood / len(rejected) >= 0.9
+    for _it, h in rejected:
+        assert h.shed_reason in ("quota", "controller", "queue_full")
+        assert h.retry_after_s is not None and h.retry_after_s > 0
+
+    # 4) bitwise replay: streams, statuses, placements, rejections
+    replay_handles, replay_router = _drive_fleet(trace, with_quota=True)
+    assert [h.tokens for _i, h in flood_handles] == \
+        [h.tokens for _i, h in replay_handles]
+    assert [h.status for _i, h in flood_handles] == \
+        [h.status for _i, h in replay_handles]
+    assert [(h.shed_reason, h.retry_after_s)
+            for _i, h in flood_handles] == \
+        [(h.shed_reason, h.retry_after_s) for _i, h in replay_handles]
+    assert router.placements == replay_router.placements
+
+
+def test_default_only_fleet_matches_pre_tenant_path():
+    """The compatibility half of the acceptance: an all-default-tenant
+    episode must take the exact pre-PR path — no tenant fields in the
+    placement log, single FIFO semantics, no per-tenant SLO surface."""
+    trace = generate_multitenant_load(23, 12, vocab=97,
+                                      tenants=[{"id": "solo"}])
+    # same arrivals, submitted as DEFAULT traffic (tenant=None)
+    clk = VClock()
+    model = tiny_gpt()
+    engines = [ServingEngine(model, num_slots=2, page_size=8,
+                             max_seq_len=64, prompt_buckets=(16, 32),
+                             seed=3, clock=clk, queue_depth=64)
+               for _ in range(2)]
+    router = FleetRouter(engines)
+    handles = []
+    for it in trace:
+        clk.t = max(clk.t, it.submit_at)
+        handles.append(router.submit(list(it.prompt), it.max_new_tokens))
+        router.step()
+        clk.t += 0.0005
+    router.run_until_idle(max_steps=10**6)
+    assert all(h.status == "completed" for h in handles)
+    for p in router.placements:
+        assert "tenant" not in p
+    for e in engines:
+        assert not e.slo.multi_tenant
+        assert set(e.batcher.queue_lens()) <= {"default"}
