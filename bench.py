@@ -764,6 +764,24 @@ def _q_or_none(v, digits: int = 6):
     return None if v is None or v != v else round(v, digits)
 
 
+def _memory_section(snap):
+    """The serve rounds' ``memory`` section from one
+    :class:`~hetu_tpu.obs.memledger.MemoryLedger` snapshot: peak pool
+    occupancy over the run, the shared-prefix fraction of the pages held
+    at that peak, and the attributed high-water mark — the capacity
+    numbers a planner sizes the fleet from."""
+    pools = list(snap["kv_pools"].values())
+    peak_pages = sum(p["peak_used_pages"] for p in pools)
+    shared_pages = sum(p["peak_shared_pages"] for p in pools)
+    return {
+        "peak_pool_occupancy": round(
+            max((p["peak_used_fraction"] for p in pools), default=0.0), 6),
+        "shared_prefix_fraction": round(shared_pages / peak_pages, 6)
+        if peak_pages else 0.0,
+        "hwm_bytes": int(snap["hwm_bytes"].get("total", 0)),
+    }
+
+
 def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
                buckets):
     """Drive one seeded trace through a fresh engine on the real clock;
@@ -774,49 +792,60 @@ def _serve_run(cfg, trace, *, paged, num_slots, page_size, max_seq_len,
     ratio."""
     from hetu_tpu.core import set_random_seed
     from hetu_tpu.models import GPT
+    from hetu_tpu.obs import memledger as _memledger
     from hetu_tpu.obs import registry as _obs
     from hetu_tpu.serve import ServingEngine
 
     set_random_seed(0)
     model = GPT(cfg)
-    eng = ServingEngine(model, num_slots=num_slots, page_size=page_size,
-                        max_seq_len=max_seq_len, prompt_buckets=buckets,
-                        queue_depth=len(trace) + 1, sampling="top_k",
-                        top_k=5, seed=11, paged_decode=paged)
-    # warmup: compile the decode program AND every prefill bucket's
-    # program outside the measured window (a serving fleet is warm; TTFT
-    # here is SLO, not compile time — a single warmup request would leave
-    # the other buckets' jit compiles inside the measured histograms)
-    for bucket in buckets:
-        eng.submit(list(range(1, bucket + 1)), 2)
-        eng.run_until_idle()
-    hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
-    cum0 = hist.cumulative()
-    # the warmup requests were graded too; summarize only the measured
-    # window by differencing the SLO engine's per-stage totals
-    stages0 = {s: v["total_s"] for s, v in eng.slo.stage_summary().items()}
-    n0 = eng.slo.requests
-    handles = [eng.submit(list(it.prompt), it.max_new_tokens)
-               for it in trace]
-    t0 = time.perf_counter()
-    eng.run_until_idle(max_steps=10**7)
-    dt = time.perf_counter() - t0
-    cum1 = hist.cumulative()
-    done = [h for h in handles if h.status == "completed"]
-    stages1 = eng.slo.stage_summary()
-    n = max(eng.slo.requests - n0, 1)
-    totals = {s: stages1[s]["total_s"] - stages0[s] for s in stages1}
-    wall = sum(totals.values())
-    decomposition = {s: {"total_s": round(totals[s], 6),
-                         "mean_s": round(totals[s] / n, 6),
-                         "fraction": round(totals[s] / wall, 6)
-                         if wall > 0 else 0.0}
-                     for s in totals}
-    # the first token of each request is prefill; the rest are decode
-    decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+    # a run-scoped ledger: peak pool occupancy + attributed HWM for the
+    # metric line's memory section (restored on exit — the bench never
+    # leaves a process-wide ledger behind)
+    with _memledger.use(_memledger.MemoryLedger()) as led:
+        eng = ServingEngine(model, num_slots=num_slots,
+                            page_size=page_size, max_seq_len=max_seq_len,
+                            prompt_buckets=buckets,
+                            queue_depth=len(trace) + 1, sampling="top_k",
+                            top_k=5, seed=11, paged_decode=paged)
+        # warmup: compile the decode program AND every prefill bucket's
+        # program outside the measured window (a serving fleet is warm;
+        # TTFT here is SLO, not compile time — a single warmup request
+        # would leave the other buckets' jit compiles inside the
+        # measured histograms)
+        for bucket in buckets:
+            eng.submit(list(range(1, bucket + 1)), 2)
+            eng.run_until_idle()
+        hist = _obs.get_registry().histogram(
+            "hetu_serve_ttft_seconds").labels()
+        cum0 = hist.cumulative()
+        # the warmup requests were graded too; summarize only the
+        # measured window by differencing the SLO engine's stage totals
+        stages0 = {s: v["total_s"]
+                   for s, v in eng.slo.stage_summary().items()}
+        n0 = eng.slo.requests
+        handles = [eng.submit(list(it.prompt), it.max_new_tokens)
+                   for it in trace]
+        t0 = time.perf_counter()
+        eng.run_until_idle(max_steps=10**7)
+        dt = time.perf_counter() - t0
+        cum1 = hist.cumulative()
+        done = [h for h in handles if h.status == "completed"]
+        stages1 = eng.slo.stage_summary()
+        n = max(eng.slo.requests - n0, 1)
+        totals = {s: stages1[s]["total_s"] - stages0[s] for s in stages1}
+        wall = sum(totals.values())
+        decomposition = {s: {"total_s": round(totals[s], 6),
+                             "mean_s": round(totals[s] / n, 6),
+                             "fraction": round(totals[s] / wall, 6)
+                             if wall > 0 else 0.0}
+                         for s in totals}
+        # the first token of each request is prefill; the rest is decode
+        decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        memory = _memory_section(led.snapshot())
     return (decode_tokens / dt if dt > 0 else 0.0,
             _hist_quantile(cum0, cum1, 0.50),
-            _hist_quantile(cum0, cum1, 0.99), len(done), decomposition)
+            _hist_quantile(cum0, cum1, 0.99), len(done), decomposition,
+            memory)
 
 
 def bench_serve(on_tpu, kind, peak):
@@ -845,15 +874,16 @@ def bench_serve(on_tpu, kind, peak):
         trace = generate_load(17, 8, vocab=cfg.vocab_size,
                               prompt_len=(2, 12), max_new=(2, 6),
                               mean_gap_s=0.0)
-    paged_tps, p50, p99, done, stages = _serve_run(
+    paged_tps, p50, p99, done, stages, memory = _serve_run(
         cfg, trace, paged=True, **kw)
-    gather_tps, g50, g99, gdone, gstages = _serve_run(
+    gather_tps, g50, g99, gdone, gstages, _gmem = _serve_run(
         cfg, trace, paged=False, **kw)
     return _line(
         "serve_decode_tokens_per_sec", paged_tps, "tokens/s",
         paged_tps / gather_tps if gather_tps > 0 else 1.0,
         ttft_p50_s=_q_or_none(p50),
         ttft_p99_s=_q_or_none(p99),
+        memory=memory,
         stage_decomposition=stages,
         gather_tokens_per_sec=round(gather_tps, 2),
         gather_ttft_p50_s=_q_or_none(g50),
@@ -905,43 +935,48 @@ def bench_serve_fleet(on_tpu, kind, peak, *, replicas: int,
     hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
 
     def drive(n):
-        engines = [ServingEngine(model, queue_depth=len(trace) + 8,
-                                 sampling="top_k", top_k=5, seed=11,
-                                 prefix_sharing=prefix_share, **kw)
-                   for _ in range(n)]
-        router = FleetRouter(engines)
-        # warmup: compile every prefill bucket on every replica outside
-        # the measured window (the _serve_run convention)
-        for eng in engines:
-            for bucket in kw["prompt_buckets"]:
-                eng.submit(list(range(1, bucket + 1)), 2)
-            eng.run_until_idle()
-        cum0 = hist.cumulative()
-        # open-loop-ish: one fleet tick between arrivals, so published
-        # prefixes exist by the time their siblings route (a burst would
-        # race every template request past the trie it feeds)
-        t0 = time.perf_counter()
-        handles = []
-        for it in trace:
-            handles.append(router.submit(list(it.prompt),
-                                         it.max_new_tokens))
-            router.step()
-        router.run_until_idle(max_steps=10**7)
-        dt = time.perf_counter() - t0
-        done = [h for h in handles if h.status == "completed"]
-        decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        from hetu_tpu.obs import memledger as _memledger
+        with _memledger.use(_memledger.MemoryLedger()) as led:
+            engines = [ServingEngine(model, queue_depth=len(trace) + 8,
+                                     sampling="top_k", top_k=5, seed=11,
+                                     prefix_sharing=prefix_share, **kw)
+                       for _ in range(n)]
+            router = FleetRouter(engines)
+            # warmup: compile every prefill bucket on every replica
+            # outside the measured window (the _serve_run convention)
+            for eng in engines:
+                for bucket in kw["prompt_buckets"]:
+                    eng.submit(list(range(1, bucket + 1)), 2)
+                eng.run_until_idle()
+            cum0 = hist.cumulative()
+            # open-loop-ish: one fleet tick between arrivals, so
+            # published prefixes exist by the time their siblings route
+            # (a burst would race every template request past the trie
+            # it feeds)
+            t0 = time.perf_counter()
+            handles = []
+            for it in trace:
+                handles.append(router.submit(list(it.prompt),
+                                             it.max_new_tokens))
+                router.step()
+            router.run_until_idle(max_steps=10**7)
+            dt = time.perf_counter() - t0
+            done = [h for h in handles if h.status == "completed"]
+            decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+            memory = _memory_section(led.snapshot())
         return (decode_tokens / dt if dt > 0 else 0.0,
                 _hist_quantile(cum0, hist.cumulative(), 0.50),
                 _hist_quantile(cum0, hist.cumulative(), 0.99),
-                len(done), router.stats())
+                len(done), router.stats(), memory)
 
-    fleet_tps, p50, p99, done, fstats = drive(replicas)
-    single_tps, s50, s99, sdone, _ = drive(1)
+    fleet_tps, p50, p99, done, fstats, memory = drive(replicas)
+    single_tps, s50, s99, sdone, _, _smem = drive(1)
     return _line(
         "serve_fleet_decode_tokens_per_sec", fleet_tps, "tokens/s",
         fleet_tps / single_tps if single_tps > 0 else 1.0,
         replicas=replicas, prefix_share=prefix_share,
         ttft_p50_s=_q_or_none(p50), ttft_p99_s=_q_or_none(p99),
+        memory=memory,
         single_tokens_per_sec=round(single_tps, 2),
         single_ttft_p50_s=_q_or_none(s50),
         single_ttft_p99_s=_q_or_none(s99),

@@ -36,6 +36,7 @@ import numpy as np
 
 from hetu_tpu.embed.layer import HBMCachedEmbedding
 from hetu_tpu.obs import journal as _obs_journal
+from hetu_tpu.obs import memledger as _memledger
 from hetu_tpu.obs import registry as _obs
 
 __all__ = ["TierPolicy", "TieredEmbedding"]
@@ -211,6 +212,10 @@ class TieredEmbedding(HBMCachedEmbedding):
         t.ps_rows += ps_rows
         t.bytes_from_ps += self.table.pull_wire_bytes(ps_rows)
         t.stages += 1
+        # memory-ledger seam: resident HBM rows after this stage's
+        # promotions/demotions/overflow — one load + branch when no
+        # ledger is installed
+        _memledger.note_embed(self)
         if _obs.enabled():
             self._publish(host1)
 

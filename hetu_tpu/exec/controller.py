@@ -126,6 +126,14 @@ class ControllerConfig:
     batch_shed_factor: float = 0.5
     # 4: compile-storm bucket freeze
     freeze_buckets: bool = True
+    # 5: ledger-backed memory pressure (PR 17): when the installed
+    # MemoryLedger's worst-pool occupancy holds at or above ``mem_on``
+    # for ``sustain_ticks`` ticks, defrag the engine's pool and shed
+    # admission; release at or below ``mem_off`` sustained equally long
+    # (the same hysteresis discipline as the SLO shed loop)
+    mem_pressure: bool = True
+    mem_on: float = 0.92
+    mem_off: float = 0.75
 
     def __post_init__(self):
         if self.headroom <= 0:
@@ -153,6 +161,13 @@ class ControllerConfig:
             raise ValueError(f"batch_shed_factor scales shed_on for "
                              f"batch-class tenants, must be in (0, 1], "
                              f"got {self.batch_shed_factor}")
+        if not 0.0 <= self.mem_off <= self.mem_on:
+            raise ValueError(
+                f"need 0 <= mem_off <= mem_on (the hysteresis band), "
+                f"got mem_off={self.mem_off} mem_on={self.mem_on}")
+        if not 0.0 < self.mem_on <= 1.0:
+            raise ValueError(f"mem_on is a used-page fraction in (0, 1], "
+                             f"got {self.mem_on}")
 
     @classmethod
     def from_env(cls, **overrides) -> "ControllerConfig":
@@ -164,7 +179,8 @@ class ControllerConfig:
                 "hysteresis": float, "cooldown_steps": int,
                 "quarantine": bool, "shed": bool, "shed_on": float,
                 "shed_off": float, "sustain_ticks": int,
-                "batch_shed_factor": float, "freeze_buckets": bool}
+                "batch_shed_factor": float, "freeze_buckets": bool,
+                "mem_pressure": bool, "mem_on": float, "mem_off": float}
         kw = {}
         for field, typ in spec.items():
             raw = os.environ.get(_ENV_PREFIX + field.upper())
@@ -209,6 +225,10 @@ def _ctrl_families(reg) -> dict:
                 "hetu_ctrl_freeze_active",
                 "1 while serving prompt-bucket growth is frozen (compile "
                 "storm), else 0"),
+            "mem_active": reg.gauge(
+                "hetu_ctrl_mem_pressure_active",
+                "1 while the ledger-backed memory-pressure remediation "
+                "is latched (sustained pool occupancy), else 0"),
         }
 
 
@@ -413,12 +433,19 @@ class RuntimeController:
             self._maybe_freeze(engine)
         if self.config.shed:
             self._maybe_shed(engine)
+        if self.config.mem_pressure:
+            self._maybe_mem(engine)
 
     def _serve_st(self, engine) -> dict:
         st = self._serve_state.get(engine)
         if st is None:
             st = {"shed_active": False, "freeze_active": False,
                   "shed_streak": 0, "ok_streak": 0,
+                  # memory-pressure latch (PR 17): mem_shed remembers
+                  # whether THIS loop engaged the batcher's shed, so a
+                  # release never unlatches the SLO loop's shed
+                  "mem_active": False, "mem_shed": False,
+                  "mem_streak": 0, "mem_ok_streak": 0,
                   # tenant-scoped latches (multi-tenant engines):
                   # tid -> {"active", "shed_streak", "ok_streak"}
                   "tenants": {}}
@@ -437,6 +464,13 @@ class RuntimeController:
     def freeze_active(self) -> bool:
         """Any driven engine currently latched frozen."""
         return any(st["freeze_active"]
+                   for st in self._serve_state.values())
+
+    @property
+    def mem_pressure_active(self) -> bool:
+        """Any driven engine currently latched on ledger memory
+        pressure."""
+        return any(st["mem_active"]
                    for st in self._serve_state.values())
 
     def _maybe_freeze(self, engine) -> None:
@@ -505,7 +539,9 @@ class RuntimeController:
             self._act("admission_release", "slo_burn",
                       pressure=round(pressure, 6),
                       sustained_ticks=int(st["ok_streak"]))
-            if not self.config.dry_run:
+            # the memory loop shares the batcher's global shed latch:
+            # only clear it when memory pressure is not also holding it
+            if not self.config.dry_run and not st["mem_shed"]:
                 engine.batcher.clear_shed()
         if _obs.enabled():
             self._m()["shed_active"].set(1.0 if self.shed_active else 0.0)
@@ -569,6 +605,72 @@ class RuntimeController:
         if _obs.enabled():
             self._m()["shed_active"].set(1.0 if self.shed_active else 0.0)
 
+    def _maybe_mem(self, engine) -> None:
+        """The ledger-backed memory loop: the installed
+        :class:`~hetu_tpu.obs.memledger.MemoryLedger`'s worst-pool
+        occupancy sustained at or above ``mem_on`` for ``sustain_ticks``
+        ticks first defrags the engine's KV pool (reclaiming
+        fragmentation is free capacity), then sheds admission if
+        occupancy alone keeps the pool pinned; releases at or below
+        ``mem_off`` sustained equally long.  No ledger installed means
+        no signal — the loop is inert, not guessing."""
+        from hetu_tpu.obs import memledger as _memledger
+        led = _memledger.get_ledger()
+        if led is None:
+            return
+        st = self._serve_st(engine)
+        cfg = self.config
+        pressure = float(led.memory_pressure())
+        if pressure >= cfg.mem_on:
+            st["mem_streak"] += 1
+            st["mem_ok_streak"] = 0
+        elif pressure <= cfg.mem_off:
+            st["mem_ok_streak"] += 1
+            st["mem_streak"] = 0
+        else:
+            st["mem_streak"] = 0
+            st["mem_ok_streak"] = 0
+        if not st["mem_active"] \
+                and st["mem_streak"] >= cfg.sustain_ticks:
+            st["mem_active"] = True
+            moved = 0
+            if not cfg.dry_run:
+                moved = int(engine.pool.defrag())
+            still = float(led.memory_pressure())
+            action = ("memory_shed" if still >= cfg.mem_on
+                      else "memory_defrag")
+            self._act(action, "memory_pressure",
+                      pressure=round(pressure, 6),
+                      moved_pages=moved,
+                      sustained_ticks=int(st["mem_streak"]))
+            _obs_journal.record("memory_pressure",
+                                pressure=round(pressure, 6),
+                                component="kv_pool", action=action)
+            if action == "memory_shed" and not cfg.dry_run:
+                st["mem_shed"] = True
+                engine.batcher.set_shed(
+                    "controller shed: sustained memory pressure "
+                    f"({pressure:.3f} >= {cfg.mem_on})")
+        elif st["mem_active"] \
+                and st["mem_ok_streak"] >= cfg.sustain_ticks:
+            st["mem_active"] = False
+            self._act("memory_release", "memory_pressure",
+                      pressure=round(pressure, 6),
+                      sustained_ticks=int(st["mem_ok_streak"]))
+            _obs_journal.record("memory_pressure",
+                                pressure=round(pressure, 6),
+                                component="kv_pool",
+                                action="memory_release")
+            if st["mem_shed"]:
+                st["mem_shed"] = False
+                # the SLO loop shares this latch: leave it held if that
+                # loop is still latched shedding
+                if not cfg.dry_run and not st["shed_active"]:
+                    engine.batcher.clear_shed()
+        if _obs.enabled():
+            self._m()["mem_active"].set(
+                1.0 if self.mem_pressure_active else 0.0)
+
     def release(self) -> None:
         """Release every latch this controller actuated (admission shed,
         bucket freeze) on every engine it drove, and reset the sustain
@@ -600,12 +702,25 @@ class RuntimeController:
                 self._act("bucket_unfreeze", "controller_detach")
                 if getattr(eng, "freeze_bucket_growth", False):
                     eng.freeze_bucket_growth = False
+            if st["mem_active"]:
+                st["mem_active"] = False
+                self._act("memory_release", "controller_detach")
+                _obs_journal.record("memory_pressure", pressure=0.0,
+                                    component="kv_pool",
+                                    action="memory_release")
+                if st["mem_shed"]:
+                    st["mem_shed"] = False
+                    if getattr(eng.batcher, "shedding", False):
+                        eng.batcher.clear_shed()
             st["shed_streak"] = 0
             st["ok_streak"] = 0
+            st["mem_streak"] = 0
+            st["mem_ok_streak"] = 0
         if _obs.enabled():
             m = self._m()
             m["shed_active"].set(0.0)
             m["freeze_active"].set(0.0)
+            m["mem_active"].set(0.0)
 
     # -- read side -------------------------------------------------------------
 
@@ -628,6 +743,7 @@ class RuntimeController:
                 {tid for st in self._serve_state.values()
                  for tid, ts in st["tenants"].items() if ts["active"]}),
             "freeze_active": bool(self.freeze_active),
+            "mem_pressure_active": bool(self.mem_pressure_active),
             "quarantined": sorted(self._quarantined),
             "actions_total": int(self.actions_total),
             "actions": list(self.actions),

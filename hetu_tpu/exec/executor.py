@@ -23,6 +23,7 @@ from hetu_tpu.core.module import Module, trainable_mask
 from hetu_tpu.core.rng import next_key
 from hetu_tpu.obs import compile as _obs_compile
 from hetu_tpu.obs import goodput as _obs_goodput
+from hetu_tpu.obs import memledger as _obs_memledger
 from hetu_tpu.obs import numerics as _obs_numerics
 from hetu_tpu.obs import registry as _obs
 from hetu_tpu.obs import tracing as _obs_tracing
@@ -247,6 +248,9 @@ class Trainer:
         # here is a shape-signature change the journal names.
         self._train_step = _obs_compile.watch(train_step, site="train.step")
         self._eval_step = _obs_compile.watch(eval_step, site="train.eval")
+        # memory-ledger seam: weights/optimizer bytes of the initial
+        # state (re-posted whenever the state is rebound — the setter)
+        _obs_memledger.note_train_state(self._state)
 
     @property
     def state(self) -> TrainState:
@@ -255,6 +259,9 @@ class Trainer:
     @state.setter
     def state(self, s: TrainState):
         self._state = s
+        # a rebind (checkpoint restore, rescale) may change leaf shapes/
+        # dtypes: re-post the ledger's train-state bytes
+        _obs_memledger.note_train_state(s)
 
     @property
     def model(self):

@@ -176,15 +176,36 @@ def audit_donation(trainer, batch, key=None) -> dict:
     out: dict = {"argument_bytes": 0.0, "output_bytes": 0.0,
                  "aliased_bytes": 0.0, "temp_bytes": 0.0,
                  "donated_fraction": 0.0, "unusable": []}
+    # a warm persistent compilation cache serves a deserialized executable
+    # whose memory_analysis reports zero aliased bytes, and XLA's "donated
+    # buffers were not usable" warnings only fire on a real compile — the
+    # audit must observe one.  Unsetting the dir alone is not enough: the
+    # cache instance is created once at first use and later config changes
+    # are ignored, so reset it (it lazily re-initializes from the restored
+    # config on the next cached compile).
+    cache_dir_was = jax.config.jax_compilation_cache_dir
+
+    def _reset_cache():
+        try:
+            from jax._src.compilation_cache import reset_cache
+            reset_cache()
+        except Exception:
+            pass
+
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_cache()
             lowered = trainer._train_step.lower(trainer.state, batch, key) \
                 if hasattr(trainer._train_step, "lower") else None
             compiled = lowered.compile() if lowered is not None else None
         except Exception as e:  # honor the degrade-don't-raise contract
             out["error"] = f"{type(e).__name__}: {e}"
             compiled = None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", cache_dir_was)
+            _reset_cache()
     out["unusable"] = [str(w.message) for w in caught
                        if "donated" in str(w.message).lower()]
     if compiled is None:

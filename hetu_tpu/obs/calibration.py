@@ -137,6 +137,11 @@ DEFAULT_THRESHOLDS = {
     "hbm_hit_rate": ("low", 0.90),
     "host_hit_rate": ("low", 0.90),
     "pull_bytes_per_stage": ("high", 1.15),
+    # memory ledger (PR 17): attributed footprint growing past the
+    # stored baseline regresses capacity planning before it OOMs
+    "kv_pool_bytes": ("high", 1.15),
+    "embed_hbm_bytes": ("high", 1.15),
+    "hwm_total_bytes": ("high", 1.15),
 }
 
 
@@ -719,6 +724,26 @@ class ProfileStore:
         return self.put("embed", values, model_sig=model_sig,
                         mesh_sig=mesh_sig, policy=policy,
                         device_kind=device_kind, source="embed.tier")
+
+    def ingest_memory(self, ledger, *, model_sig: str, mesh_sig: str = "",
+                      policy: str = "",
+                      device_kind: Optional[str] = None) -> dict:
+        """One ``memory`` record from a
+        :class:`~hetu_tpu.obs.memledger.MemoryLedger` (or a ``snapshot()``
+        dict): per-component attributed bytes, the total high-water mark,
+        and the pressure/fragmentation gauges.  The graded values are the
+        byte footprints — a >15% growth against the stored baseline
+        journals ``perf_regression`` while the fleet still fits."""
+        snap = ledger if isinstance(ledger, Mapping) else ledger.snapshot()
+        values = {"total_bytes": float(snap["total_bytes"]),
+                  "hwm_total_bytes": float(snap["hwm_bytes"]["total"]),
+                  "fragmentation": float(snap["fragmentation"]),
+                  "pressure": float(snap["pressure"])}
+        for comp, nbytes in sorted(snap["components"].items()):
+            values[f"{comp}_bytes"] = float(nbytes)
+        return self.put("memory", values, model_sig=model_sig,
+                        mesh_sig=mesh_sig, policy=policy,
+                        device_kind=device_kind, source="obs.memledger")
 
     def ingest_bench_line(self, rec: Mapping, *,
                           device_kind: Optional[str] = None) -> dict:

@@ -59,6 +59,7 @@ import time
 from typing import Callable, Optional
 
 from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import memledger as _memledger
 from hetu_tpu.obs import registry as _registry
 from hetu_tpu.obs import tracing as _tracing
 
@@ -357,6 +358,9 @@ class InstrumentedJit:
             m["seconds"].observe(compile_s)
             for kind, nbytes in memory.items():
                 m["memory"].labels(site=self.site, kind=kind).set(nbytes)
+        # memory-ledger seam: this program's executable/temp bytes join
+        # the per-site compile attribution
+        _memledger.note_compile(self.site, memory)
         # aot: the duration is pure lower+compile wall (goodput bills
         # it); watch-mode durations include the first call's execution,
         # which the step's own meter bills as useful — ingest skips them

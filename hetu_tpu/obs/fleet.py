@@ -584,6 +584,44 @@ class FleetAggregator:
         out["remediation"] = events[-tail:] if tail else []
         return out
 
+    def memory(self, tail: int = 50) -> dict:
+        """Fleet-wide memory-ledger merge — the ``/fleet/memory``
+        payload: the ``hetu_memledger_*`` byte gauges SUM across workers
+        (each worker's ledger attributes its own device), fragmentation
+        and pressure take the fleet MAX (the binding pool anywhere flags
+        the fleet), and the trailing ``mem_leak_suspect`` /
+        ``memory_pressure`` journal events ride along with the
+        publishing rank under ``publisher`` — the controller-merge
+        convention."""
+        out: dict = {"workers": len(self.snapshots)}
+        for key, family in (
+                ("component_bytes", "hetu_memledger_component_bytes"),
+                ("hwm_bytes", "hetu_memledger_hwm_bytes"),
+                ("kv_class_bytes", "hetu_memledger_kv_class_bytes")):
+            m = self.merged(family)
+            out[key] = ({k[0]: v for k, v in m["children"].items()}
+                        if m is not None else {})
+        m = self.merged("hetu_memledger_total_bytes")
+        out["total_bytes"] = (sum(m["children"].values())
+                              if m is not None else 0.0)
+        for key, family in (
+                ("fragmentation", "hetu_memledger_kv_fragmentation"),
+                ("pressure", "hetu_memledger_pressure")):
+            m = self.merged(family, agg="max")
+            out[key] = (max(m["children"].values(), default=0.0)
+                        if m is not None else 0.0)
+        events = []
+        for rank in sorted(self.snapshots):
+            events.extend(
+                {**e, "publisher": rank}
+                for e in self.snapshots[rank].get("journal", [])
+                if e.get("kind") in ("mem_leak_suspect",
+                                     "memory_pressure"))
+        events.sort(key=lambda e: (e.get("seq", 0), e["publisher"]))
+        tail = max(int(tail), 0)
+        out["events"] = events[-tail:] if tail else []
+        return out
+
     def calibration(self, tail: int = 50) -> dict:
         """Fleet-wide calibration merge — the ``/fleet/calibration``
         payload: the SHARED profile store under the gang dir (every
@@ -700,6 +738,13 @@ def fleet_routes(aggregator: FleetAggregator,
         return (json.dumps(aggregator.calibration(tail)).encode(),
                 "application/json")
 
+    def memory(q, b):
+        aggregator.refresh()
+        tail = int(q.get("n", ["50"])[0])
+        return (json.dumps(aggregator.memory(tail)).encode(),
+                "application/json")
+
+    routes.add("GET", "/fleet/memory", memory)
     routes.add("GET", "/fleet/calibration", calibration)
     routes.add("GET", "/fleet/controller", controller)
     routes.add("GET", "/fleet/divergence", divergence)

@@ -209,6 +209,10 @@ EVENT_KINDS = {
         {"metric", "baseline", "observed", "ratio"}),
     "mem_estimate_drift": frozenset(
         {"predicted_bytes", "xla_bytes", "ratio", "band"}),
+    # HBM memory ledger (PR 17): exact byte attribution + leak watchdog
+    # + the controller's memory-pressure remediation loop
+    "mem_leak_suspect": frozenset({"component", "drift", "balance"}),
+    "memory_pressure": frozenset({"pressure", "component", "action"}),
 }
 
 

@@ -273,6 +273,21 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
 
     routes.add("GET", "/calibration", calibration_view)
 
+    def memory_view(q, b):
+        """``/memory``: the process-wide installed
+        :class:`~hetu_tpu.obs.memledger.MemoryLedger`'s snapshot —
+        per-component bytes, per-pool page classes/tenants, high-water
+        marks, fragmentation, pressure, and the leak watchdog's
+        suspects (the rank-0 fleet merge lives at ``/fleet/memory``).
+        Lazy import: the scrape path must not pull the ledger until
+        asked."""
+        from hetu_tpu.obs import memledger as _memledger
+        led = _memledger.get_ledger()
+        body = led.snapshot() if led is not None else {"installed": False}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/memory", memory_view)
+
     def journal_tail(q, b):
         """Tail form (``?n=100``, newest suffix) or cursor form
         (``?since=<seq>``, everything after the gapless sequence number,

@@ -823,7 +823,8 @@ class ServingEngine:
             # ceil(bucket * cost) scheduler ticks (consumed in step())
             self._tick_prefill_charge += max(
                 1, math.ceil(bucket * self.prefill_tick_cost))
-        self.pool.alloc(req.id, plen, shared_pages=shared_pages)
+        self.pool.alloc(req.id, plen, shared_pages=shared_pages,
+                        owner=req.tenant_id)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(suffix)] = suffix
         logits, k, v = self._step_fn(
@@ -949,7 +950,8 @@ class ServingEngine:
         tl = self._timelines[req.id]
         verified = True
         try:
-            self.pool.import_pages(ticket.record, seq_id=req.id)
+            self.pool.import_pages(ticket.record, seq_id=req.id,
+                                   owner=req.tenant_id)
             self._migrations["in"] += 1
         except MigrationIntegrityError as e:
             verified = False
@@ -978,7 +980,7 @@ class ServingEngine:
         if self.prefill_tick_cost > 0:
             self._tick_prefill_charge += max(
                 1, math.ceil(bucket * self.prefill_tick_cost))
-        self.pool.alloc(req.id, plen)
+        self.pool.alloc(req.id, plen, owner=req.tenant_id)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt
         logits, k, v = self._step_fn(

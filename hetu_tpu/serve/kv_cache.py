@@ -70,6 +70,8 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
+from hetu_tpu.obs import memledger as _memledger
+
 __all__ = ["KVCachePool", "PageTable", "OutOfPages", "DoubleFree",
            "SCRATCH_PAGE", "gather_view_count", "reset_gather_view_count",
            "pages_written_count", "reset_pages_written_count",
@@ -194,6 +196,9 @@ class KVCachePool:
         self._exports: dict = {}
         self._exported_pages = 0   # cumulative pages exported
         self._imported_pages = 0   # cumulative pages imported
+        # seq_id -> owner (tenant id) for the per-tenant ledger view;
+        # absent == unowned (stats report it under "-")
+        self._owners: dict = {}
 
     # -- allocator ----------------------------------------------------------
 
@@ -212,14 +217,16 @@ class KVCachePool:
         return self.pages_needed(n_tokens) <= len(self._free)
 
     def alloc(self, seq_id: int, n_tokens: int,
-              shared_pages=()) -> PageTable:
+              shared_pages=(), owner=None) -> PageTable:
         """Reserve capacity for ``n_tokens`` (>=1 page).  Raises
         :exc:`OutOfPages` without side effects when the pool is short.
 
         ``shared_pages`` are already-allocated pages holding an identical
         prompt prefix (the prefix trie's match): the returned table's
         leading entries ALIAS them — each gains a refcount, no K/V bytes
-        move — and only the remainder is freshly allocated."""
+        move — and only the remainder is freshly allocated.  ``owner``
+        (a tenant id) tags the sequence for the per-tenant ledger/stats
+        view; it never affects placement."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
         need = self.pages_needed(n_tokens)
@@ -244,6 +251,9 @@ class KVCachePool:
         pt = PageTable(seq_id, pages)
         self._tables[seq_id] = pt
         self._allocs += 1
+        if owner is not None:
+            self._owners[seq_id] = str(owner)
+        _memledger.note_kv(self, alloc=1)
         return pt
 
     def ensure(self, seq_id: int, n_tokens: int) -> PageTable:
@@ -259,6 +269,7 @@ class KVCachePool:
             p = self._free.pop(0)
             self._refcount[p] = 1
             pt.pages.append(p)
+        _memledger.note_kv(self)
         return pt
 
     def retain(self, page: int) -> None:
@@ -267,6 +278,7 @@ class KVCachePool:
         if self._refcount.get(page, 0) < 1:
             raise ValueError(f"retain of unallocated page {page}")
         self._refcount[page] += 1
+        _memledger.note_kv(self)
 
     def release(self, page: int) -> None:
         """Drop one reference; the page returns to the free list only at
@@ -279,6 +291,7 @@ class KVCachePool:
             bisect.insort(self._free, page)
         else:
             self._refcount[page] = rc - 1
+        _memledger.note_kv(self)
 
     def free(self, seq_id: int) -> None:
         """Drop the sequence's reference on each of its pages; pages whose
@@ -291,6 +304,8 @@ class KVCachePool:
         for p in pt.pages:
             self.release(p)
         self._frees += 1
+        self._owners.pop(seq_id, None)
+        _memledger.note_kv(self, free=1)
 
     def copy_on_write(self, seq_id: int, token_index: int) -> bool:
         """Un-share before a write: if the page holding ``token_index``
@@ -313,6 +328,7 @@ class KVCachePool:
         self._refcount[new] = 1
         pt.pages[i] = new
         self.release(old)
+        _memledger.note_kv(self)
         return True
 
     # -- KV-page migration (disaggregated serving) --------------------------
@@ -340,6 +356,7 @@ class KVCachePool:
             self._refcount[p] += 1       # the export hold
         self._exports[seq_id] = pages
         self._exported_pages += len(pages)
+        _memledger.note_kv(self)
         return build_record(seq_id=seq_id, length=pt.length,
                             page_size=self.page_size, k_pages=k, v_pages=v)
 
@@ -350,6 +367,7 @@ class KVCachePool:
                              f"settled (or never exported)")
         for p in pages:
             self.release(p)
+        _memledger.note_kv(self)
 
     def ack_export(self, seq_id: int) -> None:
         """The importer admitted (or terminally resolved) the migrated
@@ -366,7 +384,7 @@ class KVCachePool:
         what happened."""
         self._settle_export(seq_id)
 
-    def import_pages(self, record, *, seq_id=None) -> PageTable:
+    def import_pages(self, record, *, seq_id=None, owner=None) -> PageTable:
         """Verify and admit a migrated sequence: re-check the record
         (``verify_record`` — torn payloads, per-page CRCs, the content
         fingerprint) and the pool geometry BEFORE allocating, then write
@@ -395,7 +413,7 @@ class KVCachePool:
                 "geometry", f"{n} pages exceed this pool's max_seq_len "
                             f"{self.max_seq_len}")
         sid = record.seq_id if seq_id is None else seq_id
-        pt = self.alloc(sid, n * self.page_size)
+        pt = self.alloc(sid, n * self.page_size, owner=owner)
         idx = jnp.asarray(pt.pages, jnp.int32)
         self.k = self.k.at[:, idx].set(jnp.asarray(record.k_pages))
         self.v = self.v.at[:, idx].set(jnp.asarray(record.v_pages))
@@ -415,6 +433,54 @@ class KVCachePool:
         ``stats()['pages_shared']`` (no invariant sweep)."""
         return sum(1 for rc in self._refcount.values() if rc > 1)
 
+    def owner(self, seq_id: int):
+        """The tenant id ``alloc(owner=)`` tagged this sequence with
+        (None when untagged)."""
+        return self._owners.get(seq_id)
+
+    def page_classes(self) -> dict:
+        """The EXACT page partition the memory ledger attributes bytes
+        by: every physical page lands in exactly one class —
+
+        - ``scratch``: the reserved page 0;
+        - ``export_hold``: under an unsettled export hold (an in-flight
+          migration may still need the bytes);
+        - ``shared_prefix``: aliased by several tables (refcount > 1) or
+          held only by the prefix trie / a hold with no table (allocated
+          but in no table);
+        - ``active``: privately held by exactly one live sequence;
+        - ``free``: on the free list.
+
+        Counts sum to ``num_pages`` (asserted by ``_check_invariants``
+        on every ``stats()`` call and by every ledger snapshot)."""
+        held_by_table = set()
+        for pt in self._tables.values():
+            held_by_table.update(pt.pages)
+        export_held = set()
+        for pages in self._exports.values():
+            export_held.update(pages)
+        classes = {"active": 0, "shared_prefix": 0, "export_hold": 0,
+                   "scratch": 1, "free": len(self._free)}
+        for p, rc in self._refcount.items():
+            if p in export_held:
+                classes["export_hold"] += 1
+            elif rc > 1 or p not in held_by_table:
+                classes["shared_prefix"] += 1
+            else:
+                classes["active"] += 1
+        return classes
+
+    def pages_by_tenant(self) -> dict:
+        """Table-page holds per owner (untagged sequences under ``"-"``),
+        sorted by tenant.  A page aliased by two tenants' tables counts
+        once per holder — this is the billing-shaped view, NOT the exact
+        physical partition (that is :meth:`page_classes`)."""
+        out: dict = {}
+        for sid, pt in self._tables.items():
+            t = self._owners.get(sid, "-")
+            out[t] = out.get(t, 0) + len(pt.pages)
+        return {t: out[t] for t in sorted(out)}
+
     def stats(self) -> dict:
         """The supported introspection surface: page classes, the
         refcount histogram, and the alloc/free balance — with the pool's
@@ -426,11 +492,16 @@ class KVCachePool:
         for rc in self._refcount.values():
             hist[rc] = hist.get(rc, 0) + 1
         shared = sum(1 for rc in self._refcount.values() if rc > 1)
+        classes = self.page_classes()
         return {
             "pages_total": self.num_pages - 1,
             "pages_free": len(self._free),
             "pages_private": len(self._refcount) - shared,
             "pages_shared": shared,
+            # the ledger's exact partition (classes sum to num_pages)
+            # and the per-tenant table-page holds (PR 16 identity)
+            "pages_by_class": classes,
+            "pages_by_tenant": self.pages_by_tenant(),
             "refcount_histogram": {str(k): hist[k] for k in sorted(hist)},
             "sequences": len(self._tables),
             "allocs": self._allocs,
@@ -475,6 +546,16 @@ class KVCachePool:
         assert self._allocs - self._frees == len(self._tables), \
             (f"alloc/free imbalance: {self._allocs} allocs - "
              f"{self._frees} frees != {len(self._tables)} live sequences")
+        # the ledger partition must be exact: every physical page in
+        # exactly one class (a page double-classed or dropped here would
+        # make the memory ledger mis-attribute bytes silently)
+        classes = self.page_classes()
+        assert sum(classes.values()) == self.num_pages, \
+            (f"page classes {classes} sum to {sum(classes.values())}, "
+             f"not num_pages {self.num_pages}")
+        assert not (set(self._owners) - set(self._tables)), \
+            (f"owner tags for dead sequences: "
+             f"{sorted(set(self._owners) - set(self._tables))}")
 
     def defrag(self) -> int:
         """Compact movable live pages into the lowest physical indices,
@@ -521,6 +602,7 @@ class KVCachePool:
         self._refcount = {mapping.get(p, p): rc
                           for p, rc in self._refcount.items()}
         self._free = sorted(slots[len(movable):])
+        _memledger.note_kv(self)
         return moved
 
     # -- the static-shape bridge -------------------------------------------

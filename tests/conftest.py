@@ -143,6 +143,14 @@ def pytest_configure(config):
                    "contract, and a two-tenant /infer + /slo HTTP smoke "
                    "stay in tier-1 — the seeded flood acceptance rides "
                    "the slow tier")
+    config.addinivalue_line(
+        "markers", "memobs: memory-observability tests (obs.memledger "
+                   "exact attribution, the KV page-class partition, the "
+                   "alloc/free leak watchdog, /memory + /fleet/memory, "
+                   "estimator reconcile and calibration ingest); the "
+                   "exactness oracle, leak-naming, bitwise-replay, and "
+                   "endpoint smokes stay in tier-1 — the fleet chaos "
+                   "acceptance rides the slow tier")
 
 
 @pytest.fixture(autouse=True)

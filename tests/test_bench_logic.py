@@ -275,6 +275,40 @@ class TestServeMode:
             bench.main()
         assert ei.value.code == bench.PREFLIGHT_RC and order == []
 
+    def test_memory_section_from_snapshot(self):
+        """The serve line's memory section: max peak occupancy across
+        pools, shared-prefix fraction of the pages held at peak, and the
+        ledger's total high-water mark."""
+        snap = {"kv_pools": {
+                    "0": {"peak_used_pages": 6, "peak_shared_pages": 3,
+                          "peak_used_fraction": 0.75},
+                    "1": {"peak_used_pages": 2, "peak_shared_pages": 0,
+                          "peak_used_fraction": 0.25}},
+                "hwm_bytes": {"total": 4096}}
+        assert bench._memory_section(snap) == {
+            "peak_pool_occupancy": 0.75,
+            "shared_prefix_fraction": 0.375,  # 3 / 8 pages at peak
+            "hwm_bytes": 4096}
+        # an idle run (no pools touched) degrades to zeros, not a crash
+        assert bench._memory_section(
+            {"kv_pools": {}, "hwm_bytes": {"total": 0}}) == {
+            "peak_pool_occupancy": 0.0, "shared_prefix_fraction": 0.0,
+            "hwm_bytes": 0}
+
+    def test_serve_line_carries_memory_section(self, monkeypatch, capture):
+        """bench_serve threads the paged run's ledger-derived memory
+        section into the JSON line verbatim."""
+        mem = {"peak_pool_occupancy": 0.5, "shared_prefix_fraction": 0.0,
+               "hwm_bytes": 1024}
+        monkeypatch.setattr(
+            bench, "_serve_run",
+            lambda cfg, trace, *, paged, **kw:
+                (10.0 if paged else 8.0, 0.01, 0.02, 8, {},
+                 mem if paged else {"hwm_bytes": -1}))
+        bench.bench_serve(False, "cpu", 0.0)
+        assert capture[-1]["metric"] == "serve_decode_tokens_per_sec"
+        assert capture[-1]["memory"] == mem
+
     def test_serve_mode_runs_behind_preflight(self, monkeypatch, capture):
         """--mode serve goes through the SAME fast-fail preflight as the
         training configs: a dead tunnel means rc=3 and NO stdout metric."""

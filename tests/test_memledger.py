@@ -1,0 +1,720 @@
+"""The HBM ledger (obs/memledger.py): exact device-byte attribution.
+
+Tier-1: the page-class partition against an independent set-arithmetic
+oracle under seeded pool chaos (prefix aliasing + CoW + export holds +
+defrag), engine- and fleet-level exactness (every snapshot asserts
+attributed bytes == pool array bytes; alloc/free balance drifts zero),
+an INJECTED leak (a seeded skip of one ``free`` posting) named by the
+watchdog within its grace, bitwise same-seed replay of snapshots and the
+journal, the disabled-path guard (no ledger -> provably no ledger work),
+the ``/memory`` + ``/fleet/memory`` endpoints, estimator reconcile,
+calibration ``ingest_memory``, the controller's memory-pressure loop,
+and the exactly-once tenant KV-page billing across migration (including
+the corruption ``_reprefill`` fallback).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hetu_tpu import obs
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec.controller import ControllerConfig, RuntimeController
+from hetu_tpu.models import GPT
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.obs import memledger
+from hetu_tpu.obs import registry as obs_registry
+from hetu_tpu.obs.memledger import KV_PAGE_CLASSES, MemoryLedger
+from hetu_tpu.serve import DisaggRouter, ServingEngine
+from test_disagg import CFG, VirtualClock, drain, make_engine, tiny_pool
+
+pytestmark = pytest.mark.memobs
+
+
+@pytest.fixture(scope="module")
+def model():
+    set_random_seed(0)
+    return GPT(CFG)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ledger():
+    """A test must never leave a process-wide ledger behind — later
+    tests' pools would post into it and skew its balances."""
+    yield
+    memledger.install_ledger(None)
+
+
+def partition_oracle(pool):
+    """The page partition recomputed with SET ARITHMETIC over the pool's
+    primitive maps — independent of ``page_classes``' classifier loop,
+    so agreement is a cross-check, not a tautology."""
+    table_held = set()
+    for pt in pool._tables.values():
+        table_held |= set(pt.pages)
+    export_held = set()
+    for pages in pool._exports.values():
+        export_held |= set(pages)
+    allocated = set(pool._refcount)
+    exported = allocated & export_held
+    shared = {p for p in allocated - exported
+              if pool._refcount[p] > 1 or p not in table_held}
+    active = allocated - exported - shared
+    return {"active": len(active), "shared_prefix": len(shared),
+            "export_hold": len(exported), "scratch": 1,
+            "free": len(pool._free)}
+
+
+def chaos_ops(pool, rng, steps=250):
+    """Seeded mutation stream over one pool: allocs (sometimes aliasing
+    a live sequence's prefix page), growth, CoW, frees, export/free/ack
+    cycles, and defrag — every mutator the ledger instruments."""
+    from hetu_tpu.serve import OutOfPages
+
+    live, exported, next_id = [], [], 0
+    for _ in range(steps):
+        op = rng.choice(["alloc", "alloc_shared", "grow", "cow", "free",
+                         "export", "ack", "defrag"])
+        try:
+            if op == "alloc":
+                pool.alloc(next_id, int(rng.integers(1, 17)),
+                           owner=f"t{next_id % 3}")
+                live.append(next_id)
+                next_id += 1
+            elif op == "alloc_shared" and live:
+                donor = pool._tables[int(rng.choice(live))]
+                pool.alloc(next_id, 2 * pool.page_size,
+                           shared_pages=donor.pages[:1])
+                live.append(next_id)
+                next_id += 1
+            elif op == "grow" and live:
+                pool.ensure(int(rng.choice(live)), pool.max_seq_len)
+            elif op == "cow" and live:
+                pool.copy_on_write(int(rng.choice(live)), 0)
+            elif op == "free" and live:
+                sid = live.pop(int(rng.integers(len(live))))
+                pool.free(sid)
+            elif op == "export" and live:
+                sid = int(rng.choice(live))
+                if sid not in pool._exports:
+                    pool.export_pages(sid)
+                    exported.append(sid)
+            elif op == "ack" and exported:
+                pool.ack_export(exported.pop(0))
+            elif op == "defrag":
+                pool.defrag()
+        except OutOfPages:
+            pass
+        yield
+    for sid in exported:
+        pool.ack_export(sid)
+        yield
+    for sid in live:
+        pool.free(sid)
+        yield
+
+
+# ------------------------------------------------- the partition oracle
+
+class TestPartitionOracle:
+    def test_seeded_chaos_matches_oracle(self):
+        """Every mutation step: ``page_classes`` == the independent
+        oracle, the partition sums to ``num_pages``, and the pool's own
+        invariants hold."""
+        pool = tiny_pool(num_pages=32, max_seq_len=16)
+        rng = np.random.default_rng(17)
+        for _ in chaos_ops(pool, rng):
+            classes = pool.page_classes()
+            assert classes == partition_oracle(pool)
+            assert sum(classes.values()) == pool.num_pages
+            assert set(classes) == set(KV_PAGE_CLASSES)
+            pool._check_invariants()
+        # drained: everything returned to the free list
+        assert pool.page_classes()["free"] == pool.num_pages - 1
+
+    def test_stats_partition_through_cow_export_defrag(self):
+        """Satellite regression: ``stats()``'s per-class counts sum to
+        the total through prefix aliasing, copy-on-write, an export
+        hold surviving ``free``, and defrag."""
+        pool = tiny_pool(num_pages=16)
+
+        def check(**expect):
+            s = pool.stats()  # runs _check_invariants
+            classes = s["pages_by_class"]
+            assert sum(classes.values()) == pool.num_pages
+            for k, v in expect.items():
+                assert classes[k] == v, (k, classes)
+            return s
+
+        a = pool.alloc(0, 8, owner="acme")           # 2 private pages
+        check(active=2, free=13)
+        pool.alloc(1, 8, shared_pages=list(a.pages), owner="beta")
+        check(shared_prefix=2, active=0, free=13)    # fully aliased
+        pool.copy_on_write(1, 0)                     # un-share page 0
+        check(shared_prefix=1, active=2, free=12)
+        s = check()
+        assert s["pages_by_tenant"] == {"acme": 2, "beta": 2}
+        pool.export_pages(0)
+        pool.free(0)                                 # hold outlives free
+        check(export_hold=2, free=12)
+        moved = pool.defrag()
+        assert moved >= 0
+        check(export_hold=2)                         # holds pinned
+        pool.ack_export(0)
+        pool.free(1)
+        check(free=pool.num_pages - 1, active=0, shared_prefix=0,
+              export_hold=0)
+        assert pool.pages_by_tenant() == {}
+
+
+# ------------------------------------------------------ ledger exactness
+
+class TestLedgerExactness:
+    def test_pool_chaos_snapshots_exact(self):
+        """Snapshots through the chaos stream: the internal exactness
+        assertion holds, bytes-by-class sums to the array bytes, and the
+        event balance tracks live sequences with zero drift."""
+        led = MemoryLedger()
+        with memledger.use(led):
+            pool = tiny_pool(num_pages=32, max_seq_len=16)
+            rng = np.random.default_rng(23)
+            for i, _ in enumerate(chaos_ops(pool, rng)):
+                if i % 25 == 0:
+                    snap = led.snapshot()
+                    p = snap["kv_pools"]["0"]
+                    assert sum(p["bytes_by_class"].values()) \
+                        == p["bytes_total"] \
+                        == int(pool.k.nbytes) + int(pool.v.nbytes)
+                    assert p["drift"] == 0
+                    assert p["allocs"] - p["frees"] == p["live_sequences"]
+            snap = led.snapshot()
+        p = snap["kv_pools"]["0"]
+        assert p["live_sequences"] == 0 and p["balance"] == 0
+        assert p["allocs"] == pool.stats()["allocs"]
+        assert p["frees"] == pool.stats()["frees"]
+        assert snap["leak_suspects"] == []
+        assert p["peak_used_pages"] >= 1
+        assert p["peak_used_fraction"] <= 1.0
+
+    def test_engine_serving_attribution(self, model):
+        """A colocated engine run: the ledger tracks the engine's pool,
+        balances land at zero after the run, owner tags land the tenant
+        view, and the peak-occupancy mark is sane."""
+        led = MemoryLedger()
+        with memledger.use(led):
+            clock = VirtualClock()
+            eng = make_engine(model, clock, queue_depth=8)
+            hs = [eng.submit(list(range(2 + i, 10 + i)), 4,
+                             tenant="acme") for i in range(3)]
+            for _ in range(5000):
+                if eng.batcher.idle:
+                    break
+                eng.step()
+                clock.advance(0.001)
+            snap = led.snapshot()
+        assert all(h.status == "completed" for h in hs)
+        p = snap["kv_pools"]["0"]
+        assert p["allocs"] == 3 and p["frees"] == 3
+        assert p["balance"] == 0 and p["drift"] == 0
+        assert p["peak_used_pages"] >= 1
+        assert snap["components"]["kv_pool"] == p["bytes_total"]
+        assert snap["leak_suspects"] == []
+
+    def test_disagg_fleet_attribution(self, model):
+        """Migration (export on the prefill worker, import on the decode
+        worker): both pools tracked, every export settled, balances
+        zero on both sides."""
+        led = MemoryLedger()
+        with memledger.use(led):
+            clock = VirtualClock()
+            engines = [make_engine(model, clock, role="prefill"),
+                       make_engine(model, clock, role="decode")]
+            router = DisaggRouter(engines)
+            hs = [router.submit(list(range(2 + i, 12 + i)), 6)
+                  for i in range(3)]
+            drain(router, clock)
+            snap = led.snapshot()
+        assert all(h.status == "completed" for h in hs)
+        assert sorted(snap["kv_pools"]) == ["0", "1"]
+        for idx in ("0", "1"):
+            p = snap["kv_pools"][idx]
+            assert p["balance"] == 0 and p["drift"] == 0
+        # prefill allocated 3 and freed 3 (exports settled); decode
+        # imported 3 (an import IS an alloc) and retired 3
+        assert snap["kv_pools"]["0"]["allocs"] == 3
+        assert snap["kv_pools"]["1"]["allocs"] == 3
+        for eng in engines:
+            assert eng.pool.stats()["exports_outstanding"] == 0
+
+    def test_embed_compile_and_train_components(self):
+        """The non-KV seams: tiered-embedding residency (rows x dim x 4),
+        per-site compile bytes (executable accumulates, temp maxes), and
+        train-state pytree bytes — each exact against its own oracle."""
+        import jax.numpy as jnp
+
+        from hetu_tpu.embed.tier import TieredEmbedding, TierPolicy
+
+        led = MemoryLedger()
+        with memledger.use(led):
+            emb = TieredEmbedding(50, 8, hbm_capacity=8, host_capacity=32,
+                                  policy=TierPolicy(promote_touches=1,
+                                                    demote_idle=8),
+                                  optimizer="sgd", lr=1.0, name="ledg")
+            emb.stage(jnp.asarray([[1, 2, 3]]))
+            resident = emb.tier_stats()["hbm"]["resident"]
+            assert resident == 3
+
+            led.note_compile("train_step", {"generated_code": 100,
+                                            "temp": 50})
+            led.note_compile("train_step", {"generated_code": 40,
+                                            "temp": 30})
+
+            class _State:
+                model = {"w": np.zeros((4, 4), np.float32)}      # 64 B
+                opt_state = {"m": np.zeros((8,), np.float32)}    # 32 B
+
+            led.note_train_state(_State())
+            snap = led.snapshot()
+        assert snap["embed"] == {"ledg": {"rows": 3, "bytes": 3 * 8 * 4}}
+        assert snap["components"]["embed_hbm"] == 3 * 8 * 4
+        assert snap["compile_sites"]["train_step"] == {
+            "executable_bytes": 140, "temp_bytes": 50, "programs": 2}
+        assert snap["components"]["compile"] == 190
+        assert snap["components"]["train_weights"] == 64
+        assert snap["components"]["train_optimizer"] == 32
+        assert snap["total_bytes"] == sum(snap["components"].values())
+        assert snap["hwm_bytes"]["total"] == snap["total_bytes"]
+
+    def test_trainer_posts_state_bytes(self):
+        """Integration: Trainer's init seam posts weights/optimizer
+        bytes without being asked."""
+        import jax.numpy as jnp
+
+        from hetu_tpu.exec import Trainer
+        from hetu_tpu.models import MLP
+        from hetu_tpu.ops import softmax_cross_entropy_sparse
+        from hetu_tpu.optim import SGDOptimizer
+
+        def loss_fn(model, batch, key):
+            logits = model(batch["x"])
+            return softmax_cross_entropy_sparse(
+                logits, batch["y"]).mean(), {}
+
+        led = MemoryLedger()
+        with memledger.use(led):
+            set_random_seed(0)
+            Trainer(MLP((8, 16, 3)), SGDOptimizer(0.1), loss_fn)
+            snap = led.snapshot()
+        # MLP(8->16->3): (8*16+16) + (16*3+3) f32 params
+        assert snap["components"]["train_weights"] == 4 * (144 + 51)
+
+
+# ------------------------------------------------------ the leak watchdog
+
+class TestLeakWatchdog:
+    def test_injected_leak_named_within_grace(self, monkeypatch):
+        """The acceptance chaos injection: a seeded skip of ONE ``free``
+        posting.  The pool is healthy (it really freed); the LEDGER's
+        balance now over-counts — drift +1, sustained, and the watchdog
+        names the component on exactly the ``leak_grace``-th snapshot,
+        once."""
+        led = MemoryLedger(leak_grace=3)
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        orig = memledger.note_kv
+        dropped = []
+
+        def lossy(pool, *, alloc=0, free=0):
+            if free and not dropped:
+                dropped.append(1)
+                return                      # the unledgered free path
+            orig(pool, alloc=alloc, free=free)
+
+        with obs_journal.use(jr), memledger.use(led):
+            pool = tiny_pool()
+            pool.alloc(0, 4)
+            pool.alloc(1, 4)
+            monkeypatch.setattr(memledger, "note_kv", lossy)
+            pool.free(0)
+            assert dropped  # the injection fired
+            snaps = [led.snapshot() for _ in range(4)]
+        assert [s["kv_pools"]["0"]["drift"] for s in snaps] == [1, 1, 1, 1]
+        # named at snapshot 3 (the grace), exactly once, with the drift
+        assert [len(s["leak_suspects"]) for s in snaps] == [0, 0, 1, 1]
+        assert led.leak_suspects == [
+            {"component": "kv_pool:0", "drift": 1, "balance": 2}]
+        events = jr.of_kind("mem_leak_suspect")
+        assert len(events) == 1
+        assert events[0]["component"] == "kv_pool:0"
+        assert events[0]["drift"] == 1
+
+    def test_clean_run_never_flags(self):
+        led = MemoryLedger(leak_grace=1)
+        with memledger.use(led):
+            pool = tiny_pool()
+            for i in range(5):
+                pool.alloc(i, 4)
+                led.snapshot()
+                pool.free(i)
+                led.snapshot()
+        assert led.leak_suspects == []
+
+
+# ------------------------------------------------------- bitwise replay
+
+class TestBitwiseReplay:
+    def _run(self, seed):
+        led = MemoryLedger()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        snaps = []
+        with obs_journal.use(jr), memledger.use(led):
+            pool = tiny_pool(num_pages=32, max_seq_len=16)
+            rng = np.random.default_rng(seed)
+            for i, _ in enumerate(chaos_ops(pool, rng)):
+                if i % 40 == 0:
+                    snaps.append(json.dumps(led.snapshot(),
+                                            sort_keys=True))
+            snaps.append(json.dumps(led.snapshot(), sort_keys=True))
+        events = [json.dumps(e, sort_keys=True) for e in jr.events]
+        return snaps, events
+
+    def test_same_seed_replay_is_bitwise(self):
+        a_snaps, a_events = self._run(5)
+        b_snaps, b_events = self._run(5)
+        assert a_snaps == b_snaps
+        assert a_events == b_events
+        c_snaps, _ = self._run(6)
+        assert c_snaps != a_snaps  # the comparison has teeth
+
+    def test_engine_replay_snapshots_bitwise(self, model):
+        def run():
+            led = MemoryLedger()
+            with memledger.use(led):
+                clock = VirtualClock()
+                eng = make_engine(model, clock, queue_depth=8)
+                for i in range(3):
+                    eng.submit(list(range(2 + i, 10 + i)), 4)
+                for _ in range(5000):
+                    if eng.batcher.idle:
+                        break
+                    eng.step()
+                    clock.advance(0.001)
+                return json.dumps(led.snapshot(), sort_keys=True)
+        assert run() == run()
+
+
+# -------------------------------------------------------- disabled path
+
+class TestDisabledPath:
+    def test_no_ledger_means_no_ledger_work(self, monkeypatch):
+        """The overhead guard, structurally: with no ledger installed
+        every seam is one module-global load and a branch — the
+        MemoryLedger methods are provably never entered."""
+        def boom(*a, **k):
+            raise AssertionError("ledger work on the disabled path")
+
+        for name in ("note_kv", "note_embed", "note_compile",
+                     "note_train_state", "_track"):
+            monkeypatch.setattr(MemoryLedger, name, boom)
+        assert memledger.get_ledger() is None
+        pool = tiny_pool()
+        pool.alloc(0, 8)
+        pool.ensure(0, 12)
+        pool.copy_on_write(0, 0)
+        pool.export_pages(0)
+        pool.free(0)
+        pool.ack_export(0)
+        pool.defrag()
+        memledger.note_compile("site", {"generated_code": 1})
+        memledger.note_train_state(object())
+
+    def test_registry_disabled_means_no_posting(self):
+        led = MemoryLedger()
+        with memledger.use(led):
+            obs_registry.disable()
+            try:
+                pool = tiny_pool()
+                pool.alloc(0, 4)
+                pool.free(0)
+            finally:
+                obs_registry.enable()
+        assert led._kv_events == {}  # nothing reached the ledger
+
+
+# ------------------------------------------------------------ endpoints
+
+class TestEndpoints:
+    def test_memory_endpoint_line_validated(self, model):
+        led = MemoryLedger()
+        with memledger.use(led), obs.serve() as srv:
+            pool = tiny_pool()
+            pool.alloc(0, 8, owner="acme")
+            with urllib.request.urlopen(srv.url + "/memory",
+                                        timeout=10) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "application/json")
+                body = json.loads(r.read())
+            assert body["installed"] is True
+            p = body["kv_pools"]["0"]
+            assert p["pages_by_class"]["active"] == 2
+            assert p["pages_by_tenant"] == {"acme": 2}
+            assert body["total_bytes"] == p["bytes_total"]
+            assert sum(body["kv_class_bytes"].values()) == p["bytes_total"]
+            pool.free(0)
+
+    def test_memory_endpoint_uninstalled(self):
+        memledger.install_ledger(None)
+        with obs.serve() as srv:
+            with urllib.request.urlopen(srv.url + "/memory",
+                                        timeout=10) as r:
+                assert json.loads(r.read()) == {"installed": False}
+
+    def test_fleet_memory_merge(self, tmp_path):
+        """Two synthetic workers publish memledger families + a leak
+        event; /fleet/memory SUMS the byte gauges, MAXES fragmentation
+        and pressure, and tails the events with the publisher rank."""
+        from hetu_tpu.obs.fleet import (FleetAggregator, SnapshotPublisher,
+                                        serve_fleet)
+
+        for rank, (kv, frag, pressure) in enumerate(
+                [(1024, 0.25, 0.5), (2048, 0.75, 0.9)]):
+            reg = obs_registry.MetricsRegistry()
+            comp = reg.gauge("hetu_memledger_component_bytes", "bytes",
+                             ("component",))
+            comp.labels(component="kv_pool").set(float(kv))
+            reg.gauge("hetu_memledger_total_bytes", "total").set(float(kv))
+            reg.gauge("hetu_memledger_kv_fragmentation", "frag").set(frag)
+            reg.gauge("hetu_memledger_pressure", "press").set(pressure)
+            jr = obs_journal.EventJournal(clock=lambda: 0.0)
+            if rank == 1:
+                jr.record("mem_leak_suspect", component="kv_pool:0",
+                          drift=1, balance=2)
+            SnapshotPublisher(str(tmp_path), rank, registry=reg,
+                              journal=jr, clock=lambda: 100.0).publish()
+        agg = FleetAggregator(str(tmp_path), stale_after=1e9,
+                              clock=lambda: 100.0)
+        agg.refresh()
+        merged = agg.memory()
+        assert merged["workers"] == 2
+        assert merged["component_bytes"] == {"kv_pool": 3072.0}
+        assert merged["total_bytes"] == 3072.0
+        assert merged["fragmentation"] == 0.75
+        assert merged["pressure"] == 0.9
+        assert [(e["kind"], e["publisher"]) for e in merged["events"]] \
+            == [("mem_leak_suspect", 1)]
+        with serve_fleet(str(tmp_path), stale_after=1e9) as srv:
+            with urllib.request.urlopen(srv.url + "/fleet/memory",
+                                        timeout=10) as r:
+                body = json.loads(r.read())
+        assert body["total_bytes"] == 3072.0
+        assert body["events"][0]["component"] == "kv_pool:0"
+
+
+# --------------------------------------- reconcile + calibration ingest
+
+class TestReconcileAndCalibration:
+    def test_reconcile_within_band_and_drift(self):
+        led = MemoryLedger()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr), memledger.use(led):
+            pool = tiny_pool()
+            pool.alloc(0, 8)
+            exact = int(pool.k.nbytes) + int(pool.v.nbytes)
+            out = led.reconcile(exact, component="kv_pool")
+            assert out["within_band"] and out["ratio"] == 1.0
+            assert out["measured_bytes"] == exact
+            assert jr.of_kind("mem_estimate_drift") == []
+            out = led.reconcile(exact * 2, component="kv_pool")
+            assert not out["within_band"]
+            drift = jr.of_kind("mem_estimate_drift")
+            assert len(drift) == 1 and drift[0]["ratio"] == 2.0
+            pool.free(0)
+
+    def test_ingest_memory_grades_byte_growth(self):
+        from hetu_tpu.obs.calibration import ProfileStore
+
+        led = MemoryLedger()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr), memledger.use(led):
+            pool = tiny_pool()
+            pool.alloc(0, 8)
+            snap = led.snapshot()
+            store = ProfileStore(clock=lambda: 0.0)
+            rec = store.ingest_memory(led, model_sig="tiny")
+            assert rec["source"] == "obs.memledger"
+            assert rec["values"]["kv_pool_bytes"] == float(
+                snap["components"]["kv_pool"])
+            assert rec["values"]["hwm_total_bytes"] == float(
+                snap["hwm_bytes"]["total"])
+            # a second ingest with >15% byte growth trips the sentinel
+            grown = dict(snap)
+            grown["components"] = {
+                c: int(b * 2) for c, b in snap["components"].items()}
+            store.ingest_memory(grown, model_sig="tiny")
+            regs = jr.of_kind("perf_regression")
+            assert any(e["metric"] == "kv_pool_bytes" for e in regs)
+            pool.free(0)
+
+
+# --------------------------------------------- controller memory loop
+
+class _StubBatcher:
+    def __init__(self):
+        self.shedding = False
+        self.log = []
+
+    def set_shed(self, reason):
+        self.shedding = True
+        self.log.append(("set", reason))
+
+    def clear_shed(self):
+        self.shedding = False
+        self.log.append(("clear", None))
+
+
+class _StubEngine:
+    def __init__(self, pool):
+        self.pool = pool
+        self.batcher = _StubBatcher()
+
+
+class TestControllerMemoryLoop:
+    CFG = dict(shed=False, freeze_buckets=False, tune_deadline=False,
+               quarantine=False, sustain_ticks=2)
+
+    def _fill(self, pool, live):
+        for i in range(pool.num_pages // 4):
+            live.append(i)
+            pool.alloc(i, pool.page_size * 4)  # 4 pages each
+
+    def test_sustained_pressure_defrags_then_sheds_then_releases(self):
+        led = MemoryLedger()
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr), memledger.use(led):
+            pool = tiny_pool(num_pages=9, max_seq_len=16)
+            eng = _StubEngine(pool)
+            ctrl = RuntimeController(
+                ControllerConfig(**self.CFG),
+                registry=obs_registry.MetricsRegistry())
+            live = []
+            self._fill(pool, live)          # 8/8 pages: pressure 1.0
+            assert led.memory_pressure() == 1.0
+            ctrl.on_serve_tick(eng)
+            assert not eng.batcher.shedding  # 1 tick < sustain
+            ctrl.on_serve_tick(eng)
+            assert eng.batcher.shedding      # defrag didn't help: shed
+            assert ctrl.mem_pressure_active
+            acts = [a["action"] for a in ctrl.actions]
+            assert acts == ["memory_shed"]
+            events = jr.of_kind("memory_pressure")
+            assert events[-1]["action"] == "memory_shed"
+            for sid in live:                 # drain below mem_off
+                pool.free(sid)
+            ctrl.on_serve_tick(eng)
+            assert eng.batcher.shedding      # release needs sustain too
+            ctrl.on_serve_tick(eng)
+            assert not eng.batcher.shedding
+            assert not ctrl.mem_pressure_active
+            assert [a["action"] for a in ctrl.actions] \
+                == ["memory_shed", "memory_release"]
+            assert ctrl.summary()["mem_pressure_active"] is False
+
+    def test_release_unlatches_everything(self):
+        led = MemoryLedger()
+        with memledger.use(led):
+            pool = tiny_pool(num_pages=9, max_seq_len=16)
+            eng = _StubEngine(pool)
+            ctrl = RuntimeController(
+                ControllerConfig(**self.CFG),
+                registry=obs_registry.MetricsRegistry())
+            self._fill(pool, [])
+            ctrl.on_serve_tick(eng)
+            ctrl.on_serve_tick(eng)
+            assert eng.batcher.shedding
+            ctrl.release()
+            assert not eng.batcher.shedding
+            assert not ctrl.mem_pressure_active
+
+    def test_no_ledger_means_inert_loop(self):
+        memledger.install_ledger(None)
+        eng = _StubEngine(tiny_pool())
+        ctrl = RuntimeController(ControllerConfig(**self.CFG),
+                                 registry=obs_registry.MetricsRegistry())
+        for _ in range(5):
+            ctrl.on_serve_tick(eng)
+        assert not eng.batcher.shedding and ctrl.actions == []
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="mem_off <= mem_on"):
+            ControllerConfig(mem_on=0.5, mem_off=0.8)
+        with pytest.raises(ValueError, match="mem_on is a used-page"):
+            ControllerConfig(mem_on=1.5, mem_off=0.5)
+
+
+# ------------------------------------- tenant billing across migration
+
+class TestTenantBillingAcrossMigration:
+    """Satellite: KV pages billed to the tenant EXACTLY ONCE however a
+    request travels — colocated, migrated prefill->decode, or recovered
+    through the corruption ``_reprefill`` fallback."""
+
+    def _billed(self, engines):
+        total = 0
+        for eng in engines:
+            row = eng.tenant_meter.summary().get("acme")
+            total += row["kv_pages"] if row else 0
+        return total
+
+    def _disagg(self, model, corrupt_victim=None):
+        from hetu_tpu.serve.kv_cache import KVCachePool as Pool
+        orig = Pool.export_pages
+        if corrupt_victim is not None:
+            def patched(pool, sid):
+                rec = orig(pool, sid)
+                if sid == corrupt_victim:
+                    rec.k_pages = np.array(rec.k_pages)
+                    rec.k_pages[0, 0, 0, 0, 0] += 1.0
+                return rec
+            Pool.export_pages = patched
+        try:
+            clock = VirtualClock()
+            engines = [make_engine(model, clock, role="prefill"),
+                       make_engine(model, clock, role="decode")]
+            router = DisaggRouter(engines)
+            hs = [router.submit(list(range(2 + i, 12 + i)), 6,
+                                tenant="acme") for i in range(3)]
+            drain(router, clock)
+            assert all(h.status == "completed" for h in hs)
+            return engines
+        finally:
+            Pool.export_pages = orig
+
+    def _colocated(self, model):
+        clock = VirtualClock()
+        eng = make_engine(model, clock, queue_depth=8)
+        hs = [eng.submit(list(range(2 + i, 12 + i)), 6, tenant="acme")
+              for i in range(3)]
+        for _ in range(5000):
+            if eng.batcher.idle:
+                break
+            eng.step()
+            clock.advance(0.001)
+        assert all(h.status == "completed" for h in hs)
+        return [eng]
+
+    def test_migrated_requests_bill_once_on_decode_side(self, model):
+        base = self._billed(self._colocated(model))
+        engines = self._disagg(model)
+        assert base > 0
+        # same trace, same pages at retire: billed equal, and ONLY by
+        # the decode worker (the prefill side freed without billing)
+        assert self._billed(engines) == base
+        assert self._billed(engines[:1]) == 0
+
+    def test_reprefill_fallback_still_bills_once(self, model):
+        base = self._billed(self._colocated(model))
+        engines = self._disagg(model, corrupt_victim=1)
+        assert engines[1]._migrations["reprefill"] == 1
+        assert self._billed(engines) == base
+        assert self._billed(engines[:1]) == 0

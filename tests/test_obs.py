@@ -591,6 +591,17 @@ def test_metric_naming_conventions():
                 ("_seconds", "_bytes", "_steps")):
             problems.append(f"{name}: histogram without a unit suffix "
                             f"({regs[0][3]})")
+        # byte-unit clause (PR 17): a family whose name claims bytes
+        # must put the unit where Prometheus conventions expect it —
+        # gauges end _bytes, counters end _bytes_total.  A family like
+        # hetu_x_bytes_fraction would dashboard as bytes and alert wrong.
+        if "bytes" in name:
+            if kind == "gauge" and not name.endswith("_bytes"):
+                problems.append(f"{name}: byte gauge must end _bytes "
+                                f"({regs[0][3]})")
+            if kind == "counter" and not name.endswith("_bytes_total"):
+                problems.append(f"{name}: byte counter must end "
+                                f"_bytes_total ({regs[0][3]})")
         # the per-tenant metering family must be attributable: every
         # hetu_tenant_* registration declares a `tenant` label (an
         # unlabeled tenant metric is a billing artifact with no payer)
