@@ -1202,6 +1202,101 @@ def bench_serve_tenants(on_tpu, kind, peak):
         device=kind, timing="wall-trace", spread=None)
 
 
+def bench_plan(on_tpu, kind, peak):
+    """``--mode plan``: the unified deployment planner's chosen serving
+    config against the hand-tuned stock default on the same seeded
+    trace.  The planner is fed by ``fit_calibration`` (named defaults
+    fill an empty history) and emits one signed Plan; both arms run the
+    SAME workload on injected zero clocks and the headline is the
+    deterministic virtual-time decode tokens per router tick —
+    ``vs_baseline`` = planner / default, with the plan's sha256 and
+    one-line description in the artifact so the decision is
+    bitwise-replayable from the journal.  Rides the same rc=3 preflight
+    as every serve round."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.obs import calibration as _calibration
+    from hetu_tpu.plan import DeploymentSpec, build_fleet, plan_deployment
+    from hetu_tpu.serve import FleetRouter, ServingEngine, generate_load
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        spec = DeploymentSpec(
+            model_sig="gpt-bench", n_layers=8, hidden_size=1024,
+            seq_len=2048, vocab_size=32000, global_batch=8,
+            n_devices=2, serve_devices=2, hbm_bytes=16e9,
+            peak_flops=max(peak, 1e12), device_kind=kind,
+            requests_per_s=4.0, prompt_p50=128, prompt_p99=1024,
+            decode_len=48, slots_per_replica=8, page_size=64)
+        trace = generate_load(17, 24, vocab=cfg.vocab_size,
+                              prompt_len=(64, 1024), max_new=(32, 64),
+                              mean_gap_s=0.0)
+    else:  # CI smoke: tiny shapes, still the full planner-vs-default A/B
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        spec = DeploymentSpec(
+            model_sig="gpt-ci", n_layers=2, hidden_size=32, seq_len=64,
+            vocab_size=97, global_batch=8, n_devices=2, serve_devices=2,
+            hbm_bytes=2e9, peak_flops=max(peak, 1e12), device_kind=kind,
+            requests_per_s=4.0, prompt_p50=8, prompt_p99=16,
+            decode_len=6, slots_per_replica=8, page_size=8)
+        trace = generate_load(17, 48, vocab=cfg.vocab_size,
+                              prompt_len=(2, 12), max_new=(2, 6),
+                              mean_gap_s=0.0)
+
+    # calibration plane in, named defaults for whatever has no history
+    # yet — a fresh checkout still plans deterministically
+    store = _calibration.get_store()
+    if store is None:
+        store = _calibration.ProfileStore(clock=lambda: 0.0)
+    cal = _calibration.fit_calibration(store, model_sig=spec.model_sig,
+                                       device_kind=kind, defaults=True)
+    plan = plan_deployment(spec, calibration=cal)
+
+    set_random_seed(0)
+    model = GPT(cfg)
+
+    def drive(router):
+        # warmup: compile every prefill bucket on every replica outside
+        # the measured window (the _serve_run convention)
+        for eng in router.engines:
+            for bucket in eng.batcher.prompt_buckets:
+                eng.submit(list(range(1, bucket + 1)), 2)
+            eng.run_until_idle()
+        handles = [router.submit(list(it.prompt), it.max_new_tokens)
+                   for it in trace]
+        ticks = 0
+        while not router.idle and ticks < 10**7:
+            router.step()
+            ticks += 1
+        done = [h for h in handles if h.status == "completed"]
+        tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        return (tokens / max(ticks, 1), tokens, ticks, len(done))
+
+    planned = build_fleet(model, plan, clock=lambda: 0.0,
+                          queue_depth=len(trace) + 8)
+    stock = FleetRouter([ServingEngine(model, clock=lambda: 0.0,
+                                       queue_depth=len(trace) + 8)])
+    p_tpt, p_tokens, p_ticks, p_done = drive(planned)
+    d_tpt, d_tokens, d_ticks, d_done = drive(stock)
+    return _line(
+        "plan_decode_tokens_per_tick", p_tpt, "tokens/tick",
+        p_tpt / d_tpt if d_tpt > 0 else 1.0,
+        plan_sha256=plan.sha256, plan=plan.describe(),
+        calibration_fallbacks=len(cal.fallbacks),
+        planner_ticks=p_ticks, planner_tokens=p_tokens,
+        default_tokens_per_tick=round(d_tpt, 4),
+        default_ticks=d_ticks, default_tokens=d_tokens,
+        requests=len(trace), completed=p_done, default_completed=d_done,
+        baseline_note="vs_baseline = planner/default decode tokens per "
+                      "virtual router tick on the same seeded trace "
+                      "(deterministic: injected zero clocks, greedy "
+                      "sampling) — the acceptance bar is >1.0 on at "
+                      "least one measured axis",
+        device=kind, timing="virtual-ticks", spread=None)
+
+
 CONFIGS = [
     ("resnet", bench_resnet),
     ("ctr", bench_ctr),
@@ -1290,9 +1385,23 @@ def main():
             sys.exit("bench: --mode needs a value (train | serve)")
         mode = args[i + 1]
         del args[i:i + 2]
-    if mode not in ("train", "serve", "ctr"):
+    if mode not in ("train", "serve", "ctr", "plan"):
         sys.exit(f"bench: unknown mode {mode!r}; one of 'train', 'serve', "
-                 f"'ctr'")
+                 f"'ctr', 'plan'")
+    if mode == "plan":
+        if args:
+            sys.exit(f"bench: --mode plan takes no config names, "
+                     f"got {args}")
+        # behind the same rc=3 preflight as every mode: a dead tunnel
+        # must never record a bogus A/B round (or planner baseline)
+        _require_backend_alive()
+        on_tpu, kind, peak = _env()
+        try:
+            bench_plan(on_tpu, kind, peak)
+        except Exception:
+            traceback.print_exc()
+            sys.exit(1)
+        return
     if mode == "ctr":
         embedding = "host"
         if "--embedding" in args:

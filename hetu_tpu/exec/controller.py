@@ -244,8 +244,15 @@ class RuntimeController:
 
     def __init__(self, config: Optional[ControllerConfig] = None, *,
                  registry: Optional[_obs.MetricsRegistry] = None,
-                 history: int = 512):
+                 history: int = 512, planner=None):
         self.config = config if config is not None else ControllerConfig()
+        # unified-deployment replanning (hetu_tpu/plan.PlanApplier): an
+        # attached planner turns remediation into planning — a
+        # quarantine decision re-plans against the surviving fleet, a
+        # sustained-SLO-burn shed engage re-plans the serving tier.
+        # Dry-run flows through: the planner journals the identical
+        # decision and actuates nothing.  None = legacy behavior.
+        self.planner = planner
         # metrics land on the process registry by default; a private one
         # (controller_smoke, tests) keeps hetu_ctrl_* series unpolluted
         self._reg = registry
@@ -351,6 +358,20 @@ class RuntimeController:
                       divergent_step=int(f["step"]))
             if not self.config.dry_run:
                 gang.quarantine(w)
+            if self.planner is not None \
+                    and getattr(gang, "planner", None) is None:
+                # re-plan against the post-eviction world now (a gang
+                # with its OWN attached planner re-plans at the rescale
+                # instead — never both, one decision per trigger).  In
+                # dry run the eviction never happened, so the surviving
+                # world is computed from the shadow-quarantine count:
+                # the decision stream matches an active controller's.
+                survivors = gang.live_world - (len(self._quarantined)
+                                               if self.config.dry_run
+                                               else 0)
+                self.planner.replan_for_gang(
+                    gang, trigger="quarantine",
+                    dry_run=self.config.dry_run, train_world=survivors)
 
     def _maybe_retune(self, step: int, config, lags: dict,
                       actuate) -> None:
@@ -533,6 +554,13 @@ class RuntimeController:
                 engine.batcher.set_shed(
                     "controller shed: sustained SLO burn (shed pressure "
                     f"{pressure:.3f} >= {self.config.shed_on})")
+            if self.planner is not None:
+                # sustained SLO burn: the serving tier is under-planned
+                # — re-plan it (the decision journals now; the plan's
+                # structural axes apply at the next fleet construction)
+                self.planner.replan_for_engine(
+                    engine, trigger="slo_burn",
+                    dry_run=self.config.dry_run)
         elif st["shed_active"] \
                 and st["ok_streak"] >= self.config.sustain_ticks:
             st["shed_active"] = False

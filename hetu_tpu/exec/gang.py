@@ -834,7 +834,8 @@ class ElasticGang:
                  seed: int = 0, save_every: int = 2, keep: int = 4,
                  lease_steps: int = 1,
                  partial: Optional["_partial.PartialReduceConfig"] = None,
-                 goodput=None, numerics=None, controller=None):
+                 goodput=None, numerics=None, controller=None,
+                 planner=None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -888,6 +889,11 @@ class ElasticGang:
         # the post-commit seam is one attribute + one global load and a
         # branch.
         self.controller = controller
+        # unified-deployment replanning (hetu_tpu/plan.PlanApplier): an
+        # attached planner re-plans against the surviving world after
+        # every rescale — eviction becomes *planning*, not just
+        # re-ranking.  None keeps the legacy behavior exactly.
+        self.planner = planner
         self.partial = partial
         self.reducer: Optional[_partial.PartialReducer] = None
         if partial is not None:
@@ -1034,6 +1040,11 @@ class ElasticGang:
             m["rescales"].inc()
             for w in range(self.world_size):
                 m["alive"].labels(worker=str(w)).set(1.0)
+        if self.planner is not None:
+            # re-plan against the survivors (journal: plan_emit +
+            # plan_apply) — deterministic, so a same-seed replay emits
+            # the byte-identical signed plan at the same step
+            self.planner.replan_for_gang(self, trigger="gang_rescale")
 
     # -- controller actuators -----------------------------------------------
 

@@ -90,9 +90,10 @@ class PartialReduceConfig:
     ``min_deadline``/``max_deadline``: the :meth:`clamp` bounds an
     online tuner (``exec.controller``) must stay inside — the operator's
     hard rails around any automated policy.
-    ``deadline_source``: ``"static"`` (configured) or ``"controller"``
-    (auto-tuned); surfaced on every ``partial_step`` journal event so
-    replays distinguish tuned from configured cuts.
+    ``deadline_source``: ``"static"`` (configured), ``"controller"``
+    (auto-tuned), or ``"planner"`` (set by an applied deployment Plan);
+    surfaced on every ``partial_step`` journal event so replays
+    distinguish tuned from configured cuts.
     """
 
     deadline: float = 0.0
@@ -117,10 +118,11 @@ class PartialReduceConfig:
             raise ValueError(
                 f"max_deadline {self.max_deadline} < min_deadline "
                 f"{self.min_deadline}")
-        if self.deadline_source not in ("static", "controller"):
+        if self.deadline_source not in ("static", "controller",
+                                        "planner"):
             raise ValueError(
-                f"deadline_source must be 'static' or 'controller', got "
-                f"{self.deadline_source!r}")
+                f"deadline_source must be 'static', 'controller', or "
+                f"'planner', got {self.deadline_source!r}")
 
     def clamp(self, deadline: float) -> float:
         """Pin a proposed deadline inside ``[min_deadline,

@@ -85,6 +85,7 @@ from hetu_tpu.obs import registry as _registry
 
 __all__ = [
     "STORE_FORMAT", "ENV_STORE", "DEFAULT_THRESHOLDS",
+    "DEFAULT_CONSTANTS",
     "CalibrationKey", "CalibrationStoreError", "ProfileStore",
     "RegressionSentinel", "FittedConstant", "Calibration",
     "fit_calibration", "install_store", "get_store",
@@ -142,6 +143,26 @@ DEFAULT_THRESHOLDS = {
     "kv_pool_bytes": ("high", 1.15),
     "embed_hbm_bytes": ("high", 1.15),
     "hwm_total_bytes": ("high", 1.15),
+}
+
+#: Named defaults for every constant the cost models consume — the
+#: 0.4/0.7 idiom, centralized.  ``fit_calibration(defaults=True)``
+#: fills these for any constant with no record history (journaling
+#: ``calibration_fallback``), so the unified planner runs
+#: uncalibrated-but-deterministic on a fresh checkout.
+DEFAULT_CONSTANTS = {
+    # training cost model (TimeCostModel's historical guesses)
+    "mfu": 0.4,
+    "dp_overlap": 0.7,
+    "mem_error_ratio": 1.0,
+    # serving-throughput model (SLO stage means, per request)
+    "prefill_mean_s": 0.08,
+    "decode_mean_s": 0.02,
+    "queue_mean_s": 0.005,
+    "spec_accept_rate": 0.6,
+    # embedding-traffic model (tier hit-rate ceilings)
+    "embed_hbm_hit_rate": 0.8,
+    "embed_host_hit_rate": 0.95,
 }
 
 
@@ -781,6 +802,10 @@ class Calibration:
 
     constants: tuple = ()           # FittedConstant, sorted by name
     source: str = ""
+    # constants that are named defaults, not fits (no record history
+    # when ``fit_calibration(defaults=...)`` ran) — the
+    # ``calibration_fallback`` diagnosis, carried on the artifact
+    fallbacks: tuple = ()
 
     def get(self, name: str, default=None):
         for c in self.constants:
@@ -839,7 +864,8 @@ def _fit_series(name: str, series: Iterable[float]
 def fit_calibration(store: ProfileStore, *, model_sig: str = "",
                     mesh_sig: str = "", policy: str = "",
                     device_kind: Optional[str] = None,
-                    n_layers: Optional[int] = None) -> Calibration:
+                    n_layers: Optional[int] = None,
+                    defaults=None) -> Calibration:
     """Fit cost-model constants for one key from the store's record
     histories — a pure function of the records (median fit, residuals
     recorded), so identical stores yield bitwise-identical calibrations:
@@ -856,7 +882,21 @@ def fit_calibration(store: ProfileStore, *, model_sig: str = "",
       (predicted / XLA-reported bytes — the correction
       ``plan_memory(calibration=...)`` divides by);
     - ``step_time_s`` from explicit ``step`` records when a driver
-      ingested them.
+      ingested them;
+    - the serving stage means (``prefill_mean_s``/``decode_mean_s``/
+      ``queue_mean_s``) from the SLO ``serve`` records, and the
+      embedding-tier signals (``embed_hbm_hit_rate``/
+      ``embed_host_hit_rate``/``embed_pull_bytes_per_stage``) from the
+      ``embed`` records — the unified planner's serving-throughput and
+      embedding-traffic constants.
+
+    ``defaults`` hardens the empty/single-record path: ``True`` fills
+    any constant in :data:`DEFAULT_CONSTANTS` that has no record
+    history with its named default (``n=0`` marks it unfitted, the
+    name lands in :attr:`Calibration.fallbacks`, and one
+    ``calibration_fallback`` event is journaled); a mapping supplies a
+    custom defaults table.  The planner passes ``defaults=True`` so a
+    fresh checkout plans deterministically instead of raising.
     """
     key = dict(model_sig=model_sig, mesh_sig=mesh_sig, policy=policy,
                device_kind=device_kind)
@@ -897,12 +937,40 @@ def fit_calibration(store: ProfileStore, *, model_sig: str = "",
         [rec["values"]["step_time_s"] for rec in steps
          if rec["values"].get("step_time_s", 0.0) > 0]))
 
+    serve = store.history("serve", **key)
+    for name in ("prefill_mean_s", "decode_mean_s", "queue_mean_s"):
+        consts.append(_fit_series(
+            name, [rec["values"][name] for rec in serve
+                   if rec["values"].get(name, 0.0) > 0]))
+
+    emb = store.history("embed", **key)
+    for src_name, fit_name in (
+            ("hbm_hit_rate", "embed_hbm_hit_rate"),
+            ("host_hit_rate", "embed_host_hit_rate"),
+            ("pull_bytes_per_stage", "embed_pull_bytes_per_stage")):
+        consts.append(_fit_series(
+            fit_name, [rec["values"][src_name] for rec in emb
+                       if src_name in rec["values"]]))
+
     fitted = tuple(sorted((c for c in consts if c is not None),
                           key=lambda c: c.name))
     src = str(CalibrationKey("fit", model_sig, mesh_sig, policy,
                              device_kind if device_kind is not None
                              else _default_device_kind()))
-    return Calibration(fitted, src)
+    fallbacks: tuple = ()
+    if defaults:
+        table = DEFAULT_CONSTANTS if defaults is True else defaults
+        have = {c.name for c in fitted}
+        missing = [name for name in sorted(table) if name not in have]
+        if missing:
+            fitted = tuple(sorted(
+                fitted + tuple(FittedConstant(name, float(table[name]), 0)
+                               for name in missing),
+                key=lambda c: c.name))
+            fallbacks = tuple(missing)
+            _journal.record("calibration_fallback", constants=missing,
+                            key=src)
+    return Calibration(fitted, src, fallbacks)
 
 
 # ------------------------------------------------ process-wide installation

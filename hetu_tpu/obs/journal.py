@@ -91,6 +91,18 @@ kind                fields (beyond ``seq``/``ts``)
 ``role_assign``       ``replica``, ``role`` (``prefill``/``decode``/
                       ``colocated`` — the DisaggRouter's worker-role
                       assignment at construction)
+``plan_emit``         ``sha256``, ``candidates``, ``slo_feasible`` (+
+                      ``mem_pruned``/``trigger``/``cost`` — the unified
+                      planner emitted one signed Plan; the counts are
+                      the considered-frontier summary)
+``plan_apply``        ``sha256``, ``trigger``, ``dry_run`` (+
+                      ``actions`` — a Plan actuated against a live
+                      system; dry-run journals the identical decision
+                      with an empty action list)
+``calibration_fallback``  ``constants``, ``key`` (``fit_calibration``
+                      filled named defaults for constants with no
+                      record history — the planner ran uncalibrated on
+                      those axes)
 ==================  =====================================================
 
 Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
@@ -213,6 +225,11 @@ EVENT_KINDS = {
     # + the controller's memory-pressure remediation loop
     "mem_leak_suspect": frozenset({"component", "drift", "balance"}),
     "memory_pressure": frozenset({"pressure", "component", "action"}),
+    # unified deployment planner (PR 18): one deterministic search,
+    # replans wired into the remediation seams
+    "plan_emit": frozenset({"sha256", "candidates", "slo_feasible"}),
+    "plan_apply": frozenset({"sha256", "trigger", "dry_run"}),
+    "calibration_fallback": frozenset({"constants", "key"}),
 }
 
 

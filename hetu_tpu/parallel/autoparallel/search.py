@@ -13,6 +13,7 @@ useful when the choice space is non-uniform (mixed layer types).
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import random
 from typing import Optional, Sequence
@@ -60,6 +61,27 @@ class Plan:
         return (f"pp={self.pp} micro={self.n_microbatches}{v} {d}{r} "
                 f"time={self.time * 1e3:.2f}ms "
                 f"mem={self.peak_bytes / 1e9:.2f}GB")
+
+    def to_json(self) -> str:
+        """Canonical serialization (sorted keys, canonical separators,
+        rounded floats): byte-identical for identical search inputs —
+        what the determinism regression asserts on."""
+        body = {"pp": self.pp, "n_microbatches": self.n_microbatches,
+                "choices": [str(c) for c in self.choices],
+                "time": round(float(self.time), 12),
+                "peak_bytes": round(float(self.peak_bytes), 3),
+                "feasible": bool(self.feasible),
+                "virtual_stages": self.virtual_stages,
+                "remat_policy": self.remat_policy}
+        return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def _plan_order(plan: Plan) -> tuple:
+    """The winner's total order: time first, then a canonical tuple
+    over every decision axis — so an exact float-time tie resolves
+    identically no matter what order candidates were enumerated in."""
+    return (plan.time, plan.pp, plan.n_microbatches, plan.remat_policy,
+            plan.virtual_stages, tuple(str(c) for c in plan.choices))
 
 
 def _choices_for(devices_per_stage: int) -> list[ParallelChoice]:
@@ -152,6 +174,11 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     """
     if not remat_policies:
         raise ValueError("remat_policies must name at least one policy")
+    # canonicalize the caller-supplied enumeration axes: a shuffled (or
+    # set-typed) microbatch_options / remat_policies argument must yield
+    # a byte-identical plan — candidate order is never a tie-breaker
+    microbatch_options = sorted({int(m) for m in microbatch_options})
+    remat_policies = sorted({str(p) for p in remat_policies})
     mem_model = mem_model or MemoryCostModel(cluster,
                                              calibration=calibration)
     time_model = time_model or TimeCostModel(cluster,
@@ -186,8 +213,13 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                     for li, layer in enumerate(layers):
                         def key(c):
                             bpr = math.ceil(global_batch / c.dp)
-                            return time_model.layer_time(layer, c, bpr,
-                                                         policy)
+                            # total order: an exact time tie resolves to
+                            # the widest dp, then narrowest tp, then
+                            # zero=False (the historical enumeration
+                            # preference, now explicit)
+                            return (time_model.layer_time(layer, c, bpr,
+                                                          policy),
+                                    -c.dp, c.tp, c.zero)
                         fits = [c for c in cands
                                 if mem_model.layer_bytes(
                                     layer, c, math.ceil(global_batch / c.dp),
@@ -202,7 +234,9 @@ def dp_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                     plan = Plan(pp, n_micro, list(choices), t, m,
                                 m <= cluster.hbm_bytes,
                                 remat_policy=policy)
-                    if plan.feasible and (best is None or t < best.time):
+                    if plan.feasible and (
+                            best is None
+                            or _plan_order(plan) < _plan_order(best)):
                         best = plan
         pp *= 2
     if best is None:  # nothing fits: return min-memory plan, flagged
@@ -314,7 +348,11 @@ def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
     time_model = TimeCostModel(cluster)
     best: Optional[Plan] = None
     best_bounds: list[int] = [len(layers)]
-    v_options = (virtual_stage_options if schedule == "pipedream" else (1,))
+    # same canonicalization as dp_search: caller-supplied enumeration
+    # order must never decide a tie
+    microbatch_options = sorted({int(m) for m in microbatch_options})
+    v_options = (sorted({int(v) for v in virtual_stage_options})
+                 if schedule == "pipedream" else (1,))
     if any(v < 1 for v in v_options):
         # the runtime rejects V < 1 too (pipedream._run_1f1b); V=0 would
         # divide by zero and V<0 would win the search with negative time
@@ -370,8 +408,9 @@ def _pipeline_search(layers: Sequence[LayerSpec], cluster: ClusterSpec,
                     plan = Plan(pp, n_micro, [c] * len(layers), t_total,
                                 max(mems), max(mems) <= cluster.hbm_bytes,
                                 virtual_stages=V)
-                    if plan.feasible and (best is None
-                                          or plan.time < best.time):
+                    if plan.feasible and (
+                            best is None
+                            or _plan_order(plan) < _plan_order(best)):
                         best, best_bounds = plan, bounds
         pp *= 2
     if best is None:

@@ -1,0 +1,43 @@
+"""Unified deployment planner (ROADMAP item 1's composition layer).
+
+One deterministic search over parallelism × memory × serving ×
+embedding, fed by calibration: a frozen :class:`DeploymentSpec` in, one
+signed, versioned :class:`Plan` out.
+
+- :mod:`~hetu_tpu.plan.spec` — the frozen inputs and the signed Plan
+  (canonical envelope: CRC32 + sha256, byte-identical from identical
+  inputs);
+- :mod:`~hetu_tpu.plan.cost` — one ``CostModel`` interface adapting
+  the autoparallel time/memory models plus serving-throughput and
+  embedding-traffic models, every constant from ``fit_calibration``
+  with named defaults when uncalibrated;
+- :mod:`~hetu_tpu.plan.search` — the staged deterministic search
+  (memory prune, then lexicographic (SLO-feasible, cost) with
+  total-order tie-breaks), journaling ``plan_emit``;
+- :mod:`~hetu_tpu.plan.apply` — Plan-bearing engine/fleet construction
+  and the replan hooks the gang and the runtime controller fire
+  (``plan_apply`` journaled, dry-run decides identically and actuates
+  nothing).
+
+Determinism bar: nothing in this package reads a clock or entropy, and
+every dict iteration is explicitly sorted (the plan-determinism lint in
+``tests/test_obs.py`` enforces all three), so a Plan is a pure function
+of (spec, calibration).
+"""
+
+from hetu_tpu.plan.apply import (PlanApplier, apply_plan, build_fleet,
+                                 engine_kwargs)
+from hetu_tpu.plan.cost import (CostModel, EmbeddingCostModel,
+                                ServingCostModel, TrainCostModel,
+                                UnifiedCostModel)
+from hetu_tpu.plan.search import DeploymentPlanner, plan_deployment
+from hetu_tpu.plan.spec import (PLAN_FORMAT, DeploymentSpec, Plan,
+                                PlanError)
+
+__all__ = [
+    "PLAN_FORMAT", "DeploymentSpec", "Plan", "PlanError",
+    "CostModel", "TrainCostModel", "ServingCostModel",
+    "EmbeddingCostModel", "UnifiedCostModel",
+    "plan_deployment", "DeploymentPlanner",
+    "engine_kwargs", "build_fleet", "apply_plan", "PlanApplier",
+]

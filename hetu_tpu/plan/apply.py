@@ -1,0 +1,151 @@
+"""Plan actuation: engines built FROM a Plan, replans wired INTO the
+runtime's remediation seams.
+
+Construction side: :func:`engine_kwargs` maps a Plan's serving axes
+onto ``ServingEngine`` keyword arguments (``ServingEngine(model,
+plan=plan)`` does this internally); :func:`build_fleet` constructs the
+whole replica set — colocated behind a ``FleetRouter``, or the plan's
+prefill/decode role split behind a ``DisaggRouter``.
+
+Runtime side: :class:`PlanApplier` is the replan hook the
+``ElasticGang`` (``planner=`` kwarg, fired from ``_rescale`` against
+the surviving world) and the ``RuntimeController`` (fired on a
+quarantine decision and on a sustained-SLO-burn shed engage) call into.
+Every replan journals ``plan_apply`` naming the trigger; in dry-run the
+decision — the emitted plan and its sha — is identical, and nothing is
+actuated (the controller discipline).
+"""
+
+from __future__ import annotations
+
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.plan.search import DeploymentPlanner, _plan_m
+from hetu_tpu.plan.spec import Plan
+
+__all__ = ["engine_kwargs", "build_fleet", "apply_plan", "PlanApplier"]
+
+
+def engine_kwargs(plan: Plan, *, role: str = None) -> dict:
+    """The ``ServingEngine`` keyword arguments a Plan's serving axes
+    pin.  Zero-valued axes are omitted (the engine's own defaults
+    apply), so a partial plan composes with explicit caller kwargs."""
+    kw = {"num_slots": plan.slots_per_replica,
+          "page_size": plan.page_size}
+    if plan.bucket_ladder:
+        kw["prompt_buckets"] = plan.bucket_ladder
+    if plan.kv_pool_pages > 0:
+        kw["num_pages"] = plan.kv_pool_pages
+    if plan.spec_k > 0:
+        kw["spec_k"] = plan.spec_k
+    if role is not None:
+        kw["role"] = role
+    return kw
+
+
+def _roles(plan: Plan) -> list:
+    if plan.prefill_workers or plan.decode_workers:
+        return ["prefill"] * plan.prefill_workers \
+            + ["decode"] * plan.decode_workers
+    return ["colocated"] * plan.replicas
+
+
+def build_fleet(model, plan: Plan, *, max_retries: int = None,
+                **extra_kwargs):
+    """Construct the plan's whole serving tier: ``plan.replicas``
+    engines with the plan's ladder/pool/slots (role split -> a
+    ``DisaggRouter``, colocated -> a ``FleetRouter``).  ``extra_kwargs``
+    (clock, slo_targets, draft_model, tenants, ...) pass through to
+    every engine."""
+    from hetu_tpu.serve.engine import ServingEngine
+    if plan.replicas < 1:
+        raise ValueError("plan deploys no serving tier "
+                         "(replicas=0) — nothing to build")
+    roles = _roles(plan)
+    disagg = any(r != "colocated" for r in roles)
+    engines = [ServingEngine(model, plan=plan, role=role, **extra_kwargs)
+               for role in roles]
+    if disagg:
+        from hetu_tpu.serve.fleet.disagg import DisaggRouter
+        return DisaggRouter(engines, max_retries=max_retries)
+    from hetu_tpu.serve.fleet.router import FleetRouter
+    return FleetRouter(engines, max_retries=max_retries)
+
+
+def apply_plan(plan: Plan, *, gang=None, dry_run: bool = False,
+               trigger: str = "apply") -> list:
+    """Actuate a Plan against a live system and journal ``plan_apply``.
+
+    Actuations are the runtime-safe knobs only (today: the gang's
+    partial-reduce deadline); structural axes — mesh shape, replica
+    count, pool geometry — take effect at the next construction from
+    the plan.  Dry-run journals the identical decision and actuates
+    nothing.  Returns the list of actions actuated (empty in
+    dry-run)."""
+    actions = []
+    if gang is not None and plan.partial_deadline_s > 0 \
+            and getattr(gang, "partial", None) is not None:
+        if not dry_run:
+            gang.set_partial_deadline(plan.partial_deadline_s,
+                                      source="planner")
+        actions.append("partial_deadline")
+    _journal.record("plan_apply", sha256=plan.sha256, trigger=trigger,
+                    dry_run=bool(dry_run),
+                    actions=sorted(actions) if not dry_run else [])
+    if _obs.enabled():
+        _plan_m()["applies"].labels(trigger=trigger).inc()
+    return actions if not dry_run else []
+
+
+class PlanApplier:
+    """The remediation-seam hook: owns a :class:`DeploymentPlanner`
+    and re-plans against the surviving fleet when the runtime asks.
+
+    Wire it as ``ElasticGang(..., planner=applier)`` (fires on every
+    rescale with the survivors' world) and/or
+    ``RuntimeController(..., planner=applier)`` (fires on a quarantine
+    decision and on a sustained-SLO-burn shed engage).  The decision
+    path is identical under ``dry_run`` — same spec adjustment, same
+    emitted plan, same journaled sha — but nothing actuates.
+    """
+
+    def __init__(self, planner: DeploymentPlanner, *,
+                 dry_run: bool = False):
+        self.planner = planner
+        self.dry_run = bool(dry_run)
+
+    @property
+    def current(self):
+        return self.planner.current
+
+    def _dry(self, dry_run) -> bool:
+        return self.dry_run if dry_run is None else bool(dry_run)
+
+    def replan_for_gang(self, gang, *, trigger: str = "gang_rescale",
+                        dry_run: bool = None,
+                        train_world: int = None) -> Plan:
+        """Re-plan against the gang's surviving world (the serving
+        carve-out is unchanged — an evicted trainer is not a lost
+        serving device) and actuate the gang-side knobs.
+        ``train_world`` overrides the observed ``gang.live_world`` (the
+        dry-run controller passes its shadow-eviction count so the
+        decision stream matches an active controller's)."""
+        spec = self.planner.spec
+        world = int(gang.live_world if train_world is None
+                    else train_world)
+        plan = self.planner.replan(
+            n_devices=world + spec.serve_devices, trigger=trigger)
+        apply_plan(plan, gang=gang, dry_run=self._dry(dry_run),
+                   trigger=trigger)
+        return plan
+
+    def replan_for_engine(self, engine, *, trigger: str = "slo_burn",
+                          dry_run: bool = None) -> Plan:
+        """Re-plan under serving distress.  The decision is journaled
+        immediately; the structural serving axes (replicas, ladder,
+        pool) take effect at the next :func:`build_fleet` from
+        ``applier.current`` — a live engine's geometry cannot be
+        re-shaped under traffic."""
+        plan = self.planner.replan(trigger=trigger)
+        apply_plan(plan, dry_run=self._dry(dry_run), trigger=trigger)
+        return plan

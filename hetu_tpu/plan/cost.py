@@ -1,0 +1,254 @@
+"""One cost-model interface over training, serving, and embedding.
+
+Adapters around the substrates the earlier PRs built: the training
+model wraps :class:`~hetu_tpu.parallel.autoparallel.cost_model.
+TimeCostModel` / ``MemoryCostModel`` (Galvatron-style per-layer
+arithmetic), the serving-throughput model consumes the SLO stage
+decomposition the ProfileStore's ``serve`` records carry, and the
+embedding-traffic model consumes the ``embed`` records'
+hit-rate/pull-bytes signals.  Every constant is drawn from
+:func:`~hetu_tpu.obs.calibration.fit_calibration` with the named
+defaults in :data:`~hetu_tpu.obs.calibration.DEFAULT_CONSTANTS` when
+uncalibrated (the 0.4/0.7 idiom) — a fresh checkout plans
+deterministically, a calibrated store plans from measurements.
+
+Predictions are plain dicts of named floats; :class:`UnifiedCostModel`
+merges the three adapters and reduces them to the (slo_feasible, cost)
+pair the lexicographic search ranks on.
+"""
+
+from __future__ import annotations
+
+import math
+
+from hetu_tpu.obs.calibration import DEFAULT_CONSTANTS
+from hetu_tpu.parallel.autoparallel.cost_model import (
+    ClusterSpec, MemoryCostModel, ParallelChoice, TimeCostModel,
+    transformer_layer_spec)
+
+__all__ = [
+    "CostModel", "TrainCostModel", "ServingCostModel",
+    "EmbeddingCostModel", "UnifiedCostModel", "constant", "ladder_bucket",
+]
+
+
+def constant(calibration, name: str) -> float:
+    """One cost-model constant: the calibrated fit when available, the
+    named default otherwise (every name must be in DEFAULT_CONSTANTS —
+    an unnamed constant has no uncalibrated behavior and is a bug)."""
+    if calibration is not None:
+        v = calibration.get(name)
+        if v is not None:
+            return float(v)
+    return float(DEFAULT_CONSTANTS[name])
+
+
+def ladder_bucket(ladder, prompt_len: int) -> int:
+    """The bucket a prompt pads to: smallest rung >= the prompt, else
+    the top rung (the ContinuousBatcher's clipping rule)."""
+    rungs = sorted(int(b) for b in ladder)
+    if not rungs:
+        return int(prompt_len)
+    for b in rungs:
+        if b >= prompt_len:
+            return b
+    return rungs[-1]
+
+
+class CostModel:
+    """Interface: ``predict(spec, plan) -> {name: float}``.  Adapters
+    return {} when their axis is not deployed, so the unified model's
+    merge covers train-only, serve-only, and hybrid plans."""
+
+    def predict(self, spec, plan) -> dict:
+        raise NotImplementedError
+
+
+class TrainCostModel(CostModel):
+    """Training step time + per-device peak bytes for the plan's mesh,
+    via the autoparallel cost models (calibrated mfu/dp_overlap/
+    activation_scale when fitted)."""
+
+    def __init__(self, calibration=None):
+        self.calibration = calibration
+
+    def predict(self, spec, plan) -> dict:
+        if plan.gang_size < 1 or spec.train_devices < 1:
+            return {}
+        cluster = ClusterSpec(n_devices=plan.gang_size,
+                              hbm_bytes=spec.hbm_bytes,
+                              peak_flops=spec.peak_flops)
+        tm = TimeCostModel(cluster, calibration=self.calibration)
+        mm = MemoryCostModel(cluster, calibration=self.calibration)
+        layer = transformer_layer_spec(spec.hidden_size, spec.seq_len,
+                                       spec.mlp_ratio)
+        choice = ParallelChoice(dp=plan.dp, tp=plan.tp, zero=plan.zero)
+        batch_per_replica = max(1, spec.global_batch // max(plan.dp, 1))
+        layers_per_stage = math.ceil(spec.n_layers / max(plan.pp, 1))
+        micro = max(plan.microbatch, 1)
+        t_layer = tm.layer_time(layer, choice, batch_per_replica,
+                                plan.remat_policy)
+        stage_t = t_layer * layers_per_stage
+        if plan.pp > 1:
+            # pipeline fill/drain bubble over the microbatch train
+            step = stage_t / micro * (micro + plan.pp - 1)
+        else:
+            step = stage_t
+        peak = mm.layer_bytes(layer, choice, batch_per_replica, micro,
+                              plan.remat_policy) * layers_per_stage
+        return {
+            "step_time_s": round(step, 12),
+            "train_peak_bytes": round(peak, 3),
+        }
+
+
+class ServingCostModel(CostModel):
+    """Fleet throughput and tail latency from the SLO calibration: the
+    per-stage means the ``serve`` records fit (``prefill_mean_s``,
+    ``decode_mean_s``, ``queue_mean_s``) plus the speculative
+    acceptance rate, applied to the plan's replica/role-split/ladder/
+    pool/spec_k axes."""
+
+    def __init__(self, calibration=None):
+        self.calibration = calibration
+
+    def predict(self, spec, plan) -> dict:
+        if plan.replicas < 1:
+            return {}
+        cal = self.calibration
+        prefill_s = constant(cal, "prefill_mean_s")
+        decode_s = constant(cal, "decode_mean_s")
+        queue_s = constant(cal, "queue_mean_s")
+        accept = constant(cal, "spec_accept_rate")
+        slots = max(plan.slots_per_replica, 1)
+        # per-token decode latency: the calibrated per-request decode
+        # mean spread over the workload's mean generation length
+        tok_s = decode_s / max(spec.decode_len, 1)
+        speedup = 1.0 + plan.spec_k * accept if plan.spec_k > 0 else 1.0
+        decode_engines = plan.decode_workers or plan.replicas
+        prefill_engines = plan.prefill_workers or plan.replicas
+        decode_tps = decode_engines * slots * speedup / tok_s
+        # prompt padding the ladder costs at the tail
+        bucket = ladder_bucket(plan.bucket_ladder, spec.prompt_p99)
+        pad = bucket / max(spec.prompt_p99, 1)
+        prefill_rps = prefill_engines * slots / max(prefill_s * pad, 1e-12)
+        util = (spec.requests_per_s / prefill_rps
+                if prefill_rps > 0 else 0.0)
+        ttft = queue_s + prefill_s * pad
+        if util >= 1.0:
+            # offered load exceeds prefill capacity: the queue diverges
+            ttft = float(spec.ttft_p99_s) + 1e9
+        # KV pool sufficiency: every slot must hold its padded prompt
+        # plus the full generation without stealing pages
+        seq_tokens = min(spec.seq_len, bucket + spec.decode_len)
+        need_pages = slots * math.ceil(
+            seq_tokens / max(plan.page_size, 1)) + 1
+        pool_pages = plan.kv_pool_pages if plan.kv_pool_pages > 0 \
+            else need_pages
+        kv_token_bytes = 4.0 * spec.n_layers * spec.hidden_size  # K+V bf16
+        return {
+            "decode_tps": round(decode_tps, 6),
+            "ttft_p99_s": round(ttft, 12),
+            "serve_util": round(util, 12),
+            "serve_pool_ok": 1.0 if pool_pages >= need_pages else 0.0,
+            "serve_kv_bytes": round(
+                pool_pages * plan.page_size * kv_token_bytes, 3),
+        }
+
+
+class EmbeddingCostModel(CostModel):
+    """Host-pull traffic and HBM residency for the plan's tiered-
+    embedding axes, from the ``embed`` calibration records (hit-rate
+    ceiling, pull bytes): the HBM hot-row budget buys hit rate up to
+    the measured ceiling; misses pull f32 rows from the host tier."""
+
+    def __init__(self, calibration=None):
+        self.calibration = calibration
+
+    def predict(self, spec, plan) -> dict:
+        if spec.embed_rows < 1 or spec.embed_dim < 1:
+            return {}
+        cal = self.calibration
+        hit_ceiling = constant(cal, "embed_hbm_hit_rate")
+        hot_rows = max(spec.embed_hot_fraction * spec.embed_rows, 1.0)
+        coverage = min(1.0, plan.embed_hbm_rows / hot_rows)
+        hbm_hit = hit_ceiling * coverage
+        row_bytes = spec.embed_dim * (1.0 if plan.embed_storage == "int8"
+                                      else 4.0)
+        lookups = float(spec.global_batch)
+        pull = (1.0 - hbm_hit) * lookups * spec.embed_dim * 4.0
+        return {
+            "embed_hbm_hit_rate": round(hbm_hit, 12),
+            "embed_hbm_bytes": round(plan.embed_hbm_rows * row_bytes, 3),
+            "embed_pull_bytes_per_stage": round(pull, 3),
+        }
+
+
+class UnifiedCostModel(CostModel):
+    """The composition the search ranks on: merge the three adapters'
+    predictions, then reduce to memory feasibility, SLO feasibility,
+    and one scalar cost (lower is better).  All pure float arithmetic
+    on (spec, plan, calibration) — bitwise-replayable."""
+
+    def __init__(self, calibration=None):
+        self.calibration = calibration
+        self.models = (TrainCostModel(calibration),
+                       ServingCostModel(calibration),
+                       EmbeddingCostModel(calibration))
+
+    def predict(self, spec, plan) -> dict:
+        out: dict = {}
+        for m in self.models:
+            out.update(m.predict(spec, plan))
+        return out
+
+    # -- feasibility -------------------------------------------------------
+
+    def serve_device_bytes(self, spec, plan, pred) -> float:
+        """Per-serving-device HBM demand: inference weights (bf16) +
+        the KV pool + the embedding hot tier."""
+        layer = transformer_layer_spec(spec.hidden_size, spec.seq_len,
+                                       spec.mlp_ratio)
+        params = layer.params * spec.n_layers \
+            + spec.vocab_size * spec.hidden_size
+        return (2.0 * params + pred.get("serve_kv_bytes", 0.0)
+                + pred.get("embed_hbm_bytes", 0.0))
+
+    def memory_feasible(self, spec, plan, pred) -> bool:
+        if pred.get("train_peak_bytes", 0.0) > spec.hbm_bytes:
+            return False
+        if plan.replicas > 0:
+            if pred.get("serve_pool_ok", 1.0) < 1.0:
+                return False
+            if self.serve_device_bytes(spec, plan, pred) > spec.hbm_bytes:
+                return False
+        return True
+
+    def slo_feasible(self, spec, plan, pred) -> bool:
+        if plan.replicas > 0:
+            if pred.get("ttft_p99_s", 0.0) > spec.ttft_p99_s:
+                return False
+            if spec.decode_tps > 0 \
+                    and pred.get("decode_tps", 0.0) < spec.decode_tps:
+                return False
+            if pred.get("serve_util", 0.0) >= 1.0:
+                return False
+        return self.memory_feasible(spec, plan, pred)
+
+    def cost(self, spec, plan, pred) -> float:
+        """The scalar the lexicographic search minimizes among
+        SLO-feasible candidates: training step time + per-request
+        serving latency inflated by utilization + embedding pull
+        traffic at host-link seconds."""
+        total = pred.get("step_time_s", 0.0)
+        if plan.replicas > 0:
+            tok_s = constant(self.calibration, "decode_mean_s") \
+                / max(spec.decode_len, 1)
+            speedup = (1.0 + plan.spec_k
+                       * constant(self.calibration, "spec_accept_rate")
+                       if plan.spec_k > 0 else 1.0)
+            request_s = pred.get("ttft_p99_s", 0.0) \
+                + spec.decode_len * tok_s / speedup
+            total += request_s * (1.0 + pred.get("serve_util", 0.0))
+        total += pred.get("embed_pull_bytes_per_stage", 0.0) * 1e-9
+        return round(total, 12)

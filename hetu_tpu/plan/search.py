@@ -1,0 +1,280 @@
+"""The deterministic staged search: one DeploymentSpec in, one Plan out.
+
+Stages (each pure, each totally ordered):
+
+1. **Parallelism** — ``dp_search`` over the training carve-out of the
+   fleet (Galvatron-style per-layer DP, already calibration-aware)
+   picks mesh/pipeline/remat/microbatch; an optional ``memory_probe``
+   (loss_fn, model_builder, batch_builder) refines remat + microbatch
+   through :func:`~hetu_tpu.mem.planner.plan_memory` against the real
+   traced peak.
+2. **Serving × embedding enumeration** — a canonical, sorted candidate
+   grid over replicas, prefill/decode role split, bucket ladder, KV
+   pool pages, ``spec_k``, and the embedding hot-tier axes.
+3. **Prune + rank** — memory-infeasible candidates drop first, then
+   lexicographic (SLO-feasible, predicted cost) with the candidate's
+   own canonical tuple as the total-order tie-break, so equal-cost
+   frontiers resolve identically on every run.
+
+Exactly one Plan comes out; the decision is journaled as ``plan_emit``
+with the considered-frontier summary (candidates scored, memory-pruned,
+SLO-feasible count) and counted on the ``hetu_plan_*`` families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+
+from hetu_tpu.mem.policy import policy_names
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.parallel.autoparallel.cost_model import (
+    ClusterSpec, transformer_layer_spec)
+from hetu_tpu.parallel.autoparallel.search import dp_search
+from hetu_tpu.plan.cost import UnifiedCostModel, ladder_bucket
+from hetu_tpu.plan.spec import DeploymentSpec, Plan
+
+__all__ = ["plan_deployment", "DeploymentPlanner"]
+
+_plan_metrics = None
+
+
+def _plan_m() -> dict:
+    global _plan_metrics
+    if _plan_metrics is None:
+        reg = _obs.get_registry()
+        _plan_metrics = {
+            "emitted": reg.counter(
+                "hetu_plan_emitted_total",
+                "deployment plans emitted by the unified planner, by "
+                "trigger (initial, gang_rescale, quarantine, slo_burn)",
+                ("trigger",)),
+            "candidates": reg.gauge(
+                "hetu_plan_candidates",
+                "candidate configurations scored by the last "
+                "unified-planner search"),
+            "slo_feasible": reg.gauge(
+                "hetu_plan_slo_feasible",
+                "1 when the last emitted plan predicts the spec's SLO "
+                "targets are met, else 0"),
+            "applies": reg.counter(
+                "hetu_plan_applies_total",
+                "plans applied to a running system, by trigger",
+                ("trigger",)),
+        }
+    return _plan_metrics
+
+
+def _calibration_sha(calibration) -> str:
+    if calibration is None:
+        return ""
+    return hashlib.sha256(calibration.to_json().encode()).hexdigest()
+
+
+# --------------------------------------------------------- stage 1: mesh
+
+def _train_axes(spec: DeploymentSpec, calibration, memory_probe) -> dict:
+    """Parallelism via the autoparallel DP; returns the Plan's training
+    fields.  No training carve-out -> no gang, defaults throughout."""
+    out = dict(dp=1, tp=1, pp=1, schedule="none", virtual_stages=1,
+               remat_policy="none", microbatch=1, zero=False,
+               gang_size=0, partial_deadline_s=0.0, train_feasible=True)
+    if spec.train_devices < 1:
+        return out
+    cluster = ClusterSpec(n_devices=spec.train_devices,
+                          hbm_bytes=spec.hbm_bytes,
+                          peak_flops=spec.peak_flops)
+    layer = transformer_layer_spec(spec.hidden_size, spec.seq_len,
+                                   spec.mlp_ratio)
+    ap = dp_search([layer] * spec.n_layers, cluster, spec.global_batch,
+                   remat_policies=policy_names(), calibration=calibration)
+    choice = ap.dominant
+    out.update(dp=choice.dp, tp=choice.tp, pp=ap.pp,
+               schedule="1f1b" if ap.pp > 1 else "none",
+               virtual_stages=ap.virtual_stages,
+               remat_policy=ap.remat_policy, microbatch=ap.n_microbatches,
+               zero=choice.zero, gang_size=spec.train_devices,
+               partial_deadline_s=spec.partial_deadline_s,
+               train_feasible=ap.feasible)
+    if memory_probe is not None:
+        # refine against the TRACED peak (plan_memory divides by the
+        # calibrated estimator-error ratio), not just the closed form
+        from hetu_tpu.mem.planner import plan_memory
+        loss_fn, model_builder, batch_builder = memory_probe
+        mp = plan_memory(loss_fn, model_builder, batch_builder,
+                         spec.hbm_bytes, policies=policy_names(),
+                         microbatch_options=(1, 2, 4, 8),
+                         calibration=calibration)
+        out.update(remat_policy=mp.policy, microbatch=mp.microbatch,
+                   train_feasible=out["train_feasible"] and mp.fits)
+    return out
+
+
+# -------------------------------------------- stage 2: candidate grids
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+def _ladder_candidates(spec: DeploymentSpec) -> list:
+    """Canonical bucket-ladder grid from the workload's prompt stats:
+    a dense power-of-two ladder, a two-rung p50/p99 ladder, and a
+    single-bucket ladder — all clipped to the model's context."""
+    p50 = min(_pow2_at_least(max(spec.prompt_p50, 8)), spec.seq_len)
+    p99 = min(_pow2_at_least(max(spec.prompt_p99, p50)), spec.seq_len)
+    dense, b = [], 8
+    while b < p99:
+        dense.append(b)
+        b *= 2
+    dense.append(p99)
+    cands = {tuple(dense), (p50, p99) if p50 < p99 else (p99,), (p99,)}
+    return sorted(cands)
+
+
+def _pool_page_candidates(spec: DeploymentSpec, ladder: tuple) -> list:
+    """KV pool sizes: exactly-sufficient for the padded tail, and a
+    1.5x headroom variant (plus 0 = the engine's own default sizing)."""
+    bucket = ladder_bucket(ladder, spec.prompt_p99)
+    seq_tokens = min(spec.seq_len, bucket + spec.decode_len)
+    need = spec.slots_per_replica * math.ceil(
+        seq_tokens / spec.page_size) + 1
+    return sorted({0, need, math.ceil(need * 1.5)})
+
+
+def _serve_candidates(spec: DeploymentSpec) -> list:
+    """The sorted serving grid: (replicas, prefill, decode, ladder,
+    pool_pages, spec_k) tuples; one all-zero row when no devices are
+    carved out for serving."""
+    if spec.serve_devices < 1:
+        return [(0, 0, 0, (), 0, 0)]
+    out = []
+    spec_ks = (0, 2, 4) if spec.speculative else (0,)
+    for r in range(1, spec.serve_devices + 1):
+        splits = [(0, 0)]
+        if r >= 2:
+            splits += [(p, r - p) for p in range(1, r)]
+        for ladder in _ladder_candidates(spec):
+            for pages in _pool_page_candidates(spec, ladder):
+                for (p, d) in sorted(splits):
+                    for k in spec_ks:
+                        out.append((r, p, d, ladder, pages, k))
+    return sorted(out)
+
+
+def _embed_candidates(spec: DeploymentSpec) -> list:
+    """The sorted embedding grid: (hbm_rows, host_rows, storage,
+    promote_touches, demote_idle); one all-off row when the workload
+    has no embedding tables."""
+    if spec.embed_rows < 1 or spec.embed_dim < 1:
+        return [(0, 0, "f32", 2, 0)]
+    hot = max(int(math.ceil(spec.embed_hot_fraction * spec.embed_rows)),
+              1)
+    out = []
+    for rows in sorted({max(hot // 2, 1), hot}):
+        for storage in ("f32", "int8"):
+            for touches in (1, 2):
+                out.append((rows, min(4 * rows, spec.embed_rows),
+                            storage, touches, 0))
+    return sorted(out)
+
+
+# ------------------------------------------------- stage 3: prune + rank
+
+def plan_deployment(spec: DeploymentSpec, *, calibration=None,
+                    memory_probe=None, trigger: str = "initial") -> Plan:
+    """Emit exactly one signed Plan for ``spec`` — a pure function of
+    (spec, calibration): byte-identical ``Plan.to_json()`` from
+    identical inputs.  Journals ``plan_emit`` with the frontier
+    summary."""
+    train = _train_axes(spec, calibration, memory_probe)
+    train_feasible = train.pop("train_feasible")
+    model = UnifiedCostModel(calibration)
+
+    best = None
+    n_cands = n_mem_pruned = n_slo = 0
+    for cand in _serve_candidates(spec):
+        (r, p, d, ladder, pages, k) = cand
+        for emb in _embed_candidates(spec):
+            (rows, host_rows, storage, touches, idle) = emb
+            n_cands += 1
+            plan = Plan(
+                replicas=r, prefill_workers=p, decode_workers=d,
+                slots_per_replica=spec.slots_per_replica,
+                bucket_ladder=ladder, kv_pool_pages=pages,
+                page_size=spec.page_size, spec_k=k,
+                embed_hbm_rows=rows, embed_host_rows=host_rows,
+                embed_storage=storage, promote_touches=touches,
+                demote_idle=idle, **train)
+            pred = model.predict(spec, plan)
+            if not model.memory_feasible(spec, plan, pred):
+                n_mem_pruned += 1
+                continue
+            slo_ok = model.slo_feasible(spec, plan, pred)
+            n_slo += slo_ok
+            # lexicographic (SLO-feasible, cost) with the candidate's
+            # canonical tuple as the deterministic total-order tie-break
+            key = (not slo_ok, model.cost(spec, plan, pred), cand, emb)
+            if best is None or key < best[0]:
+                best = (key, plan, pred, slo_ok)
+    if best is None:
+        # every candidate was memory-infeasible: surface the bare-axes
+        # plan rather than nothing, marked infeasible
+        plan = Plan(slots_per_replica=spec.slots_per_replica,
+                    page_size=spec.page_size, **train)
+        pred, slo_ok = model.predict(spec, plan), False
+    else:
+        (_, plan, pred, slo_ok) = best
+    plan = dataclasses.replace(
+        plan,
+        spec_sha256=spec.signature(),
+        calibration_sha256=_calibration_sha(calibration),
+        predicted=tuple(sorted(pred.items())),
+        feasible=bool(train_feasible and best is not None))
+    _journal.record("plan_emit", sha256=plan.sha256, candidates=n_cands,
+                    slo_feasible=int(n_slo), mem_pruned=n_mem_pruned,
+                    trigger=trigger,
+                    cost=(best[0][1] if best is not None else -1.0))
+    if _obs.enabled():
+        m = _plan_m()
+        m["emitted"].labels(trigger=trigger).inc()
+        m["candidates"].set(float(n_cands))
+        m["slo_feasible"].set(1.0 if slo_ok else 0.0)
+    return plan
+
+
+class DeploymentPlanner:
+    """The stateful wrapper the runtime hooks call: holds (spec,
+    calibration, probe), tracks the current Plan, and re-plans against
+    a surviving fleet on demand (quarantine, rescale, SLO burn)."""
+
+    def __init__(self, spec: DeploymentSpec, *, calibration=None,
+                 memory_probe=None):
+        self.spec = spec
+        self.calibration = calibration
+        self.memory_probe = memory_probe
+        self.current = None
+
+    def plan(self, trigger: str = "initial") -> Plan:
+        self.current = plan_deployment(
+            self.spec, calibration=self.calibration,
+            memory_probe=self.memory_probe, trigger=trigger)
+        return self.current
+
+    def replan(self, *, n_devices: int = None, serve_devices: int = None,
+               trigger: str = "replan") -> Plan:
+        """Re-plan against a changed fleet shape (the surviving world
+        after an eviction, a shrunk serving carve-out under SLO burn).
+        The adjusted spec becomes the planner's new baseline, so
+        successive shrinks compound."""
+        changes = {}
+        if n_devices is not None:
+            changes["n_devices"] = int(n_devices)
+            changes["serve_devices"] = min(
+                self.spec.serve_devices, int(n_devices))
+        if serve_devices is not None:
+            changes["serve_devices"] = int(serve_devices)
+        if changes:
+            self.spec = dataclasses.replace(self.spec, **changes)
+        return self.plan(trigger=trigger)

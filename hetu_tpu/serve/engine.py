@@ -227,10 +227,11 @@ class ServingEngine:
     """Continuous-batching inference over one GPT (and optionally one CTR
     model sharing the process' HET stores)."""
 
-    def __init__(self, model, *, num_slots: int = 8, page_size: int = 16,
+    def __init__(self, model, *, num_slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  num_pages: Optional[int] = None, queue_depth: int = 64,
-                 prompt_buckets=(8, 16, 32, 64, 128),
+                 prompt_buckets=None,
                  sampling: str = "greedy", top_k: int = 5,
                  temperature: float = 1.0, eos_id: Optional[int] = None,
                  seed: int = 0, clock=time.monotonic,
@@ -243,10 +244,33 @@ class ServingEngine:
                  draft_model=None, spec_k: Optional[int] = None,
                  role: Optional[str] = None,
                  prefill_tick_cost: Optional[float] = None,
-                 ctr_follower=None, tenants: Optional[TenantPolicy] = None):
+                 ctr_follower=None, tenants: Optional[TenantPolicy] = None,
+                 plan=None):
         cfg = model.config
         self.model = model
         self.eos_id = eos_id
+        # Plan-bearing construction (hetu_tpu/plan): the plan's serving
+        # axes fill every knob the caller left unset — explicit kwargs
+        # always win, so a plan composes with manual overrides.  spec_k
+        # applies only when a draft model exists to speculate with.
+        self.plan = plan
+        if plan is not None:
+            if num_slots is None:
+                num_slots = plan.slots_per_replica
+            if page_size is None and plan.page_size > 0:
+                page_size = plan.page_size
+            if prompt_buckets is None and plan.bucket_ladder:
+                prompt_buckets = plan.bucket_ladder
+            if num_pages is None and plan.kv_pool_pages > 0:
+                num_pages = plan.kv_pool_pages
+            if spec_k is None and plan.spec_k > 0 \
+                    and draft_model is not None:
+                spec_k = plan.spec_k
+        # the historical defaults, applied after the plan merge
+        num_slots = 8 if num_slots is None else int(num_slots)
+        page_size = 16 if page_size is None else int(page_size)
+        if prompt_buckets is None:
+            prompt_buckets = (8, 16, 32, 64, 128)
         # disaggregated serving (serve/fleet/disagg.py): the worker ROLE.
         # "colocated" (default) timeslices prefill and decode on this
         # engine; "prefill" hands every freshly prefilled request's KV

@@ -144,6 +144,15 @@ def pytest_configure(config):
                    "stay in tier-1 — the seeded flood acceptance rides "
                    "the slow tier")
     config.addinivalue_line(
+        "markers", "plan: unified deployment planner tests (plan.spec "
+                   "signed Plan envelope, plan.cost calibrated unified "
+                   "cost model, plan.search deterministic staged search, "
+                   "plan.apply replan seams); the round-trip/tamper "
+                   "diagnoses, the shuffled-input determinism "
+                   "regression, the seeded-quarantine replay, and the "
+                   "calibration-fallback contract stay in tier-1 — "
+                   "full-grid search sweeps ride the slow tier")
+    config.addinivalue_line(
         "markers", "memobs: memory-observability tests (obs.memledger "
                    "exact attribution, the KV page-class partition, the "
                    "alloc/free leak watchdog, /memory + /fleet/memory, "

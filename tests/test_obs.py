@@ -625,6 +625,60 @@ def test_metric_naming_conventions():
     assert not problems, "\n".join(problems)
 
 
+def test_plan_determinism_lint():
+    """Satellite lint (PR 18): ``hetu_tpu/plan/`` must stay a pure
+    function of (spec, calibration) — a Plan that depends on a wall
+    clock, entropy, or hash-order dict iteration cannot be
+    byte-identical across replays.  The AST lint rejects any ``time`` /
+    ``random`` import (plain, dotted, or from-import) and requires
+    every ``.items()`` / ``.keys()`` / ``.values()`` call to be the
+    DIRECT argument of ``sorted(...)`` — iteration order pinned at the
+    call site, not downstream."""
+    import ast
+    import pathlib
+
+    import hetu_tpu.plan
+    root = pathlib.Path(hetu_tpu.plan.__file__).parent
+    files = sorted(root.glob("*.py"))
+    assert files, "plan package has no sources to lint"
+    problems = []
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(tree):
+            where = f"{path.name}:{getattr(node, 'lineno', '?')}"
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] in ("time", "random"):
+                        problems.append(
+                            f"{where}: import {alias.name} — a plan "
+                            f"must not read clocks or entropy")
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] in ("time",
+                                                         "random"):
+                    problems.append(
+                        f"{where}: from {node.module} import ... — a "
+                        f"plan must not read clocks or entropy")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("items", "keys", "values")
+                    and not node.args and not node.keywords):
+                parent = parents.get(node)
+                wrapped = (isinstance(parent, ast.Call)
+                           and isinstance(parent.func, ast.Name)
+                           and parent.func.id == "sorted"
+                           and parent.args and parent.args[0] is node)
+                if not wrapped:
+                    problems.append(
+                        f"{where}: .{node.func.attr}() not directly "
+                        f"inside sorted(...) — dict iteration order "
+                        f"must be pinned at the call site")
+    assert not problems, "\n".join(problems)
+
+
 def test_span_naming_conventions():
     """Satellite lint: the PR-8 metric-naming AST lint extended to span
     names — every span opened in the tree uses a dotted lowercase

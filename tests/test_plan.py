@@ -1,0 +1,516 @@
+"""Unified deployment planner: the signed Plan envelope, the
+deterministic staged search, calibration fallbacks, and the replan
+seams into the gang/controller.
+
+The acceptance bar (ISSUE 18): byte-identical signed Plans from
+identical (spec, calibration) inputs across same-seed replays —
+including a replan triggered mid-run by a seeded quarantine — and a
+tampered or torn plan file diagnosed by name, never half-read.
+"""
+
+import dataclasses
+import hashlib
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec import (ElasticGang, PartialReduceConfig, Trainer,
+                           faults)
+from hetu_tpu.exec.controller import ControllerConfig, RuntimeController
+from hetu_tpu.models import MLP
+from hetu_tpu.obs import divergence as obs_divergence
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.obs.calibration import (DEFAULT_CONSTANTS, ProfileStore,
+                                      fit_calibration)
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+from hetu_tpu.optim import SGDOptimizer
+from hetu_tpu.parallel.autoparallel.cost_model import (
+    ClusterSpec, transformer_layer_spec)
+from hetu_tpu.parallel.autoparallel.search import dp_search
+from hetu_tpu.plan import (DeploymentPlanner, DeploymentSpec, Plan,
+                           PlanApplier, PlanError, apply_plan,
+                           build_fleet, engine_kwargs, plan_deployment)
+
+pytestmark = pytest.mark.plan
+
+
+@pytest.fixture
+def journal():
+    j = obs_journal.EventJournal(clock=lambda: 0.0)
+    obs_journal.set_journal(j)
+    yield j
+    obs_journal.set_journal(None)
+
+
+def serve_spec(**kw):
+    """A small hybrid spec: 2 train devices, 2 serving devices."""
+    base = dict(model_sig="ci-smoke", n_layers=2, hidden_size=32,
+                seq_len=64, vocab_size=97, global_batch=8, n_devices=4,
+                serve_devices=2, hbm_bytes=2e9, requests_per_s=4.0,
+                prompt_p50=8, prompt_p99=16, decode_len=8,
+                slots_per_replica=4, page_size=8)
+    base.update(kw)
+    return DeploymentSpec(**base)
+
+
+# ------------------------------------------------------------ the spec
+
+class TestDeploymentSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n_layers"):
+            DeploymentSpec(n_layers=0)
+        with pytest.raises(ValueError, match="serve_devices"):
+            DeploymentSpec(n_devices=4, serve_devices=5)
+        with pytest.raises(ValueError, match="embed_hot_fraction"):
+            DeploymentSpec(embed_hot_fraction=1.5)
+        with pytest.raises(ValueError, match="positive"):
+            DeploymentSpec(hbm_bytes=0)
+
+    def test_signature_is_canonical(self):
+        a, b = serve_spec(), serve_spec()
+        assert a.to_json() == b.to_json()
+        assert a.signature() == b.signature()
+        assert a.signature() != serve_spec(n_devices=8).signature()
+        assert a.train_devices == 2
+
+
+# ------------------------------------------- the signed Plan envelope
+
+class TestPlanEnvelope:
+    def plan(self):
+        return Plan(dp=2, tp=1, pp=1, gang_size=2, replicas=2,
+                    slots_per_replica=4, bucket_ladder=(8, 16),
+                    kv_pool_pages=13, page_size=8,
+                    predicted=(("step_time_s", 0.25),))
+
+    def test_round_trip_byte_identical(self, tmp_path):
+        p = self.plan()
+        raw = p.to_json()
+        assert raw == self.plan().to_json(), \
+            "identical plans must serialize byte-identically"
+        q = Plan.from_json(raw)
+        assert q == p and q.to_json() == raw
+        path = p.save(tmp_path / "p.json")
+        assert Plan.load(path) == p
+        assert p.sha256 == q.sha256
+
+    def test_hand_built_and_deserialized_normalize_alike(self):
+        # list vs tuple, unsorted predicted pairs: same bytes out
+        a = Plan(bucket_ladder=[16, 8][::-1],
+                 predicted=[("b", 2.0), ("a", 1.0)])
+        b = Plan(bucket_ladder=(8, 16),
+                 predicted=(("a", 1.0), ("b", 2.0)))
+        assert a.to_json() == b.to_json()
+
+    def test_torn_write_named(self):
+        raw = self.plan().to_json()
+        with pytest.raises(PlanError, match="torn write"):
+            Plan.from_json(raw[: len(raw) // 2])
+
+    def test_alien_format_named(self):
+        raw = json.dumps({"body": {"format": "hetu-gang-v1"}}).encode()
+        with pytest.raises(PlanError, match="format is not hetu-plan-v1"):
+            Plan.from_json(raw)
+
+    def test_crc_damage_named(self):
+        env = json.loads(self.plan().to_json())
+        env["body"]["plan"]["dp"] = 64
+        raw = json.dumps(env, sort_keys=True,
+                         separators=(",", ":")).encode()
+        with pytest.raises(PlanError, match="CRC32 mismatch"):
+            Plan.from_json(raw)
+
+    def test_tampered_body_fails_signature(self):
+        # fixing the CRC after an edit is easy; forging the signature
+        # (a stray editor won't) is what the diagnosis names
+        env = json.loads(self.plan().to_json())
+        env["body"]["plan"]["dp"] = 64
+        canon = json.dumps(env["body"], sort_keys=True,
+                           separators=(",", ":"))
+        env["crc32"] = zlib.crc32(canon.encode()) & 0xFFFFFFFF
+        raw = json.dumps(env, sort_keys=True,
+                         separators=(",", ":")).encode()
+        with pytest.raises(PlanError, match="signature mismatch"):
+            Plan.from_json(raw)
+
+    def test_body_without_plan_named(self):
+        body = {"format": "hetu-plan-v1"}
+        canon = json.dumps(body, sort_keys=True, separators=(",", ":"))
+        env = {"body": body,
+               "crc32": zlib.crc32(canon.encode()) & 0xFFFFFFFF,
+               "sha256": hashlib.sha256(
+                   b"hetu-tpu-plan-v1:" + canon.encode()).hexdigest()}
+        raw = json.dumps(env, sort_keys=True,
+                         separators=(",", ":")).encode()
+        with pytest.raises(PlanError, match="carries no plan"):
+            Plan.from_json(raw)
+
+    def test_invalid_field_values_named(self):
+        body = {"format": "hetu-plan-v1",
+                "plan": {"schedule": "magic"}}
+        canon = json.dumps(body, sort_keys=True, separators=(",", ":"))
+        env = {"body": body,
+               "crc32": zlib.crc32(canon.encode()) & 0xFFFFFFFF,
+               "sha256": hashlib.sha256(
+                   b"hetu-tpu-plan-v1:" + canon.encode()).hexdigest()}
+        raw = json.dumps(env, sort_keys=True,
+                         separators=(",", ":")).encode()
+        with pytest.raises(PlanError, match="invalid field values"):
+            Plan.from_json(raw)
+
+    def test_old_version_plan_loads_with_defaults(self):
+        # a v0 plan predates the embedding axes entirely: it must load
+        # (its own sign key verifies) with the missing axes defaulted
+        # and unknown fields ignored
+        body = {"format": "hetu-plan-v0",
+                "plan": {"dp": 4, "tp": 2, "replicas": 1,
+                         "retired_knob": True}}
+        canon = json.dumps(body, sort_keys=True, separators=(",", ":"))
+        env = {"body": body,
+               "crc32": zlib.crc32(canon.encode()) & 0xFFFFFFFF,
+               "sha256": hashlib.sha256(
+                   b"hetu-tpu-plan-v0:" + canon.encode()).hexdigest()}
+        raw = json.dumps(env, sort_keys=True,
+                         separators=(",", ":")).encode()
+        p = Plan.from_json(raw)
+        assert (p.dp, p.tp, p.replicas) == (4, 2, 1)
+        assert p.embed_storage == "f32" and p.schedule == "none"
+
+    def test_role_split_must_cover_replicas(self):
+        with pytest.raises(ValueError, match="role split"):
+            Plan(replicas=3, prefill_workers=1, decode_workers=1)
+
+
+# ------------------------------------ search determinism + provenance
+
+class TestPlanSearch:
+    def test_byte_identical_across_runs(self, journal):
+        spec = serve_spec()
+        a = plan_deployment(spec)
+        b = plan_deployment(spec)
+        assert a.to_json() == b.to_json()
+        assert a.spec_sha256 == spec.signature()
+        assert a.replicas >= 1 and a.gang_size == 2
+        emits = journal.of_kind("plan_emit")
+        assert len(emits) == 2
+        assert emits[0]["sha256"] == a.sha256
+        assert emits[0]["candidates"] > 1
+        assert emits[0]["trigger"] == "initial"
+
+    def test_calibration_feeds_provenance(self):
+        store = ProfileStore(clock=lambda: 0.0)
+        cal = fit_calibration(store, defaults=True)
+        p = plan_deployment(serve_spec(), calibration=cal)
+        assert p.calibration_sha256 == hashlib.sha256(
+            cal.to_json().encode()).hexdigest()
+        assert plan_deployment(serve_spec(),
+                               calibration=cal).to_json() == p.to_json()
+
+    def test_train_only_and_serve_only(self):
+        t = plan_deployment(serve_spec(serve_devices=0))
+        assert t.replicas == 0 and t.gang_size == 4
+        s = plan_deployment(serve_spec(n_devices=2, serve_devices=2))
+        assert s.gang_size == 0 and s.replicas >= 1
+
+    def test_speculative_spec_searches_spec_k(self):
+        p = plan_deployment(serve_spec(speculative=True))
+        q = plan_deployment(serve_spec(speculative=False))
+        assert q.spec_k == 0
+        # speculation is searched, not forced — but the axis must have
+        # been on the grid (a draft model never makes serving slower in
+        # the cost model, so the planner picks it up)
+        assert p.spec_k in (0, 2, 4)
+
+    def test_embedding_axes_planned(self):
+        p = plan_deployment(serve_spec(embed_rows=1000, embed_dim=16,
+                                       embed_hot_fraction=0.1))
+        assert p.embed_hbm_rows in (50, 100)
+        assert p.embed_storage in ("f32", "int8")
+        assert p.embed_host_rows >= p.embed_hbm_rows
+
+    def test_planner_replan_shrinks_fleet(self, journal):
+        pl = DeploymentPlanner(serve_spec())
+        first = pl.plan()
+        shrunk = pl.replan(n_devices=3, trigger="quarantine")
+        assert pl.spec.n_devices == 3
+        assert shrunk.gang_size == 1
+        assert shrunk.sha256 != first.sha256
+        kinds = [e["trigger"] for e in journal.of_kind("plan_emit")]
+        assert kinds == ["initial", "quarantine"]
+
+
+class TestDpSearchDeterminism:
+    def run(self, micro, remat):
+        cluster = ClusterSpec(n_devices=4, hbm_bytes=8e9,
+                              peak_flops=100e12)
+        layer = transformer_layer_spec(64, 128, 4)
+        return dp_search([layer] * 4, cluster, 16,
+                         microbatch_options=micro, remat_policies=remat)
+
+    def test_shuffled_insertion_order_same_plan(self):
+        """The regression: option ORDER (a set/dict iteration hazard)
+        must never pick the winner — byte-identical canonical Plans."""
+        base = self.run((1, 2, 4, 8), ("none", "full", "dots_saveable"))
+        for micro, remat in [
+                ((8, 4, 2, 1), ("dots_saveable", "none", "full")),
+                ((2, 8, 1, 4, 2),
+                 ("full", "dots_saveable", "none", "full")),
+        ]:
+            assert self.run(micro, remat).to_json() == base.to_json()
+
+    def test_repeat_run_byte_identical(self):
+        a = self.run((1, 2, 4, 8), ("none",))
+        b = self.run((1, 2, 4, 8), ("none",))
+        assert a.to_json() == b.to_json()
+
+
+# ----------------------------------------------- calibration fallback
+
+class TestCalibrationFallback:
+    def test_empty_store_fills_named_defaults(self, journal):
+        store = ProfileStore(clock=lambda: 0.0)
+        cal = fit_calibration(store, defaults=True)
+        for name, value in DEFAULT_CONSTANTS.items():
+            assert cal.get(name) == value
+        assert set(cal.fallbacks) == set(DEFAULT_CONSTANTS)
+        ev, = journal.of_kind("calibration_fallback")
+        assert ev["constants"] == sorted(DEFAULT_CONSTANTS)
+        # the fallback fit is itself deterministic
+        assert cal.to_json() == fit_calibration(
+            store, defaults=True).to_json()
+
+    def test_fitted_constants_beat_defaults(self, journal):
+        store = ProfileStore(clock=lambda: 0.0)
+        store.put("serve", {"prefill_mean_s": 0.2, "decode_mean_s": 0.05,
+                            "queue_mean_s": 0.01},
+                  model_sig="m", device_kind="cpu")
+        cal = fit_calibration(store, model_sig="m", device_kind="cpu",
+                              defaults=True)
+        assert cal.get("prefill_mean_s") == 0.2
+        assert "prefill_mean_s" not in cal.fallbacks
+        assert "mfu" in cal.fallbacks and cal.get("mfu") == 0.4
+
+    def test_no_defaults_no_fallbacks(self, journal):
+        cal = fit_calibration(ProfileStore(clock=lambda: 0.0))
+        assert cal.fallbacks == ()
+        assert journal.of_kind("calibration_fallback") == []
+
+
+# ----------------------------------------- plan-bearing construction
+
+def ci_gpt():
+    from hetu_tpu.models.gpt import GPT, GPTConfig
+    set_random_seed(0)
+    return GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, max_seq_len=64))
+
+
+class TestPlanBearingConstruction:
+    def plan(self, **kw):
+        base = dict(replicas=2, slots_per_replica=4,
+                    bucket_ladder=(8, 16), kv_pool_pages=13, page_size=8)
+        base.update(kw)
+        return Plan(**base)
+
+    def test_engine_kwargs_mapping(self):
+        kw = engine_kwargs(self.plan(), role="prefill")
+        assert kw == {"num_slots": 4, "page_size": 8,
+                      "prompt_buckets": (8, 16), "num_pages": 13,
+                      "role": "prefill"}
+        # zero axes are omitted: engine defaults apply
+        bare = engine_kwargs(Plan(replicas=1))
+        assert "num_pages" not in bare and "spec_k" not in bare
+
+    def test_engine_merges_plan_axes(self):
+        eng = build_fleet(ci_gpt(), self.plan(replicas=1),
+                          clock=lambda: 0.0).engines[0]
+        assert eng.batcher.num_slots == 4
+        assert eng.pool.page_size == 8 and eng.pool.num_pages == 13
+        assert eng.batcher.prompt_buckets == (8, 16)
+        assert eng.plan is not None
+
+    def test_explicit_kwargs_beat_the_plan(self):
+        from hetu_tpu.serve.engine import ServingEngine
+        eng = ServingEngine(ci_gpt(), plan=self.plan(replicas=1),
+                            num_slots=2, clock=lambda: 0.0)
+        assert eng.batcher.num_slots == 2      # caller override wins
+        assert eng.pool.page_size == 8         # plan fills the rest
+
+    def test_role_split_builds_disagg_router(self):
+        from hetu_tpu.serve.fleet.disagg import DisaggRouter
+        fleet = build_fleet(
+            ci_gpt(), self.plan(replicas=2, prefill_workers=1,
+                                decode_workers=1),
+            clock=lambda: 0.0)
+        assert isinstance(fleet, DisaggRouter)
+
+    def test_fleet_serves_a_request(self):
+        fleet = build_fleet(ci_gpt(), self.plan(replicas=2),
+                            clock=lambda: 0.0)
+        h = fleet.submit([5, 6, 7], max_new_tokens=4)
+        fleet.run_until_idle(200)
+        assert h.status == "completed" and len(h.tokens) == 4
+
+    def test_no_serving_tier_refused(self):
+        with pytest.raises(ValueError, match="replicas=0"):
+            build_fleet(ci_gpt(), Plan())
+
+
+# --------------------------------------------------- apply + journal
+
+class TestApplyPlan:
+    def test_dry_run_journals_identical_decision(self, journal):
+        p = Plan(replicas=1, partial_deadline_s=1.5)
+        assert apply_plan(p, dry_run=True) == []
+        active = apply_plan(p)
+        dry, act = journal.of_kind("plan_apply")
+        assert dry["sha256"] == act["sha256"] == p.sha256
+        assert dry["dry_run"] is True and act["dry_run"] is False
+        assert dry["actions"] == [] and active == []
+
+    def test_gang_deadline_actuated(self, journal):
+        class FakePartial:
+            def __init__(self):
+                self.deadline = 0.5
+
+        class FakeGang:
+            def __init__(self):
+                self.partial = FakePartial()
+
+            def set_partial_deadline(self, d, source):
+                self.partial.deadline = d
+                self.source = source
+
+        g = FakeGang()
+        p = Plan(partial_deadline_s=2.5)
+        assert apply_plan(p, gang=g) == ["partial_deadline"]
+        assert g.partial.deadline == 2.5 and g.source == "planner"
+        # dry-run: decision journaled, knob untouched
+        g2 = FakeGang()
+        apply_plan(p, gang=g2, dry_run=True)
+        assert g2.partial.deadline == 0.5
+
+
+# --------------------- the seeded-quarantine replan replay (capstone)
+
+def make_trainer():
+    set_random_seed(0)
+    model = MLP((8, 16, 3))
+
+    def loss_fn(model, batch, key):
+        logits = model(batch["x"])
+        return softmax_cross_entropy_sparse(logits, batch["y"]).mean(), {}
+
+    return Trainer(model, SGDOptimizer(0.1), loss_fn, donate=False)
+
+
+def make_data(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        out.append({"x": x, "y": (x[:, 0] > 0).astype(np.int32)})
+    return out
+
+
+class TestReplanOnQuarantine:
+    """A seeded bit flip mid-run -> quarantine -> the controller asks
+    the planner for a new Plan against the surviving world.  The
+    decision must be bitwise-replayable, and dry-run must emit the
+    byte-identical plan while actuating nothing."""
+
+    def run(self, tmpdir, dry=False):
+        obs_divergence.reset_detected()
+        data = make_data()
+        j = obs_journal.EventJournal(clock=lambda: 0.0)
+        obs_journal.set_journal(j)
+        try:
+            applier = PlanApplier(DeploymentPlanner(
+                serve_spec(serve_devices=0, partial_deadline_s=2.0)))
+            applier.planner.plan()        # the initial 4-device plan
+            ctrl = RuntimeController(
+                ControllerConfig(cooldown_steps=3, shed=False,
+                                 freeze_buckets=False, dry_run=dry,
+                                 tune_deadline=False),
+                planner=applier)
+            tr = make_trainer()
+            g = ElasticGang(
+                tr, str(tmpdir), world_size=4,
+                data_fn=lambda s: data[s - 1], global_batch_size=16,
+                seed=0, save_every=2,
+                partial=PartialReduceConfig(deadline=2.0, tau=4,
+                                            min_deadline=0.5,
+                                            max_deadline=6.0),
+                numerics=True, controller=ctrl)
+            plan = faults.FaultPlan(
+                [(6, faults.Fault("bit_flip", worker=2, arg=5))])
+            with faults.inject(plan):
+                g.run_until(12)
+            assert not plan.remaining()
+            return g, j, applier
+        finally:
+            obs_journal.set_journal(None)
+
+    def test_quarantine_triggers_bitwise_replayable_replan(
+            self, tmp_path):
+        g1, j1, a1 = self.run(tmp_path / "r1")
+        g2, j2, a2 = self.run(tmp_path / "r2")
+        # the quarantine fired and the planner re-planned for 3 devices
+        assert g1.world_size == 3
+        assert a1.current.gang_size == 3
+        assert a1.planner.spec.n_devices == 3
+        emits = [e["trigger"] for e in j1.of_kind("plan_emit")]
+        assert emits == ["initial", "quarantine"]
+        ap, = j1.of_kind("plan_apply")
+        assert ap["trigger"] == "quarantine" and ap["dry_run"] is False
+        assert ap["sha256"] == a1.current.sha256
+        # the plan's partial deadline actually actuated on the gang
+        # (deadline_source "planner" is a legal PartialReduceConfig
+        # provenance alongside static/controller)
+        assert ap["actions"] == ["partial_deadline"]
+        assert g1.partial.deadline_source == "planner"
+        # the capstone bar: byte-identical signed Plans across replays
+        assert a1.current.to_json() == a2.current.to_json()
+        assert j1.of_kind("plan_emit") == j2.of_kind("plan_emit")
+
+    def test_dry_run_decides_identically_actuates_nothing(
+            self, tmp_path):
+        _g, _j, active = self.run(tmp_path / "a")
+        gd, jd, dry = self.run(tmp_path / "d", dry=True)
+        # nothing actuated: the gang kept all 4 workers
+        assert gd.world_size == 4
+        ap, = jd.of_kind("plan_apply")
+        assert ap["dry_run"] is True and ap["actions"] == []
+        # ...but the DECISION is the active run's, byte for byte (the
+        # shadow-eviction world makes the dry replan see 3 survivors)
+        assert dry.current.to_json() == active.current.to_json()
+
+    def test_gang_attached_planner_replans_at_rescale(self, tmp_path):
+        # the other seam: planner on the GANG, no controller involved —
+        # an explicit rescale re-plans against the survivors
+        obs_divergence.reset_detected()
+        data = make_data()
+        j = obs_journal.EventJournal(clock=lambda: 0.0)
+        obs_journal.set_journal(j)
+        try:
+            applier = PlanApplier(
+                DeploymentPlanner(serve_spec(serve_devices=0)))
+            applier.planner.plan()
+            tr = make_trainer()
+            g = ElasticGang(
+                tr, str(tmp_path), world_size=4,
+                data_fn=lambda s: data[s - 1], global_batch_size=16,
+                seed=0, save_every=2, planner=applier)
+            plan = faults.FaultPlan(
+                [(3, faults.Fault("worker_kill", worker=2))])
+            with faults.inject(plan):
+                g.run_until(6)
+            assert g.world_size == 3
+            assert applier.current.gang_size == 3
+            emits = [e["trigger"] for e in j.of_kind("plan_emit")]
+            assert emits == ["initial", "gang_rescale"]
+        finally:
+            obs_journal.set_journal(None)
