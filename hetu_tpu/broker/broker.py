@@ -1,0 +1,491 @@
+"""The capacity broker: one chip inventory, two workloads.
+
+Training and serving have opposite diurnal shapes — the serving fleet
+burns its SLO budget at peak and idles overnight, the gang wants every
+chip all the time.  :class:`CapacityBroker` arbitrates: on sustained
+serve-side SLO burn (the PR 9/11 shed-pressure signal, tenant-aware per
+PR 16) it asks the PR 18 planner for a replan at ``world - k`` training
+chips, shrinks the gang through the deterministic
+:meth:`~hetu_tpu.exec.gang.ElasticGang.lend` rescale, and grants the
+freed chips to the fleet as warming replicas (PR 15's snapshot-follower
+idiom: a lent chip serves the latest gated snapshot, never stale
+weights).  When pressure releases past hysteresis, leases are reclaimed
+newest-first (LIFO) and the gang rescales back up — the save-at-lend
+discipline keeps the loss trajectory bitwise equal to an uninterrupted
+run at equal total steps.
+
+Every movement is a journaled :class:`~hetu_tpu.broker.lease.Lease`
+(``lease_grant`` / ``lease_reclaim`` / ``broker_decision`` events,
+``hetu_broker_*`` metrics, the ``/broker`` and ``/fleet/broker``
+endpoints), and the whole loop runs the RuntimeController discipline:
+hysteresis band, sustain streaks, cooldown, and a dry-run mode that
+journals the identical decision stream while actuating nothing.
+
+This package is covered by the plan-determinism lint
+(tests/test_obs.py): no wall clocks, no ambient randomness, no
+unordered dict walks — a same-seed episode replays its lease journal
+bitwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+from hetu_tpu.broker.lease import Lease
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+
+__all__ = ["BrokerConfig", "CapacityBroker", "broker_families",
+           "install", "get_broker", "use"]
+
+_ENV_PREFIX = "HETU_TPU_BROKER_"
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerConfig:
+    """The lease policy — hysteresis, sustain, cooldown, floors."""
+
+    enabled: bool = True
+    # journal every decision, actuate nothing (the rollout audit mode)
+    dry_run: bool = False
+    # shed-pressure hysteresis band: grant at sustained >= grant_on,
+    # reclaim at sustained <= grant_off (same signal the controller
+    # sheds on — broker and admission control agree who is drowning)
+    grant_on: float = 0.9
+    grant_off: float = 0.1
+    # consecutive ticks outside the band before acting
+    sustain_ticks: int = 3
+    # ticks after any action before the next (rescales are not free)
+    cooldown_ticks: int = 8
+    # chips moved per decision
+    chips_per_grant: int = 1
+    # the gang never shrinks below this many live workers
+    min_train_world: int = 1
+
+    def __post_init__(self):
+        if not 0.0 <= self.grant_off <= self.grant_on:
+            raise ValueError(
+                f"need 0 <= grant_off <= grant_on (the hysteresis "
+                f"band), got grant_off={self.grant_off} "
+                f"grant_on={self.grant_on}")
+        if not 0.0 < self.grant_on <= 1.0:
+            raise ValueError(f"grant_on is a shed-pressure fraction in "
+                             f"(0, 1], got {self.grant_on}")
+        if self.sustain_ticks < 1:
+            raise ValueError(f"sustain_ticks must be >= 1, got "
+                             f"{self.sustain_ticks}")
+        if self.cooldown_ticks < 0:
+            raise ValueError(f"cooldown_ticks must be >= 0, got "
+                             f"{self.cooldown_ticks}")
+        if self.chips_per_grant < 1:
+            raise ValueError(f"chips_per_grant must be >= 1, got "
+                             f"{self.chips_per_grant}")
+        if self.min_train_world < 1:
+            raise ValueError(f"min_train_world must be >= 1, got "
+                             f"{self.min_train_world}")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "BrokerConfig":
+        """Policy from the environment (``HETU_TPU_BROKER_*``),
+        explicit ``overrides`` winning.  Booleans parse 1/true/yes
+        (case-insensitive)."""
+        spec = {"enabled": bool, "dry_run": bool, "grant_on": float,
+                "grant_off": float, "sustain_ticks": int,
+                "cooldown_ticks": int, "chips_per_grant": int,
+                "min_train_world": int}
+        kw = {}
+        for field, typ in sorted(spec.items()):
+            raw = os.environ.get(_ENV_PREFIX + field.upper())
+            if raw is None:
+                continue
+            if typ is bool:
+                kw[field] = raw.strip().lower() in ("1", "true", "yes")
+            else:
+                kw[field] = typ(raw)
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def broker_families(reg) -> dict:
+    """The ``hetu_broker_*`` families on ``reg`` (idempotent: identical
+    re-registration returns the existing family)."""
+    return {
+        "leases": reg.counter(
+            "hetu_broker_leases_total",
+            "chip leases the broker ACTUATED, by direction (grant: "
+            "train -> serve; reclaim: lease returned to the gang) — a "
+            "dry-run broker journals decisions without counting here",
+            ("direction",)),
+        "chips_lent": reg.gauge(
+            "hetu_broker_chips_lent",
+            "chips currently out of the training gang on an active "
+            "lease (offered/warming/serving/reclaiming)"),
+        "warmup": reg.histogram(
+            "hetu_broker_warmup_seconds",
+            "grant-to-serving warm-up latency per lease (the snapshot "
+            "follower catching the lent chip up to the latest gated "
+            "version)"),
+    }
+
+
+class CapacityBroker:
+    """The gang <-> fleet lease loop.
+
+    Driven by :meth:`tick` on the episode's (virtual) clock; every
+    decision is a pure function of the fleet's published pressure and
+    the broker's own streak/cooldown state, so a seeded replay
+    reproduces the lease journal bitwise.
+    """
+
+    def __init__(self, config: Optional[BrokerConfig] = None, *,
+                 gang=None, fleet=None, planner=None,
+                 replica_factory=None, clock=None,
+                 registry: Optional[_obs.MetricsRegistry] = None,
+                 history: int = 512):
+        self.config = config if config is not None else BrokerConfig()
+        self.gang = gang
+        self.fleet = fleet
+        # plan.PlanApplier: every grant/reclaim rides a signed replan
+        # (the lease record carries the sha); None skips planning
+        self.planner = planner
+        # replica_factory(lease, plan) -> engine | (engine, warm_fn):
+        # builds the serving replica a granted chip becomes.  warm_fn
+        # is polled each tick until True (wire a PR 15
+        # SnapshotFollower's catch-up here); None serves next tick.
+        self.replica_factory = replica_factory
+        # the warm-up stopwatch only — decisions never read it (the
+        # episode's virtual clock in tests; 0.0 when absent)
+        self.clock = clock if clock is not None else (lambda: 0.0)
+        self._reg = registry
+        self._metrics = None
+        self.history = int(history)
+        self.leases: list = []      # every Lease ever, in grant order
+        self.actions: list = []     # bounded decision history
+        self.actions_total = 0
+        self._next_lease = 0
+        self._tick = 0
+        self._grant_streak = 0
+        self._ok_streak = 0
+        self._last_action_tick: Optional[int] = None
+        self._train_step = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach_gang(self, gang) -> None:
+        """The ``ElasticGang(broker=...)`` seam: the gang is usually
+        built after the broker, so it hands itself over here."""
+        self.gang = gang
+
+    def attach_fleet(self, fleet) -> None:
+        self.fleet = fleet
+
+    def on_gang_step(self, gang, step: int) -> None:
+        """The gang's post-commit seam — the broker only remembers the
+        step so ``/broker`` can show training progress next to the
+        lease table; decisions stay fleet-driven via :meth:`tick`."""
+        self._train_step = int(step)
+
+    # -- the decision record --------------------------------------------------
+
+    def _m(self) -> dict:
+        if self._metrics is None:
+            self._metrics = broker_families(
+                self._reg if self._reg is not None
+                else _obs.get_registry())
+        return self._metrics
+
+    def _decide(self, action: str, pressure: float, **fields) -> dict:
+        rec = {"tick": self._tick, "action": action,
+               "pressure": round(float(pressure), 6),
+               "dry_run": bool(self.config.dry_run), **fields}
+        self.actions.append(rec)
+        self.actions_total += 1
+        if len(self.actions) > self.history:
+            del self.actions[:len(self.actions) - self.history]
+        _journal.record("broker_decision", action=action,
+                        pressure=round(float(pressure), 6),
+                        dry_run=bool(self.config.dry_run), **fields)
+        return rec
+
+    # -- signals --------------------------------------------------------------
+
+    def lent(self) -> int:
+        """Chips currently out on an active lease."""
+        return sum(1 for lease in self.leases if lease.active)
+
+    def train_world(self) -> int:
+        """Live training chips the next grant decision sees.  A live
+        gang already dropped its lent ranks; a dry-run broker shadows
+        its own (never-actuated) leases so the decision stream stays
+        sensible — cooldown and the min_train_world floor bind the
+        same way they would for an active broker."""
+        if self.gang is None:
+            return 0
+        world = int(self.gang.live_world)
+        if self.config.dry_run:
+            world -= self.lent()
+        return world
+
+    def pressure(self) -> float:
+        """Max shed pressure over the fleet's SERVING replicas —
+        tenant-aware: an engine whose SLO plane went multi-tenant
+        reports its worst (tenant, class) scoped pressure, so a
+        flooding tenant's burn is visible even when the aggregate
+        windows still look healthy (the PR 16 signal)."""
+        if self.fleet is None:
+            return 0.0
+        worst = 0.0
+        for i in self.fleet.serving_indices():
+            engine = self.fleet.engines[i]
+            if getattr(engine.slo, "multi_tenant", False):
+                observed = engine.slo.observed_tenants()
+                p = max((float(engine.slo.tenant_shed_pressure(tid))
+                         for tid, _klass in sorted(observed.items())),
+                        default=0.0)
+            else:
+                p = float(engine.slo.shed_pressure())
+            worst = max(worst, p)
+        return worst
+
+    # -- the loop -------------------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One broker decision tick: advance in-flight lease state
+        machines (warm-ups, drains), sample pressure, and maybe act.
+        Returns the action taken ("lease_grant" / "lease_reclaim" /
+        "grant_denied") or None."""
+        if not self.config.enabled:
+            return None
+        self._tick += 1
+        press = self.pressure()
+        self._advance_warming(press)
+        self._advance_reclaiming(press)
+        cfg = self.config
+        if press >= cfg.grant_on:
+            self._grant_streak += 1
+            self._ok_streak = 0
+        elif press <= cfg.grant_off:
+            self._ok_streak += 1
+            self._grant_streak = 0
+        else:
+            # inside the hysteresis band: sustain nothing
+            self._grant_streak = 0
+            self._ok_streak = 0
+        if self._last_action_tick is not None and \
+                self._tick - self._last_action_tick < cfg.cooldown_ticks:
+            return None
+        if self._grant_streak >= cfg.sustain_ticks:
+            return self._grant(press)
+        if self._ok_streak >= cfg.sustain_ticks and \
+                any(lease.state in ("warming", "serving")
+                    for lease in self.leases):
+            return self._reclaim(press)
+        return None
+
+    # -- lease state advancement ----------------------------------------------
+
+    def _advance_warming(self, press: float) -> None:
+        for lease in self.leases:
+            if lease.state != "warming":
+                continue
+            if self.config.dry_run:
+                # a shadow lease has no engine to warm: it serves (in
+                # the books) one tick after the grant, the same shape
+                # as a trivially-warm live replica
+                lease.advance("serving", tick=self._tick)
+                self._decide("lease_serving", press,
+                             lease_id=lease.lease_id)
+                continue
+            warm = getattr(lease, "_warm", None)
+            if warm is not None and not bool(warm()):
+                continue
+            if self.fleet is not None and lease.replica is not None:
+                self.fleet.mark_serving(lease.replica)
+            lease.advance("serving", tick=self._tick)
+            started = getattr(lease, "_granted_t", None)
+            if _obs.enabled() and started is not None:
+                self._m()["warmup"].observe(
+                    max(float(self.clock()) - float(started), 0.0))
+            self._decide("lease_serving", press, lease_id=lease.lease_id)
+
+    def _advance_reclaiming(self, press: float) -> None:
+        returned = 0
+        for lease in self.leases:
+            if lease.state != "reclaiming":
+                continue
+            if not self.config.dry_run and self.fleet is not None \
+                    and lease.replica is not None:
+                engine = self.fleet.engines[lease.replica]
+                if not engine.batcher.idle:
+                    continue  # still draining — retry next tick
+                self.fleet.retire_replica(lease.replica)
+            lease.advance("returned", tick=self._tick)
+            returned += 1
+            self._decide("lease_returned", press,
+                         lease_id=lease.lease_id)
+            if _obs.enabled() and not self.config.dry_run:
+                self._m()["leases"].labels(direction="reclaim").inc()
+        if returned and not self.config.dry_run:
+            if self.gang is not None:
+                # one rejoin for the batch: one generation bump, one
+                # gang_rescale journal entry, however many chips came
+                # home this tick
+                self.gang.rejoin(returned)
+            if _obs.enabled():
+                self._m()["chips_lent"].set(float(self.lent()))
+
+    # -- actions --------------------------------------------------------------
+
+    def _replan(self, serve_delta: int, trigger: str) -> Optional[object]:
+        if self.planner is None:
+            return None
+        spec = self.planner.planner.spec
+        target = min(max(spec.serve_devices + serve_delta, 0),
+                     spec.n_devices)
+        return self.planner.replan_for_lease(
+            self.gang, serve_devices=target, trigger=trigger)
+
+    def _grant(self, press: float) -> str:
+        cfg = self.config
+        k = min(cfg.chips_per_grant,
+                self.train_world() - cfg.min_train_world)
+        if k <= 0:
+            # a denied grant is still a decision (and starts the
+            # cooldown): the journal shows the broker WANTED capacity
+            # the floor refused, and the loop does not spin on it
+            self._decide("grant_denied", press,
+                         train_world=self.train_world())
+            self._last_action_tick = self._tick
+            self._grant_streak = 0
+            return "grant_denied"
+        plan = self._replan(+k, "lease_grant")
+        sha = plan.sha256 if plan is not None else ""
+        generation = (int(self.gang.generation)
+                      if self.gang is not None else 0)
+        if self.config.dry_run:
+            # the chips an active broker would lend: the gang's dense
+            # renumbering means the k highest live ranks, offset by the
+            # shadow leases already (notionally) out
+            live = [w for w in range(self.gang.world_size)
+                    if w not in self.gang._dead]
+            shadow = self.lent()
+            hi = len(live) - shadow
+            chips = live[hi - k:hi]
+        else:
+            chips = self.gang.lend(k)
+        for chip in chips:
+            lease = Lease(lease_id=self._next_lease, chip=int(chip),
+                          from_role="train", to_role="serve",
+                          trigger="slo_burn", plan_sha=sha,
+                          generation=generation,
+                          granted_tick=self._tick)
+            self._next_lease += 1
+            self.leases.append(lease)
+            _journal.record("lease_grant", lease_id=lease.lease_id,
+                            chip=lease.chip, from_role="train",
+                            to_role="serve", trigger="slo_burn",
+                            plan_sha=sha, generation=generation,
+                            dry_run=bool(cfg.dry_run))
+            lease.advance("warming")
+            if not cfg.dry_run:
+                lease._granted_t = float(self.clock())
+                if self.replica_factory is not None \
+                        and self.fleet is not None:
+                    built = self.replica_factory(lease, plan)
+                    engine, warm = (built if isinstance(built, tuple)
+                                    else (built, None))
+                    lease.replica = self.fleet.add_replica(engine)
+                    lease._warm = warm
+                if _obs.enabled():
+                    self._m()["leases"].labels(direction="grant").inc()
+        if not cfg.dry_run and _obs.enabled():
+            self._m()["chips_lent"].set(float(self.lent()))
+        self._decide("lease_grant", press, chips=[int(c) for c in chips],
+                     plan_sha=sha)
+        self._last_action_tick = self._tick
+        self._grant_streak = 0
+        self._ok_streak = 0
+        return "lease_grant"
+
+    def _reclaim(self, press: float) -> str:
+        cfg = self.config
+        active = [lease for lease in self.leases
+                  if lease.state in ("warming", "serving")]
+        # LIFO: the newest grants go home first — the longest-serving
+        # replica keeps its warmed cache, and the reclaim order is a
+        # pure function of the grant order (replayable)
+        picked = active[-min(cfg.chips_per_grant, len(active)):]
+        for lease in reversed(picked):
+            lease.advance("reclaiming")
+            if not cfg.dry_run and self.fleet is not None \
+                    and lease.replica is not None:
+                self.fleet.begin_reclaim(lease.replica)
+            _journal.record("lease_reclaim", lease_id=lease.lease_id,
+                            chip=lease.chip, from_role="serve",
+                            to_role="train", trigger="pressure_release",
+                            generation=lease.generation,
+                            dry_run=bool(cfg.dry_run))
+        self._replan(-len(picked), "lease_reclaim")
+        self._decide("lease_reclaim", press,
+                     lease_ids=[lease.lease_id
+                                for lease in reversed(picked)])
+        self._last_action_tick = self._tick
+        self._grant_streak = 0
+        self._ok_streak = 0
+        return "lease_reclaim"
+
+    # -- introspection --------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``/broker`` payload."""
+        by_state: dict = {}
+        for lease in self.leases:
+            by_state[lease.state] = by_state.get(lease.state, 0) + 1
+        return {
+            "enabled": self.config.enabled,
+            "dry_run": self.config.dry_run,
+            "config": dataclasses.asdict(self.config),
+            "tick": self._tick,
+            "train_step": self._train_step,
+            "train_world": self.train_world(),
+            "chips_lent": self.lent(),
+            "pressure": round(self.pressure(), 6),
+            "leases": [lease.as_dict() for lease in self.leases],
+            "leases_by_state": by_state,
+            "actions_total": self.actions_total,
+            "recent_actions": list(self.actions[-50:]),
+        }
+
+
+# ------------------------------------------------------ process seams
+
+_installed: Optional[CapacityBroker] = None
+
+
+def install(broker: Optional[CapacityBroker]
+            ) -> Optional[CapacityBroker]:
+    """Install ``broker`` process-wide (the ``/broker`` endpoint and
+    ad-hoc probes read it); returns the previous one.  ``None``
+    uninstalls."""
+    global _installed
+    prev = _installed
+    _installed = broker
+    return prev
+
+
+def get_broker() -> Optional[CapacityBroker]:
+    return _installed
+
+
+@contextlib.contextmanager
+def use(broker: CapacityBroker):
+    """Scoped :func:`install` — the previous broker is restored on
+    exit."""
+    prev = install(broker)
+    try:
+        yield broker
+    finally:
+        install(prev)

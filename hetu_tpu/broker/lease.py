@@ -1,0 +1,104 @@
+"""Lease records: the unit of chip movement in the elastic market.
+
+A :class:`Lease` is one chip changing hands between the training gang
+and the serving fleet, journaled at grant and reclaim and walked
+through a strict state machine::
+
+    offered -> warming -> serving -> reclaiming -> returned
+                  \\________________/
+                   (early reclaim: pressure released before warm-up
+                    finished — the chip goes straight home)
+
+``offered`` is the broker's decision (the plan is signed, the gang is
+asked to lend); ``warming`` is the replica catching up on the latest
+gated snapshot (PR 15's follower idiom — a lent chip never serves
+stale weights); ``serving`` is rankable fleet membership; ``reclaiming``
+is the drain (no new placements, in-flight requests finish);
+``returned`` is the chip back in the gang.  Reclaims run newest-first
+(LIFO) — the broker's :meth:`CapacityBroker.tick` enforces the order,
+the record keeps the evidence (``granted_tick``/``returned_tick``).
+
+This module is covered by the plan-determinism lint (tests/test_obs.py)
+like every broker file: no wall clocks, no ambient randomness, no
+unordered dict walks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Lease", "LeaseStateError", "LEASE_STATES"]
+
+LEASE_STATES = ("offered", "warming", "serving", "reclaiming", "returned")
+
+# legal transitions; everything else is a programming error the state
+# machine refuses loudly rather than journaling nonsense
+_TRANSITIONS = {
+    "offered": ("warming",),
+    "warming": ("serving", "reclaiming"),
+    "serving": ("reclaiming",),
+    "reclaiming": ("returned",),
+    "returned": (),
+}
+
+
+class LeaseStateError(ValueError):
+    """An illegal lease state transition."""
+
+
+@dataclasses.dataclass
+class Lease:
+    """One chip lent across the training/serving boundary."""
+
+    lease_id: int
+    chip: int                  # the gang rank lent (generation-stamped)
+    from_role: str             # "train" on a grant
+    to_role: str               # "serve" on a grant
+    trigger: str               # what decided it ("slo_burn", ...)
+    plan_sha: str              # the signed replan the grant rode on
+    generation: int            # gang generation at grant time
+    state: str = "offered"
+    replica: int | None = None  # fleet index once granted (live runs)
+    granted_tick: int | None = None
+    serving_tick: int | None = None
+    returned_tick: int | None = None
+
+    def advance(self, state: str, *, tick: int | None = None) -> "Lease":
+        """Move to ``state``, enforcing the machine above; stamps the
+        serving/returned ticks as evidence for the LIFO audit."""
+        if state not in LEASE_STATES:
+            raise LeaseStateError(f"unknown lease state {state!r}; one "
+                                  f"of {LEASE_STATES}")
+        if state not in _TRANSITIONS[self.state]:
+            raise LeaseStateError(
+                f"lease {self.lease_id}: illegal transition "
+                f"{self.state!r} -> {state!r}")
+        self.state = state
+        if state == "serving":
+            self.serving_tick = tick
+        elif state == "returned":
+            self.returned_tick = tick
+        return self
+
+    @property
+    def active(self) -> bool:
+        """Whether the chip is currently out of the gang's hands."""
+        return self.state in ("offered", "warming", "serving",
+                              "reclaiming")
+
+    def as_dict(self) -> dict:
+        """The ``/broker`` row (JSON-safe)."""
+        return {
+            "lease_id": self.lease_id,
+            "chip": self.chip,
+            "from_role": self.from_role,
+            "to_role": self.to_role,
+            "trigger": self.trigger,
+            "plan_sha": self.plan_sha,
+            "generation": self.generation,
+            "state": self.state,
+            "replica": self.replica,
+            "granted_tick": self.granted_tick,
+            "serving_tick": self.serving_tick,
+            "returned_tick": self.returned_tick,
+        }

@@ -531,6 +531,23 @@ class RuntimeController:
             # windows never un-observe), so a replay flips policies at
             # the same tick.  Engines that only ever see the default
             # tenant stay on the legacy global path below, bit for bit.
+            if st["shed_active"]:
+                # a burn latched the GLOBAL door before the first tenant
+                # was observed (a tenant request in flight at engage time
+                # flips multi_tenant when it resolves).  The scoped loop
+                # only ever manages per-tenant latches, and this path
+                # never runs the global release again — left alone the
+                # legacy latch strands every tenant shut forever.  Hand
+                # the latch over: release it here (memory pressure may
+                # still be holding the shared batcher latch, same rule
+                # as the release below) and let the scoped streaks
+                # re-engage per tenant if the burn is real.
+                st["shed_active"] = False
+                st["shed_streak"] = 0
+                st["ok_streak"] = 0
+                self._act("admission_release", "tenant_policy_switch")
+                if not self.config.dry_run and not st["mem_shed"]:
+                    engine.batcher.clear_shed()
             self._maybe_shed_tenants(engine, st)
             return
         pressure = float(engine.slo.shed_pressure())

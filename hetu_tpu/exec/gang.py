@@ -835,7 +835,7 @@ class ElasticGang:
                  lease_steps: int = 1,
                  partial: Optional["_partial.PartialReduceConfig"] = None,
                  goodput=None, numerics=None, controller=None,
-                 planner=None):
+                 planner=None, broker=None):
         if getattr(trainer, "_has_staged", False):
             raise ValueError(
                 "ElasticGang drives dense data-parallel trainers; staged "
@@ -858,6 +858,10 @@ class ElasticGang:
         self.last_partition: Optional[list] = None
         self.resume_report: list = []  # diagnoses from the last restore
         self._dead: set = set()
+        # ranks whose lease the capacity broker revoked (lend()): a
+        # subset of _dead so liveness/live_world treat them as gone, but
+        # the rescale journals reason="leased", not a death
+        self._lent: set = set()
         self._stalled_until: dict = {}
         self._last_beat = {w: 0 for w in range(self.world_size)}
         # a dedicated obs.goodput.GoodputMeter the gang bills in SIM-TIME
@@ -894,6 +898,14 @@ class ElasticGang:
         # every rescale — eviction becomes *planning*, not just
         # re-ranking.  None keeps the legacy behavior exactly.
         self.planner = planner
+        # elastic chip market (hetu_tpu/broker.CapacityBroker): the
+        # broker leases this gang's chips to the serving fleet (lend /
+        # rejoin) and observes committed steps through on_gang_step.
+        # The attach runs here because the broker is usually built
+        # first, before the gang exists to hand it.
+        self.broker = broker
+        if broker is not None:
+            broker.attach_gang(self)
         self.partial = partial
         self.reducer: Optional[_partial.PartialReducer] = None
         if partial is not None:
@@ -1010,7 +1022,8 @@ class ElasticGang:
             _obs_journal.record(
                 "worker_lost", rank=w, generation=self.generation,
                 step=step,
-                reason="dead" if w in self._dead else "lease_expired")
+                reason="leased" if w in self._lent
+                else "dead" if w in self._dead else "lease_expired")
             if _obs.enabled():
                 _gang_m()["lost"].inc()
                 _gang_m()["alive"].remove(worker=str(w))
@@ -1022,6 +1035,7 @@ class ElasticGang:
         self.generation += 1
         self.world_size = len(survivors)
         self._dead = set()
+        self._lent = set()
         self._stalled_until = {remap[o]: v for o, v in
                                self._stalled_until.items() if o in remap}
         self._pending_flips = {remap[o]: v for o, v in
@@ -1104,6 +1118,39 @@ class ElasticGang:
         shutil.rmtree(worker_dir(self.gang_dir, w), ignore_errors=True)
         return True
 
+    def lend(self, n: int = 1) -> list:
+        """Release the ``n`` highest live ranks to the capacity broker
+        (hetu_tpu/broker): checkpoint NOW, then revoke their leases so
+        the very next step's liveness check rescales the gang down with
+        ZERO replayed steps — the manifest written here is at the
+        current step, so the rescale's restore rewinds nowhere and the
+        RNG seqnum resumes exactly where an uninterrupted run would be.
+        That save-at-lend is what makes the post-lend loss trajectory
+        bitwise equal to an uninterrupted run (partition invariance
+        covers the world change itself).  Returns the lent ranks; the
+        broker hands them back through :meth:`rejoin`."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"lend needs n >= 1, got {n}")
+        live = [w for w in range(self.world_size) if w not in self._dead]
+        if len(live) - n < 1:
+            raise GangError(
+                f"cannot lend {n} of {len(live)} live workers — the "
+                f"gang must keep at least one")
+        self.save()
+        lent = live[-n:]
+        for w in lent:
+            # a lent rank is gone-but-not-dead: _dead drives liveness
+            # and live_world; _lent re-labels the eviction journal
+            self._dead.add(w)
+            self._lent.add(w)
+            # revoke outright (the quarantine idiom): eviction at the
+            # NEXT step, not after lease_steps of silence — a grant is
+            # a decision, not a timeout.  Storage stays: the shard is
+            # honest, the ring replica set must survive the restore.
+            self._last_beat[w] = -(10 ** 9)
+        return lent
+
     def rejoin(self, n: int = 1) -> None:
         """Grow the gang by ``n`` workers (preempted capacity coming
         back).  Joiners adopt the survivors' replicated state; the data
@@ -1179,6 +1226,8 @@ class ElasticGang:
         # save: a quarantine's storage drop must outlive this step's
         # shard writes so the rescale restore exercises the ring replica
         _controller.maybe_gang_step(self, s, metrics)
+        if self.broker is not None:
+            self.broker.on_gang_step(self, s)
         return metrics
 
     # -- numerics observability ---------------------------------------------

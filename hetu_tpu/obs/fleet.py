@@ -55,6 +55,10 @@ snapshot or the new one, never a torn file):
      profile store under the gang dir (workers merge-save into it
      through the exclusive-lock path) plus the fleet's
      ``perf_regression`` journal tail
+   - ``/fleet/broker``      elastic chip-market merge: summed
+     ``hetu_broker_*`` lease counters and chips lent, plus the fleet's
+     lease journal tail (``lease_grant`` / ``lease_reclaim`` /
+     ``broker_decision``) — the PR-19 capacity broker's audit surface
 """
 
 from __future__ import annotations
@@ -584,6 +588,33 @@ class FleetAggregator:
         out["remediation"] = events[-tail:] if tail else []
         return out
 
+    def broker(self, tail: int = 50) -> dict:
+        """Fleet-wide chip-market merge — the ``/fleet/broker``
+        payload: the lease counters SUM across workers (each lease is
+        a disjoint event), ``chips_lent`` sums too (chips out anywhere
+        are chips the gang lacks), and the trailing lease journal
+        (``lease_grant`` / ``lease_reclaim`` / ``broker_decision``)
+        rides along with the publishing rank under ``publisher`` — the
+        controller-merge convention."""
+        out: dict = {"workers": len(self.snapshots)}
+        m = self.merged("hetu_broker_leases_total")
+        out["leases"] = ({k[0]: v for k, v in m["children"].items()}
+                         if m is not None else {})
+        m = self.merged("hetu_broker_chips_lent")
+        out["chips_lent"] = (sum(m["children"].values())
+                             if m is not None else 0.0)
+        events = []
+        for rank in sorted(self.snapshots):
+            events.extend(
+                {**e, "publisher": rank}
+                for e in self.snapshots[rank].get("journal", [])
+                if e.get("kind") in ("lease_grant", "lease_reclaim",
+                                     "broker_decision"))
+        events.sort(key=lambda e: (e.get("seq", 0), e["publisher"]))
+        tail = max(int(tail), 0)
+        out["leases_journal"] = events[-tail:] if tail else []
+        return out
+
     def memory(self, tail: int = 50) -> dict:
         """Fleet-wide memory-ledger merge — the ``/fleet/memory``
         payload: the ``hetu_memledger_*`` byte gauges SUM across workers
@@ -744,6 +775,13 @@ def fleet_routes(aggregator: FleetAggregator,
         return (json.dumps(aggregator.memory(tail)).encode(),
                 "application/json")
 
+    def broker(q, b):
+        aggregator.refresh()
+        tail = int(q.get("n", ["50"])[0])
+        return (json.dumps(aggregator.broker(tail)).encode(),
+                "application/json")
+
+    routes.add("GET", "/fleet/broker", broker)
     routes.add("GET", "/fleet/memory", memory)
     routes.add("GET", "/fleet/calibration", calibration)
     routes.add("GET", "/fleet/controller", controller)

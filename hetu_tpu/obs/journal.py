@@ -230,6 +230,17 @@ EVENT_KINDS = {
     "plan_emit": frozenset({"sha256", "candidates", "slo_feasible"}),
     "plan_apply": frozenset({"sha256", "trigger", "dry_run"}),
     "calibration_fallback": frozenset({"constants", "key"}),
+    # elastic chip market (PR 19): the capacity broker's journaled
+    # leases between the training gang and the serving fleet.  The
+    # lease records carry dry_run like plan_apply — a dry-run broker
+    # journals the identical decision stream while actuating nothing.
+    "lease_grant": frozenset(
+        {"lease_id", "chip", "from_role", "to_role", "trigger",
+         "plan_sha", "generation", "dry_run"}),
+    "lease_reclaim": frozenset(
+        {"lease_id", "chip", "from_role", "to_role", "trigger",
+         "generation", "dry_run"}),
+    "broker_decision": frozenset({"action", "pressure", "dry_run"}),
 }
 
 

@@ -259,6 +259,19 @@ def telemetry_routes(registry: Optional[_registry.MetricsRegistry] = None,
 
     routes.add("GET", "/controller", controller_view)
 
+    def broker_view(q, b):
+        """``/broker``: the process-wide installed
+        :class:`~hetu_tpu.broker.CapacityBroker`'s policy, lease table
+        (with states), chips currently lent, live pressure, and recent
+        decisions — the chip-market audit surface.  Lazy import: the
+        scrape path must not pull the broker stack until asked."""
+        from hetu_tpu.broker import get_broker
+        br = get_broker()
+        body = br.summary() if br is not None else {"installed": False}
+        return json.dumps(body).encode(), "application/json"
+
+    routes.add("GET", "/broker", broker_view)
+
     def calibration_view(q, b):
         """``/calibration``: the process-wide installed
         :class:`~hetu_tpu.obs.calibration.ProfileStore`'s summary —

@@ -139,6 +139,21 @@ class PlanApplier:
                    trigger=trigger)
         return plan
 
+    def replan_for_lease(self, gang=None, *, serve_devices: int,
+                         trigger: str = "lease_grant",
+                         dry_run: bool = None) -> Plan:
+        """Re-plan when the capacity broker (hetu_tpu/broker) moves
+        chips between roles: the total inventory is UNCHANGED — the
+        serving carve-out grows (a grant) or shrinks (a reclaim)
+        inside it, and the training side gets whatever is left.  The
+        emitted plan's sha rides on the lease record, so the journal
+        ties every chip movement to the signed deployment it served."""
+        plan = self.planner.replan(serve_devices=int(serve_devices),
+                                   trigger=trigger)
+        apply_plan(plan, gang=gang, dry_run=self._dry(dry_run),
+                   trigger=trigger)
+        return plan
+
     def replan_for_engine(self, engine, *, trigger: str = "slo_burn",
                           dry_run: bool = None) -> Plan:
         """Re-plan under serving distress.  The decision is journaled

@@ -51,7 +51,8 @@ from hetu_tpu.serve.batcher import (AdmissionQueueFull, AdmissionShed,
 from hetu_tpu.serve.engine import RequestHandle, ServingEngine
 from hetu_tpu.serve.kv_cache import (DoubleFree, KVCachePool, OutOfPages,
                                      PageTable)
-from hetu_tpu.serve.loadgen import (LoadItem, generate_load,
+from hetu_tpu.serve.loadgen import (LoadItem, generate_diurnal_load,
+                                    generate_load,
                                     generate_multitenant_load,
                                     generate_prefill_burst_load,
                                     generate_shared_prefix_load)
@@ -74,7 +75,8 @@ __all__ = [
     "ServingServer", "serve_engine",
     "FleetServingServer", "serve_fleet_router",
     "generate_load", "generate_shared_prefix_load",
-    "generate_prefill_burst_load", "generate_multitenant_load", "LoadItem",
+    "generate_prefill_burst_load", "generate_multitenant_load",
+    "generate_diurnal_load", "LoadItem",
     "PrefixTrie", "PrefixSharer", "SpeculativeDecoder", "FleetRouter",
     "DisaggRouter", "MigrationRecord", "MigrationIntegrityError",
     "MigrationFileFabric",

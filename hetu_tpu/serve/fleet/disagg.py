@@ -95,27 +95,54 @@ class DisaggRouter(FleetRouter):
             if e.role == "prefill":
                 e.migrate_out = self._migrate_out
 
+    # -- mid-flight membership ----------------------------------------------
+
+    def add_replica(self, engine, *, warming: bool = True) -> int:
+        """A broker-granted worker joins the role pools too: the index
+        lands in ``_prefill_idx``/``_decode_idx`` by role (ranking still
+        skips it until :meth:`mark_serving`), the assignment is
+        journaled like a construction-time worker's, and a prefill
+        worker gets the migration hook installed."""
+        i = super().add_replica(engine, warming=warming)
+        _journal.record("role_assign", replica=i, role=engine.role)
+        if engine.role in ("prefill", "colocated"):
+            self._prefill_idx.append(i)
+        if engine.role in ("decode", "colocated"):
+            self._decode_idx.append(i)
+        if engine.role == "prefill":
+            engine.migrate_out = self._migrate_out
+        return i
+
     # -- placement ----------------------------------------------------------
 
     def _rank(self, prompt) -> list:
         """Prefill-side ranking: the FleetRouter ordering (-affinity,
-        shed pressure, load factor, index) restricted to the
-        prefill-capable pool."""
-        return sorted(
+        shed pressure, load factor, index) restricted to the SERVING
+        members of the prefill-capable pool."""
+        ranked = sorted(
             (-(self.engines[i].sharer.match_tokens(prompt)
                if self.engines[i].sharer is not None else 0),
              self.engines[i].slo.shed_pressure(),
              self.engines[i].batcher.load_factor(), i)
-            for i in self._prefill_idx)
+            for i in self._prefill_idx
+            if self._membership[i] == "serving")
+        if not ranked:
+            raise RuntimeError("no serving prefill-capable replica — "
+                               "every one is warming, reclaiming or "
+                               "retired")
+        return ranked
 
     def _rank_decode(self) -> list:
         """Decode-side ranking: shed pressure, then load factor, then
         index — migrations have no prompt affinity (their KV travels
-        with them), so who is drowning is the whole signal."""
+        with them), so who is drowning is the whole signal.  Restricted
+        to SERVING members: a reclaiming decode worker finishes the
+        streams it has but takes no new migrations."""
         return sorted(
             (self.engines[i].slo.shed_pressure(),
              self.engines[i].batcher.load_factor(), i)
-            for i in self._decode_idx)
+            for i in self._decode_idx
+            if self._membership[i] == "serving")
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
                deadline_s=None, tenant=None):

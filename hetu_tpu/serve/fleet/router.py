@@ -29,6 +29,20 @@ The router is in-process and synchronous (the replicas' scheduler
 threads or a deterministic ``step()`` driver do the work) — the
 disaggregated prefill/decode tier (ROADMAP item 2) will swap the
 in-process list for gang-dir transport without changing this policy.
+
+**Mid-flight membership** (hetu_tpu/broker): the replica set is no
+longer fixed at construction.  Each replica carries a membership state
+— ``serving`` (rankable), ``warming`` (just granted by the capacity
+broker, catching up on the latest gated snapshot: stepped but never
+ranked, so no request ever lands on stale weights), ``reclaiming``
+(lease being called back: never ranked, still stepped, so its in-flight
+requests DRAIN rather than drop), ``retired`` (lease returned: the
+entry stays in ``engines`` forever so replica indices in the placement
+log and journal stay stable across the whole episode).  ``add_replica``
+/ ``mark_serving`` / ``begin_reclaim`` / ``retire_replica`` walk a
+replica through those states; ``retire_replica`` refuses while the
+engine still holds work — the drain guarantee is structural, not a
+broker courtesy.
 """
 
 from __future__ import annotations
@@ -40,7 +54,11 @@ import numpy as np
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.obs import registry as _obs
 
-__all__ = ["FleetRouter"]
+__all__ = ["FleetRouter", "MEMBERSHIP_STATES"]
+
+# the replica-membership lifecycle (see module docstring): only
+# "serving" is rankable; "retired" entries persist for index stability
+MEMBERSHIP_STATES = ("serving", "warming", "reclaiming", "retired")
 
 _router_metrics = None
 
@@ -75,18 +93,86 @@ class FleetRouter:
             max_retries = len(engines) - 1 if env is None else int(env)
         self.max_retries = int(max_retries)
         self.placements: list = []  # the deterministic placement log
+        # membership state per replica, parallel to ``engines`` — the
+        # construction-time set starts serving (the pre-broker fleet,
+        # bit for bit); broker-granted replicas enter warming
+        self._membership = ["serving"] * len(self.engines)
+
+    # -- mid-flight membership ----------------------------------------------
+
+    @property
+    def membership(self) -> list:
+        """Per-replica membership states (a copy), parallel to
+        ``engines``."""
+        return list(self._membership)
+
+    def serving_indices(self) -> list:
+        """The rankable replica set, in index order."""
+        return [i for i, s in enumerate(self._membership)
+                if s == "serving"]
+
+    def add_replica(self, engine, *, warming: bool = True) -> int:
+        """Append a replica mid-flight; returns its (stable) index.
+        ``warming`` (the default) keeps it out of ranking until
+        :meth:`mark_serving` — a lent chip must finish catching up on
+        the latest gated snapshot before any request can land on it."""
+        self.engines.append(engine)
+        self._membership.append("warming" if warming else "serving")
+        return len(self.engines) - 1
+
+    def mark_serving(self, replica: int) -> None:
+        """Warm-up complete: the replica joins the rankable set."""
+        if self._membership[replica] not in ("warming", "serving"):
+            raise ValueError(
+                f"replica {replica} is {self._membership[replica]!r}, "
+                f"not warming — cannot mark serving")
+        self._membership[replica] = "serving"
+
+    def begin_reclaim(self, replica: int) -> None:
+        """Start draining a replica: it leaves the rankable set
+        immediately (no new placements) but keeps stepping, so its
+        in-flight requests finish rather than drop."""
+        if self._membership[replica] not in ("serving", "warming"):
+            raise ValueError(
+                f"replica {replica} is {self._membership[replica]!r} — "
+                f"cannot begin reclaim")
+        self._membership[replica] = "reclaiming"
+
+    def retire_replica(self, replica: int) -> None:
+        """Finish a reclaim.  Refuses while the engine still holds
+        queued or active work — retirement must never drop an in-flight
+        request (the broker polls idleness and retries next tick).  The
+        entry stays in ``engines`` so every later replica index, and the
+        whole placement log, is unaffected."""
+        if self._membership[replica] != "reclaiming":
+            raise ValueError(
+                f"replica {replica} is {self._membership[replica]!r}, "
+                f"not reclaiming — cannot retire")
+        if not self.engines[replica].batcher.idle:
+            raise RuntimeError(
+                f"replica {replica} is still draining "
+                f"(queue_len={self.engines[replica].batcher.queue_len}, "
+                f"active={self.engines[replica].batcher.active_slots}) — "
+                f"retiring now would drop in-flight requests")
+        self._membership[replica] = "retired"
 
     # -- placement ----------------------------------------------------------
 
     def _rank(self, prompt) -> list:
-        """Replicas best-first: (-affinity, shed_pressure, load_factor,
-        index) ascending — all four components deterministic under the
-        engines' injected clocks."""
-        return sorted(
+        """SERVING replicas best-first: (-affinity, shed_pressure,
+        load_factor, index) ascending — all four components
+        deterministic under the engines' injected clocks.  Warming /
+        reclaiming / retired replicas are never candidates."""
+        ranked = sorted(
             (-(e.sharer.match_tokens(prompt) if e.sharer is not None
                else 0),
              e.slo.shed_pressure(), e.batcher.load_factor(), i)
-            for i, e in enumerate(self.engines))
+            for i, e in enumerate(self.engines)
+            if self._membership[i] == "serving")
+        if not ranked:
+            raise RuntimeError("no serving replica in the fleet — every "
+                               "member is warming, reclaiming or retired")
+        return ranked
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
                deadline_s: float | None = None,
@@ -138,13 +224,17 @@ class FleetRouter:
     # -- fleet drivers ------------------------------------------------------
 
     def step(self) -> int:
-        """One deterministic fleet tick: step every replica in index
-        order; returns tokens produced fleet-wide."""
-        return sum(e.step() for e in self.engines)
+        """One deterministic fleet tick: step every non-retired replica
+        in index order (reclaiming replicas keep stepping — that IS the
+        drain); returns tokens produced fleet-wide."""
+        return sum(e.step() for e, s in zip(self.engines, self._membership)
+                   if s != "retired")
 
     @property
     def idle(self) -> bool:
-        return all(e.batcher.idle for e in self.engines)
+        return all(e.batcher.idle
+                   for e, s in zip(self.engines, self._membership)
+                   if s != "retired")
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         for _ in range(max_steps):
@@ -181,6 +271,7 @@ class FleetRouter:
             pool = e.pool.stats()
             replicas.append({
                 "replica": i,
+                "membership": self._membership[i],
                 "queue_len": e.batcher.queue_len,
                 "active_slots": e.batcher.active_slots,
                 "num_slots": e.batcher.num_slots,
@@ -194,9 +285,13 @@ class FleetRouter:
                 "prefix": (None if e.sharer is None else e.sharer.stats()),
                 "speculative": (None if e.spec is None else e.spec.stats()),
             })
+        member_counts: dict = {}
+        for s in self._membership:
+            member_counts[s] = member_counts.get(s, 0) + 1
         return {
             "replicas": replicas,
             "num_replicas": len(self.engines),
+            "membership": member_counts,
             "placements": len(self.placements),
             "placements_by_reason": reasons,
             "max_retries": self.max_retries,

@@ -16,7 +16,8 @@ import dataclasses
 import numpy as np
 
 __all__ = ["LoadItem", "generate_load", "generate_shared_prefix_load",
-           "generate_prefill_burst_load", "generate_multitenant_load"]
+           "generate_prefill_burst_load", "generate_multitenant_load",
+           "generate_diurnal_load", "DEFAULT_DIURNAL_PHASES"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +40,10 @@ class LoadItem:
     # the default tenant) — drives the WFQ front door and lets the
     # flood A/B attribute sheds per tenant from the trace spec alone
     tenant: str | None = None
+    # diurnal traces: which named phase (off_peak/ramp/peak/decay) this
+    # arrival belongs to — lets the broker acceptance attribute grants
+    # and reclaims to the traffic shape from the trace spec alone
+    phase: str | None = None
 
 
 def generate_load(seed: int, n_requests: int, *, vocab: int,
@@ -185,4 +190,85 @@ def generate_multitenant_load(seed: int, n_requests: int, *, vocab: int,
             max_new_tokens=int(rng.integers(nlo, nhi + 1)),
             deadline_s=spec.get("deadline_s", deadline_s),
             tenant=str(spec["id"])))
+    return out
+
+
+# one synthetic day in four phases: name, arrival-rate multiplier over
+# ``peak_gap_s`` (1.0 = the peak gap itself), and share of the request
+# budget spent in the phase.  The 5x off-peak:peak rate swing is the
+# diurnal shape the capacity broker (hetu_tpu/broker) follows.
+DEFAULT_DIURNAL_PHASES = (
+    {"name": "off_peak", "rate": 0.2, "share": 0.2},
+    {"name": "ramp", "rate": 0.6, "share": 0.2},
+    {"name": "peak", "rate": 1.0, "share": 0.4},
+    {"name": "decay", "rate": 0.35, "share": 0.2},
+)
+
+
+def generate_diurnal_load(seed: int, n_requests: int, *, vocab: int,
+                          phases=None, peak_gap_s: float = 0.002,
+                          tenants=None,
+                          prompt_len=(2, 24), max_new=(1, 12),
+                          deadline_s: float | None = None) -> list:
+    """One seeded synthetic day: the trace walks ``phases`` in order
+    (default :data:`DEFAULT_DIURNAL_PHASES` — off-peak → ramp → peak →
+    decay), each phase spending its ``share`` of the request budget at
+    exponential-gap arrivals of mean ``peak_gap_s / rate`` (``rate`` is
+    the multiplier over the peak arrival rate, so ``rate=1.0`` is peak
+    traffic and ``rate=0.2`` is a 5x-quieter night).  A phase may carry
+    its own ``tenants`` mix (the :func:`generate_multitenant_load` spec
+    dicts) overriding the trace-wide ``tenants`` — a real day shifts
+    WHO is submitting, not just how fast; ``None`` leaves the phase
+    untenanted.  Every item is stamped with its phase name.  One shared
+    RNG stream drives gaps, tenant draws, and shapes across all phases
+    — same seed, same trace, bit for bit (unit-tested)."""
+    phases = [dict(p) for p in (DEFAULT_DIURNAL_PHASES
+                                if phases is None else phases)]
+    if not phases:
+        raise ValueError("need at least one phase")
+    shares = np.array([float(p.get("share", 1.0)) for p in phases])
+    if (shares < 0).any() or shares.sum() <= 0:
+        raise ValueError(f"phase shares must be >= 0 with a positive "
+                         f"sum, got {shares.tolist()}")
+    for p in phases:
+        if float(p.get("rate", 1.0)) <= 0:
+            raise ValueError(f"phase {p.get('name')!r} needs a positive "
+                             f"rate, got {p.get('rate')}")
+    shares = shares / shares.sum()
+    # deterministic integer budget split: floors first, the remainder to
+    # the earliest phases (largest-remainder would need a tie-break;
+    # index order IS the tie-break)
+    counts = [int(n_requests * s) for s in shares]
+    for i in range(n_requests - sum(counts)):
+        counts[i % len(counts)] += 1
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for p, count in zip(phases, counts):
+        name = str(p.get("name", "phase"))
+        gap = peak_gap_s / float(p.get("rate", 1.0))
+        specs = p.get("tenants", tenants)
+        if specs is not None:
+            specs = [dict(s) for s in specs]
+            t_shares = np.array([float(s.get("share", 1.0))
+                                 for s in specs])
+            if not specs or (t_shares < 0).any() or t_shares.sum() <= 0:
+                raise ValueError(
+                    f"phase {name!r}: tenant shares must be >= 0 with "
+                    f"a positive sum")
+            t_shares = t_shares / t_shares.sum()
+        for _ in range(count):
+            t += float(rng.exponential(gap))
+            spec = (specs[int(rng.choice(len(specs), p=t_shares))]
+                    if specs is not None else {})
+            lo, hi = spec.get("prompt_len", prompt_len)
+            nlo, nhi = spec.get("max_new", max_new)
+            plen = int(rng.integers(lo, hi + 1))
+            out.append(LoadItem(
+                submit_at=t,
+                prompt=tuple(int(x)
+                             for x in rng.integers(0, vocab, plen)),
+                max_new_tokens=int(rng.integers(nlo, nhi + 1)),
+                deadline_s=spec.get("deadline_s", deadline_s),
+                tenant=(str(spec["id"]) if "id" in spec else None),
+                phase=name))
     return out

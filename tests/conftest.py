@@ -153,6 +153,14 @@ def pytest_configure(config):
                    "calibration-fallback contract stay in tier-1 — "
                    "full-grid search sweeps ride the slow tier")
     config.addinivalue_line(
+        "markers", "broker: capacity-broker tests (broker.lease state "
+                   "machine, broker.broker hysteresis/cooldown/dry-run "
+                   "loop, the gang lend/rejoin seam, fleet membership "
+                   "states, the diurnal loadgen satellite, and the "
+                   "seeded brokered-vs-static-splits acceptance — all "
+                   "tier-1: episodes run minutes of VIRTUAL time in "
+                   "seconds of wall time)")
+    config.addinivalue_line(
         "markers", "memobs: memory-observability tests (obs.memledger "
                    "exact attribution, the KV page-class partition, the "
                    "alloc/free leak watchdog, /memory + /fleet/memory, "

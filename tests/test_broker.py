@@ -1,0 +1,806 @@
+"""Elastic chip market tests (hetu_tpu/broker + the gang lend/rejoin
+seam + fleet membership states + the diurnal loadgen satellite).
+
+Tier-1: the Lease state machine, the diurnal trace determinism suite,
+mid-flight fleet membership (a router never routes to warming or
+reclaiming replicas, and a reclaiming replica DRAINS — in-flight
+requests complete, never drop), the gang's save-at-lend zero-replay
+contract (post-lend losses bitwise equal to an uninterrupted run), the
+broker unit loop (hysteresis, sustain, cooldown, LIFO reclaim, the
+min_train_world floor, dry-run parity, metrics, /broker), and the
+seeded diurnal acceptance: a brokered fleet jointly beats BOTH static
+splits on (SLO violations, training goodput), the whole episode replays
+bitwise across same-seed runs, and the gang's loss trajectory matches
+an uninterrupted run at equal total steps.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hetu_tpu import obs
+from hetu_tpu.broker import (LEASE_STATES, BrokerConfig, CapacityBroker,
+                             Lease, LeaseStateError, broker_families,
+                             get_broker)
+from hetu_tpu.broker import use as broker_use
+from hetu_tpu.broker.episode import run_broker_episode
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec import Trainer
+from hetu_tpu.exec.gang import ElasticGang, GangError
+from hetu_tpu.models import MLP
+from hetu_tpu.models.gpt import GPT, GPTConfig
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.optim import SGDOptimizer
+from hetu_tpu.ops import softmax_cross_entropy_sparse
+from hetu_tpu.serve import ServingEngine, generate_diurnal_load
+from hetu_tpu.serve.fleet.disagg import DisaggRouter
+from hetu_tpu.serve.fleet.router import FleetRouter
+from hetu_tpu.serve.loadgen import DEFAULT_DIURNAL_PHASES
+
+pytestmark = pytest.mark.broker
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    set_random_seed(0)
+    return GPT(CFG)
+
+
+class VClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_engine(model, clock, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("seed", 0)
+    kw.setdefault("queue_depth", 64)
+    return ServingEngine(model, clock=clock, **kw)
+
+
+def drain(router, clock, max_steps: int = 5000) -> int:
+    for i in range(max_steps):
+        if router.idle:
+            return i
+        router.step()
+        clock.t += 0.001
+    raise AssertionError(f"not idle after {max_steps} ticks")
+
+
+# ------------------------------------------------- lease state machine
+
+class TestLease:
+    def mk(self, **kw):
+        kw.setdefault("lease_id", 0)
+        kw.setdefault("chip", 3)
+        kw.setdefault("from_role", "train")
+        kw.setdefault("to_role", "serve")
+        kw.setdefault("trigger", "slo_burn")
+        kw.setdefault("plan_sha", "abc")
+        kw.setdefault("generation", 2)
+        return Lease(**kw)
+
+    def test_happy_path(self):
+        lease = self.mk()
+        assert lease.state == "offered" and lease.active
+        lease.advance("warming")
+        lease.advance("serving", tick=5)
+        assert lease.serving_tick == 5 and lease.active
+        lease.advance("reclaiming")
+        lease.advance("returned", tick=9)
+        assert lease.returned_tick == 9 and not lease.active
+        assert lease.state == LEASE_STATES[-1]
+
+    def test_early_reclaim_from_warming(self):
+        lease = self.mk()
+        lease.advance("warming")
+        lease.advance("reclaiming")  # pressure released mid-warm-up
+        lease.advance("returned")
+        assert lease.state == "returned"
+
+    def test_invalid_transitions_raise(self):
+        lease = self.mk()
+        with pytest.raises(LeaseStateError):
+            lease.advance("serving")  # offered cannot skip warming
+        lease.advance("warming")
+        with pytest.raises(LeaseStateError):
+            lease.advance("offered")  # no going back
+        lease.advance("serving")
+        lease.advance("reclaiming")
+        lease.advance("returned")
+        for s in LEASE_STATES:
+            with pytest.raises(LeaseStateError):
+                lease.advance(s)  # returned is terminal
+        with pytest.raises(LeaseStateError):
+            self.mk().advance("not_a_state")
+
+    def test_as_dict(self):
+        d = self.mk().as_dict()
+        assert d["lease_id"] == 0 and d["chip"] == 3
+        assert d["from_role"] == "train" and d["to_role"] == "serve"
+        assert d["state"] == "offered" and d["plan_sha"] == "abc"
+        assert d["generation"] == 2
+
+
+# ------------------------------------- satellite: diurnal load generator
+
+class TestDiurnalLoad:
+    def test_bitwise_determinism(self):
+        a = generate_diurnal_load(7, 60, vocab=97)
+        b = generate_diurnal_load(7, 60, vocab=97)
+        assert a == b
+        assert generate_diurnal_load(8, 60, vocab=97) != a
+
+    def test_phase_walk_and_monotone_arrivals(self):
+        trace = generate_diurnal_load(1, 80, vocab=97)
+        names = [p["name"] for p in DEFAULT_DIURNAL_PHASES]
+        seen = [it.phase for it in trace]
+        # phases appear in spec order, contiguously
+        assert [n for n in dict.fromkeys(seen)] == names
+        ts = [it.submit_at for it in trace]
+        assert all(b >= a for a, b in zip(ts, ts[1:]))
+
+    def test_budget_split_is_exact(self):
+        trace = generate_diurnal_load(2, 81, vocab=97)
+        counts = {}
+        for it in trace:
+            counts[it.phase] = counts.get(it.phase, 0) + 1
+        # shares .2/.2/.4/.2 of 81: floors 16/16/32/16 = 80, the one
+        # leftover goes to the earliest phase
+        assert counts == {"off_peak": 17, "ramp": 16, "peak": 32,
+                          "decay": 16}
+        assert sum(counts.values()) == 81
+
+    def test_gap_follows_rate(self):
+        trace = generate_diurnal_load(3, 400, vocab=97,
+                                      peak_gap_s=0.01)
+        by_phase = {}
+        prev_t = 0.0
+        for it in trace:
+            by_phase.setdefault(it.phase, []).append(
+                it.submit_at - prev_t)
+            prev_t = it.submit_at
+        # off-peak (rate .2) arrivals are ~5x sparser than peak (rate 1)
+        assert np.mean(by_phase["off_peak"]) > \
+            2.5 * np.mean(by_phase["peak"])
+
+    def test_tenant_mix(self):
+        tenants = [{"id": "interactive", "share": 0.7,
+                    "deadline_s": 0.3},
+                   {"id": "batch", "share": 0.3, "max_new": (4, 8)}]
+        trace = generate_diurnal_load(4, 200, vocab=97,
+                                      tenants=tenants)
+        ids = [it.tenant for it in trace]
+        assert set(ids) == {"interactive", "batch"}
+        frac = ids.count("interactive") / len(ids)
+        assert 0.55 < frac < 0.85  # seeded draw around the 0.7 share
+        for it in trace:
+            if it.tenant == "interactive":
+                assert it.deadline_s == 0.3
+            else:
+                assert it.deadline_s is None
+                assert 4 <= it.max_new_tokens <= 8
+
+    def test_per_phase_tenant_override(self):
+        phases = [{"name": "night", "rate": 0.2, "share": 0.5},
+                  {"name": "day", "rate": 1.0, "share": 0.5,
+                   "tenants": [{"id": "t0"}]}]
+        trace = generate_diurnal_load(5, 40, vocab=97, phases=phases)
+        for it in trace:
+            if it.phase == "night":
+                assert it.tenant is None  # no trace-wide mix to inherit
+            else:
+                assert it.tenant == "t0"
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="at least one phase"):
+            generate_diurnal_load(0, 10, vocab=97, phases=[])
+        with pytest.raises(ValueError, match="shares must be >= 0"):
+            generate_diurnal_load(0, 10, vocab=97, phases=[
+                {"name": "a", "share": -1.0}])
+        with pytest.raises(ValueError, match="positive rate"):
+            generate_diurnal_load(0, 10, vocab=97, phases=[
+                {"name": "a", "rate": 0.0}])
+        with pytest.raises(ValueError, match="tenant shares"):
+            generate_diurnal_load(0, 10, vocab=97,
+                                  tenants=[{"id": "a", "share": 0.0}])
+
+
+# --------------------------- satellite: mid-flight fleet membership
+
+class TestFleetMembership:
+    def test_warming_replica_is_never_routed(self, model):
+        clock = VClock()
+        router = FleetRouter([make_engine(model, clock)])
+        idx = router.add_replica(make_engine(model, clock))
+        assert router.membership == ["serving", "warming"]
+        assert router.serving_indices() == [0]
+        hs = [router.submit(list(range(2, 10)), 2) for _ in range(6)]
+        assert all(p["replica"] == 0 for p in router.placements)
+        router.mark_serving(idx)
+        assert router.serving_indices() == [0, 1]
+        router.submit(list(range(2, 10)), 2)
+        drain(router, clock)
+        assert all(h.status == "completed" for h in hs)
+
+    def test_reclaiming_replica_drains_and_never_drops(self, model):
+        clock = VClock()
+        router = FleetRouter([make_engine(model, clock),
+                              make_engine(model, clock)])
+        # land one request on each replica, then reclaim replica 1
+        # while its request is still in flight
+        h0 = router.submit(list(range(2, 10)), 4)
+        h1 = router.submit(list(range(12, 20)), 4)
+        inflight = {p["replica"] for p in router.placements}
+        assert inflight == {0, 1}
+        router.begin_reclaim(1)
+        assert router.membership[1] == "reclaiming"
+        # retiring mid-drain must refuse — that is the never-drop
+        # guarantee, structurally
+        with pytest.raises(RuntimeError, match="draining"):
+            router.retire_replica(1)
+        # new work only lands on the serving replica
+        before = len(router.placements)
+        hs = [router.submit(list(range(3, 9)), 2) for _ in range(4)]
+        assert all(p["replica"] == 0
+                   for p in router.placements[before:])
+        drain(router, clock)
+        assert h0.status == h1.status == "completed"
+        assert all(h.status == "completed" for h in hs)
+        router.retire_replica(1)  # drained now: retire succeeds
+        assert router.membership[1] == "retired"
+
+    def test_no_serving_replica_raises(self, model):
+        clock = VClock()
+        router = FleetRouter([make_engine(model, clock)])
+        router.begin_reclaim(0)
+        with pytest.raises(RuntimeError, match="no serving replica"):
+            router.submit(list(range(2, 10)), 2)
+
+    def test_membership_transitions_guarded(self, model):
+        clock = VClock()
+        router = FleetRouter([make_engine(model, clock),
+                              make_engine(model, clock)])
+        router.begin_reclaim(1)
+        with pytest.raises(ValueError):
+            router.mark_serving(1)  # reclaiming cannot re-serve
+        router.retire_replica(1)
+        with pytest.raises(ValueError):
+            router.begin_reclaim(1)  # retired is terminal
+        with pytest.raises(ValueError):
+            router.retire_replica(0)  # serving cannot retire directly
+
+    def test_stats_expose_membership(self, model):
+        clock = VClock()
+        router = FleetRouter([make_engine(model, clock)])
+        router.add_replica(make_engine(model, clock))
+        st = router.stats()
+        assert st["membership"] == {"serving": 1, "warming": 1}
+        assert [r["membership"] for r in st["replicas"]] == \
+            ["serving", "warming"]
+
+    def test_disagg_decode_reclaim_finishes_streams(self, model):
+        clock = VClock()
+        engines = [make_engine(model, clock, role="prefill",
+                               num_slots=4),
+                   make_engine(model, clock, role="decode"),
+                   make_engine(model, clock, role="decode")]
+        router = DisaggRouter(engines)
+        hs = [router.submit(list(range(2 + i, 10 + i)), 4)
+              for i in range(4)]
+        for _ in range(2):
+            router.step()
+            clock.t += 0.001
+        # reclaim one decode worker mid-flight: it takes no NEW
+        # migrations but finishes the streams it carries
+        router.begin_reclaim(2)
+        before = len(router.migrations)
+        hs += [router.submit(list(range(20 + i, 28 + i)), 4)
+               for i in range(4)]
+        drain(router, clock)
+        assert all(h.status == "completed" for h in hs)
+        assert all(m["dst"] != 2 for m in router.migrations[before:])
+        assert len(router.migrations) > before
+        router.retire_replica(2)
+        assert router.membership == ["serving", "serving", "retired"]
+
+    def test_disagg_add_replica_extends_role_pool(self, model):
+        clock = VClock()
+        engines = [make_engine(model, clock, role="prefill"),
+                   make_engine(model, clock, role="decode")]
+        router = DisaggRouter(engines)
+        idx = router.add_replica(make_engine(model, clock,
+                                             role="decode"))
+        assert idx == 2 and router.membership[idx] == "warming"
+        assert idx in router._decode_idx
+        router.mark_serving(idx)
+        hs = [router.submit(list(range(2 + i, 12 + i)), 4)
+              for i in range(4)]
+        drain(router, clock)
+        assert all(h.status == "completed" for h in hs)
+
+
+# ----------------------------------------- the gang lend/rejoin seam
+
+def make_trainer():
+    set_random_seed(0)
+    mlp = MLP((8, 16, 3))
+
+    def loss_fn(m, batch, key):
+        logits = m(batch["x"])
+        return (softmax_cross_entropy_sparse(logits,
+                                             batch["y"]).mean(), {})
+
+    return Trainer(mlp, SGDOptimizer(0.1), loss_fn, donate=False)
+
+
+def make_gang(tmpdir, world=4, seed=0):
+    def data_fn(s):
+        rng = np.random.default_rng(seed * 100003 + s)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        return {"x": x, "y": (x[:, 0] > 0).astype(np.int32)}
+
+    return ElasticGang(make_trainer(), str(tmpdir), world_size=world,
+                       data_fn=data_fn, global_batch_size=16,
+                       seed=seed, save_every=2)
+
+
+class TestGangLend:
+    def test_lend_shrinks_with_zero_replay(self, tmp_path):
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr):
+            g = make_gang(tmp_path / "g")
+            g.run_until(3)
+            lent = g.lend(1)
+            assert lent == [3] and g.live_world == 3
+            g.run_until(4)  # the next step rescales, then steps
+        assert g.world_size == 3 and g.live_world == 3
+        lost = [e for e in jr.of_kind("worker_lost") if e["rank"] == 3]
+        assert lost and lost[-1]["reason"] == "leased"
+        rescale = jr.of_kind("gang_rescale")[-1]
+        # save-at-lend: the restore resumes at the lend step — nothing
+        # is replayed
+        assert rescale["resumed_step"] == 3
+        assert rescale["new_world"] == 3
+
+    def test_lend_guards(self, tmp_path):
+        g = make_gang(tmp_path / "g", world=2)
+        g.run_until(1)
+        with pytest.raises(ValueError, match="n >= 1"):
+            g.lend(0)
+        with pytest.raises(GangError, match="keep at least one"):
+            g.lend(2)
+
+    def test_lend_rejoin_losses_bitwise_vs_uninterrupted(self, tmp_path):
+        base = make_gang(tmp_path / "base", world=4)
+        base.run_until(12)
+
+        g = make_gang(tmp_path / "elastic", world=4)
+        g.run_until(3)
+        g.lend(1)
+        g.run_until(7)  # runs at world 3
+        assert g.world_size == 3
+        g.rejoin(1)
+        g.run_until(12)  # back at world 4
+        assert g.world_size == 4
+        assert g.losses_by_step == base.losses_by_step
+
+
+# --------------------------------------------- broker unit loop (fakes)
+
+class _FakeSLO:
+    multi_tenant = False
+
+    def __init__(self):
+        self.pressure = 0.0
+
+    def shed_pressure(self) -> float:
+        return self.pressure
+
+
+class _FakeBatcher:
+    def __init__(self):
+        self.idle = True
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.slo = _FakeSLO()
+        self.batcher = _FakeBatcher()
+
+
+class _FakeFleet:
+    def __init__(self, n=1):
+        self.engines = [_FakeEngine() for _ in range(n)]
+        self._membership = ["serving"] * n
+        self.calls = []
+
+    def serving_indices(self):
+        return [i for i, s in enumerate(self._membership)
+                if s == "serving"]
+
+    def add_replica(self, engine, *, warming=True):
+        self.engines.append(engine)
+        self._membership.append("warming" if warming else "serving")
+        self.calls.append(("add", len(self.engines) - 1))
+        return len(self.engines) - 1
+
+    def mark_serving(self, i):
+        self._membership[i] = "serving"
+        self.calls.append(("serve", i))
+
+    def begin_reclaim(self, i):
+        self._membership[i] = "reclaiming"
+        self.calls.append(("reclaim", i))
+
+    def retire_replica(self, i):
+        self._membership[i] = "retired"
+        self.calls.append(("retire", i))
+
+
+class _FakeGang:
+    def __init__(self, world=4):
+        self.world_size = world
+        self._dead: set = set()
+        self.generation = 0
+        self.lend_calls = []
+        self.rejoined = 0
+
+    @property
+    def live_world(self):
+        return self.world_size - len(self._dead)
+
+    def lend(self, n=1):
+        live = [w for w in range(self.world_size)
+                if w not in self._dead]
+        out = live[-n:]
+        for w in out:
+            self._dead.add(w)
+        self.lend_calls.append(out)
+        return out
+
+    def rejoin(self, n=1):
+        self.world_size = self.live_world + n
+        self._dead = set()
+        self.generation += 1
+        self.rejoined += n
+
+
+def mk_broker(fleet, gang, **cfg_kw):
+    cfg_kw.setdefault("sustain_ticks", 2)
+    cfg_kw.setdefault("cooldown_ticks", 3)
+    cfg_kw.setdefault("min_train_world", 1)
+    b = CapacityBroker(BrokerConfig(**cfg_kw), gang=gang, fleet=fleet,
+                       replica_factory=lambda lease, plan:
+                       _FakeEngine(),
+                       registry=obs.MetricsRegistry())
+    return b
+
+
+class TestBrokerLoop:
+    def test_grant_needs_sustain_then_cooldown_binds(self):
+        fleet, gang = _FakeFleet(), _FakeGang()
+        b = mk_broker(fleet, gang)
+        fleet.engines[0].slo.pressure = 1.0
+        assert b.tick() is None          # streak 1 < sustain 2
+        assert b.tick() == "lease_grant"
+        assert gang.lend_calls == [[3]]
+        assert fleet._membership == ["serving", "warming"]
+        assert b.tick() is None          # cooldown
+        assert b.tick() is None
+        assert b.tick() == "lease_grant"  # cooldown over, streak held
+        assert gang.lend_calls == [[3], [2]]
+
+    def test_hysteresis_band_sustains_nothing(self):
+        fleet, gang = _FakeFleet(), _FakeGang()
+        b = mk_broker(fleet, gang)
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick()
+        fleet.engines[0].slo.pressure = 0.5  # inside the band
+        for _ in range(10):
+            assert b.tick() is None
+        assert gang.lend_calls == []
+
+    def test_grant_denied_at_floor(self):
+        fleet, gang = _FakeFleet(), _FakeGang(world=2)
+        b = mk_broker(fleet, gang, min_train_world=2)
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick()
+        assert b.tick() == "grant_denied"
+        assert gang.lend_calls == [] and len(fleet.engines) == 1
+        assert b.actions[-1]["action"] == "grant_denied"
+
+    def test_lifo_reclaim_with_drain(self):
+        fleet, gang = _FakeFleet(), _FakeGang(world=5)
+        b = mk_broker(fleet, gang, cooldown_ticks=0)
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick(); b.tick()               # grant lease 0 (chip 4)
+        b.tick()                         # lease 0 warms -> serving
+        b.tick()                         # grant lease 1 (chip 3)
+        assert [lease.chip for lease in b.leases] == [4, 3]
+        fleet.engines[0].slo.pressure = 0.0
+        b.tick()
+        assert b.tick() == "lease_reclaim"
+        # LIFO: the newest lease (chip 3) goes home first
+        assert b.leases[1].state == "reclaiming"
+        assert b.leases[0].state in ("warming", "serving")
+        # replica 2 (lease 1) still draining: no return yet
+        fleet.engines[2].batcher.idle = False
+        b.tick()
+        assert b.leases[1].state == "reclaiming" and gang.rejoined == 0
+        fleet.engines[2].batcher.idle = True
+        b.tick()
+        assert b.leases[1].state == "returned"
+        assert gang.rejoined == 1
+        assert ("retire", 2) in fleet.calls
+
+    def test_warm_gate_blocks_serving(self):
+        fleet, gang = _FakeFleet(), _FakeGang()
+        ready = {"warm": False}
+        b = CapacityBroker(
+            BrokerConfig(sustain_ticks=1, cooldown_ticks=0),
+            gang=gang, fleet=fleet,
+            replica_factory=lambda lease, plan:
+            (_FakeEngine(), lambda: ready["warm"]),
+            registry=obs.MetricsRegistry())
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick()
+        assert b.leases[0].state == "warming"
+        for _ in range(3):
+            b.tick()
+            assert b.leases[0].state == "warming"
+            assert fleet._membership[1] == "warming"
+        ready["warm"] = True
+        b.tick()
+        assert b.leases[0].state == "serving"
+        assert fleet._membership[1] == "serving"
+
+    def test_dry_run_decides_identically_actuates_nothing(self):
+        jr_live = obs_journal.EventJournal(clock=lambda: 0.0)
+        jr_dry = obs_journal.EventJournal(clock=lambda: 0.0)
+        script = [1.0] * 6 + [0.0] * 8
+
+        def run(dry, jr):
+            fleet, gang = _FakeFleet(), _FakeGang()
+            b = mk_broker(fleet, gang, dry_run=dry, cooldown_ticks=2)
+            out = []
+            with obs_journal.use(jr):
+                for p in script:
+                    fleet.engines[0].slo.pressure = p
+                    out.append(b.tick())
+            return b, fleet, gang, out
+
+        b_live, _fl, _gl, acts_live = run(False, jr_live)
+        b_dry, fleet_dry, gang_dry, acts_dry = run(True, jr_dry)
+        assert acts_live == acts_dry
+        # identical decision stream: same kinds, chips, lease ids
+        strip = lambda e: {k: v for k, v in sorted(e.items())
+                           if k not in ("seq", "ts", "dry_run")}
+        assert [strip(e) for e in jr_live.events
+                if e["kind"] in ("lease_grant", "lease_reclaim")] == \
+            [strip(e) for e in jr_dry.events
+             if e["kind"] in ("lease_grant", "lease_reclaim")]
+        assert all(e["dry_run"] for e in jr_dry.events
+                   if e["kind"].startswith("lease"))
+        # ... while actuating nothing
+        assert gang_dry.lend_calls == [] and gang_dry.rejoined == 0
+        assert fleet_dry.calls == []
+        assert gang_dry.live_world == 4
+        # the shadow books still bind the floor
+        assert b_dry.train_world() == b_live.train_world()
+
+    def test_metrics_count_actuations_only(self):
+        reg = obs.MetricsRegistry()
+        fams = broker_families(reg)
+        fleet, gang = _FakeFleet(), _FakeGang()
+        b = CapacityBroker(
+            BrokerConfig(sustain_ticks=1, cooldown_ticks=0),
+            gang=gang, fleet=fleet,
+            replica_factory=lambda lease, plan: _FakeEngine(),
+            registry=reg)
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick()
+        assert fams["leases"].labels(direction="grant").value == 1
+        assert fams["chips_lent"].labels().value == 1
+        fleet.engines[0].slo.pressure = 0.0
+        b.tick()  # serving
+        b.tick()  # reclaim decision
+        b.tick()  # drained -> returned
+        assert fams["leases"].labels(direction="reclaim").value == 1
+        assert fams["chips_lent"].labels().value == 0
+
+    def test_config_validation_and_env(self, monkeypatch):
+        with pytest.raises(ValueError, match="hysteresis"):
+            BrokerConfig(grant_on=0.2, grant_off=0.5)
+        with pytest.raises(ValueError, match="sustain"):
+            BrokerConfig(sustain_ticks=0)
+        with pytest.raises(ValueError, match="chips_per_grant"):
+            BrokerConfig(chips_per_grant=0)
+        with pytest.raises(ValueError, match="min_train_world"):
+            BrokerConfig(min_train_world=0)
+        monkeypatch.setenv("HETU_TPU_BROKER_GRANT_ON", "0.8")
+        monkeypatch.setenv("HETU_TPU_BROKER_DRY_RUN", "true")
+        monkeypatch.setenv("HETU_TPU_BROKER_SUSTAIN_TICKS", "5")
+        cfg = BrokerConfig.from_env(cooldown_ticks=2)
+        assert cfg.grant_on == 0.8 and cfg.dry_run
+        assert cfg.sustain_ticks == 5 and cfg.cooldown_ticks == 2
+
+    def test_summary_and_endpoint(self):
+        fleet, gang = _FakeFleet(), _FakeGang()
+        b = mk_broker(fleet, gang, sustain_ticks=1, cooldown_ticks=0)
+        fleet.engines[0].slo.pressure = 1.0
+        b.tick()
+        s = b.summary()
+        assert s["chips_lent"] == 1 and s["tick"] == 1
+        assert s["leases"][0]["state"] == "warming"
+        assert s["leases_by_state"] == {"warming": 1}
+        assert s["recent_actions"][-1]["action"] == "lease_grant"
+        with broker_use(b):
+            assert get_broker() is b
+            with obs.serve() as srv:
+                with urllib.request.urlopen(srv.url + "/broker",
+                                            timeout=10) as r:
+                    body = json.loads(r.read())
+        assert body["chips_lent"] == 1
+        assert body["leases"][0]["chip"] == 3
+        assert get_broker() is not b
+        with obs.serve() as srv:
+            with urllib.request.urlopen(srv.url + "/broker",
+                                        timeout=10) as r:
+                assert json.loads(r.read()) == {"installed": False}
+
+    def test_fleet_broker_endpoint(self, tmp_path):
+        from hetu_tpu.obs.fleet import SnapshotPublisher, serve_fleet
+        jr = obs_journal.EventJournal(clock=lambda: 0.0)
+        with obs_journal.use(jr):
+            fams = broker_families(obs.get_registry())
+            fams["leases"].labels(direction="grant").inc()
+            fams["chips_lent"].labels().set(1.0)
+            obs_journal.record("lease_grant", lease_id=0, chip=3,
+                               from_role="train", to_role="serve",
+                               trigger="slo_burn", plan_sha="x" * 64,
+                               generation=0, dry_run=False)
+            SnapshotPublisher(str(tmp_path), 0,
+                              clock=lambda: 0.0).publish()
+        srv = serve_fleet(str(tmp_path), port=0)
+        try:
+            with urllib.request.urlopen(srv.url + "/fleet/broker",
+                                        timeout=10) as r:
+                body = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert body["workers"] == 1
+        assert body["leases"].get("grant", 0) >= 1
+        assert body["chips_lent"] >= 1.0
+        tail = body["leases_journal"]
+        assert tail and tail[-1]["kind"] == "lease_grant"
+        assert tail[-1]["publisher"] == 0 and tail[-1]["chip"] == 3
+
+
+# ------------------------------------------- seeded diurnal acceptance
+
+@pytest.fixture(scope="module")
+def episodes(tmp_path_factory):
+    """Each scenario once, shared across the acceptance assertions."""
+    root = tmp_path_factory.mktemp("broker_episodes")
+
+    def run(tag, **kw):
+        return run_broker_episode(str(root / tag), seed=0, **kw)
+
+    return {
+        "brokered": run("brokered", brokered=True),
+        "replay": run("replay", brokered=True),
+        "split_a": run("split_a", brokered=False, train_world=4,
+                       serve_replicas=1),
+        "split_b": run("split_b", brokered=False, train_world=3,
+                       serve_replicas=2),
+        "dry": run("dry", brokered=True, dry_run=True),
+        "dry2": run("dry2", brokered=True, dry_run=True),
+    }
+
+
+class TestBrokerAcceptance:
+    def test_full_lease_lifecycle(self, episodes):
+        r = episodes["brokered"]
+        grants = [e for e in r["lease_events"]
+                  if e["kind"] == "lease_grant"]
+        reclaims = [e for e in r["lease_events"]
+                    if e["kind"] == "lease_reclaim"]
+        assert grants and reclaims
+        assert all(e["trigger"] == "slo_burn" for e in grants)
+        assert all(e["trigger"] == "pressure_release"
+                   for e in reclaims)
+        # every grant carries the signed replan it rode on
+        assert all(len(e["plan_sha"]) == 64 for e in grants)
+        # LIFO: reclaims walk the grant order backwards
+        assert [e["lease_id"] for e in reclaims] == \
+            sorted((e["lease_id"] for e in grants), reverse=True)
+        # every lease came home: the day ends with the gang whole
+        assert all(lease["state"] == "returned" for lease in r["leases"])
+        assert r["chips_lent"] == 0
+        assert r["final_world"] == 4
+        assert r["membership"][0] == "serving"
+        assert set(r["membership"][1:]) <= {"retired"}
+
+    def test_brokered_jointly_beats_both_static_splits(self, episodes):
+        br = episodes["brokered"]
+        a, b = episodes["split_a"], episodes["split_b"]
+        # the broker out-trains the serve-heavy split AND out-serves
+        # the train-heavy split...
+        assert br.goodput > b.goodput
+        assert br.violations < a.violations
+        # ...and NEITHER static split weakly dominates it on the joint
+        # (violations, goodput) objective
+        for split in (a, b):
+            assert not (split.violations <= br.violations
+                        and split.goodput >= br.goodput), \
+                f"static split dominates: {split.violations}/" \
+                f"{split.goodput} vs {br.violations}/{br.goodput}"
+
+    def test_loss_trajectory_matches_uninterrupted_run(self, episodes):
+        br, a = episodes["brokered"], episodes["split_a"]
+        # split A is the SAME episode with the broker disabled: same
+        # seed, same construction order, world 4 throughout — its loss
+        # curve IS the uninterrupted run.  At equal total steps the
+        # brokered gang (lend -> world 3 -> rejoin -> world 4) must
+        # match it bitwise: save-at-lend replays nothing and partition
+        # invariance absorbs the world changes.
+        assert br["train_steps"] > 0
+        assert set(br["losses_by_step"]) <= set(a["losses_by_step"])
+        mismatch = [s for s, v in br["losses_by_step"].items()
+                    if a["losses_by_step"][s] != v]
+        assert mismatch == []
+
+    def test_same_seed_replay_is_bitwise(self, episodes):
+        r1, r2 = episodes["brokered"], episodes["replay"]
+        assert r1["lease_events"] == r2["lease_events"]
+        assert r1["decisions"] == r2["decisions"]
+        assert r1["plan_shas"] == r2["plan_shas"]
+        assert r1["placements"] == r2["placements"]
+        assert r1["streams"] == r2["streams"]
+        assert r1["losses_by_step"] == r2["losses_by_step"]
+        assert r1["leases"] == r2["leases"]
+        assert r1["world_by_tick"] == r2["world_by_tick"]
+
+    def test_dry_run_decides_and_actuates_nothing(self, episodes):
+        dry, live = episodes["dry"], episodes["brokered"]
+        # dry-vs-dry is itself bitwise
+        assert dry["lease_events"] == episodes["dry2"]["lease_events"]
+        assert dry["decisions"] == episodes["dry2"]["decisions"]
+        assert dry["losses_by_step"] == \
+            episodes["dry2"]["losses_by_step"]
+        # the first grant decision matches the live broker exactly:
+        # same tick (virtual ts), same chip, same signed plan
+        g_live = [e for e in live["lease_events"]
+                  if e["kind"] == "lease_grant"][0]
+        g_dry = [e for e in dry["lease_events"]
+                 if e["kind"] == "lease_grant"][0]
+        strip = lambda e: {k: v for k, v in sorted(e.items())
+                           if k != "dry_run"}
+        assert strip(g_live) == strip(g_dry)
+        assert g_dry["dry_run"] and not g_live["dry_run"]
+        # ... while actuating nothing: no replicas added, no chips
+        # lent, the gang trains the full uninterrupted schedule
+        assert dry["membership"] == ["serving"]
+        assert dry["chips_lent"] == 0
+        assert dry["final_world"] == 4
+        assert dry["train_steps"] == \
+            episodes["split_a"]["train_steps"]
+
+    def test_world_follows_the_leases(self, episodes):
+        r = episodes["brokered"]
+        worlds = r["world_by_tick"]
+        # the gang visibly shrinks while the lease is out and ends the
+        # night back at full width
+        assert min(worlds) == 3 and worlds[0] == 4 and worlds[-1] == 4

@@ -552,7 +552,7 @@ class TestChaosAcceptance:
 
 # --------------------------------------------------- the serving loops
 
-def make_engine(clock, controller=None, queue_depth=64):
+def make_engine(clock, controller=None, queue_depth=64, **kw):
     from hetu_tpu.models.gpt import GPT, GPTConfig
     from hetu_tpu.serve import ServingEngine
 
@@ -561,7 +561,7 @@ def make_engine(clock, controller=None, queue_depth=64):
                     num_heads=2, max_seq_len=64)
     return ServingEngine(GPT(cfg), num_slots=2, page_size=4, seed=0,
                          clock=clock, controller=controller,
-                         queue_depth=queue_depth)
+                         queue_depth=queue_depth, **kw)
 
 
 class TestServeControls:
@@ -740,6 +740,84 @@ class TestServeControls:
         h2 = eng.submit([1, 2, 3], max_new_tokens=2)
         eng.run_until_idle()
         assert h.status == "completed" and h2.status == "completed"
+
+    def test_policy_switch_releases_stranded_global_latch(self, journal):
+        """Regression: a global shed latch engaged while the engine was
+        still single-tenant must be RELEASED when the SLO plane flips
+        multi-tenant (a tenant request in flight at engage time flips it
+        on completion).  The scoped loop only manages per-tenant
+        latches, so without the hand-over the legacy global latch
+        strands every tenant shut forever — no release path ever runs
+        again."""
+        from hetu_tpu.serve.tenant import Tenant, TenantPolicy
+        clk = VClock()
+        ctrl = RuntimeController(self.serve_cfg(freeze_buckets=False))
+        eng = make_engine(clk, controller=ctrl, tenants=TenantPolicy(
+            [Tenant(id="acme", klass="latency")]))
+        # a default request that ages a full second (the burn) plus a
+        # long-running TENANT request still decoding when the latch
+        # engages
+        h1 = eng.submit([1, 2, 3], max_new_tokens=2)
+        h2 = eng.submit([4, 5, 6], max_new_tokens=12, tenant="acme")
+        clk.t += 1.0
+        for _ in range(50):
+            if h1.status == "completed":
+                break
+            eng.step()
+        assert h1.status == "completed" and h2.status is None
+        # default-only completions so far: the GLOBAL path latches
+        eng.step()
+        eng.step()
+        assert not eng.slo.multi_tenant
+        assert ctrl.shed_active and eng.batcher.shedding
+        # the in-flight tenant request resolves -> the SLO plane goes
+        # multi-tenant mid-latch
+        for _ in range(50):
+            if h2.status == "completed":
+                break
+            eng.step()
+        assert h2.status == "completed" and eng.slo.multi_tenant
+        eng.step()  # first scoped tick: the stranded latch hands over
+        assert not eng.batcher.shedding, \
+            "policy switch stranded the global admission latch"
+        assert any(a["action"] == "admission_release"
+                   and a["signal"] == "tenant_policy_switch"
+                   for a in ctrl.actions)
+        # the door is open again (scoped latches may re-engage later,
+        # per tenant, if the burn is real — that is the scoped loop's
+        # own sustain discipline, not a stranded latch)
+        h3 = eng.submit([7, 8], max_new_tokens=2, tenant="acme")
+        assert h3.status is None or h3.status == "completed"
+        eng.run_until_idle()
+
+    def test_detach_releases_tenant_scoped_latches(self, journal):
+        """Regression (PR 16 contract): ``release()`` must clear
+        tenant-scoped shed latches too, not just the global one — a
+        departing controller otherwise strands single tenants shut."""
+        from hetu_tpu.serve.tenant import Tenant, TenantPolicy
+        clk = VClock()
+        ctrl = RuntimeController(self.serve_cfg(freeze_buckets=False))
+        eng = make_engine(clk, tenants=TenantPolicy(
+            [Tenant(id="flood", klass="latency")]))
+        with ctrl_mod.use(ctrl):
+            eng.controller = None   # drive via the installed seam
+            h = eng.submit([1, 2, 3], max_new_tokens=2, tenant="flood")
+            clk.t += 1.0
+            eng.run_until_idle()
+            assert h.status == "completed" and eng.slo.multi_tenant
+            eng.step()
+            eng.step()
+            assert "flood" in eng.batcher.tenant_sheds
+            assert ctrl.shed_active
+        assert not ctrl.shed_active
+        assert not eng.batcher.tenant_sheds
+        assert any(a["action"] == "admission_release"
+                   and a["signal"] == "controller_detach"
+                   and a.get("tenant") == "flood"
+                   for a in ctrl.actions)
+        h2 = eng.submit([1, 2, 3], max_new_tokens=2, tenant="flood")
+        eng.run_until_idle()
+        assert h2.status == "completed"
 
     def test_dry_run_serve_decisions_actuate_nothing(self, journal):
         clk = VClock()

@@ -633,14 +633,19 @@ def test_plan_determinism_lint():
     ``random`` import (plain, dotted, or from-import) and requires
     every ``.items()`` / ``.keys()`` / ``.values()`` call to be the
     DIRECT argument of ``sorted(...)`` — iteration order pinned at the
-    call site, not downstream."""
+    call site, not downstream.  ``hetu_tpu/broker/`` joins the linted
+    set: a capacity broker whose lease decisions read wall clocks or
+    walk dicts in hash order cannot replay its lease journal bitwise."""
     import ast
     import pathlib
 
+    import hetu_tpu.broker
     import hetu_tpu.plan
-    root = pathlib.Path(hetu_tpu.plan.__file__).parent
-    files = sorted(root.glob("*.py"))
-    assert files, "plan package has no sources to lint"
+    roots = [pathlib.Path(hetu_tpu.plan.__file__).parent,
+             pathlib.Path(hetu_tpu.broker.__file__).parent]
+    files = [p for root in roots for p in sorted(root.glob("*.py"))]
+    assert len({p.parent for p in files}) == 2, \
+        "plan or broker package has no sources to lint"
     problems = []
     for path in files:
         tree = ast.parse(path.read_text(), filename=str(path))
