@@ -992,6 +992,104 @@ def bench_serve_fleet(on_tpu, kind, peak, *, replicas: int,
         device=kind, timing="wall-trace", spread=None)
 
 
+def bench_serve_chaos(on_tpu, kind, peak):
+    """``--mode serve --chaos``: the seeded replica-crash trace through a
+    3-replica fleet with the failover monitor attached — one replica is
+    crashed mid-decode by a seeded FaultPlan, its in-flight streams are
+    re-homed, and the SAME trace runs crash-free for the baseline.  One
+    JSON line: ``vs_baseline`` = chaos / crash-free decode tokens/s, plus
+    the completion rate, the failover and re-home tallies, whether every
+    stream (fingerprint included) matched the crash-free run bitwise, and
+    the post-run export-hold count (zero = no KV page leaked across the
+    failover).  Rides the same rc=3 preflight as every serve round."""
+    from hetu_tpu.core import set_random_seed
+    from hetu_tpu.exec import faults as _faults
+    from hetu_tpu.models import GPT, GPTConfig
+    from hetu_tpu.obs import registry as _obs
+    from hetu_tpu.serve import FleetRouter, ServingEngine, generate_load
+    from hetu_tpu.serve.fleet.failover import FailoverMonitor
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
+        kw = dict(num_slots=8, page_size=64, max_seq_len=2048,
+                  prompt_buckets=(128, 256, 512, 1024))
+        trace = generate_load(29, 24, vocab=cfg.vocab_size,
+                              prompt_len=(64, 1024), max_new=(32, 64),
+                              mean_gap_s=0.0)
+        crash_tick = 12
+    else:  # CI smoke: tiny shapes, still the full chaos-vs-clean A/B
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        kw = dict(num_slots=4, page_size=8, max_seq_len=64,
+                  prompt_buckets=(8, 16, 32))
+        trace = generate_load(29, 12, vocab=cfg.vocab_size,
+                              prompt_len=(2, 12), max_new=(2, 8),
+                              mean_gap_s=0.0)
+        crash_tick = 6
+
+    set_random_seed(0)
+    model = GPT(cfg)
+    hist = _obs.get_registry().histogram("hetu_serve_ttft_seconds").labels()
+
+    def drive(plan):
+        engines = [ServingEngine(model, queue_depth=len(trace) + 8,
+                                 sampling="top_k", top_k=5, seed=11, **kw)
+                   for _ in range(3)]
+        router = FleetRouter(engines)
+        monitor = FailoverMonitor(router, lease_ticks=3)
+        # warmup: compile every prefill bucket on every replica outside
+        # the measured window (the _serve_run convention); the monitor
+        # only ticks under router.step(), so warmup consumes no faults
+        for eng in engines:
+            for bucket in kw["prompt_buckets"]:
+                eng.submit(list(range(1, bucket + 1)), 2)
+            eng.run_until_idle()
+        cum0 = hist.cumulative()
+        with _faults.inject(plan):
+            t0 = time.perf_counter()
+            # explicit ids keep sampling keys — hence streams — aligned
+            # between the chaos and crash-free drives of the same trace
+            handles = [router.submit(list(it.prompt), it.max_new_tokens,
+                                     request_id=i)
+                       for i, it in enumerate(trace)]
+            router.run_until_idle(max_steps=10**7)
+            dt = time.perf_counter() - t0
+        done = [h for h in handles if h.status == "completed"]
+        decode_tokens = sum(max(len(h.tokens) - 1, 0) for h in done)
+        streams = [(h.status, tuple(h.tokens), h.stream_fingerprint)
+                   for h in handles]
+        held = sum(e.pool.stats()["pages_export_held"] for e in engines)
+        return (decode_tokens / dt if dt > 0 else 0.0,
+                _hist_quantile(cum0, hist.cumulative(), 0.99),
+                len(done), streams, held, monitor)
+
+    plan = _faults.FaultPlan(
+        [(crash_tick, _faults.Fault("replica_crash", worker=0))])
+    chaos_tps, p99, done, streams, held, monitor = drive(plan)
+    clean_tps, c99, cdone, clean_streams, _cheld, _cmon = drive(
+        _faults.FaultPlan([]))
+    rehomed = sum(len(d["rehomed"]) for d in monitor.decisions)
+    return _line(
+        "serve_chaos_decode_tokens_per_sec", chaos_tps, "tokens/s",
+        chaos_tps / clean_tps if clean_tps > 0 else 1.0,
+        replicas=3, crash_tick=crash_tick,
+        requests=len(trace), completed=done, clean_completed=cdone,
+        completion_rate=round(done / len(trace), 4),
+        failovers=len([d for d in monitor.decisions
+                       if d["reason"] in ("crashed", "lease_expired")]),
+        requests_rehomed=rehomed,
+        bitwise_vs_crash_free=streams == clean_streams,
+        pages_export_held=held,
+        ttft_p99_s=_q_or_none(p99), clean_ttft_p99_s=_q_or_none(c99),
+        baseline_note="vs_baseline = chaos/crash-free decode tokens/s on "
+                      "the same seeded trace; acceptance: completion_rate "
+                      "1.0, bitwise_vs_crash_free true, pages_export_held "
+                      "0 — the failover plane re-homes without changing a "
+                      "single sampled token or leaking a KV page",
+        device=kind, timing="wall-trace", spread=None)
+
+
 def bench_serve_disagg(on_tpu, kind, peak):
     """``--mode serve --disagg``: the seeded PREFILL-BURST trace (steady
     short-decode traffic + clumped long-prompt bursts, the workload
@@ -1547,13 +1645,23 @@ def main():
         if tenants and (disagg or replicas is not None or prefix_share):
             sys.exit("bench: --tenants runs its own 2-replica flood A/B; "
                      "drop --disagg/--replicas/--prefix-share")
+        chaos = "--chaos" in args
+        if chaos:
+            args.remove("--chaos")
+        if chaos and (tenants or disagg or replicas is not None
+                      or prefix_share):
+            sys.exit("bench: --chaos runs its own 3-replica crash-vs-clean "
+                     "A/B; drop --tenants/--disagg/--replicas/"
+                     "--prefix-share")
         if args:
             sys.exit(f"bench: --mode serve takes no config names, "
                      f"got {args}")
         _require_backend_alive()
         on_tpu, kind, peak = _env()
         try:
-            if tenants:
+            if chaos:
+                bench_serve_chaos(on_tpu, kind, peak)
+            elif tenants:
                 bench_serve_tenants(on_tpu, kind, peak)
             elif disagg:
                 bench_serve_disagg(on_tpu, kind, peak)

@@ -261,6 +261,7 @@ class CapacityBroker:
             return None
         self._tick += 1
         press = self.pressure()
+        self._advance_failed(press)
         self._advance_warming(press)
         self._advance_reclaiming(press)
         cfg = self.config
@@ -286,6 +287,50 @@ class CapacityBroker:
         return None
 
     # -- lease state advancement ----------------------------------------------
+
+    def _advance_failed(self, press: float) -> None:
+        """A lease whose replica the fleet's failover monitor moved to
+        ``failed`` (PR 20) is reclaimed IMMEDIATELY — no drain wait
+        (the monitor already evacuated and re-homed its streams, so
+        there is nothing left to drain) — the chip rejoins the gang the
+        same tick, and one replacement grant is attempted outside the
+        pressure/streak loop (``trigger="replica_failed"``), so a fleet
+        that was granted capacity because it was drowning does not lose
+        that capacity to a chip failure.  Dry-run shadow leases carry no
+        replica and are naturally skipped."""
+        if self.config.dry_run or self.fleet is None:
+            return
+        membership = getattr(self.fleet, "membership", None)
+        if membership is None:
+            return
+        returned = 0
+        for lease in self.leases:
+            if lease.state not in ("warming", "serving"):
+                continue
+            if lease.replica is None \
+                    or membership[lease.replica] != "failed":
+                continue
+            lease.advance("reclaiming")
+            _journal.record("lease_reclaim", lease_id=lease.lease_id,
+                            chip=lease.chip, from_role="serve",
+                            to_role="train", trigger="replica_failed",
+                            generation=lease.generation,
+                            dry_run=False)
+            self.fleet.retire_replica(lease.replica)
+            lease.advance("returned", tick=self._tick)
+            self._decide("lease_returned", press,
+                         lease_id=lease.lease_id,
+                         trigger="replica_failed")
+            if _obs.enabled():
+                self._m()["leases"].labels(direction="reclaim").inc()
+            returned += 1
+        if not returned:
+            return
+        if self.gang is not None:
+            self.gang.rejoin(returned)
+        if _obs.enabled():
+            self._m()["chips_lent"].set(float(self.lent()))
+        self._grant(press, trigger="replica_failed")
 
     def _advance_warming(self, press: float) -> None:
         for lease in self.leases:
@@ -348,7 +393,7 @@ class CapacityBroker:
         return self.planner.replan_for_lease(
             self.gang, serve_devices=target, trigger=trigger)
 
-    def _grant(self, press: float) -> str:
+    def _grant(self, press: float, *, trigger: str = "slo_burn") -> str:
         cfg = self.config
         k = min(cfg.chips_per_grant,
                 self.train_world() - cfg.min_train_world)
@@ -379,14 +424,14 @@ class CapacityBroker:
         for chip in chips:
             lease = Lease(lease_id=self._next_lease, chip=int(chip),
                           from_role="train", to_role="serve",
-                          trigger="slo_burn", plan_sha=sha,
+                          trigger=trigger, plan_sha=sha,
                           generation=generation,
                           granted_tick=self._tick)
             self._next_lease += 1
             self.leases.append(lease)
             _journal.record("lease_grant", lease_id=lease.lease_id,
                             chip=lease.chip, from_role="train",
-                            to_role="serve", trigger="slo_burn",
+                            to_role="serve", trigger=trigger,
                             plan_sha=sha, generation=generation,
                             dry_run=bool(cfg.dry_run))
             lease.advance("warming")

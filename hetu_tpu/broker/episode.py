@@ -269,11 +269,6 @@ def run_broker_episode(workdir: str, *, seed: int = 0,
                         getattr(handle, "tokens", ()) or ()]
     events = list(journal.events)
 
-    def _stable(e: dict) -> dict:
-        # journal seq counts compile events too, whose cache behaviour
-        # is process-global — the broker record itself is deterministic
-        return {k: v for k, v in sorted(e.items()) if k != "seq"}
-
     return EpisodeResult(
         seed=seed, brokered=brokered, dry_run=dry_run,
         violations=int(violations),
@@ -286,11 +281,14 @@ def run_broker_episode(workdir: str, *, seed: int = 0,
         membership=fleet.membership,
         world_by_tick=world_by_tick,
         events=events,
-        lease_events=[_stable(e) for e in events
-                      if e.get("kind") in ("lease_grant",
-                                           "lease_reclaim")],
-        decisions=[_stable(e) for e in events
-                   if e.get("kind") == "broker_decision"],
+        # journal seq counts compile events too, whose cache behaviour
+        # is process-global — the broker record itself is deterministic
+        lease_events=_journal.stable_events(
+            [e for e in events
+             if e.get("kind") in ("lease_grant", "lease_reclaim")]),
+        decisions=_journal.stable_events(
+            [e for e in events
+             if e.get("kind") == "broker_decision"]),
         plan_shas=[e["sha256"] for e in events
                    if e.get("kind") == "plan_emit"],
         leases=([lease.as_dict() for lease in broker.leases]

@@ -114,6 +114,12 @@ class ControllerConfig:
     cooldown_steps: int = 4
     # 2: divergence quarantine
     quarantine: bool = True
+    # 2b: serving flap quarantine (PR 20): a replica declared lost this
+    # many times (a hang/recover cycle that keeps repeating) is
+    # quarantined on its FailoverMonitor — never restored on heartbeat
+    # recovery — instead of oscillating in and out of the placement
+    # ranking.  Gated by the same ``quarantine`` switch as loop 2.
+    replica_flap_threshold: int = 2
     # 3: SLO-burn admission shedding
     shed: bool = True
     shed_on: float = 0.9
@@ -157,6 +163,9 @@ class ControllerConfig:
         if self.sustain_ticks < 1:
             raise ValueError(f"sustain_ticks must be >= 1, got "
                              f"{self.sustain_ticks}")
+        if self.replica_flap_threshold < 1:
+            raise ValueError(f"replica_flap_threshold must be >= 1, got "
+                             f"{self.replica_flap_threshold}")
         if not 0.0 < self.batch_shed_factor <= 1.0:
             raise ValueError(f"batch_shed_factor scales shed_on for "
                              f"batch-class tenants, must be in (0, 1], "
@@ -177,7 +186,8 @@ class ControllerConfig:
         spec = {"enabled": bool, "dry_run": bool, "tune_deadline": bool,
                 "headroom": float, "cover_fraction": float,
                 "hysteresis": float, "cooldown_steps": int,
-                "quarantine": bool, "shed": bool, "shed_on": float,
+                "quarantine": bool, "replica_flap_threshold": int,
+                "shed": bool, "shed_on": float,
                 "shed_off": float, "sustain_ticks": int,
                 "batch_shed_factor": float, "freeze_buckets": bool,
                 "mem_pressure": bool, "mem_on": float, "mem_off": float}
@@ -278,6 +288,14 @@ class RuntimeController:
         # released (or its sustain streak polluted) by engine B's ticks.
         # Weak keys: a departed engine needs no release.
         self._serve_state: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        # serving flap-quarantine state is PER FailoverMonitor (one
+        # installed controller may watch several fleets): monitor -> the
+        # set of replicas already decided.  The latch IS the hysteresis
+        # — one quarantine decision per replica, in dry run too, so the
+        # decision stream matches an active controller's even though a
+        # dry-run replica keeps recovering and failing.
+        self._fleet_state: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
 
     # -- the decision record --------------------------------------------------
@@ -440,6 +458,33 @@ class RuntimeController:
                 deadline_source="controller")
 
         self._maybe_retune(step, red.config, red.lags.lag, actuate=actuate)
+
+    def on_replica_lost(self, monitor, replica: int,
+                        lost_count: int) -> None:
+        """Serving-fleet flap quarantine (the
+        :class:`~hetu_tpu.serve.fleet.failover.FailoverMonitor` seam):
+        the monitor reports every ``replica_lost`` declaration with the
+        replica's cumulative loss count; at ``replica_flap_threshold``
+        the replica is quarantined — never restored on heartbeat
+        recovery — so a hang/recover cycle that keeps repeating stops
+        oscillating the placement ranking.  One decision per replica per
+        monitor; dry run journals the identical ``quarantine_replica``
+        decision and leaves the monitor's restore behavior untouched."""
+        if not self.config.enabled or not self.config.quarantine:
+            return
+        if int(lost_count) < self.config.replica_flap_threshold:
+            return
+        decided = self._fleet_state.get(monitor)
+        if decided is None:
+            decided = set()
+            self._fleet_state[monitor] = decided
+        if replica in decided:
+            return
+        decided.add(replica)
+        self._act("quarantine_replica", "replica_flap",
+                  replica=int(replica), lost=int(lost_count))
+        if not self.config.dry_run:
+            monitor.quarantine(replica)
 
     # -- loop 3+4: the serving engine ----------------------------------------
 

@@ -67,6 +67,38 @@ fault kind         hook site (module seam)               effect
                                                          threshold+1) — the
                                                          controller must
                                                          freeze bucket growth
+``replica_crash``  ``serve.engine.ServingEngine.step``   the replica dies
+                   (with ``worker=`` = replica index)    permanently at the
+                                                         scheduled scheduler
+                                                         tick: no more beats,
+                                                         KV pages
+                                                         unexportable — the
+                                                         failover monitor
+                                                         must mark it
+                                                         ``failed`` and
+                                                         re-home every
+                                                         in-flight request
+                                                         via re-prefill
+``decode_hang``    ``serve.engine.ServingEngine.step``   the replica goes
+                   (with ``worker=`` = replica index)    silent for ``arg``
+                                                         scheduler ticks (no
+                                                         work, no beats),
+                                                         then recovers — a
+                                                         hang past the lease
+                                                         triggers failover
+                                                         with KV salvage; a
+                                                         recovered replica is
+                                                         restored (and may
+                                                         flap into the
+                                                         controller's
+                                                         quarantine)
+``migrate_drop``   ``serve.fleet`` migration transit     the next KV
+                   (disagg hand-off or failover          migration record is
+                   salvage)                              dropped in transit —
+                                                         the importer must
+                                                         fall back to
+                                                         re-prefill, never
+                                                         serve a torn record
 =================  ====================================  ===================
 
 Two scheduling conventions coexist for the worker-targeted kinds: in
@@ -106,7 +138,7 @@ __all__ = ["Fault", "FaultPlan", "install", "uninstall", "inject", "fire",
 
 KINDS = ("ps_socket_kill", "ckpt_truncate", "ckpt_corrupt", "grad_nan",
          "hang", "worker_kill", "worker_stall", "shard_loss", "bit_flip",
-         "compile_storm")
+         "compile_storm", "replica_crash", "decode_hang", "migrate_drop")
 
 # C-client dead-socket status (net.RemoteEmbeddingTable._NET_ERRS)
 _DEAD_SOCKET = -10

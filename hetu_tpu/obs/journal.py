@@ -103,6 +103,23 @@ kind                fields (beyond ``seq``/``ts``)
                       filled named defaults for constants with no
                       record history — the planner ran uncalibrated on
                       those axes)
+``replica_lost``      ``replica``, ``reason`` (``crashed``/
+                      ``lease_expired``: the failover monitor moved a
+                      silent or crashed fleet replica into the
+                      ``failed`` membership state — the serving
+                      counterpart of ``worker_lost``)
+``request_rehome``    ``request_id``, ``from_replica``, ``to_replica``,
+                      ``kv`` (``salvaged``/``reprefill``: one in-flight
+                      request moved off a failed replica and continued
+                      on a survivor — salvaged = original KV pages
+                      imported via a verified MigrationRecord,
+                      reprefill = prompt re-prefilled and the emitted
+                      prefix regenerated bitwise)
+``failover``          ``replica``, ``rehomed``, ``reason`` (one
+                      replica-failure handling pass: how many in-flight
+                      requests were re-homed, and why the replica
+                      failed — or ``reason="recovered"`` with
+                      ``rehomed=0`` when a hung replica came back)
 ==================  =====================================================
 
 Event kinds are CENTRALIZED in :data:`EVENT_KINDS` — the registry of
@@ -137,7 +154,7 @@ from typing import Callable, Optional
 from hetu_tpu.obs import registry as _registry
 
 __all__ = ["EventJournal", "get_journal", "set_journal", "use", "record",
-           "EVENT_KINDS", "register_kind"]
+           "EVENT_KINDS", "register_kind", "stable_events"]
 
 # The registry of journal event kinds: kind -> the fields every record of
 # that kind must carry (beyond the automatic ``seq``/``ts``).  The
@@ -241,6 +258,15 @@ EVENT_KINDS = {
         {"lease_id", "chip", "from_role", "to_role", "trigger",
          "generation", "dry_run"}),
     "broker_decision": frozenset({"action", "pressure", "dry_run"}),
+    # serving fault tolerance (PR 20): replica failure detection +
+    # deterministic request failover.  ``replica_lost`` mirrors the
+    # gang's ``worker_lost``; ``request_rehome`` is per re-homed
+    # request; ``failover`` summarizes one monitor pass over a failed
+    # (or recovered) replica.
+    "replica_lost": frozenset({"replica", "reason"}),
+    "request_rehome": frozenset(
+        {"request_id", "from_replica", "to_replica", "kv"}),
+    "failover": frozenset({"replica", "rehomed", "reason"}),
 }
 
 
@@ -331,6 +357,21 @@ class EventJournal:
                     f"(expected seq {i}, found {rec.get('seq')}) — a "
                     f"write was lost or the file was truncated/merged")
         return out
+
+
+def stable_events(events, *, drop=("seq",)) -> list:
+    """Normalize journal events for bitwise replay comparison: each
+    event's fields in sorted-key order with the ``drop`` keys removed.
+
+    ``seq`` is dropped by default because interleaved emitters whose
+    *count* of events is environment-dependent (e.g. compile telemetry
+    under a warm vs cold compilation cache) shift every later sequence
+    number without changing the decision stream; replay acceptance
+    compares the decisions, not the global interleave.  Journals built
+    on a virtual clock keep ``ts`` comparable, so it is not dropped
+    here — pass ``drop=("seq", "ts")`` for wall-clock journals."""
+    return [{k: v for k, v in sorted(e.items()) if k not in drop}
+            for e in events]
 
 
 _active: Optional[EventJournal] = None

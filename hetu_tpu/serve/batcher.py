@@ -204,20 +204,28 @@ class ContinuousBatcher:
         """Engaged tenant-scoped shed latches (id -> reason), a copy."""
         return dict(self._tenant_shed)
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, *, requeue: bool = False) -> None:
         """Queue a request; raises :exc:`AdmissionShed` while the
         controller's global or tenant-scoped shed latch is engaged,
         :exc:`AdmissionQueueFull` at the tenant sub-queue's depth limit,
         and :exc:`TenantQuotaExceeded` when the tenant's token bucket
         cannot cover the request's work cost (the engine counts and
         journals all three, distinguishably).  The bucket is charged
-        only for requests actually enqueued."""
+        only for requests actually enqueued.
+
+        ``requeue=True`` is the failover re-home path (the request
+        already passed the fleet's front door once): shed latches and
+        the quota bucket are bypassed — re-billing or re-shedding an
+        admitted request on its survivor would turn one replica's death
+        into a client-visible drop — leaving only the structural depth
+        limit."""
         tid = request.tenant_id
-        if self.shed_reason is not None:
-            raise AdmissionShed(self.shed_reason)
-        scoped = self._tenant_shed.get(tid)
-        if scoped is not None:
-            raise AdmissionShed(scoped)
+        if not requeue:
+            if self.shed_reason is not None:
+                raise AdmissionShed(self.shed_reason)
+            scoped = self._tenant_shed.get(tid)
+            if scoped is not None:
+                raise AdmissionShed(scoped)
         q = self._queues.get(tid)
         if q is not None and len(q) >= self.queue_depth:
             raise AdmissionQueueFull(
@@ -227,8 +235,9 @@ class ContinuousBatcher:
         bucket = self.policy.bucket(tid)
         # migrated requests already paid their quota at the front-door
         # engine's submit — charging the shared fleet bucket again at
-        # the decode worker would double-bill the tenant
-        if bucket is not None and request.migration is None:
+        # the decode worker would double-bill the tenant (a failover
+        # requeue likewise already paid at original admission)
+        if bucket is not None and request.migration is None and not requeue:
             cost = float(request.total_budget)
             if not bucket.try_take(cost, request.arrival):
                 raise TenantQuotaExceeded(
@@ -311,6 +320,25 @@ class ContinuousBatcher:
         self._slots[slot] = None
         r.slot = None
         return r
+
+    def evacuate(self) -> list:
+        """Drain EVERYTHING — every queued request and every occupied
+        slot — in global submit order (``seq``), leaving the scheduler
+        empty.  The replica-failure path: the failover monitor re-homes
+        what this returns onto surviving replicas.  WFQ virtual time and
+        the shed latches are left as they are; a recovered replica
+        resumes with an empty, consistent scheduler."""
+        out = []
+        for tid in sorted(self._queues):
+            out.extend(self._queues[tid])
+            self._queues[tid] = []
+        for i, r in enumerate(self._slots):
+            if r is not None:
+                self._slots[i] = None
+                r.slot = None
+                out.append(r)
+        out.sort(key=lambda r: (r.seq if r.seq is not None else -1, r.id))
+        return out
 
     def load_factor(self) -> float:
         """Occupancy in [0, 1]: (waiting + decoding) over total capacity

@@ -401,6 +401,19 @@ class ServingEngine:
         self.freeze_bucket_growth = False
         self._prefill_buckets: set = set()
         self._tick = 0
+        # serving fault tolerance (serve/fleet/failover.py): the
+        # heartbeat the monitor leases against, and the injected failure
+        # modes.  _beat advances once per HEALTHY scheduler tick (a
+        # crashed or hung engine's beat freezes — that IS the failure
+        # signal); crash() is permanent, hang(n) is silence for n ticks.
+        self._beat = 0
+        self._crashed = False
+        self._hang_ticks = 0
+        # router-installed ledger hooks: on_token(rid, tok) after every
+        # emitted token, on_finish(rid) when a handle resolves — both
+        # called under this engine's lock, so they must stay tiny
+        self.on_token = None
+        self.on_finish = None
         # fleet tier (serve/fleet): copy-on-write prefix sharing maps
         # identical prompt prefixes to shared refcounted KV pages, and a
         # draft model turns decode into propose-and-verify (bitwise
@@ -655,6 +668,18 @@ class ServingEngine:
 
     def _step_locked(self) -> int:
         self._tick += 1
+        if self._crashed:
+            # a crashed replica does nothing and — critically — does not
+            # beat: the failover monitor reads the frozen heartbeat and
+            # declares it lost after its lease expires
+            return 0
+        if self._hang_ticks > 0:
+            # a hung replica is silent (no beat, no work) for the
+            # injected span, then recovers on its own — the flap the
+            # controller's quarantine hysteresis exists for
+            self._hang_ticks -= 1
+            return 0
+        self._beat += 1
         plan = _faults.active_plan()
         if plan is not None:
             # chaos seam: a scheduled compile_storm fault notes `arg`
@@ -715,6 +740,8 @@ class ServingEngine:
                 "expired",
                 error=f"deadline of {req.deadline_s}s expired after "
                       f"{waited:.6g}s in the admission queue")
+            if self.on_finish is not None:
+                self.on_finish(req.id)
         for req in tick.admitted:
             if req.migration is not None:
                 # a migrated request enters a decode slot: import its KV
@@ -1018,7 +1045,121 @@ class ServingEngine:
         tok = int(self._sample_fn(
             logits, jnp.asarray([req.id], jnp.int32),
             jnp.asarray([plen], jnp.int32))[0])
-        req.tokens[0] = tok
+        # only prompt KV was recomputed: any tokens beyond the first have
+        # no K/V here, so the stream restarts from the re-drawn first
+        # token — decode regenerates the rest from the same (seed, rid,
+        # position) keys, bitwise what the lost engine would have emitted
+        req.tokens[:] = [tok]
+
+    # -- failure & failover (serve/fleet/failover.py drives these) ----------
+
+    @property
+    def crashed(self) -> bool:
+        return self._crashed
+
+    def crash(self) -> None:
+        """Inject a permanent replica death: the engine stops beating and
+        stops doing work; its KV pages are treated as unexportable (a
+        dead chip's HBM is gone), so every in-flight request re-homes by
+        re-prefill."""
+        with self._lock:
+            self._crashed = True
+
+    def hang(self, ticks: int) -> None:
+        """Inject a silent hang: no heartbeat and no work for ``ticks``
+        scheduler ticks, then the engine resumes on its own.  A hang
+        longer than the monitor's lease triggers failover (the pages are
+        still intact, so KV salvage applies); a recovered replica is
+        restored to serving — and a flapping one is quarantined by the
+        controller."""
+        with self._lock:
+            self._hang_ticks = max(self._hang_ticks, int(ticks))
+
+    def evacuate(self) -> list:
+        """Drain every in-flight request off this (failed) engine:
+        returns ``[(request, record_or_None, handle, timeline)]`` in
+        deterministic admission order and leaves the batcher empty and
+        the pool holding nothing but export HOLDs.
+
+        Active requests' KV pages are EXPORTED when the engine is merely
+        hung (``record`` carries them; the monitor verifies and either
+        salvages them on a survivor or cancels the hold) and ``None``
+        when it crashed — a dead chip's HBM is not salvageable.  Queued
+        requests never had pages; a queued MIGRATED request's inbound
+        ticket is settled here (the source's export hold must not leak
+        just because the destination died).  Pages are freed either way:
+        the exporter's hold keeps exported bytes alive until the monitor
+        settles or cancels, so the pool's alloc/free balance survives
+        the failure."""
+        with self._lock:
+            active_ids = {r.id for _slot, r in self.batcher.active()}
+            out = []
+            for req in self.batcher.evacuate():
+                handle = self._handles.pop(req.id, None)
+                tl = self._timelines.pop(req.id, None)
+                record = None
+                if req.id in active_ids:
+                    if not self._crashed:
+                        try:
+                            record = self.pool.export_pages(req.id)
+                        except ValueError:
+                            # an outstanding export already holds these
+                            # pages (e.g. a prefill worker mid-migration):
+                            # that ticket owns the hold; re-prefill here
+                            record = None
+                    self.pool.free(req.id)
+                if req.migration is not None:
+                    # inbound migrated request that never imported: the
+                    # settle runs outside engine locks via step()'s drain
+                    self._pending_settles.append(req.migration.settle)
+                    req.migration = None
+                if handle is not None:
+                    out.append((req, record, handle, tl))
+            return out
+
+    def accept_failover(self, req: Request, handle, timeline,
+                        ticket=None) -> Optional[str]:
+        """Survivor-side intake for one request re-homed off a failed
+        replica.  With a ``ticket`` (a verified KV salvage), the request
+        keeps its emitted tokens and its pages import at slot admission
+        — decode continues exactly where the lost engine stopped.
+        Without one, the request re-enters EMPTY (no tokens): prefill
+        re-samples the first token and decode regenerates the stream,
+        bitwise identical because sampling keys derive from ``(seed,
+        request id, position)`` alone.  Either way the handle and
+        timeline transfer, so the request resolves here as if nothing
+        happened.  Returns ``None`` on acceptance or a shed reason the
+        monitor uses to try the next survivor; admission bypasses shed
+        latches and quota (``requeue``) — the request already passed the
+        fleet's front door once."""
+        if self.role == "prefill":
+            raise ValueError("a prefill-role engine cannot accept "
+                             "failover re-homes")
+        with self._lock:
+            if req.id in self._handles:
+                return "id_collision"
+            if ticket is not None:
+                mreq = Request(
+                    id=req.id, prompt=list(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                    arrival=req.arrival, deadline_s=req.deadline_s,
+                    tenant=req.tenant, tokens=list(req.tokens),
+                    prefill_at=req.prefill_at, migration=ticket)
+            else:
+                mreq = Request(
+                    id=req.id, prompt=list(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                    arrival=req.arrival, deadline_s=req.deadline_s,
+                    tenant=req.tenant)
+            try:
+                self.batcher.submit(mreq, requeue=True)
+            except AdmissionQueueFull:
+                return "queue_full"
+            self._handles[req.id] = handle
+            self._timelines[req.id] = timeline
+            self._next_id = max(self._next_id, req.id + 1)
+            _serve_m()["queue"].set(self.batcher.queue_len)
+            return None
 
     def _ensure_pages(self, req_id: int, n_tokens: int) -> None:
         """Grow a sequence's allocation, evicting trie-only cached
@@ -1121,6 +1262,10 @@ class ServingEngine:
         generated token, the prefill-sampled first token included."""
         pt = self.pool.table(req.id)
         req.tokens.append(tok)
+        if self.on_token is not None:
+            # the router's in-flight ledger tracks tokens-emitted-so-far
+            # (the failover monitor journals them at re-home time)
+            self.on_token(req.id, tok)
         self._timelines[req.id].decode(now, batch=batch, slot=req.slot)
         m = _serve_m()
         m["tokens"].inc()
@@ -1179,6 +1324,8 @@ class ServingEngine:
                     else req.prefill_at - req.arrival),
             latency_s=now - req.arrival, error=error,
             stream_fingerprint=sfp)
+        if self.on_finish is not None:
+            self.on_finish(req.id)  # prune the router's in-flight ledger
 
     def _finalize_timeline(self, tl: RequestTimeline,
                            grade: bool = True) -> None:

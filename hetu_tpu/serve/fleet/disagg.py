@@ -38,8 +38,7 @@ files under ``<dir>/kv/``) for the multi-process form.
 
 from __future__ import annotations
 
-import threading
-
+from hetu_tpu.exec import faults as _faults
 from hetu_tpu.obs import journal as _journal
 from hetu_tpu.serve.fleet.migrate import migrate_metrics
 from hetu_tpu.serve.fleet.router import FleetRouter
@@ -85,11 +84,6 @@ class DisaggRouter(FleetRouter):
             raise ValueError("no decode-capable engine (role 'decode' "
                              "or 'colocated') in the fleet")
         self.migrations: list = []   # the deterministic migration log
-        self._next_rid = 0
-        # global-id draws must be atomic: the HTTP front end submits
-        # from concurrent handler threads, and two requests sharing one
-        # id would share their sampling keys
-        self._rid_lock = threading.Lock()
         for i, e in enumerate(self.engines):
             _journal.record("role_assign", replica=i, role=e.role)
             if e.role == "prefill":
@@ -145,21 +139,20 @@ class DisaggRouter(FleetRouter):
             if self._membership[i] == "serving")
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
-               deadline_s=None, tenant=None):
+               deadline_s=None, request_id=None, tenant=None):
         """Place one request on the prefill side (``_rank`` restricts
         the base placement loop to the prefill-capable pool).  The
         router assigns a GLOBAL request id in submission order (re-route
-        retries reuse it), so streams are bitwise comparable to a
-        colocated same-seed run of the same trace.  ``tenant`` rides the
-        request end to end: the prefill worker's front door charges the
-        quota and WFQ-schedules it, and the migrated request carries the
-        id to the decode worker (whose intake never re-charges it)."""
-        with self._rid_lock:
-            rid = self._next_rid
-            self._next_rid += 1
+        retries reuse it — since PR 20 the base ``FleetRouter`` owns
+        that discipline, idempotent resubmission included), so streams
+        are bitwise comparable to a colocated same-seed run of the same
+        trace.  ``tenant`` rides the request end to end: the prefill
+        worker's front door charges the quota and WFQ-schedules it, and
+        the migrated request carries the id to the decode worker (whose
+        intake never re-charges it)."""
         return super().submit(prompt, max_new_tokens,
-                              deadline_s=deadline_s, request_id=rid,
-                              tenant=tenant)
+                              deadline_s=deadline_s,
+                              request_id=request_id, tenant=tenant)
 
     # -- the migration hook -------------------------------------------------
 
@@ -167,9 +160,18 @@ class DisaggRouter(FleetRouter):
         """Installed as every prefill engine's ``migrate_out``: place the
         exported record on the best decode worker, re-routing around
         shed rejections with the submission-side retry budget.  Returns
-        False when every candidate shed — the source cancels the export
-        and decodes the request itself (degraded, never dropped)."""
+        False when every candidate shed — or when a scheduled
+        ``migrate_drop`` fault eats the record in transit — the source
+        cancels the export and decodes the request itself (degraded,
+        never dropped)."""
         src_idx = self.engines.index(src)
+        plan = _faults.active_plan()
+        if plan is not None and plan.take(
+                "migrate_drop", late_ok=True, now=src._tick) is not None:
+            migrate_metrics()["failures"].labels(reason="dropped").inc()
+            _journal.record("migrate_verify_failed", request_id=req.id,
+                            reason="dropped")
+            return False
         handle = src._handles[req.id]
         timeline = src._timelines[req.id]
         ticket = MigrationTicket(record, src)

@@ -1,0 +1,358 @@
+"""Serving fault tolerance: replica failure detection + deterministic
+request failover.
+
+The training half of the repo survives kills, stalls and shard loss
+with bitwise-replayable recovery (resilience.py, gang.py, faults.py) —
+but a serving replica that died mid-decode used to strand every
+in-flight request silently.  This module closes that gap with the same
+discipline the training side uses: an injected-clock lease, journaled
+decisions, and streams that stay bitwise identical across the failure.
+
+:class:`FailoverMonitor` attaches to a
+:class:`~hetu_tpu.serve.fleet.router.FleetRouter` and runs once per
+fleet tick (``router.step()`` ticks it BEFORE the engines move, so
+detection latency is an exact tick count):
+
+1. **Chaos intake** — consumes the serving fault kinds from the active
+   :class:`~hetu_tpu.exec.faults.FaultPlan`: ``replica_crash``
+   (``worker=`` names the replica; permanent death) and ``decode_hang``
+   (silent for ``arg`` ticks, then recovers).  ``migrate_drop`` is
+   consumed at the KV-salvage transit seam below (and at the
+   disaggregated hand-off in disagg.py).
+
+2. **Heartbeat lease** — every engine beats once per healthy scheduler
+   tick (``ServingEngine._beat``); a beat frozen for more than
+   ``lease_ticks`` monitor ticks moves the replica into the router's
+   ``failed`` membership state (the ``GangMembership`` lease idiom on
+   the fleet's own tick clock — no wall time anywhere) and journals
+   ``replica_lost``.  A failed replica whose beat RESUMES (a hang that
+   ended) is restored to ``serving`` — unless the controller
+   quarantined it for flapping (:meth:`quarantine`, driven by
+   ``RuntimeController.on_replica_lost`` with the controller's usual
+   hysteresis + dry-run parity).
+
+3. **Request failover** — the failed engine is evacuated
+   (:meth:`~hetu_tpu.serve.engine.ServingEngine.evacuate`): every
+   in-flight request re-homes to a surviving replica and CONTINUES
+   deterministically.  When the engine merely hung, its KV pages export
+   as a verified :class:`~hetu_tpu.serve.fleet.migrate.MigrationRecord`
+   and the survivor imports them (salvage: decode resumes exactly where
+   the lost engine stopped).  A crashed engine's pages — or a record
+   that fails verification or is dropped in transit — fall back to
+   re-prefill: the request re-enters empty and regenerates its stream,
+   bitwise identical because sampling keys derive from ``(seed, request
+   id, position)`` alone.  Degraded is never dropped: a re-home that
+   finds no survivor (everything shedding or failed) parks in
+   ``pending`` and retries every tick.  Export HOLDs on the dead
+   replica are settled either way — the salvage ticket acks at import,
+   a refused record cancels here — so the pool never leaks pages.
+
+Every decision journals (``replica_lost`` / ``request_rehome`` /
+``failover``), counts (``hetu_serve_failover_*``), and lands on
+``self.decisions`` — the ``/fleet/failover`` payload and the replay
+acceptance surface: two same-seed chaos runs must produce identical
+decision sequences, and every rehomed stream (fingerprint included)
+must match the crash-free same-seed run bitwise.
+
+This file is covered by the plan-determinism AST lint (tests/
+test_obs.py): no clock or entropy imports, and every dict walk pinned
+by ``sorted(...)`` at the call site — a failover decision that cannot
+replay bitwise is a failover decision that cannot be audited.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hetu_tpu.exec import controller as _controller
+from hetu_tpu.exec import faults as _faults
+from hetu_tpu.obs import journal as _journal
+from hetu_tpu.obs import registry as _obs
+from hetu_tpu.serve.fleet.disagg import MigrationTicket
+from hetu_tpu.serve.fleet.migrate import (MigrationIntegrityError,
+                                          migrate_metrics, verify_record)
+
+__all__ = ["FailoverMonitor"]
+
+_failover_metrics = None
+
+
+def _failover_m() -> dict:
+    global _failover_metrics
+    if _failover_metrics is None:
+        reg = _obs.get_registry()
+        _failover_metrics = {
+            "replicas": reg.counter(
+                "hetu_serve_failover_replicas_total",
+                "replica failure-plane transitions by reason (crashed: "
+                "permanent death; lease_expired: heartbeat silent past "
+                "the lease; recovered: a hung replica's beat resumed "
+                "and it was restored to serving)",
+                ("reason",)),
+            "rehomed": reg.counter(
+                "hetu_serve_failover_requests_total",
+                "in-flight requests re-homed off a failed replica, by "
+                "KV disposition (salvaged: verified pages imported on "
+                "the survivor; reprefill: re-entered empty and "
+                "regenerated — same stream either way)",
+                ("kv",)),
+            "pending": reg.gauge(
+                "hetu_serve_failover_pending",
+                "re-homes waiting for a survivor (every candidate shed "
+                "or failed) — retried every fleet tick, never dropped"),
+        }
+    return _failover_metrics
+
+
+class FailoverMonitor:
+    """Heartbeat-lease failure detection + deterministic re-homing over
+    one fleet router.  Driven entirely by the fleet's tick counter (the
+    router ticks it at the top of :meth:`~hetu_tpu.serve.fleet.router.
+    FleetRouter.step`), so a same-seed replay reproduces every decision
+    bitwise."""
+
+    def __init__(self, router, *, lease_ticks: int = 3):
+        if lease_ticks < 1:
+            raise ValueError(f"lease_ticks must be >= 1, got "
+                             f"{lease_ticks}")
+        self.router = router
+        self.lease_ticks = int(lease_ticks)
+        self._tick = 0
+        # replica -> [last observed beat, tick it last changed]
+        self._beats: dict = {}
+        # replica -> how many times it has been declared lost (the
+        # controller's flap signal)
+        self.lost_counts: dict = {}
+        # replicas the controller quarantined: never restored on
+        # recovery (the flapping-replica remedy)
+        self.quarantined: set = set()
+        self._quarantine_announced: set = set()
+        # re-homes that found no survivor yet: retried every tick
+        self._pending: list = []
+        # the deterministic decision log (the replay surface)
+        self.decisions: list = []
+        router.monitor = self
+
+    # -- derived hints ------------------------------------------------------
+
+    @property
+    def retry_after_s(self) -> float:
+        """The deterministic backoff hint a degraded-fleet 503 carries:
+        one scheduler wave per lease tick — by then the monitor has
+        either re-homed onto a survivor or the fleet is still down and
+        the client should keep backing off."""
+        return round(0.05 * (self.lease_ticks + 1), 6)
+
+    # -- the per-tick loop --------------------------------------------------
+
+    def tick(self) -> None:
+        """One monitor tick: retry parked re-homes, consume scheduled
+        serving faults, scan heartbeats, fail/restore replicas."""
+        self._tick += 1
+        self._retry_pending()
+        self._consume_faults()
+        self._scan()
+        if _obs.enabled():
+            _failover_m()["pending"].set(float(len(self._pending)))
+
+    def _consume_faults(self) -> None:
+        plan = _faults.active_plan()
+        if plan is None:
+            return
+        while True:
+            f = plan.take("replica_crash", "decode_hang", late_ok=True,
+                          now=self._tick, require_worker=True)
+            if f is None:
+                return
+            engine = self.router.engines[int(f.worker)]
+            if f.kind == "replica_crash":
+                engine.crash()
+            else:
+                engine.hang(int(f.arg) if f.arg
+                            else self.lease_ticks + 2)
+
+    def _scan(self) -> None:
+        membership = self.router.membership
+        for i, state in enumerate(membership):
+            if state == "retired":
+                continue
+            beat = int(self.router.engines[i]._beat)
+            rec = self._beats.get(i)
+            if rec is None or beat != rec[0]:
+                self._beats[i] = [beat, self._tick]
+                stalled = 0
+            else:
+                stalled = self._tick - rec[1]
+            if state == "failed":
+                if stalled == 0:
+                    self._maybe_restore(i)
+                continue
+            if stalled > self.lease_ticks:
+                self._fail(i)
+
+    # -- failure ------------------------------------------------------------
+
+    def _fail(self, replica: int) -> None:
+        engine = self.router.engines[replica]
+        reason = "crashed" if engine.crashed else "lease_expired"
+        self.router.mark_failed(replica)
+        self.lost_counts[replica] = self.lost_counts.get(replica, 0) + 1
+        _journal.record("replica_lost", replica=replica, reason=reason)
+        if _obs.enabled():
+            _failover_m()["replicas"].labels(reason=reason).inc()
+        ctrl = _controller.get_controller()
+        if ctrl is not None:
+            ctrl.on_replica_lost(self, replica,
+                                 self.lost_counts[replica])
+        rehomed = self._evacuate(replica)
+        _journal.record("failover", replica=replica, rehomed=len(rehomed),
+                        reason=reason)
+        self.decisions.append({"tick": self._tick, "replica": replica,
+                               "reason": reason, "rehomed": rehomed})
+
+    def _maybe_restore(self, replica: int) -> None:
+        """A failed replica's heartbeat resumed (the hang ended): restore
+        it to serving — empty, consistent, rankable again — unless the
+        controller quarantined it for flapping."""
+        if replica in self.quarantined:
+            if replica not in self._quarantine_announced:
+                self._quarantine_announced.add(replica)
+                _journal.record("failover", replica=replica, rehomed=0,
+                                reason="quarantined")
+                self.decisions.append({"tick": self._tick,
+                                       "replica": replica,
+                                       "reason": "quarantined",
+                                       "rehomed": []})
+            return
+        self.router.mark_serving(replica)
+        _journal.record("failover", replica=replica, rehomed=0,
+                        reason="recovered")
+        if _obs.enabled():
+            _failover_m()["replicas"].labels(reason="recovered").inc()
+        self.decisions.append({"tick": self._tick, "replica": replica,
+                               "reason": "recovered", "rehomed": []})
+
+    def quarantine(self, replica: int) -> None:
+        """Controller actuator: never restore this replica on recovery
+        (it flapped past the controller's hysteresis threshold).  The
+        broker may still reclaim and replace it."""
+        self.quarantined.add(int(replica))
+
+    # -- evacuation + re-homing ---------------------------------------------
+
+    def _evacuate(self, replica: int) -> list:
+        """Drain the failed engine and re-home every in-flight request:
+        verified KV salvage when the pages survived, re-prefill
+        otherwise.  Returns the decision rows ``(request_id,
+        to_replica_or_None, kv)`` in admission order."""
+        dead = self.router.engines[replica]
+        plan = _faults.active_plan()
+        rehomed = []
+        for req, record, handle, tl in dead.evacuate():
+            ticket = None
+            kv = "reprefill"
+            if record is not None:
+                dropped = (plan is not None and plan.take(
+                    "migrate_drop", late_ok=True,
+                    now=self._tick) is not None)
+                if dropped:
+                    migrate_metrics()["failures"].labels(
+                        reason="dropped").inc()
+                    _journal.record("migrate_verify_failed",
+                                    request_id=req.id, reason="dropped")
+                    dead.pool.cancel_export(req.id)
+                else:
+                    try:
+                        verify_record(record)
+                        ticket = MigrationTicket(record, dead)
+                        kv = "salvaged"
+                    except MigrationIntegrityError as e:
+                        migrate_metrics()["failures"].labels(
+                            reason=e.reason).inc()
+                        _journal.record("migrate_verify_failed",
+                                        request_id=req.id,
+                                        reason=e.reason)
+                        dead.pool.cancel_export(req.id)
+            item = {"from": replica, "req": req, "ticket": ticket,
+                    "handle": handle, "tl": tl, "kv": kv}
+            to = self._place(item)
+            if to is None:
+                self._pending.append(item)
+            rehomed.append((req.id, to, kv))
+        return rehomed
+
+    def _place(self, item: dict) -> Optional[int]:
+        """Try every ranked survivor within the router's retry budget;
+        returns the accepting replica index or None (parked)."""
+        req = item["req"]
+        order = self._survivors(req.prompt)
+        tries = min(len(order), self.router.max_retries + 1)
+        for _aff, _pressure, _load, idx in order[:tries]:
+            shed = self.router.engines[idx].accept_failover(
+                req, item["handle"], item["tl"], ticket=item["ticket"])
+            if shed is not None:
+                continue
+            _journal.record("request_rehome", request_id=req.id,
+                            from_replica=item["from"], to_replica=idx,
+                            kv=item["kv"])
+            if _obs.enabled():
+                _failover_m()["rehomed"].labels(kv=item["kv"]).inc()
+            with self.router._ledger_lock:
+                ent = self.router._ledger.get(req.id)
+                if ent is not None:
+                    ent["replica"] = idx
+                    if item["kv"] == "reprefill":
+                        # the stream restarts from a fresh first token;
+                        # the regenerated tokens re-accrue via on_token
+                        ent["tokens"] = []
+            return idx
+        return None
+
+    def _survivors(self, prompt) -> list:
+        """Re-home ranking: the router's placement ordering (-affinity,
+        shed pressure, load, index) over SERVING members that can decode
+        (a prefill-role worker holds KV for one prefill only — it is not
+        a re-home target)."""
+        r = self.router
+        membership = r.membership
+        return sorted(
+            (-(r.engines[i].sharer.match_tokens(prompt)
+               if r.engines[i].sharer is not None else 0),
+             r.engines[i].slo.shed_pressure(),
+             r.engines[i].batcher.load_factor(), i)
+            for i in range(len(r.engines))
+            if membership[i] == "serving"
+            and r.engines[i].role != "prefill")
+
+    def _retry_pending(self) -> None:
+        if not self._pending:
+            return
+        still = []
+        for item in self._pending:
+            to = self._place(item)
+            if to is None:
+                still.append(item)
+            else:
+                for row in self.decisions:
+                    for j, (rid, dst, kv) in enumerate(row["rehomed"]):
+                        if rid == item["req"].id and dst is None:
+                            row["rehomed"][j] = (rid, to, kv)
+        self._pending = still
+
+    # -- read side ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``/fleet/failover`` payload: lease policy, per-replica
+        loss counts, quarantine set, parked re-homes, and the decision
+        log (the replay surface)."""
+        return {
+            "lease_ticks": self.lease_ticks,
+            "tick": self._tick,
+            "retry_after_s": self.retry_after_s,
+            "membership": self.router.membership,
+            "lost_counts": {str(i): self.lost_counts[i]
+                            for i in sorted(self.lost_counts)},
+            "quarantined": sorted(self.quarantined),
+            "pending": len(self._pending),
+            "decisions": [dict(d) for d in self.decisions],
+        }

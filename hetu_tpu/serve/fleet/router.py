@@ -36,18 +36,34 @@ longer fixed at construction.  Each replica carries a membership state
 broker, catching up on the latest gated snapshot: stepped but never
 ranked, so no request ever lands on stale weights), ``reclaiming``
 (lease being called back: never ranked, still stepped, so its in-flight
-requests DRAIN rather than drop), ``retired`` (lease returned: the
-entry stays in ``engines`` forever so replica indices in the placement
-log and journal stay stable across the whole episode).  ``add_replica``
-/ ``mark_serving`` / ``begin_reclaim`` / ``retire_replica`` walk a
-replica through those states; ``retire_replica`` refuses while the
-engine still holds work — the drain guarantee is structural, not a
-broker courtesy.
+requests DRAIN rather than drop), ``failed`` (the heartbeat monitor —
+serve/fleet/failover.py — declared it dead or silent: never ranked,
+still stepped so a merely-hung engine can recover, its in-flight
+requests evacuated and re-homed by the monitor), ``retired`` (lease
+returned: the entry stays in ``engines`` forever so replica indices in
+the placement log and journal stay stable across the whole episode).
+``add_replica`` / ``mark_serving`` / ``begin_reclaim`` /
+``mark_failed`` / ``retire_replica`` walk a replica through those
+states; ``retire_replica`` refuses while the engine still holds work —
+the drain guarantee is structural, not a broker courtesy (a ``failed``
+replica retires only after the monitor evacuated it).
+
+**Fault tolerance** (hetu_tpu/serve/fleet/failover.py): the router
+keeps an in-flight LEDGER — request id, tenant, prompt, and the tokens
+emitted so far — so a replica failure never loses the information
+needed to re-home its requests, and a client retry of an in-flight
+request id re-attaches to the live handle instead of double-executing.
+To make ledger keys (and the idempotent-resubmit contract) meaningful,
+the router assigns GLOBAL request ids in submission order when the
+caller does not pin one — the DisaggRouter discipline, now fleet-wide —
+which also makes token streams comparable across same-seed runs with
+and without injected replica faults.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -58,7 +74,8 @@ __all__ = ["FleetRouter", "MEMBERSHIP_STATES"]
 
 # the replica-membership lifecycle (see module docstring): only
 # "serving" is rankable; "retired" entries persist for index stability
-MEMBERSHIP_STATES = ("serving", "warming", "reclaiming", "retired")
+MEMBERSHIP_STATES = ("serving", "warming", "reclaiming", "failed",
+                     "retired")
 
 _router_metrics = None
 
@@ -97,6 +114,52 @@ class FleetRouter:
         # construction-time set starts serving (the pre-broker fleet,
         # bit for bit); broker-granted replicas enter warming
         self._membership = ["serving"] * len(self.engines)
+        # global request ids in submission order (when the caller does
+        # not pin one): ledger keys and the idempotent-resubmit contract
+        # need fleet-unique ids, and the draw must be atomic — the HTTP
+        # front end submits from concurrent handler threads
+        self._next_rid = 0
+        self._rid_lock = threading.Lock()
+        # the in-flight ledger: rid -> {handle, replica, tenant, prompt,
+        # max_new_tokens, deadline_s, tokens}.  Entries live from
+        # placement to handle resolution (the engines' on_finish hook
+        # prunes); the failover monitor re-homes from it.
+        self._ledger: dict = {}
+        self._ledger_lock = threading.Lock()
+        # a FailoverMonitor attaches itself here; step() ticks it
+        self.monitor = None
+        for i in range(len(self.engines)):
+            self._wire(i)
+
+    def _wire(self, idx: int) -> None:
+        """Install the ledger hooks on one engine: every emitted token
+        lands in the in-flight ledger entry, and handle resolution
+        prunes it (both called under the engine's own lock — keep them
+        tiny)."""
+        e = self.engines[idx]
+        e.on_token = self._note_token
+        e.on_finish = self._note_finish
+
+    def _note_token(self, rid: int, tok: int) -> None:
+        with self._ledger_lock:
+            ent = self._ledger.get(rid)
+            if ent is not None:
+                ent["tokens"].append(int(tok))
+
+    def _note_finish(self, rid: int) -> None:
+        with self._ledger_lock:
+            self._ledger.pop(rid, None)
+
+    def inflight(self, rid: int):
+        """The in-flight ledger entry for ``rid`` (a shallow copy with
+        the tokens snapshotted), or None — the idempotency window."""
+        with self._ledger_lock:
+            ent = self._ledger.get(rid)
+            if ent is None:
+                return None
+            out = dict(ent)
+            out["tokens"] = list(ent["tokens"])
+            return out
 
     # -- mid-flight membership ----------------------------------------------
 
@@ -118,15 +181,31 @@ class FleetRouter:
         the latest gated snapshot before any request can land on it."""
         self.engines.append(engine)
         self._membership.append("warming" if warming else "serving")
+        self._wire(len(self.engines) - 1)
         return len(self.engines) - 1
 
     def mark_serving(self, replica: int) -> None:
-        """Warm-up complete: the replica joins the rankable set."""
-        if self._membership[replica] not in ("warming", "serving"):
+        """Warm-up complete (or a hung replica recovered: the failover
+        monitor restores ``failed`` members whose heartbeat resumed):
+        the replica joins the rankable set."""
+        if self._membership[replica] not in ("warming", "serving",
+                                             "failed"):
             raise ValueError(
                 f"replica {replica} is {self._membership[replica]!r}, "
-                f"not warming — cannot mark serving")
+                f"not warming or failed — cannot mark serving")
         self._membership[replica] = "serving"
+
+    def mark_failed(self, replica: int) -> None:
+        """The failover monitor declared this replica dead or silent: it
+        leaves the rankable set immediately but keeps being stepped
+        (a merely-hung engine counts down to recovery; a crashed one
+        no-ops).  Only the monitor calls this — detection, evacuation
+        and journaling are one atomic decision there."""
+        if self._membership[replica] in ("failed", "retired"):
+            raise ValueError(
+                f"replica {replica} is {self._membership[replica]!r} — "
+                f"cannot mark failed")
+        self._membership[replica] = "failed"
 
     def begin_reclaim(self, replica: int) -> None:
         """Start draining a replica: it leaves the rankable set
@@ -143,8 +222,10 @@ class FleetRouter:
         queued or active work — retirement must never drop an in-flight
         request (the broker polls idleness and retries next tick).  The
         entry stays in ``engines`` so every later replica index, and the
-        whole placement log, is unaffected."""
-        if self._membership[replica] != "reclaiming":
+        whole placement log, is unaffected.  A ``failed`` replica may
+        retire directly — its lease is written off, not drained — but
+        only after the monitor's evacuation emptied it."""
+        if self._membership[replica] not in ("reclaiming", "failed"):
             raise ValueError(
                 f"replica {replica} is {self._membership[replica]!r}, "
                 f"not reclaiming — cannot retire")
@@ -185,10 +266,30 @@ class FleetRouter:
         never re-routed — the tenant's token bucket is its fleet-wide
         contract, and walking the replica list with a drained bucket
         would be quota evasion, not load balancing.  ``request_id`` pins
-        the engine-side id across every retry (the DisaggRouter's
-        global-id seam); None lets the chosen engine draw its own."""
+        the engine-side id across every retry; None draws a GLOBAL id in
+        submission order.  A ``request_id`` that is still in the
+        in-flight ledger is an idempotent RESUBMIT: the live handle is
+        returned (no double execution) — the contract a client retrying
+        a dropped ``/infer`` response relies on.  When no replica is
+        rankable AND some replica has failed, the request is rejected
+        with a ``replica_failed`` handle (outcome ``evicted`` → HTTP
+        503) carrying ``retry_after_s`` instead of raising — a degraded
+        fleet asks the client to come back, it does not traceback."""
+        if request_id is not None:
+            live = self.inflight(int(request_id))
+            if live is not None:
+                return live["handle"]
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
-        ranked = self._rank(prompt)
+        if request_id is None:
+            with self._rid_lock:
+                request_id = self._next_rid
+                self._next_rid += 1
+        try:
+            ranked = self._rank(prompt)
+        except RuntimeError:
+            if "failed" not in self._membership:
+                raise  # the pre-failover contract, bit for bit
+            return self._reject_failed(int(request_id), tenant)
         tries = min(len(ranked), self.max_retries + 1)
         for a, (neg_aff, _pressure, _load, idx) in enumerate(ranked[:tries]):
             handle = self.engines[idx].submit(prompt, max_new_tokens,
@@ -203,11 +304,51 @@ class FleetRouter:
             shed = (handle.status == "rejected")
             if shed and a + 1 < tries:
                 continue  # re-route around the shedding replica
+            if shed and "failed" in self._membership:
+                # the retry budget is exhausted AND the fleet is
+                # degraded: name the failure so the client's error is
+                # distinguishable from ordinary load shedding
+                down = [i for i, s in enumerate(self._membership)
+                        if s == "failed"]
+                handle.error = (
+                    f"{handle.error}; fleet degraded: replica(s) "
+                    f"{','.join(str(i) for i in down)} failed "
+                    f"(replica_failed)")
             reason = ("retry" if a > 0
                       else "affinity" if neg_aff < 0 else "pressure")
+            if handle.status is None:
+                with self._ledger_lock:
+                    self._ledger[handle.request_id] = {
+                        "handle": handle, "replica": idx,
+                        "tenant": tenant, "prompt": list(prompt),
+                        "max_new_tokens": int(max_new_tokens),
+                        "deadline_s": deadline_s, "tokens": []}
             self._place(handle, idx, reason)
             return handle
         raise AssertionError("unreachable: the loop always returns")
+
+    def _reject_failed(self, rid: int, tenant):
+        """The degraded-fleet rejection: no replica is rankable and at
+        least one has FAILED — reject with a named ``replica_failed``
+        reason and a retry hint (outcome ``evicted`` maps to HTTP 503
+        in serve/server.py) instead of the no-serving RuntimeError."""
+        from hetu_tpu.serve.engine import RequestHandle
+        down = [i for i, s in enumerate(self._membership)
+                if s == "failed"]
+        handle = RequestHandle(rid)
+        handle.tenant = tenant
+        # machine-readable like the shed reasons: serve/server.py gates
+        # the body's reason/retry_after_s pair on shed_reason
+        handle.shed_reason = "replica_failed"
+        handle.retry_after_s = (self.monitor.retry_after_s
+                                if self.monitor is not None else 1.0)
+        handle._finish(
+            "evicted",
+            error=(f"replica_failed: replica(s) "
+                   f"{','.join(str(i) for i in down)} failed and no "
+                   f"serving replica remains — retry after "
+                   f"{handle.retry_after_s}s"))
+        return handle
 
     def _place(self, handle, replica: int, reason: str) -> None:
         _router_m()["placements"].labels(reason=reason).inc()
@@ -224,9 +365,16 @@ class FleetRouter:
     # -- fleet drivers ------------------------------------------------------
 
     def step(self) -> int:
-        """One deterministic fleet tick: step every non-retired replica
-        in index order (reclaiming replicas keep stepping — that IS the
-        drain); returns tokens produced fleet-wide."""
+        """One deterministic fleet tick: tick the failover monitor
+        (heartbeat scan + chaos-fault consumption + re-homing decisions
+        happen BEFORE the engines move, so detection latency is an exact
+        tick count), then step every non-retired replica in index order
+        (reclaiming replicas keep stepping — that IS the drain; failed
+        replicas keep stepping so a hung engine counts down to
+        recovery while a crashed one no-ops); returns tokens produced
+        fleet-wide."""
+        if self.monitor is not None:
+            self.monitor.tick()
         return sum(e.step() for e, s in zip(self.engines, self._membership)
                    if s != "retired")
 
@@ -298,4 +446,7 @@ class FleetRouter:
             "queue_len": sum(r["queue_len"] for r in replicas),
             "active_slots": sum(r["active_slots"] for r in replicas),
             "pages_shared": sum(r["pages_shared"] for r in replicas),
+            "inflight": len(self._ledger),
+            "failover": (None if self.monitor is None
+                         else self.monitor.summary()),
         }

@@ -14,7 +14,10 @@ they describe.
   on admission rejection, 504 on deadline expiry.  ``tenant`` names the
   submitting tenant (omitted = the default tenant): admission is
   weighted-fair across tenants, quota buckets gate the front door, and
-  the controller can shed one tenant without the others.  Non-completed
+  the controller can shed one tenant without the others.  A malformed
+  body is a **400 with a named diagnosis** (``"diagnosis": "bad_json" |
+  "missing_field" | "too_large"`` plus a human-readable ``error``),
+  never a traceback.  Non-completed
   responses carry a human-readable ``error`` naming what happened
   (rejection reason; deadline stage and age), and ``trace_id`` keys the
   request's full timeline at ``/trace/<request_id>``.  Rejections that
@@ -51,6 +54,42 @@ from hetu_tpu.obs.server import Routes, RoutedHTTPServer, telemetry_routes
 
 __all__ = ["ServingServer", "serve_engine", "FleetServingServer",
            "serve_fleet_router"]
+
+# an /infer body past this is refused up front (diagnosis "too_large"):
+# the serving front door must never json-parse an unbounded upload on a
+# handler thread
+MAX_INFER_BODY_BYTES = 1 << 20
+
+
+def _infer_400(diagnosis: str, detail: str):
+    """One named /infer diagnosis: machine-readable ``diagnosis``
+    (``bad_json`` | ``missing_field`` | ``too_large``) + human-readable
+    ``error`` — the malformed-request counterpart of the shed
+    ``reason`` contract."""
+    return (json.dumps({"diagnosis": diagnosis, "error": detail}
+                       ).encode(), "application/json", 400)
+
+
+def _parse_infer(body):
+    """Validate one /infer body.  Returns ``(request_dict, None)`` or
+    ``(None, <400 response triple>)`` — the handler returns the triple
+    verbatim, so a malformed body can never reach ``submit`` (or a
+    traceback reach the client)."""
+    if body is not None and len(body) > MAX_INFER_BODY_BYTES:
+        return None, _infer_400(
+            "too_large",
+            f"request body is {len(body)} bytes; /infer accepts at "
+            f"most {MAX_INFER_BODY_BYTES}")
+    try:
+        req = json.loads(body or b"{}")
+    except (ValueError, UnicodeDecodeError) as e:
+        return None, _infer_400(
+            "bad_json", f"request body is not valid JSON: {e}")
+    if not isinstance(req, dict):
+        return None, _infer_400(
+            "bad_json", f"request body must be a JSON object, got "
+            f"{type(req).__name__}")
+    return req, None
 
 
 def _handle_body(handle) -> dict:
@@ -93,10 +132,21 @@ def serving_routes(engine) -> Routes:
     routes = telemetry_routes()
 
     def infer(query, body):
-        req = json.loads(body or b"{}")
+        req, err = _parse_infer(body)
+        if err is not None:
+            return err
         if "dense" in req or "sparse" in req:
+            if "dense" not in req or "sparse" not in req:
+                return _infer_400(
+                    "missing_field", "the CTR path needs BOTH 'dense' "
+                    "and 'sparse' feature arrays")
             pred = engine.infer_ctr(req["dense"], req["sparse"])
             return json.dumps({"pred": [float(p) for p in pred]}).encode()
+        if "prompt" not in req:
+            return _infer_400(
+                "missing_field", "/infer requires a 'prompt' field (a "
+                "list of token ids) — or 'dense'+'sparse' for the CTR "
+                "path")
         handle = engine.submit(
             req["prompt"], int(req.get("max_new_tokens", 16)),
             deadline_s=req.get("deadline_s"),
@@ -190,11 +240,23 @@ def fleet_serving_routes(router) -> Routes:
     routes = telemetry_routes()
 
     def infer(query, body):
-        req = json.loads(body or b"{}")
+        req, err = _parse_infer(body)
+        if err is not None:
+            return err
+        if "prompt" not in req:
+            return _infer_400(
+                "missing_field", "/infer requires a 'prompt' field (a "
+                "list of token ids)")
+        kwargs = {"deadline_s": req.get("deadline_s"),
+                  "tenant": req.get("tenant")}
+        if req.get("request_id") is not None:
+            # the idempotent-resubmit contract: a client retrying after
+            # a dropped connection names its request id — an id still in
+            # flight re-attaches to the LIVE handle (surviving failover,
+            # since re-homes keep the id), never double-submits
+            kwargs["request_id"] = int(req["request_id"])
         handle = router.submit(
-            req["prompt"], int(req.get("max_new_tokens", 16)),
-            deadline_s=req.get("deadline_s"),
-            tenant=req.get("tenant"))
+            req["prompt"], int(req.get("max_new_tokens", 16)), **kwargs)
         if not handle.wait(timeout=float(req.get("timeout_s") or 60.0)):
             return (json.dumps({"request_id": handle.request_id,
                                 "trace_id": handle.trace_id,
@@ -222,6 +284,10 @@ def fleet_serving_routes(router) -> Routes:
     routes.add("GET", "/tenants", tenants)
     routes.add("GET", "/fleet/serve",
                lambda q, b: json.dumps(router.stats()).encode())
+    routes.add("GET", "/fleet/failover",
+               lambda q, b: json.dumps(
+                   {"installed": False} if router.monitor is None
+                   else router.monitor.summary()).encode())
     return routes
 
 

@@ -161,6 +161,16 @@ def pytest_configure(config):
                    "tier-1: episodes run minutes of VIRTUAL time in "
                    "seconds of wall time)")
     config.addinivalue_line(
+        "markers", "failover: serving fault-tolerance tests "
+                   "(serve.fleet.failover heartbeat-lease detection, "
+                   "deterministic request re-homing with KV salvage / "
+                   "re-prefill, the seeded serving chaos plane, broker "
+                   "failed-lease reclaim, and the /infer idempotent-"
+                   "resubmit + named-400 contracts); the 2-replica "
+                   "crash-and-rehome smoke and the bitwise-stream "
+                   "checks stay in tier-1 — larger chaos sweeps ride "
+                   "the slow tier")
+    config.addinivalue_line(
         "markers", "memobs: memory-observability tests (obs.memledger "
                    "exact attribution, the KV page-class partition, the "
                    "alloc/free leak watchdog, /memory + /fleet/memory, "

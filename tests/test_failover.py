@@ -1,0 +1,571 @@
+"""Serving fault-tolerance tests (hetu_tpu/serve/fleet/failover.py).
+
+Tier-1: the 2-replica crash-and-rehome smoke (bitwise streams across a
+replica death), hang-salvage + heartbeat-recovery restore, the
+``migrate_drop`` re-prefill fallback, the 3-replica all-kinds seeded
+chaos acceptance (100% completion, bitwise streams + fingerprints vs
+the crash-free same-seed run, zero KV page leaks, bitwise replay,
+controller dry-run parity), broker failed-lease reclaim + replacement
+grant, the retry-exhaustion / degraded-fleet rejection contract, the
+idempotent ``/infer`` resubmit, the named-400 diagnoses, and the
+batcher evacuate/requeue units.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hetu_tpu.core import set_random_seed
+from hetu_tpu.exec import controller as ctrl_mod
+from hetu_tpu.exec import faults as faults_mod
+from hetu_tpu.models import GPT
+from hetu_tpu.models.gpt import GPTConfig
+from hetu_tpu.obs import journal as obs_journal
+from hetu_tpu.obs import registry as obs_registry
+from hetu_tpu.obs.journal import stable_events
+from hetu_tpu.serve import (FleetRouter, ServingEngine, generate_load,
+                            serve_engine, serve_fleet_router)
+from hetu_tpu.serve.batcher import AdmissionShed, ContinuousBatcher, Request
+from hetu_tpu.serve.fleet.failover import FailoverMonitor
+from hetu_tpu.serve.fleet.router import MEMBERSHIP_STATES
+
+pytestmark = [pytest.mark.serve, pytest.mark.failover]
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64)
+PROMPTS = [list(range(1, 9)), list(range(2, 10)), list(range(3, 11)),
+           list(range(4, 12))]
+# journal kinds the failover replay surface is made of — compile
+# telemetry is cache-dependent (first run compiles, second run hits the
+# in-process cache) and must not leak into bitwise comparisons
+REPLAY_KINDS = ("replica_lost", "request_rehome", "failover",
+                "router_place", "migrate_verify_failed")
+
+
+@pytest.fixture(scope="module")
+def model():
+    set_random_seed(0)
+    return GPT(CFG)
+
+
+class VirtualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_engine(model, clock=None, **kw):
+    if clock is not None:
+        kw["clock"] = clock
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prompt_buckets", (8, 16, 32))
+    kw.setdefault("seed", 11)
+    kw.setdefault("sampling", "top_k")
+    return ServingEngine(model, **kw)
+
+
+def run_fleet(model, plan, *, n_replicas=2, requests=None, lease_ticks=2,
+              min_ticks=0, max_steps=6000):
+    """Drive one deterministic fleet episode under ``plan`` (None =
+    crash-free) and return (router, monitor, handles, journal events).
+    ``requests`` is a list of (request_id, prompt, max_new_tokens);
+    explicit ids keep sampling keys identical across the chaos and
+    crash-free runs."""
+    if requests is None:
+        requests = [(i, p, 8) for i, p in enumerate(PROMPTS)]
+    clock = VirtualClock()
+    engines = [make_engine(model, clock) for _ in range(n_replicas)]
+    router = FleetRouter(engines)
+    monitor = FailoverMonitor(router, lease_ticks=lease_ticks)
+    with obs_journal.use(obs_journal.EventJournal(clock=clock)) as journal:
+        ctx = faults_mod.inject(plan) if plan is not None \
+            else faults_mod.inject(faults_mod.FaultPlan([]))
+        with ctx:
+            handles = [router.submit(p, n, request_id=rid)
+                       for rid, p, n in requests]
+            for i in range(max_steps):
+                if router.idle and i >= min_ticks:
+                    break
+                router.step()
+                clock.advance(0.001)
+            else:
+                raise AssertionError(f"not idle after {max_steps} ticks")
+        events = list(journal.events)
+    return router, monitor, handles, events
+
+
+def assert_no_leaks(router):
+    """Every pool balanced: alloc/free exact, zero export holds."""
+    for i, e in enumerate(router.engines):
+        st = e.pool.stats()
+        assert st["pages_export_held"] == 0, f"replica {i} leaks holds"
+        assert st["allocs"] == st["frees"], \
+            f"replica {i}: allocs={st['allocs']} frees={st['frees']}"
+
+
+def streams(handles):
+    return [(h.status, list(h.tokens), h.stream_fingerprint)
+            for h in handles]
+
+
+# ------------------------------------------------- membership + units
+
+class TestMembershipAndUnits:
+    def test_failed_state_transitions(self, model):
+        assert "failed" in MEMBERSHIP_STATES
+        router = FleetRouter([make_engine(model), make_engine(model)])
+        router.mark_failed(0)
+        assert router.membership[0] == "failed"
+        # recovered: failed -> serving is legal
+        router.mark_serving(0)
+        assert router.membership[0] == "serving"
+        router.mark_failed(0)
+        # dead for good: failed -> retired is legal
+        router.retire_replica(0)
+        assert router.membership[0] == "retired"
+        with pytest.raises(ValueError):
+            router.mark_failed(0)  # retired replicas cannot fail again
+
+    def test_batcher_evacuate_orders_and_empties(self):
+        b = ContinuousBatcher(2, queue_depth=8)
+        reqs = [Request(id=i, prompt=list(range(4)), max_new_tokens=4,
+                        arrival=0.0) for i in range(4)]
+        for r in reqs:
+            b.submit(r)
+        b.poll(0.0)  # two admitted into slots, two queued
+        assert b.active_slots == 2 and b.queue_len == 2
+        out = b.evacuate()
+        # every request exactly once, in (seq, id) order, batcher empty
+        assert [r.id for r in out] == [0, 1, 2, 3]
+        assert b.active_slots == 0 and b.queue_len == 0
+        assert all(r.slot is None for r in out)
+
+    def test_requeue_bypasses_shed_latch(self):
+        b = ContinuousBatcher(2, queue_depth=8)
+        b.set_shed("controller shed: test")
+        r = Request(id=7, prompt=list(range(4)), max_new_tokens=4,
+                    arrival=0.0)
+        with pytest.raises(AdmissionShed):
+            b.submit(r)
+        # a re-homed in-flight request is NOT new admission: the shed
+        # front door does not apply to work the fleet already accepted
+        b.submit(r, requeue=True)
+        assert b.queue_len == 1
+
+
+# ------------------------------------------------- crash-and-rehome
+
+class TestCrashAndRehome:
+    def test_two_replica_crash_rehome_bitwise(self, model):
+        """Tier-1 smoke: a replica crashes mid-decode; every in-flight
+        request re-homes and every stream (fingerprint included) is
+        bitwise identical to the crash-free same-seed run."""
+        _r0, _m0, base, _ev0 = run_fleet(model, None)
+        plan = faults_mod.FaultPlan(
+            [(3, faults_mod.Fault("replica_crash", worker=0))])
+        router, monitor, handles, events = run_fleet(model, plan)
+        assert [h.status for h in handles] == ["completed"] * 4
+        assert streams(handles) == streams(base)
+        assert router.membership[0] == "failed"
+        assert_no_leaks(router)
+        kinds = {e["kind"] for e in events}
+        assert {"replica_lost", "request_rehome", "failover"} <= kinds
+        [lost] = [e for e in events if e["kind"] == "replica_lost"]
+        assert lost["replica"] == 0 and lost["reason"] == "crashed"
+        # a crashed pool is not exportable: every re-home re-prefilled
+        assert all(e["kv"] == "reprefill" for e in events
+                   if e["kind"] == "request_rehome")
+        assert monitor.decisions[0]["reason"] == "crashed"
+        assert router.stats()["failover"]["lost_counts"] == {"0": 1}
+
+    def test_hang_salvages_kv_and_restores_on_recovery(self, model):
+        """A hung (not crashed) replica's KV pages export as verified
+        migration records — re-homed decode RESUMES (kv="salvaged")
+        instead of re-prefilling — and when the hang ends, the
+        heartbeat recovery restores the replica to serving."""
+        _r0, _m0, base, _ev0 = run_fleet(model, None)
+        plan = faults_mod.FaultPlan(
+            [(3, faults_mod.Fault("decode_hang", worker=0, arg=12.0))])
+        router, monitor, handles, events = run_fleet(model, plan,
+                                                     min_ticks=30)
+        assert streams(handles) == streams(base)
+        rehomes = [e for e in events if e["kind"] == "request_rehome"]
+        assert rehomes and all(e["kv"] == "salvaged" for e in rehomes)
+        assert router.membership == ["serving", "serving"]  # restored
+        reasons = [d["reason"] for d in monitor.decisions]
+        assert reasons == ["lease_expired", "recovered"]
+        assert_no_leaks(router)
+
+    def test_migrate_drop_falls_back_to_reprefill(self, model):
+        """A salvage record eaten in transit (``migrate_drop``) degrades
+        to re-prefill — the stream still completes bitwise, the export
+        hold is cancelled (no leak), and the drop is journaled."""
+        _r0, _m0, base, _ev0 = run_fleet(model, None)
+        plan = faults_mod.FaultPlan([
+            (3, faults_mod.Fault("decode_hang", worker=0, arg=12.0)),
+            (6, faults_mod.Fault("migrate_drop")),
+        ])
+        router, _monitor, handles, events = run_fleet(model, plan,
+                                                      min_ticks=30)
+        assert streams(handles) == streams(base)
+        kv = sorted(e["kv"] for e in events
+                    if e["kind"] == "request_rehome")
+        assert "reprefill" in kv  # the dropped one fell back
+        drops = [e for e in events if e["kind"] == "migrate_verify_failed"]
+        assert any(e["reason"] == "dropped" for e in drops)
+        assert_no_leaks(router)
+
+    def test_inflight_ledger_tracks_and_prunes(self, model):
+        clock = VirtualClock()
+        router = FleetRouter([make_engine(model, clock)])
+        FailoverMonitor(router)
+        h = router.submit(PROMPTS[0], 4, request_id=0)
+        assert router.inflight(0)["replica"] == 0
+        assert router.stats()["inflight"] == 1
+        # idempotent resubmit while in flight: the SAME live handle
+        assert router.submit(PROMPTS[0], 4, request_id=0) is h
+        for _ in range(200):
+            if router.idle:
+                break
+            router.step()
+            clock.advance(0.001)
+        assert h.status == "completed"
+        assert router.inflight(0) is None  # pruned at finish
+        # resubmitting a finished id re-runs with the pinned id: the
+        # sampling keys derive from (seed, rid, position), so the
+        # regenerated stream is bitwise the original
+        h2 = router.submit(PROMPTS[0], 4, request_id=0)
+        assert h2 is not h
+        for _ in range(200):
+            if router.idle:
+                break
+            router.step()
+            clock.advance(0.001)
+        assert (list(h2.tokens), h2.stream_fingerprint) == \
+            (list(h.tokens), h.stream_fingerprint)
+
+
+# ------------------------------------------------- degraded-fleet door
+
+class TestRetryExhaustion:
+    def test_exhaustion_with_failed_replica_is_distinguishable(
+            self, model):
+        """Every survivor shedding AND a replica failed: the rejection
+        is bounded by the retry budget, names the failure, and carries
+        the backoff hint — never an infinite loop."""
+        clock = VirtualClock()
+        engines = [make_engine(model, clock) for _ in range(3)]
+        router = FleetRouter(engines)
+        FailoverMonitor(router, lease_ticks=2)
+        plan = faults_mod.FaultPlan(
+            [(1, faults_mod.Fault("replica_crash", worker=0))])
+        with faults_mod.inject(plan):
+            for _ in range(6):
+                router.step()
+                clock.advance(0.001)
+        assert router.membership[0] == "failed"
+        for e in engines[1:]:
+            e.batcher.set_shed("test shed")
+        submits = {"n": 0}
+        for e in engines:
+            orig = e.submit
+
+            def counted(*a, _orig=orig, **kw):
+                submits["n"] += 1
+                return _orig(*a, **kw)
+
+            e.submit = counted
+        h = router.submit(PROMPTS[0], 4)
+        assert h.status == "rejected"
+        assert h.retry_after_s is not None
+        assert "replica_failed" in h.error
+        # bounded: at most max_retries + 1 placement attempts
+        assert submits["n"] <= router.max_retries + 1
+
+    def test_all_failed_rejects_with_retry_hint(self, model):
+        clock = VirtualClock()
+        router = FleetRouter([make_engine(model, clock)
+                              for _ in range(2)])
+        monitor = FailoverMonitor(router, lease_ticks=2)
+        router.mark_failed(0)
+        router.mark_failed(1)
+        h = router.submit(PROMPTS[0], 4)
+        assert h.status == "evicted"  # HTTP 503 in serve/server.py
+        assert h.shed_reason == "replica_failed"
+        assert h.retry_after_s == monitor.retry_after_s
+        assert "replica_failed" in h.error
+
+    def test_max_retries_env(self, model, monkeypatch):
+        monkeypatch.setenv("HETU_TPU_FLEET_MAX_RETRIES", "1")
+        router = FleetRouter([make_engine(model) for _ in range(3)])
+        assert router.max_retries == 1
+
+
+# ------------------------------------------------- chaos acceptance
+
+class TestChaosAcceptance:
+    N_REQ = 10
+    FAULTS = [
+        (6, "replica_crash", 0, None),
+        (10, "decode_hang", 1, 14.0),
+        (13, "migrate_drop", None, None),
+    ]
+
+    def _trace(self):
+        load = generate_load(23, self.N_REQ, vocab=CFG.vocab_size,
+                             prompt_len=(4, 12), max_new=(2, 8))
+        return [(i, list(item.prompt), item.max_new_tokens)
+                for i, item in enumerate(load)]
+
+    def _plan(self):
+        return faults_mod.FaultPlan(
+            [(at, faults_mod.Fault(kind, worker=w, arg=arg))
+             for at, kind, w, arg in self.FAULTS])
+
+    def test_all_kinds_bitwise_and_leak_free(self, model):
+        """The PR acceptance: under seeded replica_crash + decode_hang +
+        migrate_drop over a 3-replica fleet, 100% of admitted requests
+        complete, every stream (fingerprint included) is bitwise the
+        crash-free same-seed run's, and no pool leaks a page or an
+        export hold."""
+        trace = self._trace()
+        _r0, _m0, base, _e0 = run_fleet(model, None, n_replicas=3,
+                                        requests=trace)
+        assert [h.status for h in base] == ["completed"] * self.N_REQ
+        router, monitor, handles, events = run_fleet(
+            model, self._plan(), n_replicas=3, requests=trace,
+            min_ticks=40)
+        assert [h.status for h in handles] == ["completed"] * self.N_REQ
+        assert streams(handles) == streams(base)
+        assert_no_leaks(router)
+        assert len(monitor._pending) == 0
+        reasons = {d["reason"] for d in monitor.decisions}
+        assert "crashed" in reasons and "lease_expired" in reasons
+
+    def test_same_seed_episode_replays_bitwise(self, model):
+        """Two same-seed chaos episodes: identical placements, identical
+        failover decisions, identical seq-stripped journal (the shared
+        ``stable_events`` normalization — compile telemetry is the only
+        cache-dependent emitter and is excluded by kind, not by seq)."""
+        trace = self._trace()
+        r1, m1, _h1, e1 = run_fleet(model, self._plan(), n_replicas=3,
+                                    requests=trace, min_ticks=40)
+        r2, m2, _h2, e2 = run_fleet(model, self._plan(), n_replicas=3,
+                                    requests=trace, min_ticks=40)
+        assert m1.decisions == m2.decisions
+        assert r1.placements == r2.placements
+        pick = lambda ev: stable_events(
+            [e for e in ev if e["kind"] in REPLAY_KINDS],
+            drop=("seq", "ts"))
+        assert pick(e1) == pick(e2)
+        assert m1.summary() == m2.summary()
+
+
+# ------------------------------------------------- controller + broker
+
+class TestControllerQuarantine:
+    def _run(self, model, dry):
+        clock = VirtualClock()
+        router = FleetRouter([make_engine(model, clock)
+                              for _ in range(2)])
+        monitor = FailoverMonitor(router, lease_ticks=2)
+        ctrl = ctrl_mod.RuntimeController(
+            ctrl_mod.ControllerConfig(
+                dry_run=dry, replica_flap_threshold=2,
+                tune_deadline=False, shed=False, freeze_buckets=False,
+                mem_pressure=False),
+            registry=obs_registry.MetricsRegistry())
+        plan = faults_mod.FaultPlan([
+            (3, faults_mod.Fault("decode_hang", worker=0, arg=8.0)),
+            (20, faults_mod.Fault("decode_hang", worker=0, arg=8.0)),
+        ])
+        with obs_journal.use(obs_journal.EventJournal(clock=clock)), \
+                ctrl_mod.use(ctrl), faults_mod.inject(plan):
+            router.submit(PROMPTS[0], 8, request_id=0)
+            for _ in range(60):
+                router.step()
+                clock.advance(0.001)
+        return router, monitor, ctrl
+
+    def test_flapping_replica_quarantined_with_dry_run_parity(
+            self, model):
+        """A replica that fails twice (the flap threshold) is
+        quarantined: never restored on heartbeat recovery.  A dry-run
+        controller journals the IDENTICAL decision while the monitor's
+        restore behavior stays untouched."""
+        r_act, m_act, c_act = self._run(model, dry=False)
+        r_dry, m_dry, c_dry = self._run(model, dry=True)
+        strip = lambda c: [{k: v for k, v in a.items()
+                            if k != "dry_run"} for a in c.actions]
+        assert strip(c_act) == strip(c_dry)  # decision-stream parity
+        assert strip(c_act) == [{"action": "quarantine_replica",
+                                 "signal": "replica_flap",
+                                 "replica": 0, "lost": 2}]
+        # actuated: quarantined, held failed after the hang ended
+        assert m_act.quarantined == {0}
+        assert r_act.membership[0] == "failed"
+        assert m_act.decisions[-1]["reason"] == "quarantined"
+        # dry run: nothing actuated — the replica recovered as usual
+        assert m_dry.quarantined == set()
+        assert r_dry.membership[0] == "serving"
+
+    def test_flap_threshold_validated(self):
+        with pytest.raises(ValueError):
+            ctrl_mod.ControllerConfig(replica_flap_threshold=0)
+
+
+class _FakeGang:
+    def __init__(self):
+        self.live_world = 4
+        self.world_size = 4
+        self._dead: set = set()
+        self.generation = 0
+        self.rejoined = 0
+
+    def lend(self, k):
+        chips = list(range(self.live_world - k, self.live_world))
+        self.live_world -= k
+        return chips
+
+    def rejoin(self, k):
+        self.live_world += k
+        self.rejoined += k
+
+
+class TestBrokerFailedLease:
+    @pytest.mark.broker
+    def test_failed_lease_reclaimed_and_replaced(self, model):
+        """A granted replica that FAILS is reclaimed immediately (no
+        drain wait — the monitor already re-homed its streams), the
+        chip rejoins the gang, and a replacement grant keeps the fleet
+        at its decided capacity — all journaled with
+        ``trigger="replica_failed"``."""
+        from hetu_tpu.broker.broker import BrokerConfig, CapacityBroker
+        clock = VirtualClock()
+        router = FleetRouter([make_engine(model, clock)])
+        FailoverMonitor(router, lease_ticks=2)
+        gang = _FakeGang()
+        broker = CapacityBroker(
+            BrokerConfig(cooldown_ticks=100, sustain_ticks=3),
+            gang=gang, fleet=router,
+            replica_factory=lambda lease, plan: make_engine(model, clock),
+            clock=clock, registry=obs_registry.MetricsRegistry())
+        with obs_journal.use(
+                obs_journal.EventJournal(clock=clock)) as journal:
+            broker._grant(0.95)
+            broker.tick()  # warming -> serving
+            assert router.membership == ["serving", "serving"]
+            plan = faults_mod.FaultPlan(
+                [(1, faults_mod.Fault("replica_crash", worker=1))])
+            with faults_mod.inject(plan):
+                for _ in range(6):
+                    router.step()
+                    clock.advance(0.001)
+            assert router.membership[1] == "failed"
+            broker.tick()
+            events = list(journal.events)
+        lease0, lease1 = broker.leases
+        assert lease0.state == "returned"
+        assert gang.rejoined == 1
+        assert router.membership[1] == "retired"  # lease pool unleaked
+        # the replacement grant rode the same tick
+        assert lease1.trigger == "replica_failed"
+        reclaims = [e for e in events if e["kind"] == "lease_reclaim"]
+        assert [e["trigger"] for e in reclaims] == ["replica_failed"]
+        grants = [e["trigger"] for e in events
+                  if e["kind"] == "lease_grant"]
+        assert grants == ["slo_burn", "replica_failed"]
+
+
+# ------------------------------------------------- HTTP contracts
+
+def _post(base, body, raw=False):
+    data = body if raw else json.dumps(body).encode()
+    req = urllib.request.Request(base + "/infer", data=data,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestInferHardening:
+    def test_named_400_diagnoses_fleet(self, model):
+        router = FleetRouter([make_engine(model)])
+        FailoverMonitor(router)
+        srv = serve_fleet_router(router)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, body = _post(base, b"{not json", raw=True)
+            assert (code, body["diagnosis"]) == (400, "bad_json")
+            code, body = _post(base, b'[1, 2, 3]', raw=True)
+            assert (code, body["diagnosis"]) == (400, "bad_json")
+            code, body = _post(base, {"max_new_tokens": 4})
+            assert (code, body["diagnosis"]) == (400, "missing_field")
+            code, body = _post(base, b"x" * ((1 << 20) + 1), raw=True)
+            assert (code, body["diagnosis"]) == (400, "too_large")
+            assert "error" in body  # human-readable alongside
+            # the failover read side rides the same server
+            with urllib.request.urlopen(base + "/fleet/failover",
+                                        timeout=10) as r:
+                fo = json.loads(r.read())
+            assert fo["membership"] == ["serving"]
+            assert fo["decisions"] == []
+        finally:
+            srv.stop()
+            router.stop()
+
+    def test_named_400_diagnoses_single_engine(self, model):
+        engine = make_engine(model)
+        srv = serve_engine(engine)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            # empty JSON object: no prompt, no CTR arrays
+            code, body = _post(base, {})
+            assert (code, body["diagnosis"]) == (400, "missing_field")
+            code, body = _post(base, b"\xff\xfe garbage", raw=True)
+            assert (code, body["diagnosis"]) == (400, "bad_json")
+            # CTR path needs BOTH arrays
+            code, body = _post(base, {"dense": [[0.0]]})
+            assert (code, body["diagnosis"]) == (400, "missing_field")
+            code, body = _post(
+                base, {"prompt": list(range(1, 9)),
+                       "max_new_tokens": 4})
+            assert code == 200 and body["status"] == "completed"
+        finally:
+            srv.stop()
+            engine.stop()
+
+    def test_idempotent_resubmit_over_http(self, model):
+        router = FleetRouter([make_engine(model)])
+        FailoverMonitor(router)
+        srv = serve_fleet_router(router)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, body = _post(base, {"prompt": list(range(1, 9)),
+                                      "max_new_tokens": 4})
+            assert code == 200
+            code2, body2 = _post(base, {"prompt": list(range(1, 9)),
+                                        "max_new_tokens": 4,
+                                        "request_id":
+                                        body["request_id"]})
+            assert code2 == 200
+            assert body2["request_id"] == body["request_id"]
+            assert body2["tokens"] == body["tokens"]
+            assert body2["stream_fingerprint"] == \
+                body["stream_fingerprint"]
+        finally:
+            srv.stop()
+            router.stop()

@@ -635,17 +635,21 @@ def test_plan_determinism_lint():
     DIRECT argument of ``sorted(...)`` — iteration order pinned at the
     call site, not downstream.  ``hetu_tpu/broker/`` joins the linted
     set: a capacity broker whose lease decisions read wall clocks or
-    walk dicts in hash order cannot replay its lease journal bitwise."""
+    walk dicts in hash order cannot replay its lease journal bitwise.
+    ``hetu_tpu/serve/fleet/failover.py`` joins too (PR 20): a failover
+    decision that cannot replay bitwise cannot be audited."""
     import ast
     import pathlib
 
     import hetu_tpu.broker
     import hetu_tpu.plan
+    import hetu_tpu.serve.fleet.failover
     roots = [pathlib.Path(hetu_tpu.plan.__file__).parent,
              pathlib.Path(hetu_tpu.broker.__file__).parent]
     files = [p for root in roots for p in sorted(root.glob("*.py"))]
-    assert len({p.parent for p in files}) == 2, \
-        "plan or broker package has no sources to lint"
+    files.append(pathlib.Path(hetu_tpu.serve.fleet.failover.__file__))
+    assert len({p.parent for p in files}) == 3, \
+        "plan, broker, or failover has no sources to lint"
     problems = []
     for path in files:
         tree = ast.parse(path.read_text(), filename=str(path))
